@@ -1,0 +1,32 @@
+"""TPU-native causal-inference framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of the reference
+R pipeline ``Zoe187419/ATE_replication_causalML`` (the AEA-2018
+"Machine Learning and Econometrics" ATE tutorial replication,
+``ate_functions.R`` + ``ate_replication.Rmd``): the full ATE estimator
+suite — difference-in-means, regression adjustment, IPW, LASSO variants,
+AIPW/doubly-robust with sandwich + bootstrap standard errors, Belloni
+post-double-selection, double machine learning, approximate residual
+balancing, and grf-style honest causal forests — built TPU-first:
+
+* nuisance fits (IRLS logistic GLM, LASSO coordinate descent, honest
+  forests) are XLA-lowered JAX routines (Pallas kernels for the hot ops),
+* every embarrassingly parallel loop (bootstrap replicates, CV folds,
+  trees) runs as ``vmap``/``shard_map`` over a ``jax.sharding.Mesh``,
+* rows shard across devices with ``psum`` reductions for the 1M-row regime.
+
+Layer map (SURVEY.md §7.1):
+  L0 ``data``       — columnar dataset + schema, synthetic GGL generator,
+                      bias injection, R-compatible RNG
+  L1 ``ops``        — OLS/WLS, IRLS GLM, LASSO CD, QP/ADMM, bootstrap
+  L2 ``estimators`` — the uniform Estimator -> EstimatorResult protocol
+  L3 ``models``     — random forest + honest causal forest engines
+  L4 ``parallel``   — mesh config, shard_map placement, collectives
+  L5 ``pipeline``   — notebook-equivalent driver + plots + checkpointing
+"""
+
+__version__ = "0.1.0"
+
+from ate_replication_causalml_tpu.estimators.base import EstimatorResult, ResultTable
+
+__all__ = ["EstimatorResult", "ResultTable", "__version__"]

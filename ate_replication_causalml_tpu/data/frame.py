@@ -1,0 +1,99 @@
+"""Columnar causal dataset container.
+
+The reference passes an R ``data.frame`` plus string column names into every
+estimator (``ate_functions.R`` throughout). The TPU-native equivalent is a
+dense, statically-shaped struct-of-arrays: one ``(n, p)`` covariate matrix
+(covariates in schema order), plus ``w``/``y`` vectors. It is a registered
+pytree, so a ``CausalFrame`` flows through ``jit``/``vmap``/``shard_map``
+unchanged while the schema rides along as static metadata.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ate_replication_causalml_tpu.data.schema import DatasetSchema
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class CausalFrame:
+    """Dense causal dataset: covariates ``x`` ~ (n, p), treatment ``w`` ~ (n,),
+    outcome ``y`` ~ (n,). Columns of ``x`` follow ``schema.covariates`` order
+    (continuous first, then binary — ``ate_replication.Rmd:57``)."""
+
+    x: jax.Array
+    w: jax.Array
+    y: jax.Array
+    schema: DatasetSchema = dataclasses.field(
+        metadata=dict(static=True),
+        default=None,
+    )
+
+    @property
+    def n(self) -> int:
+        return self.x.shape[0]
+
+    @property
+    def p(self) -> int:
+        return self.x.shape[1]
+
+    def column(self, name: str) -> jax.Array:
+        """A single covariate column by schema name."""
+        (idx,) = self.schema.column_index(name)
+        return self.x[:, idx]
+
+    def select(self, names) -> jax.Array:
+        """Covariate submatrix in the order of ``names``."""
+        idx = jnp.asarray(self.schema.column_index(names))
+        return self.x[:, idx]
+
+    def take(self, indices) -> "CausalFrame":
+        """Row-subset (R ``df[idx, ]``) — also the bootstrap gather."""
+        indices = jnp.asarray(indices)
+        return CausalFrame(
+            x=self.x[indices], w=self.w[indices], y=self.y[indices], schema=self.schema
+        )
+
+    def astype(self, dtype) -> "CausalFrame":
+        return CausalFrame(
+            x=self.x.astype(dtype),
+            w=self.w.astype(dtype),
+            y=self.y.astype(dtype),
+            schema=self.schema,
+        )
+
+    @classmethod
+    def from_columns(
+        cls, columns: Mapping[str, np.ndarray], schema: DatasetSchema, dtype=jnp.float32
+    ) -> "CausalFrame":
+        """Build from a dict of 1-D numpy columns (host-side ingest path)."""
+        x = np.stack([np.asarray(columns[c], dtype=np.float64) for c in schema.covariates], axis=1)
+        w = np.asarray(columns[schema.treatment], dtype=np.float64)
+        y = np.asarray(columns[schema.outcome], dtype=np.float64)
+        return cls(
+            x=jnp.asarray(x, dtype=dtype),
+            w=jnp.asarray(w, dtype=dtype),
+            y=jnp.asarray(y, dtype=dtype),
+            schema=schema,
+        )
+
+    def design_matrix(self, include_treatment: bool = True, intercept: bool = True) -> jax.Array:
+        """[1, X, W] design matrix used by the regression estimators
+        (R formula ``Y ~ .`` — ``ate_functions.R:26``).
+
+        Column order matches R's ``lm(Y ~ .)`` on a frame laid out
+        [covariates..., W]: intercept, covariates in schema order, then W.
+        """
+        cols = [self.x]
+        if include_treatment:
+            cols.append(self.w[:, None])
+        m = jnp.concatenate(cols, axis=1)
+        if intercept:
+            m = jnp.concatenate([jnp.ones((m.shape[0], 1), m.dtype), m], axis=1)
+        return m

@@ -1,0 +1,137 @@
+"""Data preparation and bias injection — the reference notebook's L0 stages.
+
+Reproduces ``ate_replication.Rmd`` exactly (quirks included, SURVEY.md §7.4):
+
+* ``prepare_dataset``: subsample ``n_obs`` rows with R's RNG
+  (``Rmd:41-44, 66-68``), z-score the 15 continuous covariates with the
+  n-1 sd (R ``scale()``, ``Rmd:72-74``), pass binaries through, rename
+  outcome/treatment to Y/W (``Rmd:90-93``), drop NA rows (``Rmd:93``).
+* ``inject_bias``: construct confounding from the RCT (``Rmd:97-123``) —
+  drop the first ``round(p * k)`` treated units likely to vote and
+  control units likely not to vote. The treated-side condition tests
+  ``p2002`` twice and never tests ``p2004`` (``Rmd:104``) — a reference
+  quirk reproduced verbatim in compat mode because it shapes ``df_mod``.
+
+All of this is host-side NumPy (one-shot ingest); the resulting
+``CausalFrame`` is what lands on the TPU mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ate_replication_causalml_tpu.data.frame import CausalFrame
+from ate_replication_causalml_tpu.data.schema import GGL_SCHEMA, DatasetSchema
+from ate_replication_causalml_tpu.utils.rrandom import RCompatRNG
+
+
+@dataclasses.dataclass(frozen=True)
+class PrepConfig:
+    """Notebook-global constants, made explicit (SURVEY.md §5.6)."""
+
+    n_obs: int = 50_000          # ate_replication.Rmd:43
+    seed: int = 1991             # ate_replication.Rmd:42
+    pt: float = 0.85             # drop fraction, treated side (Rmd:99)
+    pc: float = 0.85             # drop fraction, control side (Rmd:100)
+    sample_kind: str = "rounding"  # R <= 3.5 sample.int default (2018-era)
+
+
+def _zscore(col: np.ndarray) -> np.ndarray:
+    """R ``scale()``: (x - mean) / sd with the n-1 denominator."""
+    mu = col.mean()
+    sd = col.std(ddof=1)
+    return (col - mu) / sd
+
+
+def prepare_dataset(
+    raw: dict[str, np.ndarray],
+    config: PrepConfig = PrepConfig(),
+    schema: DatasetSchema = GGL_SCHEMA,
+    rng: RCompatRNG | None = None,
+    dtype=None,
+) -> CausalFrame:
+    """Raw columns -> scaled, renamed, NA-free ``CausalFrame`` (the notebook's ``df``).
+
+    ``rng`` defaults to a fresh R-compatible stream seeded with
+    ``config.seed`` — matching ``set.seed(1991)`` followed immediately by
+    ``sample_n`` in the notebook.
+    """
+    if dtype is None:
+        # float64 under x64 (strict-parity tests), float32 otherwise —
+        # avoids silent-truncation warnings on the TPU fast path.
+        import jax
+
+        dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
+    n_raw = len(raw[schema.treatment])
+    if rng is None:
+        rng = RCompatRNG(config.seed, sample_kind=config.sample_kind)
+    idx = rng.sample_n_rows(n_raw, min(config.n_obs, n_raw))
+
+    cols: dict[str, np.ndarray] = {}
+    for c in schema.continuous:
+        cols[c] = _zscore(np.asarray(raw[c], dtype=np.float64)[idx])
+    for c in schema.binary:
+        cols[c] = np.asarray(raw[c], dtype=np.float64)[idx]
+    cols["Y"] = np.asarray(raw[schema.outcome], dtype=np.float64)[idx]
+    cols["W"] = np.asarray(raw[schema.treatment], dtype=np.float64)[idx]
+
+    # na.omit (ate_replication.Rmd:93): drop any row with a NaN.
+    keep = np.ones(len(idx), dtype=bool)
+    for v in cols.values():
+        keep &= np.isfinite(v)
+    cols = {k: v[keep] for k, v in cols.items()}
+
+    out_schema = schema.replace(outcome="Y", treatment="W")
+    return CausalFrame.from_columns(cols, out_schema, dtype=dtype)
+
+
+def bias_drop_indices(frame: CausalFrame, config: PrepConfig = PrepConfig()) -> np.ndarray:
+    """Row indices the bias injection removes (``ate_replication.Rmd:97-119``).
+
+    Returns 0-based indices into ``frame`` in the reference's order
+    (treated drops first, then control drops) — ``print(length(drop_idx))``
+    in the notebook reports 41,062 on the real data (BASELINE.md).
+    """
+    col = lambda name: np.asarray(frame.column(name))
+    w = np.asarray(frame.w)
+
+    # Likely voters, dropped from TREATMENT (Rmd:103-105). Note the
+    # reference quirk: p2002 appears twice and p2004 not at all.
+    drop_from_treat = (
+        (col("g2000") == 1) | (col("g2002") == 1)
+        | (col("p2000") == 1) | (col("p2002") == 1) | (col("p2002") == 1)
+        | (col("city") > 2) | (col("yob") > 2)
+    )
+    # Likely non-voters, dropped from CONTROL (Rmd:108-110).
+    drop_from_control = (
+        (col("g2000") == 0) | (col("g2002") == 0)
+        | (col("p2000") == 0) | (col("p2002") == 0) | (col("p2004") == 0)
+        | (col("city") < -2) | (col("yob") < -2)
+    )
+
+    # which() returns ascending indices; the notebook keeps the FIRST
+    # round(p*k) of each (Rmd:113-117). R round() is half-to-even, as is
+    # np.round.
+    drop_treat_idx = np.nonzero((w == 1) & drop_from_treat)[0]
+    drop_control_idx = np.nonzero((w == 0) & drop_from_control)[0]
+    n_t = int(np.round(config.pt * len(drop_treat_idx)))
+    n_c = int(np.round(config.pc * len(drop_control_idx)))
+    drop = np.concatenate([drop_treat_idx[:n_t], drop_control_idx[:n_c]])
+    # unique(c(...)) — the two sets are disjoint (W==1 vs W==0) so this
+    # only dedups, never reorders in practice.
+    _, first = np.unique(drop, return_index=True)
+    return drop[np.sort(first)]
+
+
+def inject_bias(
+    frame: CausalFrame, config: PrepConfig = PrepConfig()
+) -> tuple[CausalFrame, np.ndarray]:
+    """The notebook's ``df_mod <- df[-drop_idx, ]`` (``Rmd:121``).
+
+    Returns (biased frame, dropped indices).
+    """
+    drop = bias_drop_indices(frame, config)
+    keep = np.setdiff1d(np.arange(frame.n), drop, assume_unique=False)
+    return frame.take(keep), drop

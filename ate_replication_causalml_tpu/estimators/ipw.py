@@ -1,0 +1,64 @@
+"""Inverse-propensity estimators: weighting and weighted regression.
+
+Reference:
+  * ``prop_score_weight`` (``ate_functions.R:44-63``) — the
+    transformed-outcome IPW: per-row ``tau_i = ((W-p)·Y)/(p(1-p))``,
+    point estimate ``mean(tau_i)``; the SE regresses ``tau_i`` on
+    ``d = X·(W-p)`` (covariates scaled by the propensity residual) and
+    uses ``sqrt(mean(resid²))/sqrt(N)`` — a Hirano/Imbens-style variance
+    reduction.
+  * ``prop_score_ols`` (``ate_functions.R:67-86``) — WLS of ``Y ~ W``
+    with weights ``W/p + (1-W)/(1-p)``; tau and SE from the W coefficient.
+  * the inline logistic propensity (``ate_replication.Rmd:164-168``):
+    ``glm(W ~ X, binomial)`` fitted probabilities, in-sample.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ate_replication_causalml_tpu.data.frame import CausalFrame
+from ate_replication_causalml_tpu.estimators.base import EstimatorResult
+from ate_replication_causalml_tpu.ops.glm import logistic_glm
+from ate_replication_causalml_tpu.ops.linalg import add_intercept, ols, wls
+
+
+@jax.jit
+def logistic_propensity(x: jax.Array, w: jax.Array) -> jax.Array:
+    """In-sample logistic propensity p(W=1|X) (``ate_replication.Rmd:164-168``)."""
+    return logistic_glm(add_intercept(x), w).fitted
+
+
+@jax.jit
+def _psw_core(x, w, y, p):
+    tau_i = ((w - p) * y) / (p * (1.0 - p))
+    ps_er = w - p
+    d = x * ps_er[:, None]
+    fit = ols(add_intercept(d), tau_i)
+    e = fit.residuals
+    n = x.shape[0]
+    se = jnp.sqrt(jnp.mean(e * e)) / jnp.sqrt(jnp.asarray(n, x.dtype))
+    return jnp.mean(tau_i), se
+
+
+def prop_score_weight(
+    frame: CausalFrame, p: jax.Array, method: str = "Propensity_Weighting"
+) -> EstimatorResult:
+    tau, se = _psw_core(frame.x, frame.w, frame.y, jnp.asarray(p, frame.x.dtype))
+    return EstimatorResult.from_point_se(method, tau, se)
+
+
+@jax.jit
+def _psols_core(w, y, p):
+    weights = w / p + (1.0 - w) / (1.0 - p)
+    design = jnp.stack([jnp.ones_like(w), w], axis=1)
+    fit = wls(design, y, weights)
+    return fit.coef[1], fit.se[1]
+
+
+def prop_score_ols(
+    frame: CausalFrame, p: jax.Array, method: str = "Propensity_Regression"
+) -> EstimatorResult:
+    tau, se = _psols_core(frame.w, frame.y, jnp.asarray(p, frame.w.dtype))
+    return EstimatorResult.from_point_se(method, tau, se)

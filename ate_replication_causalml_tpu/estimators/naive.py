@@ -1,0 +1,47 @@
+"""Difference-in-means ATE (the reference's ``naive_ate``).
+
+Reference: ``ate_functions.R:3-21``. Groups by treatment, computes
+per-group mean/variance/count, then
+
+    tau = E[Y|W=1] - E[Y|W=0]
+    se  = sqrt( var_1/(n_1 - 1) + var_0/(n_0 - 1) )
+
+Note the reference's SE uses ``var/(count-1)`` (R sample variance divided
+by n-1 again — ``ate_functions.R:9``); reproduced as-is since it is part
+of the published oracle CI.
+
+Run on the *unbiased* RCT frame this is the oracle; on the biased frame
+it is the known-bad baseline (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ate_replication_causalml_tpu.data.frame import CausalFrame
+from ate_replication_causalml_tpu.estimators.base import EstimatorResult
+
+
+@jax.jit
+def _naive_core(w: jax.Array, y: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Masked group reductions — one pass over a (possibly row-sharded)
+    vector pair; XLA lowers the masked sums to psums under shard_map."""
+    t = w == 1.0
+    n1 = jnp.sum(t)
+    n0 = w.shape[0] - n1
+    mean1 = jnp.sum(jnp.where(t, y, 0.0)) / n1
+    mean0 = jnp.sum(jnp.where(t, 0.0, y)) / n0
+    # R var(): n-1 denominator.
+    var1 = jnp.sum(jnp.where(t, (y - mean1) ** 2, 0.0)) / (n1 - 1)
+    var0 = jnp.sum(jnp.where(t, 0.0, (y - mean0) ** 2)) / (n0 - 1)
+    tau = mean1 - mean0
+    se = jnp.sqrt(var1 / (n1 - 1) + var0 / (n0 - 1))
+    return tau, se
+
+
+def naive_ate(frame: CausalFrame, method: str = "naive") -> EstimatorResult:
+    tau, se = _naive_core(frame.w, frame.y)
+    return EstimatorResult.from_point_se(method, tau, se)

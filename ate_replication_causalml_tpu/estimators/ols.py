@@ -1,0 +1,29 @@
+"""Regression adjustment ("Direct Method") — OLS of Y on covariates + W.
+
+Reference: ``ate_condmean_ols`` (``ate_functions.R:25-39``): fit
+``lm(Y ~ .)`` on the frame, report the W coefficient and its classical
+standard error. The design matrix is [1, X, W] in schema order, matching
+R's formula expansion on a frame laid out [covariates..., W, Y].
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ate_replication_causalml_tpu.data.frame import CausalFrame
+from ate_replication_causalml_tpu.estimators.base import EstimatorResult
+from ate_replication_causalml_tpu.ops.linalg import ols
+
+
+@jax.jit
+def _direct_core(x, w, y):
+    import jax.numpy as jnp
+
+    design = jnp.concatenate([jnp.ones((x.shape[0], 1), x.dtype), x, w[:, None]], axis=1)
+    fit = ols(design, y)
+    return fit.coef[-1], fit.se[-1]
+
+
+def ate_condmean_ols(frame: CausalFrame, method: str = "Direct Method") -> EstimatorResult:
+    tau, se = _direct_core(frame.x, frame.w, frame.y)
+    return EstimatorResult.from_point_se(method, tau, se)

@@ -1,0 +1,96 @@
+"""Binomial-logit GLM via IRLS — TPU-native replacement for R ``glm.fit``.
+
+The reference fits logistic regressions for the AIPW outcome model
+(``ate_functions.R:156-158, 218-220``), the GLM propensity
+(``ate_functions.R:231-234``) and the inline notebook propensity
+(``ate_replication.Rmd:164-168``). R's ``glm.fit`` runs iteratively
+reweighted least squares with a deviance-based stopping rule
+(``epsilon = 1e-8``, ``maxit = 25``); we reproduce that rule exactly so
+coefficients agree with R to well below the 1e-4 parity contract
+(SURVEY.md §2.3), but run it as a ``lax.while_loop`` of XLA-compiled
+WLS solves — one fused (n,p)@(p,) matmul pair per iteration on the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ate_replication_causalml_tpu.ops.linalg import _PREC, _chol_solve, _spd_inverse
+
+
+class GlmResult(NamedTuple):
+    coef: jax.Array         # (p,)
+    se: jax.Array           # (p,)
+    fitted: jax.Array       # (n,) response-scale fitted probabilities
+    deviance: jax.Array     # scalar
+    n_iter: jax.Array       # scalar int
+    converged: jax.Array    # scalar bool
+
+
+def _binomial_deviance(y: jax.Array, mu: jax.Array) -> jax.Array:
+    """-2 log-likelihood of Bernoulli observations (R's binomial deviance)."""
+    eps = jnp.finfo(mu.dtype).tiny
+    ll = y * jnp.log(jnp.maximum(mu, eps)) + (1.0 - y) * jnp.log(jnp.maximum(1.0 - mu, eps))
+    return -2.0 * jnp.sum(ll)
+
+
+def logistic_glm(
+    x: jax.Array,
+    y: jax.Array,
+    epsilon: float = 1e-8,
+    max_iter: int = 25,
+) -> GlmResult:
+    """Fit ``y ~ x`` by binomial-logit IRLS with R ``glm.fit`` semantics.
+
+    ``x`` must already include the intercept column. Convergence is R's
+    relative-deviance test ``|dev - dev_old| / (|dev| + 0.1) < epsilon``.
+    Standard errors are ``sqrt(diag((X' W X)^-1))`` at the converged
+    weights — identical to ``summary.glm``.
+    """
+    n, p = x.shape
+    dtype = x.dtype
+
+    # R's binomial initialization: mustart = (y + 1/2) / 2, eta = logit(mu).
+    mu0 = (y + 0.5) / 2.0
+    eta0 = jnp.log(mu0 / (1.0 - mu0))
+    dev0 = _binomial_deviance(y, mu0)
+
+    def irls_step(eta):
+        mu = jax.nn.sigmoid(eta)
+        w = jnp.clip(mu * (1.0 - mu), 1e-10)
+        z = eta + (y - mu) / w
+        xw = x * w[:, None]
+        xtwx = jnp.matmul(xw.T, x, precision=_PREC)
+        xtwz = jnp.matmul(xw.T, z, precision=_PREC)
+        coef = _chol_solve(xtwx, xtwz)
+        eta_new = jnp.matmul(x, coef, precision=_PREC)
+        mu_new = jax.nn.sigmoid(eta_new)
+        return coef, eta_new, _binomial_deviance(y, mu_new)
+
+    def cond(state):
+        _, _, dev, dev_old, it, done = state
+        return (~done) & (it < max_iter)
+
+    def body(state):
+        coef, eta, dev, _, it, _ = state
+        coef_new, eta_new, dev_new = irls_step(eta)
+        done = jnp.abs(dev_new - dev) / (jnp.abs(dev_new) + 0.1) < epsilon
+        return coef_new, eta_new, dev_new, dev, it + 1, done
+
+    init = (jnp.zeros(p, dtype), eta0, dev0, dev0 + 1.0, jnp.array(0), jnp.array(False))
+    coef, eta, dev, _, n_iter, converged = lax.while_loop(cond, body, init)
+
+    mu = jax.nn.sigmoid(eta)
+    w = jnp.clip(mu * (1.0 - mu), 1e-10)
+    xtwx = jnp.matmul((x * w[:, None]).T, x, precision=_PREC)
+    se = jnp.sqrt(jnp.clip(jnp.diag(_spd_inverse(xtwx)), 0.0))
+    return GlmResult(coef=coef, se=se, fitted=mu, deviance=dev, n_iter=n_iter, converged=converged)
+
+
+def predict_proba(coef: jax.Array, x: jax.Array) -> jax.Array:
+    """Response-scale prediction ``sigmoid(x @ coef)`` (R ``predict(type="response")``)."""
+    return jax.nn.sigmoid(jnp.matmul(x, coef, precision=_PREC))

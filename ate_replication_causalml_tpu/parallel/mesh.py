@@ -1,0 +1,89 @@
+"""Device-mesh configuration — the framework's communication "backend".
+
+The reference has no distributed execution at all (one R process,
+SURVEY.md §2.4); the TPU build's parallel axes are the reference's
+embarrassingly parallel structures mapped onto a ``jax.sharding.Mesh``:
+
+  * ``boot`` — bootstrap replicates (``ate_functions.R:192-194``)
+  * ``tree`` — forest trees (randomForest / grf tree loops)
+  * ``fold`` — CV / cross-fitting folds (``cv.glmnet``; ``double_ml``)
+  * ``data`` — row sharding for the 1M-row regime, with ``psum``
+    reductions for X'X / gradient sums over ICI
+
+XLA compiles the collectives; there is no hand-written transport layer
+(the scaling-book recipe: pick a mesh, annotate shardings, let XLA
+insert collectives).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+# Canonical axis names used across the framework.
+BOOT_AXIS = "boot"
+TREE_AXIS = "tree"
+FOLD_AXIS = "fold"
+DATA_AXIS = "data"
+
+_ACTIVE_MESH: Mesh | None = None
+
+
+def make_mesh(
+    axis_names: Sequence[str] = (BOOT_AXIS,),
+    axis_sizes: Sequence[int] | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a mesh over the available devices.
+
+    Default: one axis spanning every device — right for the
+    embarrassingly parallel estimator loops. Multi-axis shapes (e.g.
+    ``("data", "boot")``) reshape the device array accordingly.
+    """
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    if axis_sizes is None:
+        axis_sizes = [len(devs)] + [1] * (len(axis_names) - 1)
+    devs = devs[: int(np.prod(axis_sizes))].reshape(tuple(axis_sizes))
+    return Mesh(devs, tuple(axis_names))
+
+
+def set_mesh(mesh: Mesh) -> None:
+    global _ACTIVE_MESH
+    _ACTIVE_MESH = mesh
+
+
+def get_mesh() -> Mesh:
+    """The active mesh, defaulting to a single-axis mesh over all devices."""
+    global _ACTIVE_MESH
+    if _ACTIVE_MESH is None:
+        _ACTIVE_MESH = make_mesh()
+    return _ACTIVE_MESH
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    global _ACTIVE_MESH
+    prev = _ACTIVE_MESH
+    _ACTIVE_MESH = mesh
+    try:
+        yield mesh
+    finally:
+        _ACTIVE_MESH = prev
+
+
+def shard_axis_size(mesh: Mesh, axis_name: str) -> int:
+    return mesh.shape[axis_name]
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def row_sharded(mesh: Mesh, axis_name: str = DATA_AXIS) -> NamedSharding:
+    """Shard the leading (row) dimension of an array across ``axis_name``."""
+    return NamedSharding(mesh, P(axis_name))

@@ -1,0 +1,38 @@
+"""Test harness: 8 virtual CPU devices so mesh/shard_map code paths are
+exercised without TPU hardware (SURVEY.md §4 — the "fake backend" the
+reference lacks). Must run before JAX initializes its backend."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+# Strict-precision mode for R-parity tests; the TPU production path runs
+# float32/bfloat16 by construction (frames are built with explicit dtypes).
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from ate_replication_causalml_tpu.data.pipeline import PrepConfig, inject_bias, prepare_dataset
+from ate_replication_causalml_tpu.data.synthetic import make_ggl_like
+
+
+@pytest.fixture(scope="session")
+def raw_small():
+    return make_ggl_like(n=20_000, seed=7, true_ate=0.095)
+
+
+@pytest.fixture(scope="session")
+def prep_small(raw_small):
+    cfg = PrepConfig(n_obs=8_000, seed=1991)
+    frame = prepare_dataset(raw_small, cfg, dtype=np.float64)
+    frame_mod, dropped = inject_bias(frame, cfg)
+    return frame, frame_mod, dropped

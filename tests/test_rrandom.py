@@ -1,0 +1,64 @@
+"""R RNG fidelity tests — golden values are the published outputs of R's
+``set.seed``/``runif``/``sample`` (independently well-known sequences, not
+taken from the reference repo)."""
+
+import numpy as np
+
+from ate_replication_causalml_tpu.utils.rrandom import RCompatRNG
+
+
+def test_runif_seed_42_matches_r():
+    r = RCompatRNG(42)
+    got = r.runif(5)
+    want = [0.9148060435, 0.9370754133, 0.2861395348, 0.8304476261, 0.6417455189]
+    np.testing.assert_allclose(got, want, atol=1e-9)
+
+
+def test_runif_seed_1_matches_r():
+    r = RCompatRNG(1)
+    got = r.runif(5)
+    want = [0.2655086631, 0.3721238996, 0.5728533633, 0.9082077907, 0.2016819473]
+    np.testing.assert_allclose(got, want, atol=1e-9)
+
+
+def test_runif_crosses_block_boundary():
+    # Draw across the 624-word MT block boundary in two different chunkings;
+    # streams must agree.
+    a = RCompatRNG(1991).runif(2000)
+    r = RCompatRNG(1991)
+    b = np.concatenate([r.runif(600), r.runif(30), r.runif(1370)])
+    np.testing.assert_array_equal(a, b)
+
+
+def test_sample_rejection_matches_r36():
+    # R >= 3.6 default: set.seed(42); sample(10) -> 1 5 10 8 2 4 6 9 7 3
+    r = RCompatRNG(42, sample_kind="rejection")
+    got = r.sample_int(10, 10) + 1
+    np.testing.assert_array_equal(got, [1, 5, 10, 8, 2, 4, 6, 9, 7, 3])
+
+
+def test_sample_rounding_consumes_one_uniform_per_draw():
+    # The pre-3.6 algorithm is floor(m * u) with a shrinking pool; verify
+    # against a hand-rolled replay of the same uniform stream.
+    u = RCompatRNG(1991).runif(100)
+    got = RCompatRNG(1991, sample_kind="rounding").sample_int(1000, 100)
+    x = np.arange(1000)
+    m = 1000
+    want = np.empty(100, dtype=np.int64)
+    for i in range(100):
+        j = int(m * u[i])
+        want[i] = x[j]
+        m -= 1
+        x[j] = x[m]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_sample_without_replacement_is_permutation():
+    got = RCompatRNG(5, sample_kind="rounding").sample_int(500, 500)
+    assert sorted(got.tolist()) == list(range(500))
+
+
+def test_sample_with_replacement_rounding():
+    u = RCompatRNG(3).runif(50)
+    got = RCompatRNG(3, sample_kind="rounding").sample_int(77, 50, replace=True)
+    np.testing.assert_array_equal(got, np.floor(77 * u).astype(np.int64))
