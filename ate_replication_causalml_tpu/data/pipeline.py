@@ -77,10 +77,11 @@ def prepare_dataset(
     cols["Y"] = np.asarray(raw[schema.outcome], dtype=np.float64)[idx]
     cols["W"] = np.asarray(raw[schema.treatment], dtype=np.float64)[idx]
 
-    # na.omit (ate_replication.Rmd:93): drop any row with a NaN.
+    # na.omit (ate_replication.Rmd:93): drop rows with NA/NaN. R keeps
+    # +/-Inf rows (Inf is not NA), so isnan — not isfinite — matches.
     keep = np.ones(len(idx), dtype=bool)
     for v in cols.values():
-        keep &= np.isfinite(v)
+        keep &= ~np.isnan(v)
     cols = {k: v[keep] for k, v in cols.items()}
 
     out_schema = schema.replace(outcome="Y", treatment="W")

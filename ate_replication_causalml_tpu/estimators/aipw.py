@@ -66,12 +66,13 @@ def clip_propensity(p: jax.Array) -> jax.Array:
 def _outcome_model_mu(x, w, y):
     """Logit outcome model on [1, X, W]; mu1/mu0 via W := 1/0
     (``ate_functions.R:156-166``)."""
-    design = jnp.concatenate([jnp.ones((x.shape[0], 1), x.dtype), x, w[:, None]], axis=1)
-    fit = logistic_glm(design, y)
-    ones = jnp.ones_like(w)
-    d1 = jnp.concatenate([jnp.ones((x.shape[0], 1), x.dtype), x, ones[:, None]], axis=1)
-    d0 = jnp.concatenate([jnp.ones((x.shape[0], 1), x.dtype), x, (ones * 0.0)[:, None]], axis=1)
-    return predict_proba(fit.coef, d0), predict_proba(fit.coef, d1)
+    base = add_intercept(x)
+    with_w = lambda col: jnp.concatenate([base, col[:, None]], axis=1)
+    fit = logistic_glm(with_w(w), y)
+    return (
+        predict_proba(fit.coef, with_w(jnp.zeros_like(w))),
+        predict_proba(fit.coef, with_w(jnp.ones_like(w))),
+    )
 
 
 def _aipw_result(

@@ -1,0 +1,100 @@
+"""Belloni–Chernozhukov–Hansen (2013) post-double-selection.
+
+Reference: ``belloni`` (``ate_functions.R:286-328``):
+
+  1. expand X to all pairwise products — both orders AND self-squares,
+     k + k² columns total (``ate_functions.R:289-296``; duplicated
+     interactions enter the design twice, as published);
+  2. two gaussian CV-LASSOs: X→W and X→Y (``:304-305``);
+  3. take coefficients — with the reference's **wrong-λ bug**: both
+     models are evaluated at ``model_xw$lambda.min`` (``:308-309``),
+     which for model_xy is an off-path value that R's ``coef`` serves by
+     linear interpolation in λ (glmnet ``lambda.interp``) — reproduced;
+  4. support union — with the reference's **sign bug**: ``> 0`` keeps
+     only positive coefficients (``:312-313``) — reproduced in
+     ``compat="r"`` (default), ``compat="fixed"`` uses ``!= 0``;
+  5. OLS of Y on [X_selected, W]; ATE and SE from W's coefficient.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ate_replication_causalml_tpu.data.frame import CausalFrame
+from ate_replication_causalml_tpu.estimators.base import EstimatorResult
+from ate_replication_causalml_tpu.ops.lasso import cv_glmnet
+from ate_replication_causalml_tpu.ops.linalg import add_intercept, ols
+
+
+def interaction_expand(x: jax.Array) -> jax.Array:
+    """[X, all pairwise products x_i*x_j in the reference's double-loop
+    order] — (n, k + k^2)."""
+    n, k = x.shape
+    prods = jnp.einsum("ni,nj->nij", x, x).reshape(n, k * k)
+    return jnp.concatenate([x, prods], axis=1)
+
+
+def _interp_coef_at(path_lambdas, coefs, s):
+    """R glmnet ``coef(fit, s=)`` off-path behavior: linear interpolation
+    between the two bracketing path λs (``lambda.interp``), constant
+    extrapolation outside the path."""
+    lams = path_lambdas
+    L = lams.shape[0]
+    s = jnp.clip(s, lams[-1], lams[0])
+    # Path is decreasing; find right bracket.
+    right = jnp.clip(jnp.searchsorted(-lams, -s), 1, L - 1)
+    left = right - 1
+    frac = (s - lams[right]) / (lams[left] - lams[right])
+    return frac * coefs[left] + (1.0 - frac) * coefs[right]
+
+
+def belloni(
+    frame: CausalFrame,
+    foldid_xw=None,
+    foldid_xy=None,
+    key: jax.Array | None = None,
+    compat: str = "r",
+    method: str = "Belloni et.al",
+) -> EstimatorResult:
+    if key is None:
+        key = jax.random.key(0)
+    kxw, kxy = jax.random.split(key)
+    x_big = interaction_expand(frame.x)
+
+    cv_xw = cv_glmnet(x_big, frame.w, family="gaussian", foldid=foldid_xw, key=kxw)
+    cv_xy = cv_glmnet(x_big, frame.y, family="gaussian", foldid=foldid_xy, key=kxy)
+
+    lam = cv_xw.lambda_min
+    c_xw = _interp_coef_at(cv_xw.path.lambdas, cv_xw.path.coefs, lam)
+    # The wrong-λ bug: model_xy evaluated at model_xw's lambda.min.
+    c_xy = _interp_coef_at(cv_xy.path.lambdas, cv_xy.path.coefs, lam)
+
+    if compat == "r":
+        sel = (np.asarray(c_xw) > 0) | (np.asarray(c_xy) > 0)
+    elif compat == "fixed":
+        sel = (np.asarray(c_xw) != 0) | (np.asarray(c_xy) != 0)
+    else:
+        raise ValueError(f"compat must be 'r' or 'fixed', got {compat!r}")
+    sel_idx = np.nonzero(sel)[0]
+
+    # The expansion contains exact duplicates (c1*c2 and c2*c1; squares
+    # of binary flags reproduce the flag itself). R's lm() drops aliased
+    # columns during its pivoted QR; we drop exact duplicates up front so
+    # the normal-equations solve sees a full-rank design. W's coefficient
+    # is identical either way.
+    cols = np.asarray(x_big[:, sel_idx])
+    seen: dict[bytes, int] = {}
+    keep: list[int] = []
+    for j in range(cols.shape[1]):
+        h = cols[:, j].tobytes()
+        if h not in seen:
+            seen[h] = j
+            keep.append(j)
+    x_restricted = jnp.concatenate(
+        [jnp.asarray(cols[:, keep]), frame.w[:, None]], axis=1
+    )
+    fit = ols(add_intercept(x_restricted), frame.y)
+    tau, se = fit.coef[-1], fit.se[-1]
+    return EstimatorResult.from_point_se(method, tau, se)

@@ -1,0 +1,68 @@
+"""LASSO-based estimators: single-equation, usual, and LASSO propensity.
+
+Reference:
+  * ``ate_condmean_lasso`` (``ate_functions.R:89-108``): gaussian
+    ``cv.glmnet`` of Y on [X, W] with **penalty.factor 0 on W** (W never
+    shrunk); the ATE is W's coefficient at the CV-selected λ. R's
+    ``coef(cvfit)`` defaults to ``s = "lambda.1se"`` — reproduced.
+    Returns a point estimate with no SE (``lower_ci == upper_ci``).
+  * ``ate_lasso`` (``ate_functions.R:111-130``): identical but W is
+    penalized like every other column.
+  * ``prop_score_lasso`` (``ate_functions.R:133-146``): binomial-logit
+    LASSO of W on X; returns **in-sample** fitted probabilities at
+    ``lambda.1se`` (a vector, not a result row), which the notebook
+    feeds to the IPW estimator (``ate_replication.Rmd:183-188``).
+
+Note the reference treats the binary outcome as *gaussian* in both
+outcome LASSOs — that is the published behavior and is kept.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ate_replication_causalml_tpu.data.frame import CausalFrame
+from ate_replication_causalml_tpu.estimators.base import EstimatorResult
+from ate_replication_causalml_tpu.ops.lasso import cv_glmnet, predict_path
+
+
+def _xw_design(frame: CausalFrame) -> jax.Array:
+    """[X, W] matrix — covariates in schema order then treatment
+    (``ate_functions.R:91-94``)."""
+    return jnp.concatenate([frame.x, frame.w[:, None]], axis=1)
+
+
+def ate_condmean_lasso(
+    frame: CausalFrame,
+    foldid=None,
+    key: jax.Array | None = None,
+    method: str = "Single-equation LASSO",
+) -> EstimatorResult:
+    x = _xw_design(frame)
+    pfac = jnp.concatenate([jnp.ones(frame.p, x.dtype), jnp.zeros(1, x.dtype)])
+    cv = cv_glmnet(x, frame.y, family="gaussian", penalty_factor=pfac, foldid=foldid, key=key)
+    _, coefs = cv.coef_at("1se")
+    return EstimatorResult.point_only(method, coefs[-1])
+
+
+def ate_lasso(
+    frame: CausalFrame,
+    foldid=None,
+    key: jax.Array | None = None,
+    method: str = "Usual LASSO",
+) -> EstimatorResult:
+    x = _xw_design(frame)
+    cv = cv_glmnet(x, frame.y, family="gaussian", foldid=foldid, key=key)
+    _, coefs = cv.coef_at("1se")
+    return EstimatorResult.point_only(method, coefs[-1])
+
+
+def prop_score_lasso(
+    frame: CausalFrame, foldid=None, key: jax.Array | None = None
+) -> jax.Array:
+    """LASSO-logit propensity vector at lambda.1se, in-sample."""
+    cv = cv_glmnet(frame.x, frame.w, family="binomial", foldid=foldid, key=key)
+    idx = cv.index_1se
+    eta = predict_path(cv.path, frame.x, idx)
+    return jax.nn.sigmoid(eta)
