@@ -1,0 +1,276 @@
+"""Random-forest classifier engine — TPU-native replacement for the
+Breiman–Cutler Fortran CART forest behind R's ``randomForest``.
+
+The reference uses ``randomForest`` for the AIPW propensity (OOB votes,
+``ate_functions.R:169-174``) and both DML nuisances
+(``ate_functions.R:340-349``). Those forests are *nuisance models* and
+are not even seeded in the reference (the ``seed=`` arg is silently
+swallowed, SURVEY.md §2.1 #8/#12), so the contract is statistical
+fidelity — bootstrap-per-tree, per-node feature subsampling
+(mtry = floor(sqrt(p))), Gini split search, OOB vote probabilities —
+not bit parity.
+
+TPU-first design (nothing like the Fortran recursion):
+
+  * features are quantile-binned once into uint8 codes; a split is
+    "bin > t", so split search is a histogram problem;
+  * trees grow **level-wise** to a fixed depth with node masking —
+    static shapes, no recursion, XLA-friendly;
+  * per-level histograms are computed as **MXU matmuls**:
+    ``hist[node, (feat,bin)] = onehot_nodes^T @ onehot_bins`` with the
+    per-tree bootstrap counts folded into the node one-hot. The
+    feature/bin one-hot is tree-independent and shared; only the tiny
+    (n, nodes) node one-hot is per-tree;
+  * trees are embarrassingly parallel: ``vmap`` over a tree chunk, and
+    the chunk axis can be ``shard_map``'ed over the mesh's tree axis
+    (SURVEY.md §2.4: trees are the expert-parallel analogue);
+  * bootstrap counts default to Poisson(1) (same large-n argument as
+    the bootstrap engine, ops/bootstrap.py) with an exact multinomial
+    option; OOB rows are ``count == 0`` either way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ate_replication_causalml_tpu.data.frame import CausalFrame
+from ate_replication_causalml_tpu.ops.bootstrap import _poisson1_counts
+from ate_replication_causalml_tpu.ops.linalg import _PREC
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Forest:
+    """A fitted level-wise forest.
+
+    ``split_feat``/``split_bin`` index internal nodes per level as
+    [0, 2^level) offsets (children of node k at level l are 2k/2k+1 at
+    level l+1). A row goes RIGHT when its bin code satisfies
+    ``bin > split_bin``. Frozen nodes (pure/empty/no valid split) store
+    ``split_feat=0, split_bin=n_bins-1`` — every row routes LEFT, which
+    is how a leaf is represented in a fixed-depth tree. ``leaf_value``
+    is the bootstrap-weighted P(y=1) in the depth-D leaf; empty leaves
+    fall back to the tree's overall bootstrap-weighted rate (they are
+    never reached by training rows and only matter for unseen rows).
+    """
+
+    split_feat: jax.Array   # (T, D, max_nodes) int32, -1 where frozen
+    split_bin: jax.Array    # (T, D, max_nodes) int32
+    leaf_value: jax.Array   # (T, 2^D) float32
+    counts: jax.Array       # (T, n) bootstrap counts of the training rows
+    bin_edges: jax.Array = dataclasses.field(metadata=dict(static=False), default=None)
+
+    @property
+    def n_trees(self) -> int:
+        return self.split_feat.shape[0]
+
+    @property
+    def depth(self) -> int:
+        return self.split_feat.shape[1]
+
+
+def quantile_bins(x: jax.Array, n_bins: int = 64) -> jax.Array:
+    """Per-feature quantile bin edges, (p, n_bins-1). Computed once and
+    shared by every tree (the binned representation is what CART's
+    exhaustive threshold scan degrades to at histogram resolution)."""
+    qs = jnp.linspace(0.0, 1.0, n_bins + 1)[1:-1]
+    return jnp.quantile(x, qs, axis=0).T  # (p, n_bins-1)
+
+
+def binarize(x: jax.Array, edges: jax.Array) -> jax.Array:
+    """Map features to int32 bin codes in [0, n_bins)."""
+    return jax.vmap(
+        lambda col, e: jnp.searchsorted(e, col, side="left"), in_axes=(1, 0), out_axes=1
+    )(x, edges).astype(jnp.int32)
+
+
+class ForestPredictions(NamedTuple):
+    prob: jax.Array   # mean leaf probability over trees
+    vote: jax.Array   # fraction of trees voting class 1 (randomForest "prob")
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_trees", "depth", "mtry", "n_bins", "tree_chunk")
+)
+def fit_forest_classifier(
+    x: jax.Array,
+    y: jax.Array,
+    key: jax.Array,
+    n_trees: int = 500,
+    depth: int = 9,
+    mtry: int | None = None,
+    n_bins: int = 64,
+    tree_chunk: int = 32,
+) -> Forest:
+    """Fit a classification forest of ``n_trees`` depth-``depth`` trees.
+
+    mtry defaults to floor(sqrt(p)) (randomForest's classification
+    default). Trees are grown in chunks of ``tree_chunk`` via ``lax.map``
+    (bounded memory), vmapped within a chunk.
+    """
+    n, p = x.shape
+    if mtry is None:
+        mtry = max(1, int(np.sqrt(p)))
+    edges = quantile_bins(x, n_bins)
+    codes = binarize(x, edges)  # (n, p) int32
+    # Shared one-hot bin encoding for the histogram matmuls: one 1 per
+    # feature block, built by scatter (a dense (n, p, p*n_bins) one_hot
+    # intermediate would be ~1 GB at reference scale).
+    flat_idx = codes + jnp.arange(p, dtype=jnp.int32)[None, :] * n_bins
+    xb_onehot = (
+        jnp.zeros((n, p * n_bins), jnp.float32)
+        .at[jnp.arange(n)[:, None], flat_idx]
+        .set(1.0)
+    )
+    yf = y.astype(jnp.float32)
+    max_nodes = 1 << (depth - 1)
+    n_leaves = 1 << depth
+
+    def grow_one(tree_key):
+        ck, gk = jax.random.split(tree_key)
+        counts = _poisson1_counts(ck, (n,))
+
+        def level_step(node_of_row, lk):
+            level_nodes = max_nodes  # padded width, ids stay < 2^level
+            node_oh = jax.nn.one_hot(node_of_row, level_nodes, dtype=jnp.float32)
+            hist_c = jnp.matmul(
+                (node_oh * counts[:, None]).T, xb_onehot, precision=_PREC
+            ).reshape(level_nodes, p, n_bins)
+            hist_y = jnp.matmul(
+                (node_oh * (counts * yf)[:, None]).T, xb_onehot, precision=_PREC
+            ).reshape(level_nodes, p, n_bins)
+
+            cl = jnp.cumsum(hist_c, axis=2)
+            yl = jnp.cumsum(hist_y, axis=2)
+            ct, yt = cl[:, :, -1:], yl[:, :, -1:]
+            cr, yr = ct - cl, yt - yl
+            eps = 1e-12
+            score = yl * (cl - yl) / jnp.maximum(cl, eps) + yr * (cr - yr) / jnp.maximum(
+                cr, eps
+            )
+            score = jnp.where((cl > 0) & (cr > 0), score, jnp.inf)
+
+            feat_scores = jax.random.uniform(lk, (level_nodes, p))
+            kth = jnp.sort(feat_scores, axis=1)[:, mtry - 1 : mtry]
+            score = jnp.where((feat_scores <= kth)[:, :, None], score, jnp.inf)
+
+            flat = score.reshape(level_nodes, p * n_bins)
+            best = jnp.argmin(flat, axis=1)
+            has_split = jnp.isfinite(jnp.min(flat, axis=1))
+            best_feat = jnp.where(has_split, (best // n_bins).astype(jnp.int32), 0)
+            best_bin = jnp.where(
+                has_split, (best % n_bins).astype(jnp.int32), n_bins - 1
+            )
+
+            row_feat = best_feat[node_of_row]
+            row_bin = best_bin[node_of_row]
+            code_at_feat = jnp.take_along_axis(codes, row_feat[:, None], axis=1)[:, 0]
+            node_of_row = node_of_row * 2 + (code_at_feat > row_bin).astype(jnp.int32)
+            return node_of_row, (best_feat, best_bin)
+
+        level_keys = jax.random.split(gk, depth)
+        node_of_row, (feats, bins) = lax.scan(
+            level_step, jnp.zeros(n, jnp.int32), level_keys
+        )
+
+        # Leaf stats at depth D (bootstrap-weighted), parent-filled where
+        # empty by falling back to the overall rate.
+        leaf_oh = jax.nn.one_hot(node_of_row, n_leaves, dtype=jnp.float32)
+        leaf_c = jnp.matmul(counts, leaf_oh, precision=_PREC)
+        leaf_y = jnp.matmul(counts * yf, leaf_oh, precision=_PREC)
+        overall = jnp.sum(counts * yf) / jnp.maximum(jnp.sum(counts), 1e-12)
+        leaf_value = jnp.where(leaf_c > 0, leaf_y / jnp.maximum(leaf_c, 1e-12), overall)
+        return feats, bins, leaf_value, counts
+
+    # Avoid growing throwaway trees: prefer the largest divisor of
+    # n_trees within the chunk budget (zero padding waste); fall back to
+    # ceil-padding only when n_trees has no usable divisor (e.g. prime).
+    tree_chunk = min(tree_chunk, n_trees)
+    divisors = [d for d in range(tree_chunk, 0, -1) if n_trees % d == 0]
+    if divisors and divisors[0] * 2 >= tree_chunk:
+        tree_chunk = divisors[0]
+    n_chunks = -(-n_trees // tree_chunk)  # ceil: padded, sliced after
+    tree_keys = jax.random.split(key, n_chunks * tree_chunk)
+
+    def chunk_fn(keys):
+        return jax.vmap(grow_one)(keys)
+
+    feats, bins, leaf_values, counts = lax.map(
+        chunk_fn, tree_keys.reshape(n_chunks, tree_chunk, *tree_keys.shape[1:])
+    )
+    reshape = lambda a: a.reshape((n_chunks * tree_chunk,) + a.shape[2:])[:n_trees]
+    return Forest(
+        split_feat=reshape(feats),
+        split_bin=reshape(bins),
+        leaf_value=reshape(leaf_values),
+        counts=reshape(counts),
+        bin_edges=edges,
+    )
+
+
+@jax.jit
+def forest_apply(forest: Forest, codes: jax.Array) -> jax.Array:
+    """Leaf value of every (tree, row): (T, n)."""
+
+    def one_tree(feats, bins, leaf_value):
+        def step(node, level):
+            f = feats[level][node]
+            b = bins[level][node]
+            code = jnp.take_along_axis(codes, f[:, None], axis=1)[:, 0]
+            return node * 2 + (code > b).astype(jnp.int32), None
+
+        node0 = jnp.zeros(codes.shape[0], jnp.int32)
+        node, _ = lax.scan(step, node0, jnp.arange(forest.depth))
+        return leaf_value[node]
+
+    return jax.vmap(one_tree)(forest.split_feat, forest.split_bin, forest.leaf_value)
+
+
+def predict_forest(forest: Forest, x: jax.Array, oob: bool = False) -> ForestPredictions:
+    """Forest predictions for rows ``x``.
+
+    ``vote`` is the randomForest ``predict(type="prob")`` semantics: the
+    fraction of trees whose leaf majority-class is 1. With ``oob=True``
+    (valid only for the training matrix) each row averages only over
+    trees whose bootstrap count for that row is zero — the reference's
+    OOB propensity (``ate_functions.R:174``).
+    """
+    codes = binarize(x, forest.bin_edges)
+    leaf_vals = forest_apply(forest, codes)  # (T, n)
+    votes = (leaf_vals > 0.5).astype(jnp.float32)
+    if oob:
+        if x.shape[0] != forest.counts.shape[1]:
+            raise ValueError(
+                "oob=True is only valid for the training matrix: forest was "
+                f"fit on {forest.counts.shape[1]} rows, got {x.shape[0]}"
+            )
+        mask = (forest.counts == 0).astype(jnp.float32)  # (T, n)
+        denom = jnp.maximum(mask.sum(axis=0), 1.0)
+        prob = (leaf_vals * mask).sum(axis=0) / denom
+        vote = (votes * mask).sum(axis=0) / denom
+    else:
+        prob = leaf_vals.mean(axis=0)
+        vote = votes.mean(axis=0)
+    return ForestPredictions(prob=prob, vote=vote)
+
+
+def rf_oob_propensity(
+    frame: CausalFrame,
+    key: jax.Array | None = None,
+    n_trees: int = 500,
+    depth: int = 9,
+    **kwargs,
+) -> jax.Array:
+    """The reference's AIPW propensity: classification forest of W on X,
+    OOB vote fractions (``ate_functions.R:169-174``)."""
+    if key is None:
+        key = jax.random.key(12325)  # the seed the reference *meant* to set
+    forest = fit_forest_classifier(frame.x, frame.w, key, n_trees=n_trees, depth=depth, **kwargs)
+    return predict_forest(forest, frame.x, oob=True).vote
