@@ -1,0 +1,55 @@
+"""Estimator library — the TPU-native counterpart of ``ate_functions.R``.
+
+Every estimator takes a :class:`~ate_replication_causalml_tpu.data.frame.CausalFrame`
+and returns the uniform :class:`EstimatorResult` record (SURVEY.md §1:
+``data.frame(Method, ATE, lower_ci, upper_ci)``).
+"""
+
+from ate_replication_causalml_tpu.estimators.aipw import (
+    doubly_robust,
+    doubly_robust_glm,
+)
+from ate_replication_causalml_tpu.estimators.balance import (
+    approx_balance,
+    residual_balance_ate,
+)
+from ate_replication_causalml_tpu.estimators.base import (
+    EstimatorResult,
+    ResultTable,
+    Z_95,
+)
+from ate_replication_causalml_tpu.estimators.belloni import belloni
+from ate_replication_causalml_tpu.estimators.dml import chernozhukov, double_ml
+from ate_replication_causalml_tpu.estimators.ipw import (
+    logistic_propensity,
+    prop_score_ols,
+    prop_score_weight,
+)
+from ate_replication_causalml_tpu.estimators.lasso_est import (
+    ate_condmean_lasso,
+    ate_lasso,
+    prop_score_lasso,
+)
+from ate_replication_causalml_tpu.estimators.naive import naive_ate
+from ate_replication_causalml_tpu.estimators.ols import ate_condmean_ols
+
+__all__ = [
+    "EstimatorResult",
+    "ResultTable",
+    "Z_95",
+    "approx_balance",
+    "ate_condmean_lasso",
+    "ate_condmean_ols",
+    "ate_lasso",
+    "belloni",
+    "chernozhukov",
+    "double_ml",
+    "doubly_robust",
+    "doubly_robust_glm",
+    "logistic_propensity",
+    "naive_ate",
+    "prop_score_lasso",
+    "prop_score_ols",
+    "prop_score_weight",
+    "residual_balance_ate",
+]

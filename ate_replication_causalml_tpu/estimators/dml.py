@@ -81,9 +81,7 @@ def double_ml(
     if se_mode == "r":
         # The reference averages the two fold SEs (ate_functions.R:383).
         se = (se1 + se2) / 2.0
-    elif se_mode == "pooled":
-        # Conservative alternative: treat folds as independent estimates.
-        se = jnp.sqrt(se1**2 + se2**2) / 2.0
     else:
-        raise ValueError(f"se_mode must be 'r' or 'pooled', got {se_mode!r}")
+        # "pooled" (validated above): treat folds as independent estimates.
+        se = jnp.sqrt(se1**2 + se2**2) / 2.0
     return EstimatorResult.from_point_se(method, tau, se)

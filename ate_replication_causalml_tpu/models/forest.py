@@ -61,7 +61,7 @@ class Forest:
     never reached by training rows and only matter for unseen rows).
     """
 
-    split_feat: jax.Array   # (T, D, max_nodes) int32, -1 where frozen
+    split_feat: jax.Array   # (T, D, max_nodes) int32 (frozen nodes: 0)
     split_bin: jax.Array    # (T, D, max_nodes) int32
     leaf_value: jax.Array   # (T, 2^D) float32
     counts: jax.Array       # (T, n) bootstrap counts of the training rows
