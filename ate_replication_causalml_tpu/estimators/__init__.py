@@ -19,6 +19,10 @@ from ate_replication_causalml_tpu.estimators.base import (
     Z_95,
 )
 from ate_replication_causalml_tpu.estimators.belloni import belloni
+from ate_replication_causalml_tpu.estimators.causal_forest_est import (
+    causal_forest_ate,
+    causal_forest_report,
+)
 from ate_replication_causalml_tpu.estimators.dml import chernozhukov, double_ml
 from ate_replication_causalml_tpu.estimators.ipw import (
     logistic_propensity,
@@ -42,6 +46,8 @@ __all__ = [
     "ate_condmean_ols",
     "ate_lasso",
     "belloni",
+    "causal_forest_ate",
+    "causal_forest_report",
     "chernozhukov",
     "double_ml",
     "doubly_robust",

@@ -1,0 +1,67 @@
+"""Causal-forest ATE estimator — the reference's estimator #15, which is
+implemented inline in the notebook rather than in ``ate_functions.R``
+(``ate_replication.Rmd:249-272``, SURVEY.md §2.1 #15)."""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+
+from ate_replication_causalml_tpu.data.frame import CausalFrame
+from ate_replication_causalml_tpu.estimators.base import EstimatorResult
+from ate_replication_causalml_tpu.models.causal_forest import (
+    average_treatment_effect,
+    fit_causal_forest,
+    incorrect_forest_ate,
+    predict_cate,
+)
+
+
+class CausalForestReport(NamedTuple):
+    """Everything the notebook's causal-forest chunk produces: the
+    deliberately 'incorrect' mean-CATE ATE/SE it prints
+    (``Rmd:258-262``) plus the correct doubly-robust result row."""
+
+    result: EstimatorResult
+    incorrect_ate: float
+    incorrect_se: float
+
+
+def causal_forest_ate(
+    frame: CausalFrame,
+    key: jax.Array | None = None,
+    n_trees: int = 2000,
+    method_name: str = "Causal Forest(GRF)",
+    **fit_kwargs,
+) -> EstimatorResult:
+    """Honest causal forest → doubly-robust ATE
+    (``grf::estimate_average_effect``, ``ate_replication.Rmd:265-270``)."""
+    fitted = fit_causal_forest(frame, key=key, n_trees=n_trees, **fit_kwargs)
+    eff = average_treatment_effect(fitted)
+    return EstimatorResult.from_point_se(
+        method_name, float(eff.estimate), float(eff.std_err)
+    )
+
+
+def causal_forest_report(
+    frame: CausalFrame,
+    key: jax.Array | None = None,
+    n_trees: int = 2000,
+    method_name: str = "Causal Forest(GRF)",
+    **fit_kwargs,
+) -> CausalForestReport:
+    """One fit, both outputs of the notebook chunk: the incorrect
+    mean-of-CATEs ATE/SE demo and the correct AIPW result row — sharing
+    the fitted forest and its CATE predictions."""
+    fitted = fit_causal_forest(frame, key=key, n_trees=n_trees, **fit_kwargs)
+    cate = predict_cate(fitted.forest, fitted.x, oob=True)
+    ate_bad, se_bad = incorrect_forest_ate(cate)
+    eff = average_treatment_effect(fitted, cate=cate)
+    return CausalForestReport(
+        result=EstimatorResult.from_point_se(
+            method_name, float(eff.estimate), float(eff.std_err)
+        ),
+        incorrect_ate=float(ate_bad),
+        incorrect_se=float(se_bad),
+    )

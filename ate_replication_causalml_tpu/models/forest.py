@@ -91,6 +91,31 @@ def binarize(x: jax.Array, edges: jax.Array) -> jax.Array:
     )(x, edges).astype(jnp.int32)
 
 
+def bin_onehot(codes: jax.Array, n_bins: int) -> jax.Array:
+    """Shared one-hot bin encoding for the histogram matmuls: one 1 per
+    feature block, built by scatter (a dense (n, p, p*n_bins) one_hot
+    intermediate would be ~1 GB at reference scale). Tree-independent —
+    computed once per forest."""
+    n, p = codes.shape
+    flat_idx = codes + jnp.arange(p, dtype=jnp.int32)[None, :] * n_bins
+    return (
+        jnp.zeros((n, p * n_bins), jnp.float32)
+        .at[jnp.arange(n)[:, None], flat_idx]
+        .set(1.0)
+    )
+
+
+def pick_chunk(total: int, chunk: int) -> int:
+    """Pick a work-chunk size: prefer the largest divisor of ``total``
+    within the budget (zero padding waste); fall back to ceil-padding
+    only when ``total`` has no usable divisor (e.g. prime)."""
+    chunk = max(1, min(chunk, total))
+    divisors = [d for d in range(chunk, 0, -1) if total % d == 0]
+    if divisors and divisors[0] * 2 >= chunk:
+        return divisors[0]
+    return chunk
+
+
 class ForestPredictions(NamedTuple):
     prob: jax.Array   # mean leaf probability over trees
     vote: jax.Array   # fraction of trees voting class 1 (randomForest "prob")
@@ -120,15 +145,7 @@ def fit_forest_classifier(
         mtry = max(1, int(np.sqrt(p)))
     edges = quantile_bins(x, n_bins)
     codes = binarize(x, edges)  # (n, p) int32
-    # Shared one-hot bin encoding for the histogram matmuls: one 1 per
-    # feature block, built by scatter (a dense (n, p, p*n_bins) one_hot
-    # intermediate would be ~1 GB at reference scale).
-    flat_idx = codes + jnp.arange(p, dtype=jnp.int32)[None, :] * n_bins
-    xb_onehot = (
-        jnp.zeros((n, p * n_bins), jnp.float32)
-        .at[jnp.arange(n)[:, None], flat_idx]
-        .set(1.0)
-    )
+    xb_onehot = bin_onehot(codes, n_bins)
     yf = y.astype(jnp.float32)
     max_nodes = 1 << (depth - 1)
     n_leaves = 1 << depth
@@ -152,8 +169,14 @@ def fit_forest_classifier(
             ct, yt = cl[:, :, -1:], yl[:, :, -1:]
             cr, yr = ct - cl, yt - yl
             eps = 1e-12
-            score = yl * (cl - yl) / jnp.maximum(cl, eps) + yr * (cr - yr) / jnp.maximum(
-                cr, eps
+            # Universal split score: minimizing -(S_L²/c_L + S_R²/c_R) is
+            # the SSE-reduction criterion for a regression target and is
+            # identical (up to the per-node constant S_parent) to the
+            # weighted-Gini criterion when y is 0/1 — so one engine
+            # serves both randomForest classification (Gini) and
+            # regression (MSE) semantics.
+            score = -(
+                yl * yl / jnp.maximum(cl, eps) + yr * yr / jnp.maximum(cr, eps)
             )
             score = jnp.where((cl > 0) & (cr > 0), score, jnp.inf)
 
@@ -189,13 +212,7 @@ def fit_forest_classifier(
         leaf_value = jnp.where(leaf_c > 0, leaf_y / jnp.maximum(leaf_c, 1e-12), overall)
         return feats, bins, leaf_value, counts
 
-    # Avoid growing throwaway trees: prefer the largest divisor of
-    # n_trees within the chunk budget (zero padding waste); fall back to
-    # ceil-padding only when n_trees has no usable divisor (e.g. prime).
-    tree_chunk = min(tree_chunk, n_trees)
-    divisors = [d for d in range(tree_chunk, 0, -1) if n_trees % d == 0]
-    if divisors and divisors[0] * 2 >= tree_chunk:
-        tree_chunk = divisors[0]
+    tree_chunk = pick_chunk(n_trees, tree_chunk)
     n_chunks = -(-n_trees // tree_chunk)  # ceil: padded, sliced after
     tree_keys = jax.random.split(key, n_chunks * tree_chunk)
 
@@ -259,6 +276,40 @@ def predict_forest(forest: Forest, x: jax.Array, oob: bool = False) -> ForestPre
         prob = leaf_vals.mean(axis=0)
         vote = votes.mean(axis=0)
     return ForestPredictions(prob=prob, vote=vote)
+
+
+def fit_forest_regressor(
+    x: jax.Array,
+    y: jax.Array,
+    key: jax.Array,
+    n_trees: int = 500,
+    depth: int = 9,
+    mtry: int | None = None,
+    n_bins: int = 64,
+    tree_chunk: int = 32,
+) -> Forest:
+    """Regression forest — same engine as the classifier (the split
+    score is SSE-reduction, see ``level_step``), leaf values are
+    bootstrap-weighted means of a continuous target. mtry defaults to
+    randomForest's regression default max(1, floor(p/3)).
+
+    This is the nuisance-forest used for grf-style local centering
+    (``ate_replication.Rmd:250-255`` fits ``causal_forest`` whose C++
+    core first fits Y~X and W~X regression forests).
+    """
+    if mtry is None:
+        mtry = max(1, x.shape[1] // 3)
+    return fit_forest_classifier(
+        x, y, key, n_trees=n_trees, depth=depth, mtry=mtry,
+        n_bins=n_bins, tree_chunk=tree_chunk,
+    )
+
+
+def forest_oob_mean(forest: Forest, x: jax.Array) -> jax.Array:
+    """OOB leaf-mean prediction on the training matrix (regression
+    analogue of the OOB vote; the local-centering estimates Ŷ(x), Ŵ(x)
+    in grf are OOB predictions of exactly this kind)."""
+    return predict_forest(forest, x, oob=True).prob
 
 
 def rf_oob_propensity(
