@@ -38,6 +38,31 @@ class PrepConfig:
     sample_kind: str = "rounding"  # R <= 3.5 sample.int default (2018-era)
 
 
+def load_raw_csv(path: str, schema: DatasetSchema = GGL_SCHEMA) -> dict[str, np.ndarray]:
+    """Load the reference's CSV (``read.csv``, ``ate_replication.Rmd:33``)
+    into raw columns keyed by the schema's names.
+
+    The real ``socialpresswgeooneperhh_NEIGH.csv`` is gitignored in the
+    reference and downloaded separately (``Rmd:30``); this loader accepts
+    it — or any CSV with the schema's columns. Non-numeric entries (R's
+    ``NA`` strings, blanks) become NaN and are dropped later by
+    ``prepare_dataset``'s na.omit stage.
+    """
+    with open(path, "r") as f:
+        header = [h.strip().strip('"') for h in f.readline().rstrip("\n").split(",")]
+    wanted = set(schema.all_columns)
+    missing = wanted - set(header)
+    if missing:
+        raise ValueError(f"CSV {path} is missing columns: {sorted(missing)}")
+    usecols = [i for i, h in enumerate(header) if h in wanted]
+    data = np.genfromtxt(
+        path, delimiter=",", skip_header=1, usecols=usecols,
+        dtype=np.float64, missing_values=("NA", "", "NaN"), filling_values=np.nan,
+    )
+    data = np.atleast_2d(data)
+    return {header[c]: data[:, j] for j, c in enumerate(usecols)}
+
+
 def _zscore(col: np.ndarray) -> np.ndarray:
     """R ``scale()``: (x - mean) / sd with the n-1 denominator."""
     mu = col.mean()

@@ -342,6 +342,12 @@ def predict_cate(
     subsample from its contributions — the grf semantics for in-sample
     ``predict(forest)`` (``ate_replication.Rmd:259``).
     """
+    if oob and x.shape[0] != forest.in_sample.shape[1]:
+        raise ValueError(
+            "oob=True is only valid for the training matrix: forest was "
+            f"fit on {forest.in_sample.shape[1]} rows, got {x.shape[0]}; "
+            "pass oob=False for new data"
+        )
     codes = binarize(x, forest.bin_edges)
     n = codes.shape[0]
     T, depth = forest.n_trees, forest.depth

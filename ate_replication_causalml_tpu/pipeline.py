@@ -1,0 +1,337 @@
+"""L5 driver — the notebook-equivalent experiment pipeline.
+
+Replicates ``ate_replication.Rmd`` end to end (SURVEY.md §2.2, §3.1):
+data ingest → prep (z-score, rename to W/Y, na.omit) → bias injection →
+RCT oracle → the full estimator sweep in notebook order → uniform result
+table → the three comparison figures.
+
+What the notebook lacks, the driver adds (SURVEY.md §5):
+
+* **Checkpoint/resume** — every estimator's result row is appended to
+  ``results.jsonl`` the moment it finishes; re-running with the same
+  output directory skips completed estimators (the notebook recomputes
+  everything, §5.4).
+* **Observability** — per-estimator wall-clock seconds recorded with
+  each row (the north star is a wall-clock metric, §5.1).
+* **Config as data** — every notebook global and call-site constant
+  lives in :class:`SweepConfig` (§5.6).
+
+CLI::
+
+    python -m ate_replication_causalml_tpu.pipeline --out results/ \
+        [--csv socialpresswgeooneperhh_NEIGH.csv] [--quick] [--no-plots]
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Callable, Iterable
+
+import jax
+import numpy as np
+
+from ate_replication_causalml_tpu.data.frame import CausalFrame
+from ate_replication_causalml_tpu.data.pipeline import (
+    PrepConfig,
+    inject_bias,
+    load_raw_csv,
+    prepare_dataset,
+)
+from ate_replication_causalml_tpu.data.synthetic import make_ggl_like
+from ate_replication_causalml_tpu.estimators import (
+    EstimatorResult,
+    ResultTable,
+    ate_condmean_lasso,
+    ate_condmean_ols,
+    ate_lasso,
+    belloni,
+    causal_forest_report,
+    double_ml,
+    doubly_robust,
+    doubly_robust_glm,
+    logistic_propensity,
+    naive_ate,
+    prop_score_lasso,
+    prop_score_ols,
+    prop_score_weight,
+    residual_balance_ate,
+)
+from ate_replication_causalml_tpu.models.forest import rf_oob_propensity
+
+
+@dataclasses.dataclass(frozen=True)
+class SweepConfig:
+    """Every constant the notebook hardcodes, in one place.
+
+    Tree counts are the notebook's call-site values
+    (``ate_replication.Rmd:217, 232, 255``); ``quick()`` scales them down
+    for smoke runs.
+    """
+
+    prep: PrepConfig = PrepConfig()
+    synthetic_pool: int = 120_000   # raw rows generated when no CSV is given
+    synthetic_seed: int = 0
+    true_ate: float = 0.095         # synthetic generator's target (oracle ≈ this)
+    dr_trees: int = 2500            # doubly_robust(..., 2500), Rmd:217
+    dml_trees: int = 2000           # double_ml(..., num_tree = 2000), Rmd:232
+    cf_trees: int = 2000            # grf num.trees, Rmd:255
+    cf_nuisance_trees: int = 500
+    forest_depth: int = 9
+    seed: int = 0                   # jax.random seed for the TPU fast path
+
+    def quick(self) -> "SweepConfig":
+        return dataclasses.replace(
+            self,
+            prep=dataclasses.replace(self.prep, n_obs=8_000),
+            synthetic_pool=20_000,
+            dr_trees=250, dml_trees=200, cf_trees=200, cf_nuisance_trees=100,
+            forest_depth=7,
+        )
+
+
+@dataclasses.dataclass
+class SweepReport:
+    """Everything the notebook run produces."""
+
+    oracle: EstimatorResult
+    results: ResultTable
+    n_dropped: int
+    n_biased: int
+    incorrect_cf_ate: float | None = None
+    incorrect_cf_se: float | None = None
+    timings_s: dict = dataclasses.field(default_factory=dict)
+    figure_paths: list = dataclasses.field(default_factory=list)
+
+
+def _jsonsafe(obj):
+    """NaN/Inf → None, recursively — report.json and results.jsonl must
+    stay valid for strict parsers (the no-SE LASSO rows carry se=NaN)."""
+    import math as _m
+
+    if isinstance(obj, float):
+        return None if not _m.isfinite(obj) else obj
+    if isinstance(obj, dict):
+        return {k: _jsonsafe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonsafe(v) for v in obj]
+    return obj
+
+
+class _Checkpoint:
+    """Append-only JSONL of finished result rows, keyed by method name.
+
+    The first record is a config fingerprint; a checkpoint written under
+    a different config is set aside (renamed ``*.stale``) instead of
+    being silently reused as current results.
+    """
+
+    def __init__(self, path: str | None, fingerprint: str, log=print):
+        self.path = path
+        self.done: dict[str, dict] = {}
+        if path and os.path.exists(path):
+            with open(path) as f:
+                recs = [json.loads(l) for l in f if l.strip()]
+            header = next((r for r in recs if r.get("method") == "__config__"), None)
+            if header is None or header.get("fingerprint") != fingerprint:
+                stale = path + ".stale"
+                os.replace(path, stale)
+                log(f"checkpoint {path} was written under a different config; "
+                    f"moved to {stale} and starting fresh")
+            else:
+                self.done = {r["method"]: r for r in recs if r["method"] != "__config__"}
+        if path and not self.done and not os.path.exists(path):
+            with open(path, "w") as f:
+                f.write(json.dumps({"method": "__config__",
+                                    "fingerprint": fingerprint}) + "\n")
+
+    def get(self, method: str) -> dict | None:
+        return self.done.get(method)
+
+    def put(self, rec: dict) -> None:
+        rec = _jsonsafe(rec)
+        self.done[rec["method"]] = rec
+        if self.path:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+
+
+def build_frames(
+    config: SweepConfig, csv_path: str | None = None
+) -> tuple[CausalFrame, CausalFrame, int]:
+    """Ingest → prep → bias injection: the notebook's df and df_mod."""
+    if csv_path:
+        raw = load_raw_csv(csv_path)
+    else:
+        raw = make_ggl_like(
+            config.synthetic_pool, seed=config.synthetic_seed, true_ate=config.true_ate
+        )
+    df = prepare_dataset(raw, config.prep)
+    df_mod, dropped = inject_bias(df, config.prep)
+    return df, df_mod, len(dropped)
+
+
+def run_sweep(
+    config: SweepConfig = SweepConfig(),
+    csv_path: str | None = None,
+    outdir: str | None = None,
+    plots: bool = True,
+    log: Callable[[str], None] = print,
+) -> SweepReport:
+    """The full notebook run, checkpointed and timed."""
+    if outdir:
+        os.makedirs(outdir, exist_ok=True)
+    # Resume is only valid for the same config + data source.
+    fingerprint = f"{config!r}|csv={csv_path or 'synthetic'}"
+    ckpt = _Checkpoint(
+        os.path.join(outdir, "results.jsonl") if outdir else None,
+        fingerprint, log=log,
+    )
+
+    df, df_mod, n_dropped = build_frames(config, csv_path)
+    log(f"prepared df n={df.n}, dropped {n_dropped} -> df_mod n={df_mod.n} "
+        f"(reference on real data: 41,062 dropped, BASELINE.md)")
+
+    report = SweepReport(
+        oracle=None, results=ResultTable(), n_dropped=n_dropped, n_biased=df_mod.n
+    )
+    # Deterministic per-stage keys (stable across resume: skipping a
+    # completed stage must not shift the keys of later stages).
+    import zlib
+
+    root_key = jax.random.key(config.seed)
+
+    def key_for(name: str) -> jax.Array:
+        return jax.random.fold_in(root_key, zlib.crc32(name.encode()))
+
+    def stage(method: str, fn: Callable[[], object]) -> EstimatorResult:
+        """Run one estimator with timing + checkpointing. ``fn`` returns
+        an EstimatorResult, or (EstimatorResult, extras-dict) — extras
+        ride the checkpoint record (read back via ``ckpt.get``)."""
+        cached = ckpt.get(method)
+        if cached is not None:
+            log(f"  [resume] {method}: ate={cached['ate']:.4f}")
+            nanf = lambda v: float("nan") if v is None else v
+            res = EstimatorResult(
+                method=cached["method"], ate=cached["ate"],
+                lower_ci=nanf(cached["lower_ci"]), upper_ci=nanf(cached["upper_ci"]),
+                se=nanf(cached["se"]),
+            )
+            report.timings_s[method] = cached.get("seconds", 0.0)
+            return res
+        t0 = time.perf_counter()
+        out = fn()
+        res, extras = out if isinstance(out, tuple) else (out, {})
+        dt = time.perf_counter() - t0
+        report.timings_s[method] = dt
+        ckpt.put(dict(res.to_dict(), seconds=round(dt, 3), **extras))
+        log(f"  {method}: ate={res.ate:.4f} ci=[{res.lower_ci:.4f},{res.upper_ci:.4f}] "
+            f"({dt:.1f}s)")
+        return res
+
+    # ── The sweep, in notebook order (Rmd:128-272) ────────────────────
+    report.oracle = stage("oracle", lambda: naive_ate(df, method="oracle"))
+    add = report.results.append
+
+    add(stage("naive", lambda: naive_ate(df_mod)))
+    add(stage("Direct Method", lambda: ate_condmean_ols(df_mod)))
+
+    # Shared logistic propensity (Rmd:164-168), fit lazily so a fully
+    # checkpointed rerun never pays for it.
+    _p_log = []
+
+    def p_logistic():
+        if not _p_log:
+            _p_log.append(logistic_propensity(df_mod.x, df_mod.w))
+        return _p_log[0]
+
+    add(stage("Propensity_Weighting",
+              lambda: prop_score_weight(df_mod, p_logistic())))
+    add(stage("Propensity_Regression",
+              lambda: prop_score_ols(df_mod, p_logistic())))
+    add(stage("Propensity_Weighting_LASSOPS",
+              lambda: prop_score_weight(
+                  df_mod, prop_score_lasso(df_mod, key=key_for("ps_lasso")),
+                  method="Propensity_Weighting_LASSOPS")))
+    add(stage("Single-equation LASSO",
+              lambda: ate_condmean_lasso(df_mod, key=key_for("seq_lasso"))))
+    add(stage("Usual LASSO", lambda: ate_lasso(df_mod, key=key_for("usual_lasso"))))
+    add(stage("Doubly Robust with Random Forest PS",
+              lambda: doubly_robust(
+                  df_mod,
+                  lambda f: rf_oob_propensity(
+                      f, key=key_for("dr_rf_prop"), n_trees=config.dr_trees,
+                      depth=config.forest_depth),
+                  key=key_for("dr_rf"))))
+    add(stage("Doubly Robust with logistic regression PS",
+              lambda: doubly_robust_glm(df_mod, key=key_for("dr_glm"))))
+    add(stage("Belloni et.al", lambda: belloni(df_mod, key=key_for("belloni"))))
+    add(stage("Double Machine Learning",
+              lambda: double_ml(df_mod, n_trees=config.dml_trees,
+                                depth=config.forest_depth, key=key_for("dml"))))
+    add(stage("residual_balancing",
+              lambda: residual_balance_ate(df_mod, key=key_for("balance"))))
+
+    # Causal forest: the result row plus the notebook's 'incorrect' demo
+    # (Rmd:258-262). The demo values ride the checkpoint record as stage
+    # extras.
+    def cf_fn():
+        cf = causal_forest_report(
+            df_mod, key=key_for("causal_forest"), n_trees=config.cf_trees,
+            nuisance_trees=config.cf_nuisance_trees)
+        log(f"  Incorrect ATE: {cf.incorrect_ate:.3f} (SE: {cf.incorrect_se:.3f})"
+            f"  [deliberate negative example, Rmd:262]")
+        return cf.result, {"incorrect_ate": cf.incorrect_ate,
+                           "incorrect_se": cf.incorrect_se}
+
+    add(stage("Causal Forest(GRF)", cf_fn))
+    cf_rec = ckpt.get("Causal Forest(GRF)") or {}
+    report.incorrect_cf_ate = cf_rec.get("incorrect_ate")
+    report.incorrect_cf_se = cf_rec.get("incorrect_se")
+
+    if outdir:
+        with open(os.path.join(outdir, "report.json"), "w") as f:
+            json.dump(
+                _jsonsafe({
+                    "oracle": report.oracle.to_dict(),
+                    "results": [r.to_dict() for r in report.results],
+                    "n_dropped": report.n_dropped,
+                    "n_biased": report.n_biased,
+                    "incorrect_cf": [report.incorrect_cf_ate, report.incorrect_cf_se],
+                    "timings_s": {k: round(v, 3) for k, v in report.timings_s.items()},
+                }),
+                f, indent=1,
+            )
+    if plots and outdir:
+        from ate_replication_causalml_tpu.viz import notebook_figures
+
+        report.figure_paths = notebook_figures(
+            report.results, report.oracle, outdir)
+        log(f"figures: {report.figure_paths}")
+    return report
+
+
+def main(argv: Iterable[str] | None = None) -> SweepReport:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--out", default="results", help="output directory")
+    ap.add_argument("--csv", default=None,
+                    help="path to socialpresswgeooneperhh_NEIGH.csv (else synthetic)")
+    ap.add_argument("--quick", action="store_true", help="small smoke-run sizes")
+    ap.add_argument("--no-plots", action="store_true")
+    args = ap.parse_args(argv if argv is None else list(argv))
+
+    config = SweepConfig()
+    if args.quick:
+        config = config.quick()
+    report = run_sweep(config, csv_path=args.csv, outdir=args.out,
+                       plots=not args.no_plots)
+    print(repr(report.results))
+    return report
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+"""Comparison figures — the notebook's three ggplot pointrange charts
+(``ate_replication.Rmd:146-150, 209-213, 277-281``), the reference's only
+"dashboard" (SURVEY.md §5.5).
+
+Design deviates from the ggplot default deliberately: methods go on the
+y-axis (long labels read horizontally instead of at 45°), every estimate
+uses one hue (identity is carried by the axis label, not color), and the
+RCT oracle is drawn as a reference band behind the marks so "which CI
+brackets the truth" — the chart's actual question — is answerable at a
+glance. Matplotlib renders to PNG next to the result table.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+from ate_replication_causalml_tpu.estimators.base import EstimatorResult
+
+# Brand-neutral defaults validated for the light surface.
+_SURFACE = "#fcfcfb"
+_INK = "#0b0b0b"
+_INK_2 = "#52514e"
+_GRID = "#e4e3df"
+_ESTIMATE = "#2a78d6"   # all estimate marks — one entity class, one hue
+_ORACLE = "#eb6834"     # the reference band
+
+
+def pointrange_figure(
+    results: Sequence[EstimatorResult],
+    oracle: EstimatorResult | None = None,
+    title: str = "ATE estimates vs the RCT oracle",
+    path: str | None = None,
+):
+    """Horizontal pointrange chart of estimate ± CI per method.
+
+    ``oracle`` (the unbiased RCT difference-in-means,
+    ``ate_replication.Rmd:130``) renders as a vertical line + CI band
+    behind the marks. Returns the matplotlib Figure; saves PNG when
+    ``path`` is given.
+    """
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    rows = list(results)
+    n = len(rows)
+    fig, ax = plt.subplots(figsize=(7.2, 1.1 + 0.52 * n), dpi=150)
+    fig.patch.set_facecolor(_SURFACE)
+    ax.set_facecolor(_SURFACE)
+
+    ys = range(n - 1, -1, -1)  # first method on top
+    if oracle is not None:
+        ax.axvspan(oracle.lower_ci, oracle.upper_ci, color=_ORACLE, alpha=0.12, lw=0)
+        ax.axvline(oracle.ate, color=_ORACLE, lw=2, label=f"RCT oracle ({oracle.ate:.3f})")
+    for y, r in zip(ys, rows):
+        ax.plot([r.lower_ci, r.upper_ci], [y, y], color=_ESTIMATE, lw=2,
+                solid_capstyle="round", zorder=3)
+        ax.plot([r.ate], [y], "o", color=_ESTIMATE, ms=7, zorder=4)
+    ax.set_yticks(list(ys))
+    ax.set_yticklabels([r.method for r in rows], fontsize=9, color=_INK)
+    ax.set_xlabel("ATE (95% CI)", fontsize=9, color=_INK_2)
+    ax.set_title(title, fontsize=11, color=_INK, loc="left", pad=12)
+    ax.grid(axis="x", color=_GRID, lw=0.8)
+    for side in ("top", "right", "left"):
+        ax.spines[side].set_visible(False)
+    ax.spines["bottom"].set_color(_GRID)
+    ax.tick_params(colors=_INK_2, labelsize=8)
+    if oracle is not None:
+        ax.legend(loc="upper right", frameon=False, fontsize=8, labelcolor=_INK_2)
+    fig.tight_layout()
+    if path is not None:
+        fig.savefig(path, facecolor=_SURFACE)
+        plt.close(fig)
+    return fig
+
+
+def notebook_figures(
+    results: Iterable[EstimatorResult],
+    oracle: EstimatorResult,
+    outdir: str,
+) -> list[str]:
+    """The notebook's three charts, same stage boundaries:
+    ``rct_naive_plot`` (oracle + naive), ``compare_regression``
+    (through the LASSO family), ``compare_CausalML`` (everything)."""
+    import os
+
+    rows = list(results)
+    by_method = {r.method: r for r in rows}
+    paths = []
+
+    def save(name, subset, title):
+        p = os.path.join(outdir, f"{name}.png")
+        pointrange_figure(subset, oracle=oracle, title=title, path=p)
+        paths.append(p)
+
+    naive = [by_method[m] for m in ("naive",) if m in by_method]
+    save("rct_naive_plot", naive, "Naive estimate on the biased sample vs RCT oracle")
+
+    regression_methods = (
+        "naive", "Direct Method", "Propensity_Weighting", "Propensity_Regression",
+        "Propensity_Weighting_LASSOPS", "Single-equation LASSO", "Usual LASSO",
+    )
+    reg = [by_method[m] for m in regression_methods if m in by_method]
+    save("compare_regression", reg, "Regression extensions vs RCT oracle")
+
+    save("compare_CausalML", rows, "All estimators vs RCT oracle")
+    return paths

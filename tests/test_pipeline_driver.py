@@ -1,0 +1,80 @@
+"""L5 driver tests: the full sweep on tiny sizes, checkpoint/resume
+semantics, and figure output (``ate_replication.Rmd`` end-to-end,
+SURVEY.md §3.1)."""
+
+import dataclasses
+import json
+import os
+
+from ate_replication_causalml_tpu.data.pipeline import PrepConfig
+from ate_replication_causalml_tpu.pipeline import SweepConfig, run_sweep
+
+TINY = dataclasses.replace(
+    SweepConfig().quick(),
+    prep=PrepConfig(n_obs=3000),
+    synthetic_pool=6000,
+    dr_trees=50, dml_trees=50, cf_trees=50, cf_nuisance_trees=50,
+    forest_depth=5,
+)
+
+EXPECTED_METHODS = [
+    "naive", "Direct Method", "Propensity_Weighting", "Propensity_Regression",
+    "Propensity_Weighting_LASSOPS", "Single-equation LASSO", "Usual LASSO",
+    "Doubly Robust with Random Forest PS",
+    "Doubly Robust with logistic regression PS", "Belloni et.al",
+    "Double Machine Learning", "residual_balancing", "Causal Forest(GRF)",
+]
+
+
+def test_full_sweep_and_resume(tmp_path):
+    out = str(tmp_path / "sweep")
+    logs = []
+    report = run_sweep(TINY, outdir=out, plots=True, log=logs.append)
+
+    # All 13 estimator rows in notebook order, plus the oracle.
+    assert report.results.methods() == EXPECTED_METHODS
+    assert report.oracle.method == "oracle"
+    assert report.n_dropped > 0 and report.n_biased > 0
+    assert report.incorrect_cf_ate is not None
+    # The synthetic RCT oracle should land near the generator's target.
+    assert abs(report.oracle.ate - TINY.true_ate) < 0.06
+    # Outputs on disk: results, report, three figures.
+    assert os.path.exists(os.path.join(out, "results.jsonl"))
+    rep = json.load(open(os.path.join(out, "report.json")))
+    assert len(rep["results"]) == len(EXPECTED_METHODS)
+    assert len(report.figure_paths) == 3
+    for p in report.figure_paths:
+        assert os.path.getsize(p) > 10_000
+
+    # Resume: every stage must come from the checkpoint, same numbers.
+    logs2 = []
+    report2 = run_sweep(TINY, outdir=out, plots=False, log=logs2.append)
+    resumed = [l for l in logs2 if "[resume]" in l]
+    assert len(resumed) == len(EXPECTED_METHODS) + 1  # + oracle
+    for m in EXPECTED_METHODS:
+        assert abs(report2.results[m].ate - report.results[m].ate) < 1e-12
+    assert report2.incorrect_cf_ate == report.incorrect_cf_ate
+
+
+def test_changed_config_invalidates_checkpoint(tmp_path):
+    out = str(tmp_path / "sweep")
+    run_sweep(TINY, outdir=out, plots=False, log=lambda s: None)
+    # report.json must be strict JSON (the no-SE LASSO rows carry NaN
+    # internally; on disk they must be null).
+    import json as _json
+
+    txt = open(os.path.join(out, "report.json")).read()
+    assert "NaN" not in txt
+    _json.loads(txt)
+
+    changed = dataclasses.replace(TINY, dr_trees=TINY.dr_trees + 1)
+    logs = []
+    run_sweep(changed, outdir=out, plots=False, log=logs.append)
+    assert not any("[resume]" in l for l in logs)
+    assert any("different config" in l for l in logs)
+    assert os.path.exists(os.path.join(out, "results.jsonl.stale"))
+
+
+def test_sweep_no_outdir_runs_in_memory():
+    report = run_sweep(TINY, outdir=None, plots=False, log=lambda s: None)
+    assert len(report.results) == len(EXPECTED_METHODS)
