@@ -62,7 +62,9 @@ from ate_replication_causalml_tpu.models.forest import (
     forest_oob_mean,
     pick_chunk,
     quantile_bins,
+    resolve_hist_backend,
 )
+from ate_replication_causalml_tpu.ops.hist_pallas import bin_histogram
 from ate_replication_causalml_tpu.ops.linalg import _PREC
 
 _EPS = 1e-12
@@ -145,6 +147,7 @@ def _node_tau(mom: jax.Array):
     static_argnames=(
         "n_trees", "depth", "mtry", "n_bins", "min_node",
         "ci_group_size", "honesty", "group_chunk", "sample_fraction",
+        "hist_backend",
     ),
 )
 def grow_causal_forest(
@@ -161,6 +164,7 @@ def grow_causal_forest(
     ci_group_size: int = 2,
     honesty: bool = True,
     group_chunk: int = 16,
+    hist_backend: str = "auto",
 ) -> CausalForest:
     """Grow the causal forest on *centered* treatment/outcome residuals.
 
@@ -176,9 +180,10 @@ def grow_causal_forest(
     mtry = min(mtry, p)
     k = ci_group_size
     n_groups = -(-n_trees // k)
+    hist_backend = resolve_hist_backend(hist_backend)
     edges = quantile_bins(x, n_bins)
     codes = binarize(x, edges)
-    xb_onehot = bin_onehot(codes, n_bins)
+    xb_onehot = bin_onehot(codes, n_bins) if hist_backend == "onehot" else None
     mom_stack = _moments_stack(wt, yt)  # (n, 5)
     s = max(2, int(n * sample_fraction))
     max_nodes = 1 << (depth - 1)
@@ -202,12 +207,22 @@ def grow_causal_forest(
             yc = yt - ybar[node_of_row]
             rho = wc * (yc - wc * tau[node_of_row])
 
-            hist_c = jnp.matmul(gw_oh.T, xb_onehot, precision=_PREC).reshape(
-                max_nodes, p, n_bins
-            )
-            hist_r = jnp.matmul(
-                (gw_oh * rho[:, None]).T, xb_onehot, precision=_PREC
-            ).reshape(max_nodes, p, n_bins)
+            if hist_backend == "onehot":
+                hist_c = jnp.matmul(gw_oh.T, xb_onehot, precision=_PREC).reshape(
+                    max_nodes, p, n_bins
+                )
+                hist_r = jnp.matmul(
+                    (gw_oh * rho[:, None]).T, xb_onehot, precision=_PREC
+                ).reshape(max_nodes, p, n_bins)
+            else:
+                hist_c, hist_r = bin_histogram(
+                    codes,
+                    node_of_row,
+                    jnp.stack([gw, gw * rho]),
+                    max_nodes=max_nodes,
+                    n_bins=n_bins,
+                    backend=hist_backend,
+                )
 
             cl = jnp.cumsum(hist_c, axis=2)
             rl = jnp.cumsum(hist_r, axis=2)
@@ -282,6 +297,7 @@ def fit_causal_forest(
     depth: int = 8,
     nuisance_trees: int = 500,
     nuisance_depth: int = 9,
+    hist_backend: str = "auto",
     **grow_kwargs,
 ) -> FittedCausalForest:
     """End-to-end grf-equivalent fit: OOB nuisance forests for Ŷ, Ŵ,
@@ -291,12 +307,17 @@ def fit_causal_forest(
         key = jax.random.key(12345)  # the seed grf is given (Rmd:255)
     ky, kw, kc = jax.random.split(key, 3)
     x, w, y = frame.x, frame.w, frame.y
-    fy = fit_forest_regressor(x, y, ky, n_trees=nuisance_trees, depth=nuisance_depth)
-    fw = fit_forest_regressor(x, w, kw, n_trees=nuisance_trees, depth=nuisance_depth)
+    fy = fit_forest_regressor(
+        x, y, ky, n_trees=nuisance_trees, depth=nuisance_depth, hist_backend=hist_backend
+    )
+    fw = fit_forest_regressor(
+        x, w, kw, n_trees=nuisance_trees, depth=nuisance_depth, hist_backend=hist_backend
+    )
     y_hat = forest_oob_mean(fy, x)
     w_hat = forest_oob_mean(fw, x)
     forest = grow_causal_forest(
-        x, w - w_hat, y - y_hat, kc, n_trees=n_trees, depth=depth, **grow_kwargs
+        x, w - w_hat, y - y_hat, kc, n_trees=n_trees, depth=depth,
+        hist_backend=hist_backend, **grow_kwargs,
     )
     return FittedCausalForest(forest=forest, y_hat=y_hat, w_hat=w_hat, x=x, y=y, w=w)
 

@@ -42,6 +42,10 @@ from jax import lax
 
 from ate_replication_causalml_tpu.data.frame import CausalFrame
 from ate_replication_causalml_tpu.ops.bootstrap import _poisson1_counts
+from ate_replication_causalml_tpu.ops.hist_pallas import (
+    bin_histogram,
+    resolve_hist_backend,
+)
 from ate_replication_causalml_tpu.ops.linalg import _PREC
 
 
@@ -122,7 +126,8 @@ class ForestPredictions(NamedTuple):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_trees", "depth", "mtry", "n_bins", "tree_chunk")
+    jax.jit,
+    static_argnames=("n_trees", "depth", "mtry", "n_bins", "tree_chunk", "hist_backend"),
 )
 def fit_forest_classifier(
     x: jax.Array,
@@ -133,19 +138,22 @@ def fit_forest_classifier(
     mtry: int | None = None,
     n_bins: int = 64,
     tree_chunk: int = 32,
+    hist_backend: str = "auto",
 ) -> Forest:
     """Fit a classification forest of ``n_trees`` depth-``depth`` trees.
 
     mtry defaults to floor(sqrt(p)) (randomForest's classification
     default). Trees are grown in chunks of ``tree_chunk`` via ``lax.map``
-    (bounded memory), vmapped within a chunk.
+    (bounded memory), vmapped within a chunk. ``hist_backend`` selects
+    the split-histogram implementation (see :func:`resolve_hist_backend`).
     """
     n, p = x.shape
     if mtry is None:
         mtry = max(1, int(np.sqrt(p)))
+    hist_backend = resolve_hist_backend(hist_backend)
     edges = quantile_bins(x, n_bins)
     codes = binarize(x, edges)  # (n, p) int32
-    xb_onehot = bin_onehot(codes, n_bins)
+    xb_onehot = bin_onehot(codes, n_bins) if hist_backend == "onehot" else None
     yf = y.astype(jnp.float32)
     max_nodes = 1 << (depth - 1)
     n_leaves = 1 << depth
@@ -156,13 +164,23 @@ def fit_forest_classifier(
 
         def level_step(node_of_row, lk):
             level_nodes = max_nodes  # padded width, ids stay < 2^level
-            node_oh = jax.nn.one_hot(node_of_row, level_nodes, dtype=jnp.float32)
-            hist_c = jnp.matmul(
-                (node_oh * counts[:, None]).T, xb_onehot, precision=_PREC
-            ).reshape(level_nodes, p, n_bins)
-            hist_y = jnp.matmul(
-                (node_oh * (counts * yf)[:, None]).T, xb_onehot, precision=_PREC
-            ).reshape(level_nodes, p, n_bins)
+            if hist_backend == "onehot":
+                node_oh = jax.nn.one_hot(node_of_row, level_nodes, dtype=jnp.float32)
+                hist_c = jnp.matmul(
+                    (node_oh * counts[:, None]).T, xb_onehot, precision=_PREC
+                ).reshape(level_nodes, p, n_bins)
+                hist_y = jnp.matmul(
+                    (node_oh * (counts * yf)[:, None]).T, xb_onehot, precision=_PREC
+                ).reshape(level_nodes, p, n_bins)
+            else:
+                hist_c, hist_y = bin_histogram(
+                    codes,
+                    node_of_row,
+                    jnp.stack([counts, counts * yf]),
+                    max_nodes=level_nodes,
+                    n_bins=n_bins,
+                    backend=hist_backend,
+                )
 
             cl = jnp.cumsum(hist_c, axis=2)
             yl = jnp.cumsum(hist_y, axis=2)
@@ -287,6 +305,7 @@ def fit_forest_regressor(
     mtry: int | None = None,
     n_bins: int = 64,
     tree_chunk: int = 32,
+    hist_backend: str = "auto",
 ) -> Forest:
     """Regression forest — same engine as the classifier (the split
     score is SSE-reduction, see ``level_step``), leaf values are
@@ -301,7 +320,7 @@ def fit_forest_regressor(
         mtry = max(1, x.shape[1] // 3)
     return fit_forest_classifier(
         x, y, key, n_trees=n_trees, depth=depth, mtry=mtry,
-        n_bins=n_bins, tree_chunk=tree_chunk,
+        n_bins=n_bins, tree_chunk=tree_chunk, hist_backend=hist_backend,
     )
 
 

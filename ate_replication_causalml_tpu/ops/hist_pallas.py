@@ -1,0 +1,240 @@
+"""Pallas TPU kernel: weighted bin-histogram build for forest split search.
+
+This is THE hot op of both forest engines (SURVEY.md §2.3 — the Fortran
+CART core behind ``randomForest`` and the grf C++ honest-split core).
+Each tree level needs, per (node, feature, bin) cell, the total bootstrap
+weight and the total weighted target:
+
+    hist[k, m, f, b] = Σ_rows  w[k, row] · 1[node(row) = m] · 1[code(row, f) = b]
+
+The pure-XLA formulation (models/forest.py) computes this as
+``(node_onehot · w)ᵀ @ bin_onehot`` with the bin one-hot materialised
+once in HBM — fine at the reference's 8.9k rows, but the one-hot is
+``n × p·n_bins`` f32, i.e. **~5.4 GB at the 1M-row north-star scale**
+(BASELINE.md). This kernel never materialises it: rows stream through
+VMEM in tiles, both one-hots are built tile-wise with ``broadcasted_iota``
+comparisons (VPU), and the per-tile contraction runs on the MXU,
+accumulating into a VMEM-resident histogram block across the sequential
+grid. HBM traffic drops from O(n·p·n_bins) to O(n·p) — the raw codes.
+
+Layout notes (pallas_guide.md):
+  * last dim of every VMEM block is a multiple of 128 lanes: the
+    histogram's trailing axis is ``p·n_bins`` (padded to 128); the
+    row-tile axis (sublanes) is the contraction axis of the MXU matmul;
+  * iota is always ≥2D (``broadcasted_iota``);
+  * the output BlockSpec maps every grid step to block (0, 0, 0) so the
+    accumulator stays VMEM-resident; it is zeroed at step 0 via
+    ``pl.when`` (standard sequential-grid accumulation pattern).
+
+CPU tests run the same kernel with ``interpret=True`` (tests/conftest.py
+forces the CPU backend); ``backend="auto"`` picks the compiled kernel on
+TPU and the chunked-XLA fallback elsewhere.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def resolve_hist_backend(backend: str, allow_onehot: bool = True) -> str:
+    """The single place the 'auto' policy lives: the compiled Pallas
+    kernel on TPU; elsewhere the shared-one-hot XLA matmul when the
+    caller supports it (the forest engines, fastest at reference scale
+    on CPU), else the chunked-XLA fallback."""
+    if backend == "auto":
+        if jax.default_backend() == "tpu":
+            return "pallas"
+        return "onehot" if allow_onehot else "xla"
+    return backend
+
+
+def _hist_kernel(codes_ref, node_ref, w_ref, out_ref, *, n_weights, max_nodes, p, n_bins):
+    """One grid step: fold a row tile into the resident histogram.
+
+    codes_ref: (TILE, p_pad) int32    — bin codes, padded features are 0
+    node_ref:  (TILE, 1)   int32      — node id per row (padded rows: -1)
+    w_ref:     (n_weights, TILE) f32  — weight vectors (padded rows: 0)
+    out_ref:   (n_weights * max_nodes, pb_pad) f32 — accumulator
+    """
+    tile = codes_ref.shape[0]
+    pb_pad = out_ref.shape[-1]
+
+    @pl.when(pl.program_id(0) == 0)
+    def _zero():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    # Node one-hot: (TILE, max_nodes). Padded rows carry node=-1 → all 0.
+    node_iota = lax.broadcasted_iota(jnp.int32, (tile, max_nodes), 1)
+    node_oh = (node_ref[:] == node_iota).astype(jnp.float32)
+
+    # Bin one-hot: (TILE, pb_pad), one 1 per real feature block. Built in
+    # one shot from the flat index code + f·n_bins — padded lanes ≥ p·n_bins
+    # match nothing because real flat codes are < p·n_bins.
+    feat_iota = lax.broadcasted_iota(jnp.int32, (tile, p), 1)
+    flat_code = codes_ref[:, :p] + feat_iota * n_bins  # (TILE, p)
+    lane_iota = lax.broadcasted_iota(jnp.int32, (tile, pb_pad), 1)
+    bin_oh = jnp.zeros((tile, pb_pad), jnp.float32)
+    for f in range(p):  # p is small (21 in the GGL schema) — static unroll
+        bin_oh = bin_oh + (lane_iota == flat_code[:, f : f + 1]).astype(jnp.float32)
+
+    # Weighted node one-hots for every weight vector, stacked on the
+    # sublane axis: (n_weights·max_nodes, TILE) @ (TILE, pb_pad) on MXU.
+    lhs = jnp.concatenate(
+        [node_oh * w_ref[k, :][:, None] for k in range(n_weights)], axis=1
+    )  # (TILE, n_weights*max_nodes)
+    out_ref[:] += lax.dot_general(
+        lhs,
+        bin_oh,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_nodes", "n_bins", "tile", "interpret")
+)
+def bin_histogram_pallas(
+    codes: jax.Array,
+    node_of_row: jax.Array,
+    weights: jax.Array,
+    *,
+    max_nodes: int,
+    n_bins: int,
+    tile: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """Weighted (node, feature, bin) histograms via the Pallas kernel.
+
+    Args:
+      codes: (n, p) int32 bin codes in [0, n_bins).
+      node_of_row: (n,) int32 node ids in [0, max_nodes); rows with ids
+        outside the range contribute nothing.
+      weights: (K, n) f32 — e.g. (counts, counts·y) for the classifier,
+        (counts, counts·ρ) for the causal forest's gradient splits.
+
+    Returns:
+      (K, max_nodes, p, n_bins) f32.
+    """
+    n, p = codes.shape
+    k_w = weights.shape[0]
+    pb = p * n_bins
+    pb_pad = _round_up(pb, _LANES)
+    n_pad = _round_up(max(n, tile), tile)
+
+    codes = jnp.pad(codes, ((0, n_pad - n), (0, 0)))
+    node2d = jnp.pad(
+        node_of_row.astype(jnp.int32)[:, None], ((0, n_pad - n), (0, 0)),
+        constant_values=-1,
+    )
+    weights = jnp.pad(weights.astype(jnp.float32), ((0, 0), (0, n_pad - n)))
+
+    grid = (n_pad // tile,)
+    out = pl.pallas_call(
+        functools.partial(
+            _hist_kernel, n_weights=k_w, max_nodes=max_nodes, p=p, n_bins=n_bins
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile, p), lambda i: (i, 0)),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+            pl.BlockSpec((k_w, tile), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((k_w * max_nodes, pb_pad), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((k_w * max_nodes, pb_pad), jnp.float32),
+        interpret=interpret,
+    )(codes, node2d, weights)
+    return out[:, :pb].reshape(k_w, max_nodes, p, n_bins)
+
+
+@functools.partial(jax.jit, static_argnames=("max_nodes", "n_bins", "row_chunk"))
+def bin_histogram_xla(
+    codes: jax.Array,
+    node_of_row: jax.Array,
+    weights: jax.Array,
+    *,
+    max_nodes: int,
+    n_bins: int,
+    row_chunk: int = 65536,
+) -> jax.Array:
+    """Chunked-XLA fallback with the same contract as the kernel: scans
+    row chunks so the bin one-hot never exceeds ``row_chunk × p·n_bins``
+    (memory-safe at 1M rows, unlike the monolithic one-hot)."""
+    n, p = codes.shape
+    k_w = weights.shape[0]
+    n_pad = _round_up(max(n, 1), row_chunk) if n > row_chunk else n
+    if n_pad != n:
+        codes = jnp.pad(codes, ((0, n_pad - n), (0, 0)))
+        node_of_row = jnp.pad(node_of_row, (0, n_pad - n), constant_values=-1)
+        weights = jnp.pad(weights, ((0, 0), (0, n_pad - n)))
+    if n_pad <= row_chunk:
+        return _hist_chunk_xla(codes, node_of_row, weights, max_nodes, n_bins)
+
+    n_chunks = n_pad // row_chunk
+    codes_c = codes.reshape(n_chunks, row_chunk, p)
+    node_c = node_of_row.reshape(n_chunks, row_chunk)
+    w_c = weights.reshape(k_w, n_chunks, row_chunk).transpose(1, 0, 2)
+
+    def step(acc, chunk):
+        c, m, w = chunk
+        return acc + _hist_chunk_xla(c, m, w, max_nodes, n_bins), None
+
+    init = jnp.zeros((k_w, max_nodes, p, n_bins), jnp.float32)
+    acc, _ = lax.scan(step, init, (codes_c, node_c, w_c))
+    return acc
+
+
+def _hist_chunk_xla(codes, node_of_row, weights, max_nodes, n_bins):
+    n, p = codes.shape
+    k_w = weights.shape[0]
+    flat = codes + jnp.arange(p, dtype=jnp.int32)[None, :] * n_bins
+    bin_oh = (
+        jnp.zeros((n, p * n_bins), jnp.float32)
+        .at[jnp.arange(n)[:, None], flat]
+        .set(1.0)
+    )
+    node_oh = jax.nn.one_hot(node_of_row, max_nodes, dtype=jnp.float32)
+    lhs = (node_oh[None, :, :] * weights[:, :, None]).reshape(k_w, n, max_nodes)
+    out = jnp.einsum("knm,nb->kmb", lhs, bin_oh)
+    return out.reshape(k_w, max_nodes, p, n_bins)
+
+
+def bin_histogram(
+    codes: jax.Array,
+    node_of_row: jax.Array,
+    weights: jax.Array,
+    *,
+    max_nodes: int,
+    n_bins: int,
+    backend: str = "auto",
+) -> jax.Array:
+    """Dispatch: compiled Pallas kernel on TPU, chunked XLA elsewhere.
+
+    ``backend``: "auto" | "pallas" | "pallas_interpret" | "xla".
+    """
+    backend = resolve_hist_backend(backend, allow_onehot=False)
+    if backend == "pallas":
+        return bin_histogram_pallas(
+            codes, node_of_row, weights, max_nodes=max_nodes, n_bins=n_bins
+        )
+    if backend == "pallas_interpret":
+        return bin_histogram_pallas(
+            codes, node_of_row, weights, max_nodes=max_nodes, n_bins=n_bins,
+            interpret=True,
+        )
+    if backend == "xla":
+        return bin_histogram_xla(
+            codes, node_of_row, weights, max_nodes=max_nodes, n_bins=n_bins
+        )
+    raise ValueError(f"unknown histogram backend {backend!r}")

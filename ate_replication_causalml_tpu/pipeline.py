@@ -132,8 +132,17 @@ class _Checkpoint:
         self.path = path
         self.done: dict[str, dict] = {}
         if path and os.path.exists(path):
+            recs = []
             with open(path) as f:
-                recs = [json.loads(l) for l in f if l.strip()]
+                for line in f:
+                    if not line.strip():
+                        continue
+                    try:
+                        recs.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        # A kill mid-append leaves a truncated last line;
+                        # completed rows before it are still good.
+                        log(f"checkpoint {path}: skipping unparsable line")
             header = next((r for r in recs if r.get("method") == "__config__"), None)
             if header is None or header.get("fingerprint") != fingerprint:
                 stale = path + ".stale"

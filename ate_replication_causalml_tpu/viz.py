@@ -38,14 +38,16 @@ def pointrange_figure(
     behind the marks. Returns the matplotlib Figure; saves PNG when
     ``path`` is given.
     """
-    import matplotlib
-
-    matplotlib.use("Agg")
-    import matplotlib.pyplot as plt
+    # Agg canvas bound to this figure only — never touches the process-
+    # global backend (a notebook user's interactive backend stays live).
+    from matplotlib.backends.backend_agg import FigureCanvasAgg
+    from matplotlib.figure import Figure
 
     rows = list(results)
     n = len(rows)
-    fig, ax = plt.subplots(figsize=(7.2, 1.1 + 0.52 * n), dpi=150)
+    fig = Figure(figsize=(7.2, 1.1 + 0.52 * n), dpi=150)
+    FigureCanvasAgg(fig)
+    ax = fig.add_subplot(111)
     fig.patch.set_facecolor(_SURFACE)
     ax.set_facecolor(_SURFACE)
 
@@ -71,7 +73,6 @@ def pointrange_figure(
     fig.tight_layout()
     if path is not None:
         fig.savefig(path, facecolor=_SURFACE)
-        plt.close(fig)
     return fig
 
 

@@ -1,0 +1,149 @@
+"""Pallas histogram kernel vs chunked-XLA reference vs numpy truth.
+
+The kernel runs in interpret mode here (conftest forces the CPU
+backend); on TPU the same kernel compiles via Mosaic.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ate_replication_causalml_tpu.ops.hist_pallas import (
+    bin_histogram,
+    bin_histogram_pallas,
+    bin_histogram_xla,
+)
+
+
+def _numpy_hist(codes, node, weights, max_nodes, n_bins):
+    k_w, n = weights.shape
+    p = codes.shape[1]
+    out = np.zeros((k_w, max_nodes, p, n_bins), np.float64)
+    for i in range(n):
+        m = node[i]
+        if 0 <= m < max_nodes:
+            for f in range(p):
+                out[:, m, f, codes[i, f]] += weights[:, i]
+    return out
+
+
+@pytest.fixture(scope="module")
+def case():
+    rng = np.random.default_rng(0)
+    n, p, n_bins, max_nodes = 1000, 7, 16, 8
+    codes = rng.integers(0, n_bins, (n, p)).astype(np.int32)
+    node = rng.integers(0, max_nodes, n).astype(np.int32)
+    weights = rng.poisson(1.0, (2, n)).astype(np.float32)
+    weights[1] *= rng.uniform(-1, 1, n).astype(np.float32)
+    return codes, node, weights, max_nodes, n_bins
+
+
+def test_pallas_interpret_matches_numpy(case):
+    codes, node, weights, max_nodes, n_bins = case
+    truth = _numpy_hist(codes, node, weights, max_nodes, n_bins)
+    got = bin_histogram_pallas(
+        jnp.asarray(codes), jnp.asarray(node), jnp.asarray(weights),
+        max_nodes=max_nodes, n_bins=n_bins, tile=256, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got), truth, rtol=0, atol=1e-4)
+
+
+def test_xla_fallback_matches_numpy(case):
+    codes, node, weights, max_nodes, n_bins = case
+    truth = _numpy_hist(codes, node, weights, max_nodes, n_bins)
+    got = bin_histogram_xla(
+        jnp.asarray(codes), jnp.asarray(node), jnp.asarray(weights),
+        max_nodes=max_nodes, n_bins=n_bins,
+    )
+    np.testing.assert_allclose(np.asarray(got), truth, rtol=0, atol=1e-4)
+
+
+def test_xla_chunked_path(case):
+    codes, node, weights, max_nodes, n_bins = case
+    truth = _numpy_hist(codes, node, weights, max_nodes, n_bins)
+    got = bin_histogram_xla(
+        jnp.asarray(codes), jnp.asarray(node), jnp.asarray(weights),
+        max_nodes=max_nodes, n_bins=n_bins, row_chunk=128,
+    )
+    np.testing.assert_allclose(np.asarray(got), truth, rtol=0, atol=1e-4)
+
+
+def test_out_of_range_nodes_drop(case):
+    codes, node, weights, max_nodes, n_bins = case
+    node = node.copy()
+    node[:100] = -1  # padded/inactive rows must contribute nothing
+    truth = _numpy_hist(codes, node, weights, max_nodes, n_bins)
+    for backend in ("pallas_interpret", "xla"):
+        got = bin_histogram(
+            jnp.asarray(codes), jnp.asarray(node), jnp.asarray(weights),
+            max_nodes=max_nodes, n_bins=n_bins, backend=backend,
+        )
+        np.testing.assert_allclose(np.asarray(got), truth, rtol=0, atol=1e-4)
+
+
+def test_vmap_over_trees(case):
+    """The forest engine vmaps the histogram over a tree chunk — node ids
+    and weights are per-tree, codes shared."""
+    codes, node, weights, max_nodes, n_bins = case
+    rng = np.random.default_rng(1)
+    nodes_t = np.stack([node, rng.integers(0, max_nodes, node.shape[0]).astype(np.int32)])
+    weights_t = np.stack([weights, rng.poisson(1.0, weights.shape).astype(np.float32)])
+
+    def one(nd, w):
+        return bin_histogram_pallas(
+            jnp.asarray(codes), nd, w, max_nodes=max_nodes, n_bins=n_bins,
+            tile=256, interpret=True,
+        )
+
+    got = jax.vmap(one)(jnp.asarray(nodes_t), jnp.asarray(weights_t))
+    for t in range(2):
+        truth = _numpy_hist(codes, nodes_t[t], weights_t[t], max_nodes, n_bins)
+        np.testing.assert_allclose(np.asarray(got[t]), truth, rtol=0, atol=1e-4)
+
+
+def test_forest_identical_across_backends():
+    """Same key → bit-identical splits and leaves whether the level
+    histograms come from the Pallas kernel (interpret), the chunked-XLA
+    path, or the shared-one-hot matmul.
+
+    Bit-identity holds everywhere for *integer-weight* channels (counts,
+    counts·y∈{0,1} — exact in f32 in any summation order). For the causal
+    forest's continuous ρ channel it holds on CPU but is tolerance-level
+    on real TPU (~2e-3 relative accumulation-order noise, which can flip
+    near-tie splits); the downstream ATE was verified statistically
+    equivalent across backends on TPU (0.4391 vs 0.4394, SE 0.034)."""
+    from ate_replication_causalml_tpu.models.forest import fit_forest_classifier
+
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(400, 6)).astype(np.float32))
+    y = jnp.asarray((rng.uniform(size=400) < 0.4).astype(np.float32))
+    key = jax.random.key(11)
+    kw = dict(n_trees=4, depth=4, n_bins=16, tree_chunk=4)
+    ref = fit_forest_classifier(x, y, key, hist_backend="onehot", **kw)
+    for backend in ("pallas_interpret", "xla"):
+        got = fit_forest_classifier(x, y, key, hist_backend=backend, **kw)
+        np.testing.assert_array_equal(np.asarray(got.split_feat), np.asarray(ref.split_feat))
+        np.testing.assert_array_equal(np.asarray(got.split_bin), np.asarray(ref.split_bin))
+        np.testing.assert_allclose(
+            np.asarray(got.leaf_value), np.asarray(ref.leaf_value), atol=1e-5
+        )
+
+
+def test_causal_forest_identical_across_backends():
+    from ate_replication_causalml_tpu.models.causal_forest import grow_causal_forest
+
+    rng = np.random.default_rng(4)
+    n = 300
+    x = jnp.asarray(rng.normal(size=(n, 5)).astype(np.float32))
+    wt = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    yt = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    key = jax.random.key(5)
+    kw = dict(n_trees=4, depth=4, n_bins=16, group_chunk=2)
+    ref = grow_causal_forest(x, wt, yt, key, hist_backend="onehot", **kw)
+    got = grow_causal_forest(x, wt, yt, key, hist_backend="pallas_interpret", **kw)
+    np.testing.assert_array_equal(np.asarray(got.split_feat), np.asarray(ref.split_feat))
+    np.testing.assert_array_equal(np.asarray(got.split_bin), np.asarray(ref.split_bin))
+    np.testing.assert_allclose(
+        np.asarray(got.leaf_stats), np.asarray(ref.leaf_stats), atol=1e-4
+    )
