@@ -46,21 +46,32 @@ def load_raw_csv(path: str, schema: DatasetSchema = GGL_SCHEMA) -> dict[str, np.
     reference and downloaded separately (``Rmd:30``); this loader accepts
     it — or any CSV with the schema's columns. Non-numeric entries (R's
     ``NA`` strings, blanks) become NaN and are dropped later by
-    ``prepare_dataset``'s na.omit stage.
+    ``prepare_dataset``'s na.omit stage. Parsing uses the native C++
+    reader when available (the 229k-row GGL panel in ~0.1 s), with
+    ``np.genfromtxt`` as the fallback.
     """
-    with open(path, "r") as f:
-        header = [h.strip().strip('"') for h in f.readline().rstrip("\n").split(",")]
+    from ate_replication_causalml_tpu.native import native_available, read_csv_native
+
+    if native_available():
+        header, data = read_csv_native(path)
+        header = [h.strip() for h in header]
+    else:
+        with open(path, "r") as f:
+            header = [h.strip().strip('"') for h in f.readline().rstrip("\n").split(",")]
+        data = None
     wanted = set(schema.all_columns)
     missing = wanted - set(header)
     if missing:
         raise ValueError(f"CSV {path} is missing columns: {sorted(missing)}")
     usecols = [i for i, h in enumerate(header) if h in wanted]
-    data = np.genfromtxt(
-        path, delimiter=",", skip_header=1, usecols=usecols,
-        dtype=np.float64, missing_values=("NA", "", "NaN"), filling_values=np.nan,
-    )
-    data = np.atleast_2d(data)
-    return {header[c]: data[:, j] for j, c in enumerate(usecols)}
+    if data is None:
+        data = np.genfromtxt(
+            path, delimiter=",", skip_header=1, usecols=usecols,
+            dtype=np.float64, missing_values=("NA", "", "NaN"), filling_values=np.nan,
+        )
+        data = np.atleast_2d(data)
+        return {header[c]: data[:, j] for j, c in enumerate(usecols)}
+    return {header[c]: np.ascontiguousarray(data[:, c]) for c in usecols}
 
 
 def _zscore(col: np.ndarray) -> np.ndarray:
@@ -91,7 +102,9 @@ def prepare_dataset(
         dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
     n_raw = len(raw[schema.treatment])
     if rng is None:
-        rng = RCompatRNG(config.seed, sample_kind=config.sample_kind)
+        from ate_replication_causalml_tpu.native import make_rcompat_rng
+
+        rng = make_rcompat_rng(config.seed, sample_kind=config.sample_kind)
     idx = rng.sample_n_rows(n_raw, min(config.n_obs, n_raw))
 
     cols: dict[str, np.ndarray] = {}

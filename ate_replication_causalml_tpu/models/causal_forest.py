@@ -198,8 +198,8 @@ def grow_causal_forest(
             gw = ew = in_mask.astype(jnp.float32)
         split_key = jax.random.split(tree_key, depth + 1)[1:]
 
-        def level_step(node_of_row, lk):
-            node_oh = jax.nn.one_hot(node_of_row, max_nodes, dtype=jnp.float32)
+        def level_step(node_of_row, lk, level_nodes):
+            node_oh = jax.nn.one_hot(node_of_row, level_nodes, dtype=jnp.float32)
             gw_oh = node_oh * gw[:, None]
             mom = jnp.matmul(gw_oh.T, mom_stack, precision=_PREC)  # (M, 5)
             wbar, ybar, tau = _node_tau(mom)
@@ -209,17 +209,17 @@ def grow_causal_forest(
 
             if hist_backend == "onehot":
                 hist_c = jnp.matmul(gw_oh.T, xb_onehot, precision=_PREC).reshape(
-                    max_nodes, p, n_bins
+                    level_nodes, p, n_bins
                 )
                 hist_r = jnp.matmul(
                     (gw_oh * rho[:, None]).T, xb_onehot, precision=_PREC
-                ).reshape(max_nodes, p, n_bins)
+                ).reshape(level_nodes, p, n_bins)
             else:
                 hist_c, hist_r = bin_histogram(
                     codes,
                     node_of_row,
                     jnp.stack([gw, gw * rho]),
-                    max_nodes=max_nodes,
+                    max_nodes=level_nodes,
                     n_bins=n_bins,
                     backend=hist_backend,
                 )
@@ -235,11 +235,11 @@ def grow_causal_forest(
             )
             score = jnp.where((cl >= min_node) & (cr >= min_node), score, jnp.inf)
 
-            feat_scores = jax.random.uniform(lk, (max_nodes, p))
+            feat_scores = jax.random.uniform(lk, (level_nodes, p))
             kth = jnp.sort(feat_scores, axis=1)[:, mtry - 1 : mtry]
             score = jnp.where((feat_scores <= kth)[:, :, None], score, jnp.inf)
 
-            flat = score.reshape(max_nodes, p * n_bins)
+            flat = score.reshape(level_nodes, p * n_bins)
             best = jnp.argmin(flat, axis=1)
             has_split = jnp.isfinite(jnp.min(flat, axis=1))
             best_feat = jnp.where(has_split, (best // n_bins).astype(jnp.int32), 0)
@@ -253,9 +253,21 @@ def grow_causal_forest(
             node_of_row = node_of_row * 2 + (code_at_feat > row_bin).astype(jnp.int32)
             return node_of_row, (best_feat, best_bin)
 
-        node_of_row, (feats, bins) = lax.scan(
-            level_step, jnp.zeros(n, jnp.int32), split_key
-        )
+        # Unrolled levels: level l computes moments/histograms only for
+        # its 2^l live nodes (a scan would pad every level to the final
+        # width — ~depth/2× wasted FLOPs). Split tables pad to max_nodes.
+        node_of_row = jnp.zeros(n, jnp.int32)
+        feats_l, bins_l = [], []
+        for level in range(depth):
+            level_nodes = min(1 << level, max_nodes)
+            node_of_row, (bf, bb) = level_step(
+                node_of_row, split_key[level], level_nodes
+            )
+            pad = max_nodes - level_nodes
+            feats_l.append(jnp.pad(bf, (0, pad)))
+            bins_l.append(jnp.pad(bb, (0, pad), constant_values=n_bins - 1))
+        feats = jnp.stack(feats_l)
+        bins = jnp.stack(bins_l)
         leaf_oh = jax.nn.one_hot(node_of_row, n_leaves, dtype=jnp.float32)
         leaf_stats = jnp.matmul(
             (leaf_oh * ew[:, None]).T, mom_stack, precision=_PREC

@@ -14,6 +14,12 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 
+# Persistent XLA compilation cache: the forest/estimator graphs take
+# 10-20 s each to compile on CPU and dominate suite wall-clock; steady-
+# state execution is <1 s. Cached executables survive across processes.
+jax.config.update("jax_compilation_cache_dir", os.path.join(os.path.dirname(__file__), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 # Strict-precision mode for R-parity tests; the TPU production path runs
 # float32/bfloat16 by construction (frames are built with explicit dtypes).
 jax.config.update("jax_enable_x64", True)

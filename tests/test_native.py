@@ -1,0 +1,122 @@
+"""Native host-runtime tests: the C++ R-compat RNG must bit-match the
+NumPy implementation (which is itself validated against published R
+streams in test_rrandom.py), and the C++ CSV reader must agree with the
+NumPy loader."""
+
+import numpy as np
+import pytest
+
+from ate_replication_causalml_tpu.native import (
+    NativeRCompatRNG,
+    make_rcompat_rng,
+    native_available,
+    native_status,
+    read_csv_native,
+)
+from ate_replication_causalml_tpu.utils.rrandom import RCompatRNG
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason=f"native build unavailable: {native_status()}"
+)
+
+
+@pytest.mark.parametrize("seed", [1991, 0, 12325, 2**31 - 1])
+def test_runif_bit_matches_python(seed):
+    a = NativeRCompatRNG(seed).runif(2000)
+    b = RCompatRNG(seed).runif(2000)
+    np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("kind", ["rounding", "rejection"])
+def test_sample_with_replacement_matches(kind):
+    a = NativeRCompatRNG(1991, kind).sample_int(8937, 8937, replace=True)
+    b = RCompatRNG(1991, kind).sample_int(8937, 8937, replace=True)
+    np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("kind", ["rounding", "rejection"])
+def test_sample_without_replacement_matches(kind):
+    a = NativeRCompatRNG(7, kind).sample_n_rows(229461, 50000)
+    b = RCompatRNG(7, kind).sample_n_rows(229461, 50000)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_stream_interleaving_matches():
+    """runif / sample calls drawing from one stream, in sequence."""
+    a = NativeRCompatRNG(42)
+    b = RCompatRNG(42)
+    np.testing.assert_array_equal(a.runif(7), b.runif(7))
+    np.testing.assert_array_equal(a.sample_int(100, 10), b.sample_int(100, 10))
+    np.testing.assert_array_equal(a.runif(630), b.runif(630))  # crosses a block
+    np.testing.assert_array_equal(
+        a.sample_int(50, 50, replace=True), b.sample_int(50, 50, replace=True)
+    )
+
+
+def test_factory_backends():
+    nat = make_rcompat_rng(1991, backend="auto")
+    py = make_rcompat_rng(1991, backend="python")
+    assert isinstance(py, RCompatRNG)
+    np.testing.assert_array_equal(nat.runif(10), py.runif(10))
+
+
+def test_csv_reader_matches_numpy(tmp_path):
+    rng = np.random.default_rng(0)
+    mat = rng.normal(size=(50, 4)).round(6)
+    path = tmp_path / "t.csv"
+    header = "a,b,c,d"
+    lines = [header]
+    for i, row in enumerate(mat):
+        cells = [f"{v:.6f}" for v in row]
+        if i == 3:
+            cells[1] = "NA"   # R's missing marker
+        if i == 7:
+            cells[2] = ""     # blank field
+        lines.append(",".join(cells))
+    path.write_text("\n".join(lines) + "\n")
+
+    names, out = read_csv_native(str(path))
+    assert names == ["a", "b", "c", "d"]
+    assert out.shape == (50, 4)
+    expect = mat.copy()
+    expect[3, 1] = np.nan
+    expect[7, 2] = np.nan
+    np.testing.assert_allclose(out, expect, rtol=0, atol=1e-9)
+
+
+def test_csv_reader_no_trailing_newline(tmp_path):
+    path = tmp_path / "t.csv"
+    path.write_text("x,y\n1,2\n3,4")
+    names, out = read_csv_native(str(path))
+    assert names == ["x", "y"]
+    np.testing.assert_array_equal(out, [[1.0, 2.0], [3.0, 4.0]])
+
+
+def test_csv_reader_skips_blank_lines(tmp_path):
+    """Blank lines are not rows (genfromtxt semantics) — a stray blank
+    line must not shift the R-seeded subsample draw."""
+    path = tmp_path / "t.csv"
+    path.write_text("a,b\n1,2\n\n3,4\n\r\n5,6\n")
+    _, out = read_csv_native(str(path))
+    np.testing.assert_array_equal(out, [[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+
+
+def test_csv_reader_short_rows_are_nan(tmp_path):
+    """Missing trailing fields read as NaN, never uninitialized memory."""
+    path = tmp_path / "t.csv"
+    path.write_text("a,b,c\n1,2,3\n4,5\n7,8,9\n")
+    _, out = read_csv_native(str(path))
+    assert out.shape == (3, 3)
+    np.testing.assert_array_equal(out[0], [1.0, 2.0, 3.0])
+    np.testing.assert_array_equal(out[1, :2], [4.0, 5.0])
+    assert np.isnan(out[1, 2])
+    np.testing.assert_array_equal(out[2], [7.0, 8.0, 9.0])
+
+
+def test_csv_reader_all_missing_line(tmp_path):
+    path = tmp_path / "t.csv"
+    path.write_text("a,b\n,\nNA,7\n")
+    _, out = read_csv_native(str(path))
+    assert out.shape == (2, 2)
+    assert np.isnan(out[0]).all()
+    assert np.isnan(out[1, 0]) and out[1, 1] == 7.0
