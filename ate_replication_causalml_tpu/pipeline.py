@@ -27,7 +27,6 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-import time
 from typing import Callable, Iterable
 
 import jax
@@ -60,6 +59,7 @@ from ate_replication_causalml_tpu.estimators import (
     residual_balance_ate,
 )
 from ate_replication_causalml_tpu.models.forest import rf_oob_propensity
+from ate_replication_causalml_tpu.utils.profiling import StageTimer, xla_trace
 
 
 @dataclasses.dataclass(frozen=True)
@@ -203,8 +203,10 @@ def run_sweep(
     log(f"prepared df n={df.n}, dropped {n_dropped} -> df_mod n={df_mod.n} "
         f"(reference on real data: 41,062 dropped, BASELINE.md)")
 
+    timer = StageTimer()
     report = SweepReport(
-        oracle=None, results=ResultTable(), n_dropped=n_dropped, n_biased=df_mod.n
+        oracle=None, results=ResultTable(), n_dropped=n_dropped, n_biased=df_mod.n,
+        timings_s=timer.seconds,
     )
     # Deterministic per-stage keys (stable across resume: skipping a
     # completed stage must not shift the keys of later stages).
@@ -228,13 +230,12 @@ def run_sweep(
                 lower_ci=nanf(cached["lower_ci"]), upper_ci=nanf(cached["upper_ci"]),
                 se=nanf(cached["se"]),
             )
-            report.timings_s[method] = cached.get("seconds", 0.0)
+            timer.seconds[method] = cached.get("seconds", 0.0)
             return res
-        t0 = time.perf_counter()
-        out = fn()
+        with timer.stage(method), xla_trace(method.replace(" ", "_")):
+            out = fn()
         res, extras = out if isinstance(out, tuple) else (out, {})
-        dt = time.perf_counter() - t0
-        report.timings_s[method] = dt
+        dt = timer.seconds[method]
         ckpt.put(dict(res.to_dict(), seconds=round(dt, 3), **extras))
         log(f"  {method}: ate={res.ate:.4f} ci=[{res.lower_ci:.4f},{res.upper_ci:.4f}] "
             f"({dt:.1f}s)")

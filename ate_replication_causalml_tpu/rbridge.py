@@ -1,0 +1,212 @@
+"""reticulate bridge — the R-facing API surface.
+
+The north star (BASELINE.json) preserves the reference's ``.Rmd``
+entrypoint: an R session loads this module through ``reticulate`` and
+calls functions with the *reference's* signatures
+(``f(dataset, treatment_var, outcome_var, ...)`` returning a one-row
+``data.frame(Method, ATE, lower_ci, upper_ci)`` — SURVEY.md §1), while
+every FLOP executes on the TPU backend.
+
+Marshalling contract (kept reticulate-trivial on purpose):
+
+* ``dataset`` arrives as a named list / dict of numeric column vectors
+  (R side: ``as.list(df)``). Everything that is neither the treatment
+  nor the outcome column is a covariate, in dict order — mirroring the
+  notebook's ``df_mod`` whose columns are exactly [covariates, W, Y].
+  An explicit ``covariates=`` list overrides that default.
+* Results return as plain dicts of scalars (reticulate → one-row
+  data.frame). NaN CIs (the no-SE LASSO estimators,
+  ``ate_functions.R:107, 129``) pass through as NA.
+
+The R wrappers live in ``r/ate_functions_tpu.R``; the notebook-
+equivalent driver is ``r/ate_replication_tpu.Rmd``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ate_replication_causalml_tpu.data.frame import CausalFrame
+from ate_replication_causalml_tpu.data.schema import DatasetSchema
+from ate_replication_causalml_tpu.estimators import (
+    EstimatorResult,
+)
+from ate_replication_causalml_tpu import estimators as E
+
+
+def frame_from_columns(
+    dataset,
+    treatment_var: str = "W",
+    outcome_var: str = "Y",
+    covariates=None,
+    dtype=jnp.float32,
+) -> CausalFrame:
+    """Named columns → :class:`CausalFrame` (the bridge's only ingest)."""
+    cols = {k: np.asarray(v, dtype=np.float64).ravel() for k, v in dict(dataset).items()}
+    if treatment_var not in cols or outcome_var not in cols:
+        raise ValueError(
+            f"dataset must contain treatment {treatment_var!r} and outcome {outcome_var!r}; "
+            f"has {sorted(cols)}"
+        )
+    if covariates is None:
+        covariates = [k for k in cols if k not in (treatment_var, outcome_var)]
+    else:
+        covariates = [str(c) for c in covariates]
+        missing = [c for c in covariates if c not in cols]
+        if missing:
+            raise ValueError(f"covariates not in dataset: {missing}")
+    x = np.stack([cols[c] for c in covariates], axis=1) if covariates else np.zeros(
+        (len(cols[treatment_var]), 0)
+    )
+    schema = DatasetSchema(
+        continuous=tuple(covariates), binary=(),
+        outcome=outcome_var, treatment=treatment_var,
+    )
+    return CausalFrame(
+        x=jnp.asarray(x, dtype),
+        w=jnp.asarray(cols[treatment_var], dtype),
+        y=jnp.asarray(cols[outcome_var], dtype),
+        schema=schema,
+    )
+
+
+def _row(res: EstimatorResult) -> dict:
+    out = {
+        "Method": res.method,
+        "ATE": float(res.ate),
+        "lower_ci": float(res.lower_ci),
+        "upper_ci": float(res.upper_ci),
+    }
+    return out
+
+
+# --- the reference's public API (ate_functions.R), TPU-backed ----------
+
+def naive_ate(dataset, treatment_var="W", outcome_var="Y", method="naive"):
+    frame = frame_from_columns(dataset, treatment_var, outcome_var)
+    return _row(E.naive_ate(frame, method=method))
+
+
+def ate_condmean_ols(dataset, treatment_var="W", outcome_var="Y"):
+    frame = frame_from_columns(dataset, treatment_var, outcome_var)
+    return _row(E.ate_condmean_ols(frame))
+
+
+def prop_score_weight(dataset, p, treatment_var="W", outcome_var="Y",
+                      covariates=None, method="Propensity_Weighting"):
+    frame = frame_from_columns(dataset, treatment_var, outcome_var, covariates)
+    return _row(E.prop_score_weight(frame, np.asarray(p, np.float64), method=method))
+
+
+def prop_score_ols(dataset, p, treatment_var="W", outcome_var="Y"):
+    frame = frame_from_columns(dataset, treatment_var, outcome_var)
+    return _row(E.prop_score_ols(frame, np.asarray(p, np.float64)))
+
+
+def logistic_propensity(dataset, treatment_var="W", outcome_var="Y"):
+    """The notebook's inline ``glm(W ~ ., binomial)`` propensity
+    (``ate_replication.Rmd:164-168``) — returns the fitted vector."""
+    frame = frame_from_columns(dataset, treatment_var, outcome_var)
+    return np.asarray(E.logistic_propensity(frame.x, frame.w), np.float64)
+
+
+def ate_condmean_lasso(dataset, treatment_var="W", outcome_var="Y", covariates=None):
+    frame = frame_from_columns(dataset, treatment_var, outcome_var, covariates)
+    return _row(E.ate_condmean_lasso(frame))
+
+
+def ate_lasso(dataset, treatment_var="W", outcome_var="Y", covariates=None):
+    frame = frame_from_columns(dataset, treatment_var, outcome_var, covariates)
+    return _row(E.ate_lasso(frame))
+
+
+def prop_score_lasso(dataset, treatment_var="W", outcome_var="Y", covariates=None):
+    """Returns the LASSO-logit propensity vector, like the reference
+    (``ate_functions.R:133-146`` returns predictions, not a row)."""
+    frame = frame_from_columns(dataset, treatment_var, outcome_var, covariates)
+    return np.asarray(E.prop_score_lasso(frame), np.float64)
+
+
+def doubly_robust(dataset, treatment_var="W", outcome_var="Y", num_trees=100,
+                  bootstrap_se=False, seed=12325):
+    from ate_replication_causalml_tpu.models.forest import rf_oob_propensity
+
+    frame = frame_from_columns(dataset, treatment_var, outcome_var)
+    res = E.doubly_robust(
+        frame,
+        propensity_fn=lambda f: rf_oob_propensity(
+            f, jax.random.key(int(seed)), n_trees=int(num_trees)
+        ),
+        bootstrap_se=bool(bootstrap_se),
+        key=jax.random.key(int(seed) + 1),
+    )
+    return _row(res)
+
+
+def doubly_robust_glm(dataset, treatment_var="W", outcome_var="Y",
+                      bootstrap_se=False, seed=0):
+    frame = frame_from_columns(dataset, treatment_var, outcome_var)
+    res = E.doubly_robust_glm(
+        frame, bootstrap_se=bool(bootstrap_se), key=jax.random.key(int(seed))
+    )
+    return _row(res)
+
+
+def belloni(dataset, treatment_var="W", outcome_var="Y", covariates=None,
+            compat="r"):
+    frame = frame_from_columns(dataset, treatment_var, outcome_var, covariates)
+    return _row(E.belloni(frame, compat=compat))
+
+
+def double_ml(dataset, treatment_var="W", outcome_var="Y", num_trees=100, seed=123):
+    frame = frame_from_columns(dataset, treatment_var, outcome_var)
+    return _row(E.double_ml(frame, n_trees=int(num_trees), key=jax.random.key(int(seed))))
+
+
+def residual_balance_ATE(dataset, treatment_var="W", outcome_var="Y",
+                         optimizer="admm", seed=0):
+    # The reference's `optimizer=` selects quadprog vs pogs; both map to
+    # the same graph-form ADMM solver here (SURVEY.md §2.3).
+    frame = frame_from_columns(dataset, treatment_var, outcome_var)
+    return _row(E.residual_balance_ate(frame, key=jax.random.key(int(seed))))
+
+
+def causal_forest(dataset, treatment_var="W", outcome_var="Y", num_trees=2000,
+                  seed=12345):
+    """The notebook's grf block (``ate_replication.Rmd:249-272``):
+    returns the AIPW result row plus the deliberately 'incorrect'
+    mean-CATE ATE/SE demo."""
+    frame = frame_from_columns(dataset, treatment_var, outcome_var)
+    rep = E.causal_forest_report(frame, key=jax.random.key(int(seed)),
+                                 n_trees=int(num_trees))
+    out = _row(rep.result)
+    out["incorrect_ate"] = float(rep.incorrect_ate)
+    out["incorrect_se"] = float(rep.incorrect_se)
+    return out
+
+
+def run_notebook_sweep(n_obs=50_000, seed=1991, outdir=None, quick=False):
+    """One-call driver for the R notebook: the full estimator sweep on
+    the synthetic GGL panel (SweepConfig defaults mirror the notebook's
+    call sites). Returns the rows as a list of dicts for rbind."""
+    import dataclasses as _dc
+
+    from ate_replication_causalml_tpu.data.pipeline import PrepConfig
+    from ate_replication_causalml_tpu.pipeline import SweepConfig, run_sweep
+
+    cfg = SweepConfig(prep=PrepConfig(n_obs=int(n_obs), seed=int(seed)))
+    if quick:
+        # quick() shrinks tree counts AND the synthetic pool; restore a
+        # pool large enough that the caller's n_obs is actually sampled.
+        cfg = _dc.replace(
+            cfg.quick(),
+            prep=PrepConfig(n_obs=int(n_obs), seed=int(seed)),
+            synthetic_pool=max(cfg.quick().synthetic_pool, 3 * int(n_obs)),
+        )
+    report = run_sweep(cfg, outdir=outdir, plots=outdir is not None,
+                       log=lambda s: None)
+    rows = [_row(report.oracle)] + [_row(r) for r in report.results.rows]
+    return rows

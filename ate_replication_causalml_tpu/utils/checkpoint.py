@@ -1,0 +1,132 @@
+"""Model checkpointing — persist fitted nuisances and forests.
+
+The reference recomputes everything on every knit (knitr caching is not
+even enabled — SURVEY.md §5.4); the expensive fits it would want to keep
+are the forests (minutes of CPU) and the GLM/LASSO nuisances. Here any
+of the framework's fitted objects round-trips through one ``.npz`` file:
+
+* registered pytree dataclasses (``Forest``, ``CausalForest``,
+  ``FittedCausalForest``), nested arbitrarily;
+* NamedTuple results (``GlmResult``, ``CvGlmnetResult``, …);
+* plain dicts / lists / scalars / arrays.
+
+Arrays are stored once each under their tree path; static metadata
+(ints, strings, None) and the structure itself live in a JSON manifest
+inside the same archive — no pickle, so checkpoints are portable and
+inspectable (``np.load(path).files``). The L4 driver persists *result
+rows* via its own jsonl checkpoint (pipeline.py); this module is the
+model-level complement.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import json
+from typing import Any
+
+import jax
+import numpy as np
+
+_ARR = "__array__"
+
+
+def _is_namedtuple(obj) -> bool:
+    return isinstance(obj, tuple) and hasattr(obj, "_fields")
+
+
+def _encode(obj: Any, path: str, arrays: dict[str, np.ndarray]):
+    """Structure manifest for ``obj``; arrays stored out-of-band under
+    sequential keys (tree paths can collide — dict keys may contain
+    '.' — so they appear only in the manifest, not as archive keys)."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, (np.ndarray, jax.Array, np.generic)):
+        key = f"arr_{len(arrays)}"
+        arrays[key] = np.asarray(obj)
+        return {_ARR: key, "path": path}
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        cls = type(obj)
+        fields = {
+            f.name: _encode(getattr(obj, f.name), f"{path}.{f.name}", arrays)
+            for f in dataclasses.fields(obj)
+        }
+        return {"__dataclass__": f"{cls.__module__}:{cls.__qualname__}", "fields": fields}
+    if _is_namedtuple(obj):
+        cls = type(obj)
+        fields = {
+            name: _encode(val, f"{path}.{name}", arrays)
+            for name, val in zip(obj._fields, obj)
+        }
+        return {"__namedtuple__": f"{cls.__module__}:{cls.__qualname__}", "fields": fields}
+    if isinstance(obj, dict):
+        if not all(isinstance(k, str) for k in obj):
+            raise TypeError(f"only string dict keys are checkpointable at {path}")
+        return {"__dict__": {k: _encode(v, f"{path}.{k}", arrays) for k, v in obj.items()}}
+    if isinstance(obj, (list, tuple)):
+        kind = "__list__" if isinstance(obj, list) else "__tuple__"
+        return {kind: [_encode(v, f"{path}[{i}]", arrays) for i, v in enumerate(obj)]}
+    raise TypeError(f"cannot checkpoint {type(obj).__name__} at {path!r}")
+
+
+def _resolve(qualname: str) -> type:
+    mod, _, name = qualname.partition(":")
+    obj: Any = importlib.import_module(mod)
+    for part in name.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def _decode(spec: Any, arrays) -> Any:
+    if not isinstance(spec, dict):
+        return spec
+    if _ARR in spec:
+        return arrays[spec[_ARR]]
+    if "__dataclass__" in spec:
+        cls = _resolve(spec["__dataclass__"])
+        fields = {k: _decode(v, arrays) for k, v in spec["fields"].items()}
+        return cls(**fields)
+    if "__namedtuple__" in spec:
+        cls = _resolve(spec["__namedtuple__"])
+        fields = {k: _decode(v, arrays) for k, v in spec["fields"].items()}
+        return cls(**fields)
+    if "__dict__" in spec:
+        return {k: _decode(v, arrays) for k, v in spec["__dict__"].items()}
+    if "__list__" in spec:
+        return [_decode(v, arrays) for v in spec["__list__"]]
+    if "__tuple__" in spec:
+        return tuple(_decode(v, arrays) for v in spec["__tuple__"])
+    raise ValueError(f"unrecognized checkpoint spec {spec!r}")
+
+
+def save_fitted(path: str, obj: Any) -> None:
+    """Write ``obj`` (fitted model / pytree of the kinds above) to one
+    compressed ``.npz``."""
+    arrays: dict[str, np.ndarray] = {}
+    manifest = _encode(obj, "root", arrays)
+    np.savez_compressed(
+        path, __manifest__=np.frombuffer(json.dumps(manifest).encode(), dtype=np.uint8),
+        **arrays,
+    )
+
+
+def load_fitted(path: str, device: bool = True) -> Any:
+    """Restore an object written by :func:`save_fitted`. With
+    ``device=True`` arrays come back as ``jax.Array`` (placed by the
+    default device policy) — except 64-bit arrays when x64 is disabled,
+    which stay host NumPy rather than silently truncating (JAX converts
+    them on first use; the x64 strict-parity tests get exact values).
+    ``device=False`` returns host NumPy throughout."""
+    with np.load(path) as z:
+        manifest = json.loads(bytes(z["__manifest__"]).decode())
+        arrays = {k: z[k] for k in z.files if k != "__manifest__"}
+    if device:
+        x64 = jax.config.read("jax_enable_x64")
+
+        def place(v: np.ndarray):
+            if v.dtype.itemsize == 8 and v.dtype.kind in "fiu" and not x64:
+                return v
+            return jax.numpy.asarray(v)
+
+        arrays = {k: place(v) for k, v in arrays.items()}
+    return _decode(manifest, arrays)

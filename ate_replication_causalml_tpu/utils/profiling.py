@@ -1,0 +1,84 @@
+"""Tracing / profiling (SURVEY.md §5.1).
+
+The reference's only performance instrumentation is two "~1min"
+comments (``ate_functions.R:168, 230``); the north star here is a
+wall-clock metric, so timing is a first-class subsystem:
+
+* :class:`StageTimer` — accumulates named wall-clock stage timings;
+  the L5 driver (pipeline.py) times every estimator through one of
+  these and persists the result next to each checkpoint row. Callers
+  must sync device work themselves (convert outputs via ``float(...)``
+  / ``np.asarray`` — reliable on every platform, including axon where
+  ``block_until_ready`` is not dependable).
+* :func:`stage` — one-off variant logging a single block's duration.
+* :func:`xla_trace` — wraps ``jax.profiler.trace`` when a trace dir is
+  set (``ATE_TPU_TRACE_DIR`` env var or argument) and is a no-op
+  otherwise, so production code can leave the hook in place.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from typing import Iterator
+
+import jax
+
+_TRACE_ENV = "ATE_TPU_TRACE_DIR"
+
+
+class StageTimer:
+    """Accumulates wall-clock seconds per named stage."""
+
+    def __init__(self):
+        self.seconds: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def stage(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.seconds[name] = self.seconds.get(name, 0.0) + (
+                time.perf_counter() - t0
+            )
+
+    def report(self) -> str:
+        total = sum(self.seconds.values())
+        lines = [
+            f"{name:<40s} {sec:8.3f}s"
+            for name, sec in sorted(self.seconds.items(), key=lambda kv: -kv[1])
+        ]
+        lines.append(f"{'TOTAL':<40s} {total:8.3f}s")
+        return "\n".join(lines)
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.seconds, f, indent=2, sort_keys=True)
+
+
+@contextlib.contextmanager
+def stage(name: str, log=None) -> Iterator[None]:
+    """Time one stage; ``log`` (e.g. ``print``) receives `name: N.NNNs`."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        if log is not None:
+            log(f"{name}: {time.perf_counter() - t0:.3f}s")
+
+
+@contextlib.contextmanager
+def xla_trace(label: str = "trace", trace_dir: str | None = None) -> Iterator[None]:
+    """``jax.profiler.trace`` scoped to a block when a trace directory is
+    configured; no-op otherwise. View with TensorBoard / xprof."""
+    trace_dir = trace_dir or os.environ.get(_TRACE_ENV)
+    if not trace_dir:
+        yield
+        return
+    path = os.path.join(trace_dir, label)
+    os.makedirs(path, exist_ok=True)
+    with jax.profiler.trace(path):
+        yield

@@ -1,0 +1,122 @@
+# TPU-backed estimator library for R — the reticulate shim.
+#
+# Drop-in replacements for the reference's estimator API
+# (ate_functions.R): same function names, same
+# `f(dataset, treatment_var, outcome_var, ...)` signatures, same one-row
+# `data.frame(Method, ATE, lower_ci, upper_ci)` return — but every fit
+# executes on the TPU backend through
+# ate_replication_causalml_tpu.rbridge (reticulate marshals the
+# data.frame as a named list of columns; see rbridge.py's contract).
+#
+# Usage:
+#   source("ate_functions_tpu.R")
+#   tpu_init()                      # once per session
+#   result <- naive_ate(df, "W", "Y")
+
+library(reticulate)
+
+.tpu <- new.env()
+
+tpu_init <- function(python = NULL) {
+  if (!is.null(python)) reticulate::use_python(python, required = TRUE)
+  .tpu$bridge <- reticulate::import("ate_replication_causalml_tpu.rbridge")
+  invisible(.tpu$bridge)
+}
+
+.bridge <- function() {
+  if (is.null(.tpu$bridge)) tpu_init()
+  .tpu$bridge
+}
+
+# A dataset crosses the boundary as a named list of numeric columns.
+.cols <- function(dataset) lapply(as.list(dataset), as.numeric)
+
+.as_row <- function(res) {
+  data.frame(
+    Method = res$Method,
+    ATE = res$ATE,
+    lower_ci = ifelse(is.nan(res$lower_ci), NA, res$lower_ci),
+    upper_ci = ifelse(is.nan(res$upper_ci), NA, res$upper_ci),
+    stringsAsFactors = FALSE
+  )
+}
+
+naive_ate <- function(dataset, treatment_var = "W", outcome_var = "Y") {
+  .as_row(.bridge()$naive_ate(.cols(dataset), treatment_var, outcome_var))
+}
+
+ate_condmean_ols <- function(dataset, treatment_var = "W", outcome_var = "Y") {
+  .as_row(.bridge()$ate_condmean_ols(.cols(dataset), treatment_var, outcome_var))
+}
+
+logistic_propensity <- function(dataset, treatment_var = "W", outcome_var = "Y") {
+  as.numeric(.bridge()$logistic_propensity(.cols(dataset), treatment_var, outcome_var))
+}
+
+prop_score_weight <- function(dataset, p, treatment_var = "W", outcome_var = "Y",
+                              covariates = NULL) {
+  .as_row(.bridge()$prop_score_weight(.cols(dataset), as.numeric(p),
+                                      treatment_var, outcome_var, covariates))
+}
+
+prop_score_ols <- function(dataset, p, treatment_var = "W", outcome_var = "Y") {
+  .as_row(.bridge()$prop_score_ols(.cols(dataset), as.numeric(p),
+                                   treatment_var, outcome_var))
+}
+
+ate_condmean_lasso <- function(dataset, treatment_var = "W", outcome_var = "Y",
+                               covariates = NULL) {
+  .as_row(.bridge()$ate_condmean_lasso(.cols(dataset), treatment_var, outcome_var,
+                                       covariates))
+}
+
+ate_lasso <- function(dataset, treatment_var = "W", outcome_var = "Y",
+                      covariates = NULL) {
+  .as_row(.bridge()$ate_lasso(.cols(dataset), treatment_var, outcome_var, covariates))
+}
+
+prop_score_lasso <- function(dataset, treatment_var = "W", outcome_var = "Y",
+                             covariates = NULL) {
+  as.numeric(.bridge()$prop_score_lasso(.cols(dataset), treatment_var, outcome_var,
+                                        covariates))
+}
+
+doubly_robust <- function(dataset, treatment_var = "W", outcome_var = "Y",
+                          num_trees = 100, bootstrap_se = FALSE) {
+  .as_row(.bridge()$doubly_robust(.cols(dataset), treatment_var, outcome_var,
+                                  as.integer(num_trees), bootstrap_se))
+}
+
+doubly_robust_glm <- function(dataset, treatment_var = "W", outcome_var = "Y",
+                              bootstrap_se = FALSE) {
+  .as_row(.bridge()$doubly_robust_glm(.cols(dataset), treatment_var, outcome_var,
+                                      bootstrap_se))
+}
+
+belloni <- function(dataset, treatment_var = "W", outcome_var = "Y",
+                    covariates = NULL, compat = "r") {
+  .as_row(.bridge()$belloni(.cols(dataset), treatment_var, outcome_var,
+                            covariates, compat))
+}
+
+double_ml <- function(dataset, treatment_var = "W", outcome_var = "Y",
+                      num_trees = 100) {
+  .as_row(.bridge()$double_ml(.cols(dataset), treatment_var, outcome_var,
+                              as.integer(num_trees)))
+}
+
+residual_balance_ATE <- function(dataset, treatment_var = "W", outcome_var = "Y",
+                                 optimizer = "admm") {
+  .as_row(.bridge()$residual_balance_ATE(.cols(dataset), treatment_var, outcome_var,
+                                         optimizer))
+}
+
+causal_forest_tpu <- function(dataset, treatment_var = "W", outcome_var = "Y",
+                              num_trees = 2000, seed = 12345) {
+  res <- .bridge()$causal_forest(.cols(dataset), treatment_var, outcome_var,
+                                 as.integer(num_trees), as.integer(seed))
+  row <- .as_row(res)
+  attr(row, "incorrect_ate") <- res$incorrect_ate
+  attr(row, "incorrect_se") <- res$incorrect_se
+  row
+}

@@ -1,0 +1,100 @@
+"""Checkpoint round-trip tests for fitted models (SURVEY.md §5.4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ate_replication_causalml_tpu.models.forest import fit_forest_classifier
+from ate_replication_causalml_tpu.ops.glm import logistic_glm
+from ate_replication_causalml_tpu.ops.linalg import add_intercept
+from ate_replication_causalml_tpu.utils.checkpoint import load_fitted, save_fitted
+
+RNG = np.random.default_rng(5)
+
+
+def _tree_equal(a, b):
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    assert ta == tb
+    for xa, xb in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+
+
+def test_forest_roundtrip(tmp_path):
+    x = jnp.asarray(RNG.normal(size=(300, 4)), jnp.float32)
+    y = (x[:, 0] > 0).astype(jnp.float32)
+    forest = fit_forest_classifier(x, y, jax.random.key(0), n_trees=8, depth=4)
+    path = str(tmp_path / "forest.npz")
+    save_fitted(path, forest)
+    restored = load_fitted(path)
+    assert type(restored).__name__ == "Forest"
+    _tree_equal(forest, restored)
+
+
+def test_glm_namedtuple_roundtrip(tmp_path):
+    x = add_intercept(jnp.asarray(RNG.normal(size=(200, 3)), jnp.float32))
+    w = (RNG.random(200) < 0.4).astype(np.float32)
+    fit = logistic_glm(x, jnp.asarray(w))
+    path = str(tmp_path / "glm.npz")
+    save_fitted(path, fit)
+    restored = load_fitted(path)
+    assert type(restored).__name__ == type(fit).__name__
+    _tree_equal(tuple(fit), tuple(restored))
+
+
+def test_nested_container_roundtrip(tmp_path):
+    obj = {
+        "taus": jnp.arange(5.0),
+        "meta": {"method": "aipw", "n_boot": 1000, "ok": True, "missing": None},
+        "folds": [jnp.ones(3), jnp.zeros(2)],
+        "pair": (1.5, "x"),
+    }
+    path = str(tmp_path / "obj.npz")
+    save_fitted(path, obj)
+    r = load_fitted(path, device=False)
+    assert r["meta"] == obj["meta"]
+    assert isinstance(r["pair"], tuple) and r["pair"] == (1.5, "x")
+    np.testing.assert_array_equal(r["taus"], np.arange(5.0))
+    assert isinstance(r["folds"][0], np.ndarray)
+
+
+def test_unpicklable_rejected(tmp_path):
+    with pytest.raises(TypeError):
+        save_fitted(str(tmp_path / "bad.npz"), {"fn": lambda: None})
+
+
+def test_dotted_dict_keys_do_not_collide(tmp_path):
+    """Dict keys containing '.' must not alias each other's arrays."""
+    obj = {"a": {"b": np.ones(3)}, "a.b": np.zeros(3)}
+    path = str(tmp_path / "dots.npz")
+    save_fitted(path, obj)
+    r = load_fitted(path, device=False)
+    np.testing.assert_array_equal(r["a"]["b"], np.ones(3))
+    np.testing.assert_array_equal(r["a.b"], np.zeros(3))
+
+
+def test_float64_roundtrip_exact(tmp_path):
+    """64-bit arrays round-trip exactly even when x64 is disabled in
+    the loading process (they stay host NumPy rather than truncating)."""
+    v = np.array([1.0 + 1e-12, 2.0], dtype=np.float64)
+    path = str(tmp_path / "f64.npz")
+    save_fitted(path, {"v": v, "i": np.int64(2**40) + np.arange(2)})
+    r = load_fitted(path)  # device=True
+    assert np.asarray(r["v"]).dtype == np.float64
+    np.testing.assert_array_equal(np.asarray(r["v"]), v)
+    assert np.asarray(r["i"]).dtype == np.int64
+
+
+def test_stage_timer_accumulates():
+    from ate_replication_causalml_tpu.utils.profiling import StageTimer
+
+    t = StageTimer()
+    with t.stage("a"):
+        pass
+    with t.stage("a"):
+        pass
+    with t.stage("b"):
+        pass
+    assert set(t.seconds) == {"a", "b"}
+    assert t.seconds["a"] >= 0 and "TOTAL" in t.report()

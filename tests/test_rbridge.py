@@ -1,0 +1,73 @@
+"""reticulate-bridge tests: the R-facing API surface (reference
+signatures in, one-row result records out) — exercised from Python
+since the marshalling layer is plain dict/ndarray."""
+
+import numpy as np
+import pytest
+
+from ate_replication_causalml_tpu import rbridge
+
+RNG = np.random.default_rng(3)
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    """Columns in notebook layout: covariates..., then W, Y."""
+    n = 1200
+    x1, x2 = RNG.normal(size=n), RNG.normal(size=n)
+    e = 1 / (1 + np.exp(-0.8 * x1))
+    w = (RNG.random(n) < e).astype(float)
+    y = (RNG.random(n) < 1 / (1 + np.exp(-(0.5 * x1 + 0.4 * w)))).astype(float)
+    return {"x1": x1, "x2": x2, "W": w, "Y": y}
+
+
+def _check_row(row, method=None):
+    assert set(row) >= {"Method", "ATE", "lower_ci", "upper_ci"}
+    assert np.isfinite(row["ATE"])
+    if method:
+        assert row["Method"] == method
+
+
+def test_frame_from_columns_roles(dataset):
+    frame = rbridge.frame_from_columns(dataset)
+    assert frame.p == 2 and frame.n == 1200
+    assert frame.schema.covariates == ("x1", "x2")
+    # Explicit covariate subset.
+    frame1 = rbridge.frame_from_columns(dataset, covariates=["x2"])
+    assert frame1.p == 1
+    with pytest.raises(ValueError):
+        rbridge.frame_from_columns({"a": [1.0]}, "W", "Y")
+    with pytest.raises(ValueError):
+        rbridge.frame_from_columns(dataset, covariates=["nope"])
+
+
+def test_simple_estimators(dataset):
+    _check_row(rbridge.naive_ate(dataset), "naive")
+    _check_row(rbridge.ate_condmean_ols(dataset), "Direct Method")
+    p = rbridge.logistic_propensity(dataset)
+    assert p.shape == (1200,) and (0 < p).all() and (p < 1).all()
+    _check_row(rbridge.prop_score_weight(dataset, p), "Propensity_Weighting")
+    _check_row(rbridge.prop_score_ols(dataset, p), "Propensity_Regression")
+
+
+def test_lasso_family(dataset):
+    _check_row(rbridge.ate_condmean_lasso(dataset))
+    p = rbridge.prop_score_lasso(dataset)
+    assert p.shape == (1200,)
+
+
+def test_aipw_and_forest(dataset):
+    _check_row(rbridge.doubly_robust_glm(dataset),
+               "Doubly Robust with logistic regression PS")
+    _check_row(rbridge.doubly_robust(dataset, num_trees=16),
+               "Doubly Robust with Random Forest PS")
+    row = rbridge.causal_forest(dataset, num_trees=16)
+    _check_row(row, "Causal Forest(GRF)")
+    assert np.isfinite(row["incorrect_ate"]) and row["incorrect_se"] >= 0
+
+
+def test_dml_and_balance(dataset):
+    _check_row(rbridge.double_ml(dataset, num_trees=16),
+               "Double Machine Learning")
+    _check_row(rbridge.residual_balance_ATE(dataset), "residual_balancing")
+    _check_row(rbridge.belloni(dataset), "Belloni et.al")
