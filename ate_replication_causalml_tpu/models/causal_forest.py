@@ -66,6 +66,7 @@ from ate_replication_causalml_tpu.models.forest import (
 )
 from ate_replication_causalml_tpu.ops.hist_pallas import bin_histogram
 from ate_replication_causalml_tpu.ops.linalg import _PREC
+from ate_replication_causalml_tpu.parallel.retry import require_all, run_shards
 
 _EPS = 1e-12
 
@@ -142,14 +143,6 @@ def _node_tau(mom: jax.Array):
     return wbar, ybar, tau
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "n_trees", "depth", "mtry", "n_bins", "min_node",
-        "ci_group_size", "honesty", "group_chunk", "sample_fraction",
-        "hist_backend",
-    ),
-)
 def grow_causal_forest(
     x: jax.Array,
     wt: jax.Array,
@@ -186,37 +179,91 @@ def grow_causal_forest(
     xb_onehot = bin_onehot(codes, n_bins) if hist_backend == "onehot" else None
     mom_stack = _moments_stack(wt, yt)  # (n, 5)
     s = max(2, int(n * sample_fraction))
+
+    group_chunk = pick_chunk(n_groups, group_chunk)
+    n_chunks = -(-n_groups // group_chunk)
+    group_keys = jax.random.split(key, n_chunks * group_chunk)
+
+    # Elastic host loop over one compiled chunk executable (shared
+    # across chunks and fits): bounded device-program size, and a
+    # transient device failure re-runs only that chunk (keys are
+    # explicit, so the retry is bit-identical — parallel/retry.py).
+    def chunk_shard(i: int):
+        return _grow_cf_chunk(
+            group_keys[i * group_chunk : (i + 1) * group_chunk],
+            codes, wt, yt, mom_stack, xb_onehot,
+            depth=depth, mtry=mtry, n_bins=n_bins, min_node=min_node,
+            s=s, k=k, honesty=honesty, hist_backend=hist_backend,
+        )
+
+    chunks = require_all(
+        run_shards(chunk_shard, n_chunks, retriable=(jax.errors.JaxRuntimeError,))
+    )
+    flat = lambda j: jnp.concatenate(
+        [c[j].reshape((-1,) + c[j].shape[2:]) for c in chunks], axis=0
+    )[: n_groups * k]
+    return CausalForest(
+        split_feat=flat(0),
+        split_bin=flat(1),
+        leaf_stats=flat(2),
+        in_sample=flat(3),
+        bin_edges=edges,
+        ci_group_size=k,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("depth", "mtry", "n_bins", "min_node", "s", "k",
+                     "honesty", "hist_backend"),
+)
+def _grow_cf_chunk(group_keys, codes, wt, yt, mom_stack, xb_onehot, *,
+                   depth, mtry, n_bins, min_node, s, k, honesty, hist_backend):
+    """One compiled chunk of little-bag groups (vmapped), k trees per
+    group sharing a half-sample. Module-level jit — shared executable."""
+    n, p = codes.shape
     max_nodes = 1 << (depth - 1)
     n_leaves = 1 << depth
 
-    def grow_one(in_mask, tree_key):
+    def grow_one(codes_g, wt_g, yt_g, mom_g, oh_g, base, tree_key):
+        """Grow one honest tree.
+
+        For the streaming backends (xla/pallas) the caller gathers the
+        group's s-row half-sample, so every histogram/moment pass
+        touches s = n·sample_fraction rows and ``base`` is all-ones.
+        For the 'onehot' backend the rows stay full-n with ``base`` the
+        subsample mask — gathering would copy the shared (n, p·n_bins)
+        one-hot per vmapped group (gigabytes); masking keeps it shared.
+        """
+        rows = codes_g.shape[0]
         if honesty:
-            bern = jax.random.bernoulli(tree_key, 0.5, (n,))
-            gw = (in_mask & bern).astype(jnp.float32)
-            ew = (in_mask & ~bern).astype(jnp.float32)
+            bern = jax.random.bernoulli(tree_key, 0.5, (rows,))
+            gw = base * bern.astype(jnp.float32)
+            ew = base * (1.0 - bern.astype(jnp.float32))
         else:
-            gw = ew = in_mask.astype(jnp.float32)
+            gw = ew = base
         split_key = jax.random.split(tree_key, depth + 1)[1:]
 
         def level_step(node_of_row, lk, level_nodes):
-            node_oh = jax.nn.one_hot(node_of_row, level_nodes, dtype=jnp.float32)
-            gw_oh = node_oh * gw[:, None]
-            mom = jnp.matmul(gw_oh.T, mom_stack, precision=_PREC)  # (M, 5)
+            mom = jax.ops.segment_sum(
+                gw[:, None] * mom_g, node_of_row, num_segments=level_nodes
+            )  # (M, 5)
             wbar, ybar, tau = _node_tau(mom)
-            wc = wt - wbar[node_of_row]
-            yc = yt - ybar[node_of_row]
+            wc = wt_g - wbar[node_of_row]
+            yc = yt_g - ybar[node_of_row]
             rho = wc * (yc - wc * tau[node_of_row])
 
             if hist_backend == "onehot":
-                hist_c = jnp.matmul(gw_oh.T, xb_onehot, precision=_PREC).reshape(
+                gw_oh = jax.nn.one_hot(node_of_row, level_nodes, dtype=jnp.float32) * gw[:, None]
+                hist_c = jnp.matmul(gw_oh.T, oh_g, precision=_PREC).reshape(
                     level_nodes, p, n_bins
                 )
                 hist_r = jnp.matmul(
-                    (gw_oh * rho[:, None]).T, xb_onehot, precision=_PREC
+                    (gw_oh * rho[:, None]).T, oh_g, precision=_PREC
                 ).reshape(level_nodes, p, n_bins)
             else:
                 hist_c, hist_r = bin_histogram(
-                    codes,
+                    codes_g,
                     node_of_row,
                     jnp.stack([gw, gw * rho]),
                     max_nodes=level_nodes,
@@ -249,14 +296,14 @@ def grow_causal_forest(
 
             row_feat = best_feat[node_of_row]
             row_bin = best_bin[node_of_row]
-            code_at_feat = jnp.take_along_axis(codes, row_feat[:, None], axis=1)[:, 0]
+            code_at_feat = jnp.take_along_axis(codes_g, row_feat[:, None], axis=1)[:, 0]
             node_of_row = node_of_row * 2 + (code_at_feat > row_bin).astype(jnp.int32)
             return node_of_row, (best_feat, best_bin)
 
         # Unrolled levels: level l computes moments/histograms only for
         # its 2^l live nodes (a scan would pad every level to the final
         # width — ~depth/2× wasted FLOPs). Split tables pad to max_nodes.
-        node_of_row = jnp.zeros(n, jnp.int32)
+        node_of_row = jnp.zeros(rows, jnp.int32)
         feats_l, bins_l = [], []
         for level in range(depth):
             level_nodes = min(1 << level, max_nodes)
@@ -268,38 +315,33 @@ def grow_causal_forest(
             bins_l.append(jnp.pad(bb, (0, pad), constant_values=n_bins - 1))
         feats = jnp.stack(feats_l)
         bins = jnp.stack(bins_l)
-        leaf_oh = jax.nn.one_hot(node_of_row, n_leaves, dtype=jnp.float32)
-        leaf_stats = jnp.matmul(
-            (leaf_oh * ew[:, None]).T, mom_stack, precision=_PREC
+        # Honest leaf payloads via segment_sum (a (n, 2^D) one-hot here
+        # costs gigabytes per vmapped chunk at reference scale).
+        leaf_stats = jax.ops.segment_sum(
+            ew[:, None] * mom_g, node_of_row, num_segments=n_leaves
         )  # (L, 5)
         return feats, bins, leaf_stats
 
     def grow_group(group_key):
         sk, tk = jax.random.split(group_key)
         perm = jax.random.permutation(sk, n)
-        in_mask = jnp.zeros((n,), bool).at[perm[:s]].set(True)
+        idx = perm[:s]
+        in_mask = jnp.zeros((n,), bool).at[idx].set(True)
         tree_keys = jax.random.split(tk, k)
-        feats, bins, stats = jax.vmap(grow_one, in_axes=(None, 0))(in_mask, tree_keys)
+        vone = jax.vmap(grow_one, in_axes=(None, None, None, None, None, None, 0))
+        if hist_backend == "onehot":
+            feats, bins, stats = vone(
+                codes, wt, yt, mom_stack, xb_onehot,
+                in_mask.astype(jnp.float32), tree_keys,
+            )
+        else:
+            feats, bins, stats = vone(
+                codes[idx], wt[idx], yt[idx], mom_stack[idx], None,
+                jnp.ones((s,), jnp.float32), tree_keys,
+            )
         return feats, bins, stats, jnp.broadcast_to(in_mask, (k, n))
 
-    group_chunk = pick_chunk(n_groups, group_chunk)
-    n_chunks = -(-n_groups // group_chunk)
-    group_keys = jax.random.split(key, n_chunks * group_chunk)
-
-    feats, bins, stats, in_mask = lax.map(
-        lambda ks: jax.vmap(grow_group)(ks),
-        group_keys.reshape(n_chunks, group_chunk, *group_keys.shape[1:]),
-    )
-    total = n_chunks * group_chunk * k
-    flat = lambda a: a.reshape((total,) + a.shape[3:])[: n_groups * k]
-    return CausalForest(
-        split_feat=flat(feats),
-        split_bin=flat(bins),
-        leaf_stats=flat(stats),
-        in_sample=flat(in_mask),
-        bin_edges=edges,
-        ci_group_size=k,
-    )
+    return jax.vmap(grow_group)(group_keys)
 
 
 def fit_causal_forest(

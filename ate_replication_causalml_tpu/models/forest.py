@@ -47,6 +47,7 @@ from ate_replication_causalml_tpu.ops.hist_pallas import (
     resolve_hist_backend,
 )
 from ate_replication_causalml_tpu.ops.linalg import _PREC
+from ate_replication_causalml_tpu.parallel.retry import require_all, run_shards
 
 
 @jax.tree_util.register_dataclass
@@ -125,10 +126,6 @@ class ForestPredictions(NamedTuple):
     vote: jax.Array   # fraction of trees voting class 1 (randomForest "prob")
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("n_trees", "depth", "mtry", "n_bins", "tree_chunk", "hist_backend"),
-)
 def fit_forest_classifier(
     x: jax.Array,
     y: jax.Array,
@@ -143,9 +140,11 @@ def fit_forest_classifier(
     """Fit a classification forest of ``n_trees`` depth-``depth`` trees.
 
     mtry defaults to floor(sqrt(p)) (randomForest's classification
-    default). Trees are grown in chunks of ``tree_chunk`` via ``lax.map``
-    (bounded memory), vmapped within a chunk. ``hist_backend`` selects
-    the split-histogram implementation (see :func:`resolve_hist_backend`).
+    default). Trees are grown in chunks of ``tree_chunk``: one jitted
+    chunk executable (compiled once), driven by a host loop — bounded
+    device-program size and memory, chunk-level progress/retry points
+    (parallel/retry.py), identical numbers to a monolithic run since
+    every chunk owns its fold-in keys.
     """
     n, p = x.shape
     if mtry is None:
@@ -155,6 +154,42 @@ def fit_forest_classifier(
     codes = binarize(x, edges)  # (n, p) int32
     xb_onehot = bin_onehot(codes, n_bins) if hist_backend == "onehot" else None
     yf = y.astype(jnp.float32)
+
+    tree_chunk = pick_chunk(n_trees, tree_chunk)
+    n_chunks = -(-n_trees // tree_chunk)  # ceil: padded, sliced after
+    tree_keys = jax.random.split(key, n_chunks * tree_chunk)
+
+    def chunk_shard(i: int):
+        return _grow_chunk(
+            tree_keys[i * tree_chunk : (i + 1) * tree_chunk],
+            codes, yf, xb_onehot,
+            depth=depth, mtry=mtry, n_bins=n_bins, hist_backend=hist_backend,
+        )
+
+    # Elastic host loop (parallel/retry.py): a transient device failure
+    # (dropped tunnel, preemption) re-runs only that chunk; keys are
+    # explicit so the retried chunk is bit-identical.
+    chunks = require_all(
+        run_shards(chunk_shard, n_chunks, retriable=(jax.errors.JaxRuntimeError,))
+    )
+    cat = lambda j: jnp.concatenate([c[j] for c in chunks], axis=0)[:n_trees]
+    return Forest(
+        split_feat=cat(0),
+        split_bin=cat(1),
+        leaf_value=cat(2),
+        counts=cat(3),
+        bin_edges=edges,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("depth", "mtry", "n_bins", "hist_backend")
+)
+def _grow_chunk(tree_keys, codes, yf, xb_onehot, *, depth, mtry, n_bins, hist_backend):
+    """One compiled chunk of trees (vmapped). Module-level jit: the
+    executable is shared by every chunk of every forest with the same
+    shapes/statics — the host loop in the fitters adds no recompiles."""
+    n, p = codes.shape
     max_nodes = 1 << (depth - 1)
     n_leaves = 1 << depth
 
@@ -234,32 +269,16 @@ def fit_forest_classifier(
         bins = jnp.stack(bins_l)
 
         # Leaf stats at depth D (bootstrap-weighted), parent-filled where
-        # empty by falling back to the overall rate.
-        leaf_oh = jax.nn.one_hot(node_of_row, n_leaves, dtype=jnp.float32)
-        leaf_c = jnp.matmul(counts, leaf_oh, precision=_PREC)
-        leaf_y = jnp.matmul(counts * yf, leaf_oh, precision=_PREC)
+        # empty by falling back to the overall rate. segment_sum, not a
+        # (n, 2^D) one-hot matmul: at reference scale the one-hot is
+        # gigabytes per vmapped tree chunk.
+        leaf_c = jax.ops.segment_sum(counts, node_of_row, num_segments=n_leaves)
+        leaf_y = jax.ops.segment_sum(counts * yf, node_of_row, num_segments=n_leaves)
         overall = jnp.sum(counts * yf) / jnp.maximum(jnp.sum(counts), 1e-12)
         leaf_value = jnp.where(leaf_c > 0, leaf_y / jnp.maximum(leaf_c, 1e-12), overall)
         return feats, bins, leaf_value, counts
 
-    tree_chunk = pick_chunk(n_trees, tree_chunk)
-    n_chunks = -(-n_trees // tree_chunk)  # ceil: padded, sliced after
-    tree_keys = jax.random.split(key, n_chunks * tree_chunk)
-
-    def chunk_fn(keys):
-        return jax.vmap(grow_one)(keys)
-
-    feats, bins, leaf_values, counts = lax.map(
-        chunk_fn, tree_keys.reshape(n_chunks, tree_chunk, *tree_keys.shape[1:])
-    )
-    reshape = lambda a: a.reshape((n_chunks * tree_chunk,) + a.shape[2:])[:n_trees]
-    return Forest(
-        split_feat=reshape(feats),
-        split_bin=reshape(bins),
-        leaf_value=reshape(leaf_values),
-        counts=reshape(counts),
-        bin_edges=edges,
-    )
+    return jax.vmap(grow_one)(tree_keys)
 
 
 @jax.jit

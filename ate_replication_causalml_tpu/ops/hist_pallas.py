@@ -49,13 +49,21 @@ def _round_up(x: int, m: int) -> int:
 
 
 def resolve_hist_backend(backend: str, allow_onehot: bool = True) -> str:
-    """The single place the 'auto' policy lives: the compiled Pallas
-    kernel on TPU; elsewhere the shared-one-hot XLA matmul when the
-    caller supports it (the forest engines, fastest at reference scale
-    on CPU), else the chunked-XLA fallback."""
+    """The single place the 'auto' policy lives.
+
+    Measured on TPU v5-lite (n=100k, p=21, 64 bins, 32-tree chunks):
+    the chunked-XLA contraction runs ~36 ms/tree vs ~55 ms/tree for the
+    Pallas kernel, and the kernel's VMEM-resident accumulator
+    (K·max_nodes × p·n_bins f32) exhausts scoped VMEM for deep trees
+    under tree-vmap. So 'auto' is the XLA path everywhere — the fastest
+    *and* the memory-robust choice; the kernel remains selectable
+    (``backend="pallas"``) and bit-exact (tests/test_hist_pallas.py)
+    for platforms/shapes where a fused kernel wins. On CPU the forest
+    engines pass ``allow_onehot=True`` to use the shared one-hot matmul
+    (fastest at reference scale)."""
     if backend == "auto":
         if jax.default_backend() == "tpu":
-            return "pallas"
+            return "xla"
         return "onehot" if allow_onehot else "xla"
     return backend
 

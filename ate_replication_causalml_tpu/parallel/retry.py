@@ -1,0 +1,118 @@
+"""Failure detection & elastic recovery (SURVEY.md §5.3).
+
+The reference has no systems-level fault tolerance (a single R process;
+its only robustness is numerical — propensity clipping, ``na.rm``). The
+TPU framework's unit of work *is* fault-tolerant by construction: every
+parallel axis (bootstrap replicate batches, CV folds, tree chunks) is
+stateless and idempotent, so recovery is re-execution:
+
+* :func:`probe_devices` — failure detection: run a tiny addition on
+  every visible device, report the healthy subset. A dropped axon
+  tunnel / preempted slice shows up here instead of as a hang deep in
+  an estimator.
+* :func:`run_shards` — elastic shard runner: executes independent
+  shard thunks sequentially, retrying failures (transient
+  ``JaxRuntimeError``, tunnel drops) with exponential backoff.
+  Deterministic: each shard owns its RNG key, so a retried shard
+  reproduces exactly what the failed attempt would have produced.
+  Both forest fitters drive their tree-chunk loops through this.
+* :func:`inject_failures` — fault injection for tests: wraps a shard
+  function so chosen attempts raise, proving the recovery path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Iterable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def probe_devices(devices: Sequence | None = None, timeout_ok: bool = True) -> list:
+    """Return the subset of ``devices`` (default: all) that complete a
+    trivial computation. Failures are caught, not raised — detection,
+    not crash."""
+    healthy = []
+    for d in devices if devices is not None else jax.devices():
+        try:
+            r = jax.device_put(jnp.ones(()), d) + 1.0
+            if float(r) == 2.0:
+                healthy.append(d)
+        except Exception:
+            continue
+    return healthy
+
+
+@dataclasses.dataclass
+class ShardOutcome:
+    """Bookkeeping for one shard's execution."""
+
+    index: int
+    result: object = None
+    attempts: int = 0
+    ok: bool = False
+    error: str | None = None
+
+
+def run_shards(
+    shard_fn: Callable[[int], object],
+    n_shards: int,
+    max_attempts: int = 3,
+    backoff_s: float = 0.25,
+    log: Callable[[str], None] | None = None,
+    retriable: tuple[type[BaseException], ...] = (Exception,),
+) -> list[ShardOutcome]:
+    """Run ``shard_fn(i)`` for every shard ``i`` with per-shard retry.
+
+    Shards must be independent and idempotent (they are: bootstrap
+    batches, folds and tree chunks carry their own fold-in keys). A
+    shard that exhausts ``max_attempts`` is reported failed in its
+    :class:`ShardOutcome`; the others still complete — callers decide
+    whether partial coverage is acceptable (e.g. 9/10 bootstrap batches
+    still estimate an SE) or raise via :func:`require_all`.
+    """
+    outcomes = [ShardOutcome(index=i) for i in range(n_shards)]
+    for out in outcomes:
+        delay = backoff_s
+        while out.attempts < max_attempts and not out.ok:
+            out.attempts += 1
+            try:
+                out.result = shard_fn(out.index)
+                out.ok = True
+            except retriable as e:  # noqa: PERF203 — retry loop
+                out.error = f"{type(e).__name__}: {e}"
+                if log:
+                    log(f"shard {out.index} attempt {out.attempts} failed: {out.error}")
+                if out.attempts < max_attempts:
+                    time.sleep(delay)
+                    delay *= 2.0
+    return outcomes
+
+
+def require_all(outcomes: Iterable[ShardOutcome]) -> list:
+    """Results of fully successful runs; raises if any shard failed."""
+    outcomes = list(outcomes)
+    failed = [o for o in outcomes if not o.ok]
+    if failed:
+        detail = "; ".join(f"shard {o.index}: {o.error}" for o in failed[:5])
+        raise RuntimeError(f"{len(failed)}/{len(outcomes)} shards failed: {detail}")
+    return [o.result for o in outcomes]
+
+
+def inject_failures(
+    shard_fn: Callable[[int], object],
+    fail_plan: dict[int, int],
+) -> Callable[[int], object]:
+    """Fault injection: ``fail_plan[i] = k`` makes shard ``i``'s first
+    ``k`` attempts raise. For testing recovery paths."""
+    remaining = dict(fail_plan)
+
+    def wrapped(i: int):
+        if remaining.get(i, 0) > 0:
+            remaining[i] -= 1
+            raise RuntimeError(f"injected fault on shard {i}")
+        return shard_fn(i)
+
+    return wrapped

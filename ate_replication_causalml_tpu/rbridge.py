@@ -201,10 +201,11 @@ def run_notebook_sweep(n_obs=50_000, seed=1991, outdir=None, quick=False):
     if quick:
         # quick() shrinks tree counts AND the synthetic pool; restore a
         # pool large enough that the caller's n_obs is actually sampled.
+        q = cfg.quick()
         cfg = _dc.replace(
-            cfg.quick(),
+            q,
             prep=PrepConfig(n_obs=int(n_obs), seed=int(seed)),
-            synthetic_pool=max(cfg.quick().synthetic_pool, 3 * int(n_obs)),
+            synthetic_pool=max(q.synthetic_pool, 3 * int(n_obs)),
         )
     report = run_sweep(cfg, outdir=outdir, plots=outdir is not None,
                        log=lambda s: None)

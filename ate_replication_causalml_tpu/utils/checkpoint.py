@@ -99,9 +99,16 @@ def _decode(spec: Any, arrays) -> Any:
     raise ValueError(f"unrecognized checkpoint spec {spec!r}")
 
 
+def _npz_path(path: str) -> str:
+    # np.savez appends '.npz' when missing but np.load does not;
+    # normalize so save/load accept the same string.
+    return path if path.endswith(".npz") else path + ".npz"
+
+
 def save_fitted(path: str, obj: Any) -> None:
     """Write ``obj`` (fitted model / pytree of the kinds above) to one
-    compressed ``.npz``."""
+    compressed ``.npz`` (extension appended if missing)."""
+    path = _npz_path(path)
     arrays: dict[str, np.ndarray] = {}
     manifest = _encode(obj, "root", arrays)
     np.savez_compressed(
@@ -117,7 +124,7 @@ def load_fitted(path: str, device: bool = True) -> Any:
     which stay host NumPy rather than silently truncating (JAX converts
     them on first use; the x64 strict-parity tests get exact values).
     ``device=False`` returns host NumPy throughout."""
-    with np.load(path) as z:
+    with np.load(_npz_path(path)) as z:
         manifest = json.loads(bytes(z["__manifest__"]).decode())
         arrays = {k: z[k] for k in z.files if k != "__manifest__"}
     if device:
