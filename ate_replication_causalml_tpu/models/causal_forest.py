@@ -225,21 +225,25 @@ def _grow_cf_chunk(group_keys, codes, wt, yt, mom_stack, xb_onehot, *,
     max_nodes = 1 << (depth - 1)
     n_leaves = 1 << depth
 
-    def grow_one(codes_g, wt_g, yt_g, mom_g, oh_g, base, tree_key):
+    def grow_one(codes_g, wt_g, yt_g, mom_g, oh_g, base, idx, tree_key):
         """Grow one honest tree.
 
         For the streaming backends (xla/pallas) the caller gathers the
-        group's s-row half-sample, so every histogram/moment pass
-        touches s = n·sample_fraction rows and ``base`` is all-ones.
-        For the 'onehot' backend the rows stay full-n with ``base`` the
-        subsample mask — gathering would copy the shared (n, p·n_bins)
-        one-hot per vmapped group (gigabytes); masking keeps it shared.
+        group's s-row half-sample (``idx``), so every histogram/moment
+        pass touches s = n·sample_fraction rows and ``base`` is
+        all-ones. For the 'onehot' backend the rows stay full-n with
+        ``base`` the subsample mask (``idx=None``) — gathering would
+        copy the shared (n, p·n_bins) one-hot per vmapped group
+        (gigabytes); masking keeps it shared. The honesty Bernoulli is
+        always drawn in full-n row space and gathered, so every backend
+        sees the same honest partition from the same key.
         """
         rows = codes_g.shape[0]
         if honesty:
-            bern = jax.random.bernoulli(tree_key, 0.5, (rows,))
-            gw = base * bern.astype(jnp.float32)
-            ew = base * (1.0 - bern.astype(jnp.float32))
+            bern_full = jax.random.bernoulli(tree_key, 0.5, (n,)).astype(jnp.float32)
+            bern = bern_full if idx is None else bern_full[idx]
+            gw = base * bern
+            ew = base * (1.0 - bern)
         else:
             gw = ew = base
         split_key = jax.random.split(tree_key, depth + 1)[1:]
@@ -328,16 +332,18 @@ def _grow_cf_chunk(group_keys, codes, wt, yt, mom_stack, xb_onehot, *,
         idx = perm[:s]
         in_mask = jnp.zeros((n,), bool).at[idx].set(True)
         tree_keys = jax.random.split(tk, k)
-        vone = jax.vmap(grow_one, in_axes=(None, None, None, None, None, None, 0))
+        vone = jax.vmap(
+            grow_one, in_axes=(None, None, None, None, None, None, None, 0)
+        )
         if hist_backend == "onehot":
             feats, bins, stats = vone(
                 codes, wt, yt, mom_stack, xb_onehot,
-                in_mask.astype(jnp.float32), tree_keys,
+                in_mask.astype(jnp.float32), None, tree_keys,
             )
         else:
             feats, bins, stats = vone(
                 codes[idx], wt[idx], yt[idx], mom_stack[idx], None,
-                jnp.ones((s,), jnp.float32), tree_keys,
+                jnp.ones((s,), jnp.float32), idx, tree_keys,
             )
         return feats, bins, stats, jnp.broadcast_to(in_mask, (k, n))
 

@@ -327,6 +327,64 @@ def predict_forest(forest: Forest, x: jax.Array, oob: bool = False) -> ForestPre
     return ForestPredictions(prob=prob, vote=vote)
 
 
+def fit_forest_sharded(
+    x: jax.Array,
+    y: jax.Array,
+    key: jax.Array,
+    mesh,
+    n_trees: int = 500,
+    depth: int = 9,
+    mtry: int | None = None,
+    n_bins: int = 64,
+    axis_name: str = "tree",
+    hist_backend: str = "auto",
+) -> Forest:
+    """Tree-parallel forest fit over a mesh axis (SURVEY.md §2.4: trees
+    are the expert-parallel analogue).
+
+    Every device grows ``n_trees / axis_size`` trees from its own slice
+    of the key array against replicated binned data; the forest arrays
+    come back sharded along the tree axis (all_gather is XLA's job when
+    a consumer needs them replicated). Numbers are NOT identical to
+    :func:`fit_forest_classifier` (keys are partitioned differently),
+    but the ensemble is statistically equivalent.
+    """
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    n, p = x.shape
+    if mtry is None:
+        mtry = max(1, int(np.sqrt(p)))
+    hist_backend = resolve_hist_backend(hist_backend, allow_onehot=False)
+    axis_size = mesh.shape[axis_name]
+    per_dev = -(-n_trees // axis_size)
+    edges = quantile_bins(x, n_bins)
+    codes = binarize(x, edges)
+    yf = y.astype(jnp.float32)
+    tree_keys = jax.random.split(key, axis_size * per_dev)
+
+    grow = jax.shard_map(
+        functools.partial(
+            _grow_chunk, xb_onehot=None,
+            depth=depth, mtry=mtry, n_bins=n_bins, hist_backend=hist_backend,
+        ),
+        mesh=mesh,
+        in_specs=(P(axis_name), P(), P()),
+        out_specs=P(axis_name),
+    )
+    keys_sharded = jax.device_put(
+        tree_keys, NamedSharding(mesh, P(axis_name))
+    )
+    feats, bins, leaf_values, counts = grow(keys_sharded, codes, yf)
+    return Forest(
+        split_feat=feats[:n_trees],
+        split_bin=bins[:n_trees],
+        leaf_value=leaf_values[:n_trees],
+        counts=counts[:n_trees],
+        bin_edges=edges,
+    )
+
+
 def fit_forest_regressor(
     x: jax.Array,
     y: jax.Array,

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 
 
-def probe_devices(devices: Sequence | None = None, timeout_ok: bool = True) -> list:
+def probe_devices(devices: Sequence | None = None) -> list:
     """Return the subset of ``devices`` (default: all) that complete a
     trivial computation. Failures are caught, not raised — detection,
     not crash."""

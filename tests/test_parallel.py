@@ -102,3 +102,32 @@ def test_bootstrap_nan_semantics_match_r_na_rm():
     want_center = np.nanmean(np.where(np.isfinite(est1), est1, np.nan)) + est2.mean()
     assert abs(taus_m.mean() - want_center) < 0.1
     assert abs(taus_p.mean() - want_center) < 0.1
+
+
+def test_tree_sharded_forest_fit():
+    """EP-analogue tree parallelism: forest grown via shard_map over the
+    mesh's tree axis matches single-device quality (SURVEY.md §2.4)."""
+    import jax.numpy as jnp
+
+    from ate_replication_causalml_tpu.models.forest import (
+        fit_forest_sharded,
+        predict_forest,
+    )
+    from ate_replication_causalml_tpu.parallel.mesh import TREE_AXIS, make_mesh
+
+    rng = np.random.default_rng(2)
+    n = 2048
+    x = jnp.asarray(rng.normal(size=(n, 6)), jnp.float32)
+    logits = 1.5 * np.asarray(x[:, 0]) - 1.0 * np.asarray(x[:, 1])
+    y = jnp.asarray((rng.random(n) < 1 / (1 + np.exp(-logits))).astype(np.float32))
+
+    mesh = make_mesh((TREE_AXIS,))
+    assert mesh.shape[TREE_AXIS] == 8
+    forest = fit_forest_sharded(x, y, jax.random.key(0), mesh, n_trees=64, depth=6)
+    assert forest.n_trees == 64
+    pred = predict_forest(forest, x)
+    sep = float(pred.prob[np.asarray(y) == 1].mean() - pred.prob[np.asarray(y) == 0].mean())
+    assert sep > 0.3
+    # OOB votes exist for every row at these sizes.
+    oob = predict_forest(forest, x, oob=True)
+    assert np.isfinite(np.asarray(oob.vote)).all()
