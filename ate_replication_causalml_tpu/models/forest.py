@@ -71,6 +71,14 @@ class Forest:
     leaf_value: jax.Array   # (T, 2^D) float32
     counts: jax.Array       # (T, n) bootstrap counts of the training rows
     bin_edges: jax.Array = dataclasses.field(metadata=dict(static=False), default=None)
+    # Training-row leaf values, recorded during growth: the grower
+    # already routed every training row, so OOB predictions on the
+    # training matrix (the only OOB there is) need no re-routing pass.
+    # Costs a second (T, n) array while the forest is alive — for a
+    # long-lived forest whose OOB aggregate has been consumed, drop it
+    # with ``dataclasses.replace(forest, train_leaf=None)`` (predictions
+    # fall back to re-routing).
+    train_leaf: jax.Array = dataclasses.field(metadata=dict(static=False), default=None)
 
     @property
     def n_trees(self) -> int:
@@ -179,6 +187,7 @@ def fit_forest_classifier(
         leaf_value=cat(2),
         counts=cat(3),
         bin_edges=edges,
+        train_leaf=cat(4),
     )
 
 
@@ -276,7 +285,7 @@ def _grow_chunk(tree_keys, codes, yf, xb_onehot, *, depth, mtry, n_bins, hist_ba
         leaf_y = jax.ops.segment_sum(counts * yf, node_of_row, num_segments=n_leaves)
         overall = jnp.sum(counts * yf) / jnp.maximum(jnp.sum(counts), 1e-12)
         leaf_value = jnp.where(leaf_c > 0, leaf_y / jnp.maximum(leaf_c, 1e-12), overall)
-        return feats, bins, leaf_value, counts
+        return feats, bins, leaf_value, counts, leaf_value[node_of_row]
 
     return jax.vmap(grow_one)(tree_keys)
 
@@ -306,10 +315,17 @@ def predict_forest(forest: Forest, x: jax.Array, oob: bool = False) -> ForestPre
     fraction of trees whose leaf majority-class is 1. With ``oob=True``
     (valid only for the training matrix) each row averages only over
     trees whose bootstrap count for that row is zero — the reference's
-    OOB propensity (``ate_functions.R:174``).
+    OOB propensity (``ate_functions.R:174``). With ``oob=True`` the
+    per-tree leaf values recorded at growth time (``train_leaf``) are
+    used directly — ``x`` MUST be the training matrix in training row
+    order (a same-shape different matrix is indistinguishable and would
+    silently get training predictions); row-count mismatches raise.
     """
-    codes = binarize(x, forest.bin_edges)
-    leaf_vals = forest_apply(forest, codes)  # (T, n)
+    if oob and forest.train_leaf is not None:
+        leaf_vals = forest.train_leaf  # (T, n) — recorded during growth
+    else:
+        codes = binarize(x, forest.bin_edges)
+        leaf_vals = forest_apply(forest, codes)  # (T, n)
     votes = (leaf_vals > 0.5).astype(jnp.float32)
     if oob:
         if x.shape[0] != forest.counts.shape[1]:
@@ -355,6 +371,11 @@ def fit_forest_sharded(
     n, p = x.shape
     if mtry is None:
         mtry = max(1, int(np.sqrt(p)))
+    if hist_backend == "onehot":
+        raise ValueError(
+            "hist_backend='onehot' is not supported on the sharded path "
+            "(the shared bin one-hot is not built here); use 'auto'/'xla'/'pallas'"
+        )
     hist_backend = resolve_hist_backend(hist_backend, allow_onehot=False)
     axis_size = mesh.shape[axis_name]
     per_dev = -(-n_trees // axis_size)
@@ -375,13 +396,14 @@ def fit_forest_sharded(
     keys_sharded = jax.device_put(
         tree_keys, NamedSharding(mesh, P(axis_name))
     )
-    feats, bins, leaf_values, counts = grow(keys_sharded, codes, yf)
+    feats, bins, leaf_values, counts, train_leaf = grow(keys_sharded, codes, yf)
     return Forest(
         split_feat=feats[:n_trees],
         split_bin=bins[:n_trees],
         leaf_value=leaf_values[:n_trees],
         counts=counts[:n_trees],
         bin_edges=edges,
+        train_leaf=train_leaf[:n_trees],
     )
 
 

@@ -279,9 +279,6 @@ def _binomial_deviance_loss(y, eta, w):
     return -2.0 * jnp.sum(w * ll) / jnp.maximum(jnp.sum(w), eps)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("family", "alpha", "nfolds", "nlambda", "fold_axis")
-)
 def cv_glmnet(
     x: jax.Array,
     y: jax.Array,
@@ -293,6 +290,40 @@ def cv_glmnet(
     key: jax.Array | None = None,
     nlambda: int = DEFAULT_NLAMBDA,
     fold_axis: str | None = None,
+) -> CvGlmnetResult:
+    """See :func:`_cv_glmnet_impl`. This thin wrapper resolves the
+    active mesh *outside* the jit boundary when ``fold_axis`` is given —
+    the mesh is then a static (hashable) argument, so a later call under
+    a different mesh recompiles instead of silently reusing a stale
+    device assignment baked in at trace time."""
+    mesh = None
+    if fold_axis is not None:
+        from ate_replication_causalml_tpu.parallel.mesh import get_mesh
+
+        mesh = get_mesh()
+    return _cv_glmnet_impl(
+        x, y, family=family, alpha=alpha, penalty_factor=penalty_factor,
+        nfolds=nfolds, foldid=foldid, key=key, nlambda=nlambda,
+        fold_axis=fold_axis, mesh=mesh,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("family", "alpha", "nfolds", "nlambda", "fold_axis", "mesh"),
+)
+def _cv_glmnet_impl(
+    x: jax.Array,
+    y: jax.Array,
+    family: str = "gaussian",
+    alpha: float = 1.0,
+    penalty_factor: jax.Array | None = None,
+    nfolds: int = 10,
+    foldid: jax.Array | None = None,
+    key: jax.Array | None = None,
+    nlambda: int = DEFAULT_NLAMBDA,
+    fold_axis: str | None = None,
+    mesh=None,
 ) -> CvGlmnetResult:
     """K-fold cross-validated elastic net (R ``cv.glmnet``).
 
@@ -327,8 +358,28 @@ def cv_glmnet(
             loss = jax.vmap(lambda e: _binomial_deviance_loss(y, e, test_w))(eta)
         return loss
 
-    fold_ids = jnp.arange(1, nfolds + 1)
-    losses = jax.vmap(fold_fit)(fold_ids)  # (K, L)
+    if fold_axis is None:
+        fold_ids = jnp.arange(1, nfolds + 1)
+        losses = jax.vmap(fold_fit)(fold_ids)  # (K, L)
+    else:
+        # Shard the fold batch over the active mesh's ``fold_axis``:
+        # each device fits its folds against replicated data; XLA
+        # all_gathers the (K, L) loss matrix. Fold count pads up to a
+        # multiple of the axis size (padded ids select no test rows;
+        # their losses are sliced off before selection).
+        from jax.sharding import PartitionSpec as _P
+
+        ax = mesh.shape[fold_axis]
+        k_pad = -(-nfolds // ax) * ax
+        fold_ids = jnp.arange(1, k_pad + 1)
+        sharded = jax.shard_map(
+            lambda ids: jax.vmap(fold_fit)(ids),
+            mesh=mesh,
+            in_specs=_P(fold_axis),
+            out_specs=_P(fold_axis),
+            check_vma=False,  # fold_fit closes over replicated x/y/path
+        )
+        losses = sharded(fold_ids)[:nfolds]
 
     # cv.glmnet: cvm = weighted mean over folds (equal fold sizes up to
     # rounding -> plain mean matches R to O(1/n)); cvsd = sd/sqrt(K).

@@ -113,6 +113,36 @@ def test_csv_reader_short_rows_are_nan(tmp_path):
     np.testing.assert_array_equal(out[2], [7.0, 8.0, 9.0])
 
 
+def test_sanitizer_clean(tmp_path):
+    """Build the native runtime + selftest under ASan/UBSan and run it
+    (SURVEY.md §5.2 — sanitizers for the only native code in the
+    framework). Catches leaks, overflow, UB in the RNG/CSV cores."""
+    import os
+    import shutil
+    import subprocess
+
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ toolchain for the sanitizer build")
+
+    here = os.path.dirname(
+        __import__("ate_replication_causalml_tpu.native", fromlist=["x"]).__file__
+    )
+    exe = str(tmp_path / "selftest")
+    subprocess.run(
+        ["g++", "-O1", "-g", "-std=c++17",
+         "-fsanitize=address,undefined", "-fno-sanitize-recover=all",
+         os.path.join(here, "rcompat.cpp"),
+         os.path.join(here, "rcompat_selftest.cpp"),
+         "-o", exe],
+        check=True, capture_output=True, text=True,
+    )
+    out = subprocess.run(
+        [exe], check=True, capture_output=True, text=True,
+        env={**os.environ, "ASAN_OPTIONS": "detect_leaks=1"},
+    )
+    assert "all checks passed" in out.stdout
+
+
 def test_csv_reader_all_missing_line(tmp_path):
     path = tmp_path / "t.csv"
     path.write_text("a,b\n,\nNA,7\n")
