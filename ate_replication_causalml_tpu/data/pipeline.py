@@ -75,9 +75,12 @@ def load_raw_csv(path: str, schema: DatasetSchema = GGL_SCHEMA) -> dict[str, np.
 
 
 def _zscore(col: np.ndarray) -> np.ndarray:
-    """R ``scale()``: (x - mean) / sd with the n-1 denominator."""
-    mu = col.mean()
-    sd = col.std(ddof=1)
+    """R ``scale()``: (x - mean) / sd with the n-1 denominator. NA-
+    tolerant like R (``colMeans(na.rm=TRUE)`` / per-column sd over
+    complete values): an NA row must not poison the whole column — it
+    stays NA and is dropped by the later na.omit stage."""
+    mu = np.nanmean(col)
+    sd = np.nanstd(col, ddof=1)
     return (col - mu) / sd
 
 

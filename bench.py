@@ -79,7 +79,15 @@ def bench_forest():
     compile_s, fitted = one_fit(1)
     steady_s, fitted = one_fit(2)
     eff = average_treatment_effect(fitted)
+    ate, se = float(eff.estimate), float(eff.std_err)  # device sync HERE
     sec_per_1m = steady_s * 1e6 / n
+    # Stderr diagnostics first; the required JSON line is the LAST thing
+    # printed, so a mid-run failure can never leave two JSON lines.
+    print(
+        f"# rows={n} trees={FOREST_TREES} first={compile_s:.1f}s steady={steady_s:.1f}s "
+        f"ate={ate:.4f} se={se:.4f} (true 1.5)",
+        file=sys.stderr,
+    )
     print(
         json.dumps(
             {
@@ -89,11 +97,6 @@ def bench_forest():
                 "vs_baseline": round(FOREST_BASELINE_S_PER_1M / sec_per_1m, 2),
             }
         )
-    )
-    print(
-        f"# rows={n} trees={FOREST_TREES} first={compile_s:.1f}s steady={steady_s:.1f}s "
-        f"ate={float(eff.estimate):.4f} se={float(eff.std_err):.4f} (true 1.5)",
-        file=sys.stderr,
     )
 
 
@@ -138,6 +141,14 @@ def main():
         tau, se = float(tau), float(se)
         best = min(best, time.perf_counter() - t0)
 
+    # Stderr diagnostics first; the required JSON line is the LAST
+    # thing printed (a mid-run failure can never leave two JSON lines).
+    print(
+        f"# tau={tau:.6f} se={se:.6f} "
+        f"first_call={compile_and_run:.1f}s steady={best:.3f}s "
+        f"devices={jax.device_count()}",
+        file=sys.stderr,
+    )
     print(
         json.dumps(
             {
@@ -148,13 +159,25 @@ def main():
             }
         )
     )
-    print(
-        f"# tau={float(tau):.6f} se={float(se):.6f} "
-        f"first_call={compile_and_run:.1f}s steady={best:.3f}s "
-        f"devices={jax.device_count()}",
-        file=sys.stderr,
-    )
 
 
 if __name__ == "__main__":
-    main()
+    # The axon TPU tunnel occasionally drops mid-run (remote compile /
+    # worker restarts). JAX caches the PJRT client process-globally, so
+    # recovery needs a FRESH process: re-exec ourselves once after a
+    # cool-down (env flag prevents a retry loop). The JSON line is the
+    # last print of a successful run, so the record stays single-line.
+    try:
+        main()
+    except Exception:  # noqa: BLE001 — re-exec-once guard
+        import os
+        import traceback
+
+        traceback.print_exc()
+        if os.environ.get("ATE_BENCH_RETRIED"):
+            sys.exit(1)
+        print("# first attempt failed; re-executing in 30s", file=sys.stderr)
+        sys.stderr.flush()
+        time.sleep(30)
+        os.environ["ATE_BENCH_RETRIED"] = "1"
+        os.execv(sys.executable, [sys.executable] + sys.argv)
