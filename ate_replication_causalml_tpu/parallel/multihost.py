@@ -1,0 +1,96 @@
+"""Multi-host (multi-slice) initialization — the DCN scale-out path.
+
+The reference has no communication backend at all (one R process,
+SURVEY.md §2.4/§5.8). This framework's equivalent of an NCCL/MPI world
+is JAX's distributed runtime: every host calls
+:func:`init_multihost`, after which ``jax.devices()`` spans the pod and
+the same mesh/shard_map code compiles to ICI collectives within a slice
+and DCN transfers across slices.
+
+The framework's parallel axes place cleanly on a multi-slice mesh:
+
+* ``boot`` / ``tree`` / ``fold`` — embarrassingly parallel, zero
+  tight coupling: put these on the OUTER (DCN) mesh dimension, so
+  cross-slice traffic is one result-gather per estimator.
+* ``data`` — row sharding with psum reductions: keep within a slice
+  (ICI) via the inner mesh dimension.
+
+``make_pod_mesh`` encodes exactly that layout.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh
+
+from ate_replication_causalml_tpu.parallel.mesh import BOOT_AXIS, DATA_AXIS
+
+
+def init_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Initialize JAX's distributed runtime when running multi-process.
+
+    On TPU pods the arguments are discovered from the environment, so
+    bare ``init_multihost()`` is correct there — call it BEFORE anything
+    touches ``jax.devices()`` (``jax.distributed.initialize`` refuses to
+    run once the backend exists, which is also why this function never
+    probes the backend before initializing). Single-process runs
+    (tests, one chip, CPU meshes) return False and everything else
+    works identically.
+    """
+    if num_processes == 1:
+        return False  # explicit single-process: documented no-op
+    kwargs = {}
+    if coordinator_address is not None or process_id is not None:
+        kwargs = dict(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    try:
+        jax.distributed.initialize(**kwargs)
+        return True
+    except RuntimeError as e:
+        if "already" in str(e).lower():
+            # A launcher (or an early devices() call) initialized first;
+            # report whether a multi-process world actually exists.
+            return jax.process_count() > 1
+        raise
+    except ValueError:
+        return False  # auto-detection found no multi-host environment
+
+
+def make_pod_mesh(
+    replicate_axis: str = BOOT_AXIS,
+    data_axis: str = DATA_AXIS,
+    data_parallel_per_slice: int | None = None,
+) -> Mesh:
+    """Two-axis pod mesh: (replicates over DCN+remaining ICI, rows over
+    ICI). The replicate axis carries bootstrap/tree/fold work — pure
+    fan-out, so it tolerates DCN latency; the data axis carries psum
+    reductions, so it stays inside a slice.
+
+    ``data_parallel_per_slice`` defaults to the size of the first
+    slice, read from the devices' ``slice_index`` attribute (TPU
+    multi-slice); when the platform has no slice notion (CPU meshes,
+    single slice) it is all devices. Pass it explicitly to subdivide.
+    """
+    from ate_replication_causalml_tpu.parallel.mesh import make_mesh
+
+    devs = list(jax.devices())
+    if data_parallel_per_slice is None:
+        s0 = getattr(devs[0], "slice_index", None)
+        if s0 is not None:
+            data_parallel_per_slice = sum(
+                1 for d in devs if getattr(d, "slice_index", None) == s0
+            )
+        else:
+            data_parallel_per_slice = len(devs)
+    data_parallel_per_slice = min(max(1, data_parallel_per_slice), len(devs))
+    n_rep = len(devs) // data_parallel_per_slice
+    return make_mesh(
+        (replicate_axis, data_axis), (n_rep, data_parallel_per_slice)
+    )
