@@ -43,13 +43,16 @@ def init_multihost(
     """
     if num_processes == 1:
         return False  # explicit single-process: documented no-op
-    kwargs = {}
-    if coordinator_address is not None or process_id is not None:
-        kwargs = dict(
+    explicit = (coordinator_address, num_processes, process_id) != (None, None, None)
+    kwargs = (
+        dict(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
         )
+        if explicit
+        else {}
+    )
     try:
         jax.distributed.initialize(**kwargs)
         return True
@@ -60,6 +63,8 @@ def init_multihost(
             return jax.process_count() > 1
         raise
     except ValueError:
+        if explicit:
+            raise  # misconfigured explicit args must not be swallowed
         return False  # auto-detection found no multi-host environment
 
 
@@ -91,6 +96,17 @@ def make_pod_mesh(
             data_parallel_per_slice = len(devs)
     data_parallel_per_slice = min(max(1, data_parallel_per_slice), len(devs))
     n_rep = len(devs) // data_parallel_per_slice
+    used = n_rep * data_parallel_per_slice
+    if used < len(devs):
+        import warnings
+
+        warnings.warn(
+            f"make_pod_mesh: {len(devs) - used} of {len(devs)} devices idle "
+            f"(device count not divisible by data_parallel_per_slice="
+            f"{data_parallel_per_slice})",
+            RuntimeWarning,
+            stacklevel=2,
+        )
     return make_mesh(
         (replicate_axis, data_axis), (n_rep, data_parallel_per_slice)
     )

@@ -80,6 +80,7 @@ class SweepConfig:
     cf_trees: int = 2000            # grf num.trees, Rmd:255
     cf_nuisance_trees: int = 500
     forest_depth: int = 9
+    balance_iters: int = 12_000     # ADMM budget; 4k leaves ~3e-3 residual at 50k rows
     seed: int = 0                   # jax.random seed for the TPU fast path
 
     def quick(self) -> "SweepConfig":
@@ -88,7 +89,7 @@ class SweepConfig:
             prep=dataclasses.replace(self.prep, n_obs=8_000),
             synthetic_pool=20_000,
             dr_trees=250, dml_trees=200, cf_trees=200, cf_nuisance_trees=100,
-            forest_depth=7,
+            forest_depth=7, balance_iters=4_000,
         )
 
 
@@ -282,7 +283,8 @@ def run_sweep(
               lambda: double_ml(df_mod, n_trees=config.dml_trees,
                                 depth=config.forest_depth, key=key_for("dml"))))
     add(stage("residual_balancing",
-              lambda: residual_balance_ate(df_mod, key=key_for("balance"))))
+              lambda: residual_balance_ate(df_mod, key=key_for("balance"),
+                                           max_iters=config.balance_iters)))
 
     # Causal forest: the result row plus the notebook's 'incorrect' demo
     # (Rmd:258-262). The demo values ride the checkpoint record as stage

@@ -38,3 +38,14 @@ def test_make_pod_mesh_explicit_split_runs_collectives():
     )
     got = jax.jit(lambda a: a.mean(axis=1))(xs)
     np.testing.assert_allclose(np.asarray(got), x.reshape(2, 32).mean(axis=1))
+
+def test_make_pod_mesh_warns_on_idle_devices():
+    import warnings
+
+    from ate_replication_causalml_tpu.parallel.multihost import make_pod_mesh
+
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        mesh = make_pod_mesh(data_parallel_per_slice=3)
+    assert mesh.shape == {"boot": 2, "data": 3}
+    assert any("idle" in str(w.message) for w in rec)

@@ -71,3 +71,19 @@ def test_dml_and_balance(dataset):
                "Double Machine Learning")
     _check_row(rbridge.residual_balance_ATE(dataset), "residual_balancing")
     _check_row(rbridge.belloni(dataset), "Belloni et.al")
+
+
+def test_run_notebook_sweep_quick(tmp_path):
+    """The R notebook's one-call driver: full sweep rows in rbind-ready
+    form, quick config with the caller's n_obs actually honored."""
+    rows = rbridge.run_notebook_sweep(n_obs=2_500, seed=1991, quick=True,
+                                      outdir=str(tmp_path / "out"))
+    methods = [r["Method"] for r in rows]
+    assert methods[0] == "oracle" and "Causal Forest(GRF)" in methods
+    assert len(methods) == 14
+    for r in rows:
+        assert np.isfinite(r["ATE"])
+    import json as _json
+    recs = [_json.loads(l) for l in
+            open(tmp_path / "out" / "results.jsonl") if l.strip()]
+    assert any(r.get("method") == "oracle" for r in recs)
