@@ -89,7 +89,9 @@ def _hist_kernel(codes_ref, node_ref, w_ref, out_ref, *, n_weights, max_nodes, p
 
     # Bin one-hot: (TILE, pb_pad), one 1 per real feature block. Built in
     # one shot from the flat index code + f·n_bins — padded lanes ≥ p·n_bins
-    # match nothing because real flat codes are < p·n_bins.
+    # match nothing because real flat codes are < p·n_bins. (A blockwise
+    # (TILE, p, n_bins)-compare + lane-flatten would be ~22× less VPU
+    # work, but Mosaic cannot lower that reshape across the lane axis.)
     feat_iota = lax.broadcasted_iota(jnp.int32, (tile, p), 1)
     flat_code = codes_ref[:, :p] + feat_iota * n_bins  # (TILE, p)
     lane_iota = lax.broadcasted_iota(jnp.int32, (tile, pb_pad), 1)
