@@ -188,10 +188,15 @@ def causal_forest(dataset, treatment_var="W", outcome_var="Y", num_trees=2000,
     return out
 
 
-def run_notebook_sweep(n_obs=50_000, seed=1991, outdir=None, quick=False):
+def run_notebook_sweep(n_obs=50_000, seed=1991, outdir=None, quick=False,
+                       overrides=None):
     """One-call driver for the R notebook: the full estimator sweep on
     the synthetic GGL panel (SweepConfig defaults mirror the notebook's
-    call sites). Returns the rows as a list of dicts for rbind."""
+    call sites). Returns the rows as a list of dicts for rbind.
+
+    ``overrides``: optional dict of SweepConfig field overrides (e.g.
+    ``list(dr_trees = 500L)`` from R) applied last.
+    """
     import dataclasses as _dc
 
     from ate_replication_causalml_tpu.data.pipeline import PrepConfig
@@ -207,6 +212,15 @@ def run_notebook_sweep(n_obs=50_000, seed=1991, outdir=None, quick=False):
             prep=PrepConfig(n_obs=int(n_obs), seed=int(seed)),
             synthetic_pool=max(q.synthetic_pool, 3 * int(n_obs)),
         )
+    if overrides:
+        # Coerce at the boundary like every other entry point here: R
+        # numerics arrive as Python floats (500, not 500L), and the
+        # int-typed SweepConfig fields must stay ints.
+        coerced = {}
+        for k, v in dict(overrides).items():
+            field_type = SweepConfig.__dataclass_fields__[k].type
+            coerced[k] = int(v) if field_type == "int" else v
+        cfg = _dc.replace(cfg, **coerced)
     report = run_sweep(cfg, outdir=outdir, plots=outdir is not None,
                        log=lambda s: None)
     rows = [_row(report.oracle)] + [_row(r) for r in report.results.rows]

@@ -76,8 +76,21 @@ def test_dml_and_balance(dataset):
 def test_run_notebook_sweep_quick(tmp_path):
     """The R notebook's one-call driver: full sweep rows in rbind-ready
     form, quick config with the caller's n_obs actually honored."""
-    rows = rbridge.run_notebook_sweep(n_obs=2_500, seed=1991, quick=True,
-                                      outdir=str(tmp_path / "out"))
+    # Shapes/configs come FROM test_pipeline_driver's TINY sweep so the
+    # two tests share compiled executables within a suite run (and the
+    # invariant can't silently drift). Floats mimic R-numeric arrival.
+    from tests.test_pipeline_driver import TINY
+
+    rows = rbridge.run_notebook_sweep(
+        n_obs=TINY.prep.n_obs, seed=1991, quick=True,
+        outdir=str(tmp_path / "out"),
+        overrides=dict(
+            synthetic_pool=float(TINY.synthetic_pool),
+            dr_trees=float(TINY.dr_trees), dml_trees=TINY.dml_trees,
+            cf_trees=TINY.cf_trees, cf_nuisance_trees=TINY.cf_nuisance_trees,
+            forest_depth=TINY.forest_depth,
+        ),
+    )
     methods = [r["Method"] for r in rows]
     assert methods[0] == "oracle" and "Causal Forest(GRF)" in methods
     assert len(methods) == 14
