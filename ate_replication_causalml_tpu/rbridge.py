@@ -216,10 +216,16 @@ def run_notebook_sweep(n_obs=50_000, seed=1991, outdir=None, quick=False,
         # Coerce at the boundary like every other entry point here: R
         # numerics arrive as Python floats (500, not 500L), and the
         # int-typed SweepConfig fields must stay ints.
+        import typing
+
+        hints = typing.get_type_hints(SweepConfig)
         coerced = {}
         for k, v in dict(overrides).items():
-            field_type = SweepConfig.__dataclass_fields__[k].type
-            coerced[k] = int(v) if field_type == "int" else v
+            if k not in hints:
+                raise ValueError(
+                    f"unknown SweepConfig override {k!r}; valid: {sorted(hints)}"
+                )
+            coerced[k] = int(v) if hints[k] is int else v
         cfg = _dc.replace(cfg, **coerced)
     report = run_sweep(cfg, outdir=outdir, plots=outdir is not None,
                        log=lambda s: None)

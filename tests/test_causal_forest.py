@@ -100,6 +100,26 @@ def test_ci_group_size_travels_with_forest():
     assert np.all(np.asarray(cate.variance) >= 0)
 
 
+def test_cate_prediction_on_new_data():
+    """grf ``predict(forest, newdata)``: oob=False routes held-out rows
+    through the trees and recovers the heterogeneity pattern."""
+    frame, _, _ = _heterogeneous_problem(n=2400)
+    train = CausalFrame(x=frame.x[:2000], w=frame.w[:2000], y=frame.y[:2000])
+    fitted = _fit_small(train, n_trees=100)
+    x_new = frame.x[2000:]
+    cate = predict_cate(fitted.forest, x_new, oob=False)
+    pred = np.asarray(cate.cate)
+    assert pred.shape == (400,)
+    lo = pred[np.asarray(x_new[:, 0]) <= 0].mean()
+    hi = pred[np.asarray(x_new[:, 0]) > 0].mean()
+    assert hi - lo > 1.0, (lo, hi)
+    # oob=True on non-training data must refuse.
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        predict_cate(fitted.forest, x_new, oob=True)
+
+
 def test_estimator_result_row():
     frame, _, ate_true = _heterogeneous_problem(n=1500)
     res = causal_forest_ate(
