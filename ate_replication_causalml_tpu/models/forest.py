@@ -79,6 +79,11 @@ class Forest:
     # with ``dataclasses.replace(forest, train_leaf=None)`` (predictions
     # fall back to re-routing).
     train_leaf: jax.Array = dataclasses.field(metadata=dict(static=False), default=None)
+    # Order-sensitive fingerprint of the training codes, recorded at fit
+    # time so ``predict_forest(oob=True)`` can detect a same-row-count
+    # matrix that is NOT the training matrix (permuted / re-standardized)
+    # instead of silently returning training-time predictions.
+    train_fp: jax.Array = dataclasses.field(metadata=dict(static=False), default=None)
 
     @property
     def n_trees(self) -> int:
@@ -87,6 +92,20 @@ class Forest:
     @property
     def depth(self) -> int:
         return self.split_feat.shape[1]
+
+
+@jax.jit
+def codes_fingerprint(codes: jax.Array) -> jax.Array:
+    """Cheap order-sensitive int32 fingerprint of a bin-code matrix:
+    Σ codes[i,j]·(31·i + j + 1) with int32 wraparound. Row permutations
+    and any code change move it (unlike a plain sum)."""
+    n, p = codes.shape
+    mix = (
+        31 * jnp.arange(n, dtype=jnp.int32)[:, None]
+        + jnp.arange(p, dtype=jnp.int32)[None, :]
+        + 1
+    )
+    return jnp.sum(codes * mix, dtype=jnp.int32)
 
 
 def quantile_bins(x: jax.Array, n_bins: int = 64) -> jax.Array:
@@ -188,6 +207,7 @@ def fit_forest_classifier(
         counts=cat(3),
         bin_edges=edges,
         train_leaf=cat(4),
+        train_fp=codes_fingerprint(codes),
     )
 
 
@@ -322,6 +342,24 @@ def predict_forest(forest: Forest, x: jax.Array, oob: bool = False) -> ForestPre
     silently get training predictions); row-count mismatches raise.
     """
     if oob and forest.train_leaf is not None:
+        # Guard against a same-shape matrix that is not the training
+        # matrix (checked only when everything involved is concrete —
+        # inside a trace of either x or the forest the fingerprint is
+        # symbolic and the caller owns the contract).
+        concrete = lambda a: not isinstance(a, jax.core.Tracer)
+        if (
+            forest.train_fp is not None
+            and concrete(x)
+            and concrete(forest.train_fp)
+            and concrete(forest.bin_edges)
+        ):
+            fp = codes_fingerprint(binarize(x, forest.bin_edges))
+            if int(fp) != int(forest.train_fp):
+                raise ValueError(
+                    "oob=True with recorded training leaves, but x does not "
+                    "fingerprint as the training matrix (permuted or altered "
+                    "rows?); pass oob=False for new data"
+                )
         leaf_vals = forest.train_leaf  # (T, n) — recorded during growth
     else:
         codes = binarize(x, forest.bin_edges)
@@ -404,6 +442,7 @@ def fit_forest_sharded(
         counts=counts[:n_trees],
         bin_edges=edges,
         train_leaf=train_leaf[:n_trees],
+        train_fp=codes_fingerprint(codes),
     )
 
 

@@ -30,11 +30,31 @@ _lib_error: str | None = None
 
 
 def _build() -> None:
+    # Compile to a per-process temp path and os.replace() into place:
+    # concurrent builders (pytest-xdist, multi-host launches on a shared
+    # filesystem) each produce a complete .so and the rename is atomic,
+    # so no process can ever dlopen a half-written file. An flock on a
+    # sidecar serializes the (cheap) compiles across processes where the
+    # filesystem supports it.
+    tmp_path = f"{_LIB_PATH}.{os.getpid()}.tmp"
     cmd = [
         "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-        "-o", _LIB_PATH, _SRC,
+        "-o", tmp_path, _SRC,
     ]
-    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    lockfile = open(f"{_LIB_PATH}.lock", "w")  # noqa: SIM115 — held across build
+    try:
+        try:
+            import fcntl
+
+            fcntl.flock(lockfile, fcntl.LOCK_EX)
+        except (ImportError, OSError):
+            pass  # no flock (non-POSIX / NFS quirk): atomic rename still safe
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp_path, _LIB_PATH)
+    finally:
+        lockfile.close()
+        if os.path.exists(tmp_path):
+            os.unlink(tmp_path)
 
 
 def load_library(rebuild: bool = False):
@@ -141,6 +161,10 @@ def make_rcompat_rng(seed: int, sample_kind: str = "rounding", backend: str = "a
     and falls back to the NumPy implementation."""
     from ate_replication_causalml_tpu.utils.rrandom import RCompatRNG
 
+    if backend not in ("auto", "native", "python"):
+        raise ValueError(
+            f"unknown RNG backend {backend!r}: expected 'auto', 'native' or 'python'"
+        )
     if backend == "python":
         return RCompatRNG(seed, sample_kind=sample_kind)
     if backend == "native" or native_available():
@@ -161,7 +185,11 @@ def read_csv_native(path: str) -> tuple[list[str], np.ndarray]:
     if lib.csv_dims(bpath, ctypes.byref(rows), ctypes.byref(cols)) != 0:
         raise FileNotFoundError(path)
     buf = ctypes.create_string_buffer(1 << 20)
-    lib.csv_header(bpath, buf, len(buf))
+    rc = lib.csv_header(bpath, buf, len(buf))
+    if rc == -2:
+        raise ValueError(f"{path}: header line longer than {len(buf)} bytes")
+    if rc != 0:
+        raise FileNotFoundError(path)
     header = buf.value.decode().split(",")
     out = np.empty((rows.value, cols.value), dtype=np.float64)
     if lib.csv_read_f64(

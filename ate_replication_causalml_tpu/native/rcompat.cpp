@@ -166,12 +166,20 @@ int csv_dims(const char* path, int64_t* rows, int64_t* cols) {
 }
 
 int csv_header(const char* path, char* buf, int64_t buflen) {
+  // Returns 0 on success, -1 if unreadable, -2 if the header line did
+  // not fit in buflen (truncated output — callers must not trust it).
   FILE* f = std::fopen(path, "rb");
   if (!f) return -1;
   int64_t i = 0;
   int ch;
-  while ((ch = std::fgetc(f)) != EOF && ch != '\n' && i < buflen - 1) {
-    if (ch != '\r' && ch != '"') buf[i++] = (char)ch;
+  while ((ch = std::fgetc(f)) != EOF && ch != '\n') {
+    if (ch == '\r' || ch == '"') continue;
+    if (i >= buflen - 1) {  // would overflow: report truncation
+      buf[i] = '\0';
+      std::fclose(f);
+      return -2;
+    }
+    buf[i++] = (char)ch;
   }
   buf[i] = '\0';
   std::fclose(f);
@@ -202,6 +210,11 @@ int csv_read_f64(const char* path, double* out, int64_t rows, int64_t cols) {
     char* end = nullptr;
     double v = std::strtod(p, &end);
     bool ok = end != p && field.size() > 1;
+    // Trailing non-whitespace after the number ("1x") is non-numeric —
+    // NaN, matching np.genfromtxt (plain strtod would accept 1.0).
+    for (; ok && *end != '\0'; ++end) {
+      if (*end != ' ' && *end != '\t') ok = false;
+    }
     out[rr * cols + cc] = ok ? v : nan;  // "NA", "", non-numeric -> NaN
     field.clear();
   };

@@ -68,43 +68,55 @@ def resolve_hist_backend(backend: str, allow_onehot: bool = True) -> str:
     return backend
 
 
-def _hist_kernel(codes_ref, node_ref, w_ref, out_ref, *, n_weights, max_nodes, p, n_bins):
-    """One grid step: fold a row tile into the resident histogram.
+def _hist_kernel(codes_ref, node_ref, w_ref, out_ref, *, n_weights, max_nodes,
+                 bw, f_pb, n_bins, in_dtype):
+    """One grid step: fold one row tile into one group of feature blocks.
 
-    codes_ref: (TILE, p_pad) int32    — bin codes, padded features are 0
-    node_ref:  (TILE, 1)   int32      — node id per row (padded rows: -1)
-    w_ref:     (n_weights, TILE) f32  — weight vectors (padded rows: 0)
-    out_ref:   (n_weights * max_nodes, pb_pad) f32 — accumulator
+    Grid is (p_groups, n_tiles) with the row-tile axis innermost, so the
+    (n_weights·max_nodes, bw·LANES) output block stays VMEM-resident
+    across the whole row sweep of its feature group (zeroed at tile 0).
+    ``bw`` feature blocks (128 lanes each) per step amortizes the
+    per-step grid overhead; ``bw`` is capped by the scoped-VMEM budget.
+
+    codes_ref: (1, TILE, bw·f_pb) int32 — this group's features only
+    node_ref:  (TILE, 1)   int32        — node id per row (padded: -1)
+    w_ref:     (n_weights, TILE) f32    — weight vectors (padded: 0)
+    out_ref:   (1, n_weights·max_nodes, bw·LANES) f32 — group's slice
     """
-    tile = codes_ref.shape[0]
-    pb_pad = out_ref.shape[-1]
+    tile = codes_ref.shape[1]
 
-    @pl.when(pl.program_id(0) == 0)
+    @pl.when(pl.program_id(1) == 0)
     def _zero():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    # Node one-hot: (TILE, max_nodes). Padded rows carry node=-1 → all 0.
-    node_iota = lax.broadcasted_iota(jnp.int32, (tile, max_nodes), 1)
-    node_oh = (node_ref[:] == node_iota).astype(jnp.float32)
+    # Bin one-hot per 128-lane block, concatenated along lanes. Each
+    # feature is compared only against its own block's 128 lanes —
+    # pb_pad/LANES (~10× at the GGL shape) less VPU compare work than
+    # v1's full-width compares — and each block's lane iota is local, so
+    # the compare constant is just code + f·n_bins < 128.
+    lane_iota = lax.broadcasted_iota(jnp.int32, (tile, _LANES), 1)
+    pieces = []
+    for g in range(bw):
+        oh_g = jnp.zeros((tile, _LANES), in_dtype)
+        for f in range(f_pb):  # static unroll — f_pb = LANES // n_bins
+            flat = codes_ref[0, :, g * f_pb + f : g * f_pb + f + 1] + f * n_bins
+            oh_g = oh_g + (lane_iota == flat).astype(in_dtype)
+        pieces.append(oh_g)
+    bin_oh = pieces[0] if bw == 1 else jnp.concatenate(pieces, axis=1)
 
-    # Bin one-hot: (TILE, pb_pad), one 1 per real feature block. Built in
-    # one shot from the flat index code + f·n_bins — padded lanes ≥ p·n_bins
-    # match nothing because real flat codes are < p·n_bins. (A blockwise
-    # (TILE, p, n_bins)-compare + lane-flatten would be ~22× less VPU
-    # work, but Mosaic cannot lower that reshape across the lane axis.)
-    feat_iota = lax.broadcasted_iota(jnp.int32, (tile, p), 1)
-    flat_code = codes_ref[:, :p] + feat_iota * n_bins  # (TILE, p)
-    lane_iota = lax.broadcasted_iota(jnp.int32, (tile, pb_pad), 1)
-    bin_oh = jnp.zeros((tile, pb_pad), jnp.float32)
-    for f in range(p):  # p is small (21 in the GGL schema) — static unroll
-        bin_oh = bin_oh + (lane_iota == flat_code[:, f : f + 1]).astype(jnp.float32)
+    # Node one-hot: (TILE, max_nodes). Padded rows carry node=-1 → all 0,
+    # which also kills the padded rows' garbage bin one-hot.
+    node_iota = lax.broadcasted_iota(jnp.int32, (tile, max_nodes), 1)
+    node_oh = (node_ref[:] == node_iota).astype(in_dtype)
 
     # Weighted node one-hots for every weight vector, stacked on the
-    # sublane axis: (n_weights·max_nodes, TILE) @ (TILE, pb_pad) on MXU.
+    # sublane axis: (n_weights·max_nodes, TILE) @ (TILE, bw·LANES) on
+    # the MXU, f32 accumulation regardless of in_dtype.
     lhs = jnp.concatenate(
-        [node_oh * w_ref[k, :][:, None] for k in range(n_weights)], axis=1
+        [node_oh * w_ref[k, :][:, None].astype(in_dtype) for k in range(n_weights)],
+        axis=1,
     )  # (TILE, n_weights*max_nodes)
-    out_ref[:] += lax.dot_general(
+    out_ref[0] += lax.dot_general(
         lhs,
         bin_oh,
         dimension_numbers=(((0,), (0,)), ((), ())),
@@ -112,8 +124,12 @@ def _hist_kernel(codes_ref, node_ref, w_ref, out_ref, *, n_weights, max_nodes, p
     )
 
 
+_VMEM_BUDGET = 100 * 1024 * 1024  # raise Mosaic's 16 MB scoped default
+
+
 @functools.partial(
-    jax.jit, static_argnames=("max_nodes", "n_bins", "tile", "interpret")
+    jax.jit,
+    static_argnames=("max_nodes", "n_bins", "tile", "bw", "interpret", "bf16"),
 )
 def bin_histogram_pallas(
     codes: jax.Array,
@@ -122,50 +138,84 @@ def bin_histogram_pallas(
     *,
     max_nodes: int,
     n_bins: int,
-    tile: int = 512,
+    tile: int = 2048,
+    bw: int | None = None,
     interpret: bool = False,
+    bf16: bool = False,
 ) -> jax.Array:
     """Weighted (node, feature, bin) histograms via the Pallas kernel.
 
     Args:
-      codes: (n, p) int32 bin codes in [0, n_bins).
+      codes: (n, p) int32 bin codes in [0, n_bins); n_bins ≤ 128.
       node_of_row: (n,) int32 node ids in [0, max_nodes); rows with ids
         outside the range contribute nothing.
       weights: (K, n) f32 — e.g. (counts, counts·y) for the classifier,
         (counts, counts·ρ) for the causal forest's gradient splits.
+      tile: rows per grid step.
+      bw: feature blocks (128 lanes each) per grid step; default covers
+        all of p in one step (grid = row tiles only).
+      bf16: feed the MXU bf16 operands (f32 accumulation). Bit-exact
+        whenever the weights are integer-valued in [-256, 256] (one-hots
+        are exact 0/1 and small-int bf16 products are exact in f32);
+        lossy for general float weights — callers opt in through the
+        ``backend="pallas_bf16"`` dispatch string.
 
     Returns:
       (K, max_nodes, p, n_bins) f32.
     """
     n, p = codes.shape
     k_w = weights.shape[0]
-    pb = p * n_bins
-    pb_pad = _round_up(pb, _LANES)
+    if n_bins > _LANES:
+        raise ValueError(f"n_bins={n_bins} > {_LANES} unsupported")
+    # Feature-block the (feat, bin) axis: f_pb features per 128-lane
+    # block. Lane layout inside a block is [f_pb × n_bins] + dead pad.
+    f_pb = _LANES // n_bins
+    p_blocks = -(-p // f_pb)
+    if bw is None:
+        bw = p_blocks
+    bw = min(bw, p_blocks)
+    p_groups = -(-p_blocks // bw)
+    p_pad = p_groups * bw * f_pb
     n_pad = _round_up(max(n, tile), tile)
 
-    codes = jnp.pad(codes, ((0, n_pad - n), (0, 0)))
+    codes = jnp.pad(codes, ((0, n_pad - n), (0, p_pad - p)))
+    # (p_groups, n, bw·f_pb): each grid step DMAs one contiguous
+    # (tile, bw·f_pb) slab of its own feature group (Mosaic requires the
+    # block's trailing dim to be lane-aligned or the full array dim).
+    codes_b = codes.reshape(n_pad, p_groups, bw * f_pb).transpose(1, 0, 2)
     node2d = jnp.pad(
         node_of_row.astype(jnp.int32)[:, None], ((0, n_pad - n), (0, 0)),
         constant_values=-1,
     )
     weights = jnp.pad(weights.astype(jnp.float32), ((0, 0), (0, n_pad - n)))
 
-    grid = (n_pad // tile,)
+    grid = (p_groups, n_pad // tile)  # row tiles innermost: accumulation
     out = pl.pallas_call(
         functools.partial(
-            _hist_kernel, n_weights=k_w, max_nodes=max_nodes, p=p, n_bins=n_bins
+            _hist_kernel, n_weights=k_w, max_nodes=max_nodes,
+            bw=bw, f_pb=f_pb, n_bins=n_bins,
+            in_dtype=jnp.bfloat16 if bf16 else jnp.float32,
         ),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((tile, p), lambda i: (i, 0)),
-            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
-            pl.BlockSpec((k_w, tile), lambda i: (0, i)),
+            pl.BlockSpec((1, tile, bw * f_pb), lambda j, i: (j, i, 0)),
+            pl.BlockSpec((tile, 1), lambda j, i: (i, 0)),
+            pl.BlockSpec((k_w, tile), lambda j, i: (0, i)),
         ],
-        out_specs=pl.BlockSpec((k_w * max_nodes, pb_pad), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((k_w * max_nodes, pb_pad), jnp.float32),
+        out_specs=pl.BlockSpec(
+            (1, k_w * max_nodes, bw * _LANES), lambda j, i: (j, 0, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (p_groups, k_w * max_nodes, bw * _LANES), jnp.float32
+        ),
         interpret=interpret,
-    )(codes, node2d, weights)
-    return out[:, :pb].reshape(k_w, max_nodes, p, n_bins)
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_BUDGET),
+    )(codes_b, node2d, weights)
+    # (p_groups, K·M, bw·LANES) → per 128-lane block keep the live
+    # f_pb·n_bins lanes, then restore feature order.
+    out = out.reshape(p_groups, k_w * max_nodes, bw, _LANES)[..., : f_pb * n_bins]
+    out = out.transpose(1, 0, 2, 3).reshape(k_w, max_nodes, p_pad, n_bins)
+    return out[:, :, :p, :]
 
 
 @functools.partial(jax.jit, static_argnames=("max_nodes", "n_bins", "row_chunk"))
@@ -231,12 +281,21 @@ def bin_histogram(
 ) -> jax.Array:
     """Dispatch: compiled Pallas kernel on TPU, chunked XLA elsewhere.
 
-    ``backend``: "auto" | "pallas" | "pallas_interpret" | "xla".
+    ``backend``: "auto" | "pallas" | "pallas_bf16" | "pallas_interpret"
+    | "xla". ``pallas_bf16`` feeds the MXU bf16 operands (f32
+    accumulation) — bit-exact only for integer-valued weights (see
+    :func:`bin_histogram_pallas`); callers opt in per forest via their
+    ``hist_backend`` argument.
     """
     backend = resolve_hist_backend(backend, allow_onehot=False)
     if backend == "pallas":
         return bin_histogram_pallas(
             codes, node_of_row, weights, max_nodes=max_nodes, n_bins=n_bins
+        )
+    if backend == "pallas_bf16":
+        return bin_histogram_pallas(
+            codes, node_of_row, weights, max_nodes=max_nodes, n_bins=n_bins,
+            bf16=True,
         )
     if backend == "pallas_interpret":
         return bin_histogram_pallas(

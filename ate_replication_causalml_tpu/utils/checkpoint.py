@@ -69,11 +69,40 @@ def _encode(obj: Any, path: str, arrays: dict[str, np.ndarray]):
     raise TypeError(f"cannot checkpoint {type(obj).__name__} at {path!r}")
 
 
+_PKG_ROOT = __name__.split(".", 1)[0]  # this framework's package
+
+
 def _resolve(qualname: str) -> type:
+    """Resolve a ``module:QualName`` manifest reference. Restricted to
+    dataclass/NamedTuple *types defined in this package*: a manifest is
+    data, and letting it import arbitrary modules / call arbitrary
+    callables would make loading a checkpoint equivalent to executing
+    it. The module-prefix check alone is bypassable via re-exported
+    attributes (``pkg.native:subprocess.Popen``), so the resolved object
+    itself must also be a package-defined dataclass or NamedTuple type.
+    Checkpoints remain trusted inputs (field values reach constructors),
+    but the reachable surface is this framework's record types only."""
     mod, _, name = qualname.partition(":")
+    if mod.split(".", 1)[0] != _PKG_ROOT:
+        raise ValueError(
+            f"checkpoint references type {qualname!r} outside {_PKG_ROOT!r}; "
+            "refusing to import it"
+        )
     obj: Any = importlib.import_module(mod)
     for part in name.split("."):
         obj = getattr(obj, part)
+    is_namedtuple_cls = (
+        isinstance(obj, type) and issubclass(obj, tuple) and hasattr(obj, "_fields")
+    )
+    if not (
+        isinstance(obj, type)
+        and (dataclasses.is_dataclass(obj) or is_namedtuple_cls)
+        and getattr(obj, "__module__", "").split(".", 1)[0] == _PKG_ROOT
+    ):
+        raise ValueError(
+            f"checkpoint references {qualname!r}, which is not a "
+            f"dataclass/NamedTuple type defined in {_PKG_ROOT!r}; refusing"
+        )
     return obj
 
 
