@@ -25,7 +25,7 @@ import numpy as np
 from ate_replication_causalml_tpu.data.frame import CausalFrame
 from ate_replication_causalml_tpu.estimators.base import EstimatorResult
 from ate_replication_causalml_tpu.ops.lasso import cv_glmnet
-from ate_replication_causalml_tpu.ops.linalg import add_intercept, ols
+from ate_replication_causalml_tpu.ops.linalg import add_intercept, alias_filter, ols
 
 
 def interaction_expand(x: jax.Array) -> jax.Array:
@@ -79,19 +79,16 @@ def belloni(
         raise ValueError(f"compat must be 'r' or 'fixed', got {compat!r}")
     sel_idx = np.nonzero(sel)[0]
 
-    # The expansion contains exact duplicates (c1*c2 and c2*c1; squares
-    # of binary flags reproduce the flag itself). R's lm() drops aliased
-    # columns during its pivoted QR; we drop exact duplicates up front so
-    # the normal-equations solve sees a full-rank design. W's coefficient
-    # is identical either way.
+    # The expansion contains aliased columns: exact duplicates (c1*c2 and
+    # c2*c1; squares of binary flags reproduce the flag itself) and any
+    # linear dependencies among selected columns (three-way collinear
+    # combinations, constants). R's lm() drops them during its pivoted QR
+    # with left-to-right preference (``ate_functions.R:317-320`` relies
+    # on that); alias_filter reproduces the same rule so the
+    # normal-equations solve sees a full-rank design. W's coefficient is
+    # identical either way.
     cols = np.asarray(x_big[:, sel_idx])
-    seen: dict[bytes, int] = {}
-    keep: list[int] = []
-    for j in range(cols.shape[1]):
-        h = cols[:, j].tobytes()
-        if h not in seen:
-            seen[h] = j
-            keep.append(j)
+    keep = alias_filter(cols, with_intercept=True)
     x_restricted = jnp.concatenate(
         [jnp.asarray(cols[:, keep]), frame.w[:, None]], axis=1
     )

@@ -156,7 +156,7 @@ def grow_causal_forest(
     sample_fraction: float = 0.5,
     ci_group_size: int = 2,
     honesty: bool = True,
-    group_chunk: int = 16,
+    group_chunk: int | None = None,
     hist_backend: str = "auto",
 ) -> CausalForest:
     """Grow the causal forest on *centered* treatment/outcome residuals.
@@ -180,6 +180,16 @@ def grow_causal_forest(
     mom_stack = _moments_stack(wt, yt)  # (n, 5)
     s = max(2, int(n * sample_fraction))
 
+    if group_chunk is None:
+        from ate_replication_causalml_tpu.models.forest import auto_tree_chunk
+
+        # The honest-leaf payload contraction builds a (rows, 2^depth)
+        # one-hot, and the 'onehot' backend streams full-n rows (mask
+        # path) rather than the s-row subsample.
+        chunk_rows = n if hist_backend == "onehot" else s
+        group_chunk = auto_tree_chunk(
+            chunk_rows, depth, cap=16, trees_per_unit=k, leaf_onehot=True
+        )
     group_chunk = pick_chunk(n_groups, group_chunk)
     n_chunks = -(-n_groups // group_chunk)
     group_keys = jax.random.split(key, n_chunks * group_chunk)
@@ -249,16 +259,28 @@ def _grow_cf_chunk(group_keys, codes, wt, yt, mom_stack, xb_onehot, *,
         split_key = jax.random.split(tree_key, depth + 1)[1:]
 
         def level_step(node_of_row, lk, level_nodes):
-            mom = jax.ops.segment_sum(
-                gw[:, None] * mom_g, node_of_row, num_segments=level_nodes
+            # TPU-first level pipeline: every per-node → per-row lookup
+            # runs through ONE (rows, M) node one-hot and MXU matmuls —
+            # per-row dynamic gathers (wbar[node], bf[node], …) serialize
+            # on TPU and measured ~2/3 of tree wall-clock; the matmul
+            # broadcast is two orders of magnitude cheaper.
+            node_oh = jax.nn.one_hot(node_of_row, level_nodes, dtype=jnp.float32)
+            # Per-node moments: (M, rows) @ (rows, 5) — segment_sum is a
+            # serialized scatter-add on TPU.
+            mom = jnp.matmul(
+                node_oh.T, gw[:, None] * mom_g, precision=_PREC
             )  # (M, 5)
             wbar, ybar, tau = _node_tau(mom)
-            wc = wt_g - wbar[node_of_row]
-            yc = yt_g - ybar[node_of_row]
-            rho = wc * (yc - wc * tau[node_of_row])
+            # Broadcast (w̄, ȳ, τ) of each row's node: (rows, M) @ (M, 3).
+            row_nt = jnp.matmul(
+                node_oh, jnp.stack([wbar, ybar, tau], axis=1), precision=_PREC
+            )
+            wc = wt_g - row_nt[:, 0]
+            yc = yt_g - row_nt[:, 1]
+            rho = wc * (yc - wc * row_nt[:, 2])
 
             if hist_backend == "onehot":
-                gw_oh = jax.nn.one_hot(node_of_row, level_nodes, dtype=jnp.float32) * gw[:, None]
+                gw_oh = node_oh * gw[:, None]
                 hist_c = jnp.matmul(gw_oh.T, oh_g, precision=_PREC).reshape(
                     level_nodes, p, n_bins
                 )
@@ -298,9 +320,21 @@ def _grow_cf_chunk(group_keys, codes, wt, yt, mom_stack, xb_onehot, *,
                 has_split, (best % n_bins).astype(jnp.int32), n_bins - 1
             )
 
-            row_feat = best_feat[node_of_row]
-            row_bin = best_bin[node_of_row]
-            code_at_feat = jnp.take_along_axis(codes_g, row_feat[:, None], axis=1)[:, 0]
+            # Route rows: per-node (bin threshold, feature one-hot) table
+            # broadcast by the same node_oh matmul; the row's split-
+            # feature code is then a (rows, p) · (rows, p) dot — no
+            # take_along_axis. All quantities are small ints in f32, so
+            # the comparisons are exact.
+            route_tab = jnp.concatenate(
+                [
+                    best_bin.astype(jnp.float32)[:, None],
+                    jax.nn.one_hot(best_feat, p, dtype=jnp.float32),
+                ],
+                axis=1,
+            )  # (M, 1 + p)
+            row_route = jnp.matmul(node_oh, route_tab, precision=_PREC)
+            row_bin = row_route[:, 0]
+            code_at_feat = jnp.sum(codes_g.astype(jnp.float32) * row_route[:, 1:], axis=1)
             node_of_row = node_of_row * 2 + (code_at_feat > row_bin).astype(jnp.int32)
             return node_of_row, (best_feat, best_bin)
 
@@ -319,10 +353,11 @@ def _grow_cf_chunk(group_keys, codes, wt, yt, mom_stack, xb_onehot, *,
             bins_l.append(jnp.pad(bb, (0, pad), constant_values=n_bins - 1))
         feats = jnp.stack(feats_l)
         bins = jnp.stack(bins_l)
-        # Honest leaf payloads via segment_sum (a (n, 2^D) one-hot here
-        # costs gigabytes per vmapped chunk at reference scale).
-        leaf_stats = jax.ops.segment_sum(
-            ew[:, None] * mom_g, node_of_row, num_segments=n_leaves
+        # Honest leaf payloads as one more (L, rows) @ (rows, 5) one-hot
+        # matmul (a TPU segment_sum lowers to a serialized scatter-add).
+        leaf_oh = jax.nn.one_hot(node_of_row, n_leaves, dtype=jnp.float32)
+        leaf_stats = jnp.matmul(
+            leaf_oh.T, ew[:, None] * mom_g, precision=_PREC
         )  # (L, 5)
         return feats, bins, leaf_stats
 
@@ -382,19 +417,76 @@ def fit_causal_forest(
     return FittedCausalForest(forest=forest, y_hat=y_hat, w_hat=w_hat, x=x, y=y, w=w)
 
 
-def _tree_leaf_stats(feats, bins, leaf_stats, codes, depth):
-    """Route every query row down one tree, gather its leaf's honest
-    statistics: (n, 5)."""
+def _tree_route(feats, bins, codes, depth):
+    """Leaf index of every query row down one tree: (n,) int32.
 
-    def step(node, level):
-        f = feats[level][node]
-        b = bins[level][node]
-        code = jnp.take_along_axis(codes, f[:, None], axis=1)[:, 0]
-        return node * 2 + (code > b).astype(jnp.int32), None
+    Per-level one-hot matmuls, not gathers: per-row dynamic gathers
+    serialize on TPU (measured ~2/3 of forest wall-clock before the
+    grow loop was converted the same way). All quantities are small
+    ints in f32, so comparisons are exact.
+    """
+    rows, p = codes.shape
+    codes_f = codes.astype(jnp.float32)
+    node = jnp.zeros(rows, jnp.int32)
+    for level in range(depth):
+        m = 1 << level
+        node_oh = jax.nn.one_hot(node, m, dtype=jnp.float32)
+        tab = jnp.concatenate(
+            [
+                bins[level][:m].astype(jnp.float32)[:, None],
+                jax.nn.one_hot(feats[level][:m], p, dtype=jnp.float32),
+            ],
+            axis=1,
+        )  # (m, 1 + p)
+        rr = jnp.matmul(node_oh, tab, precision=_PREC)
+        code_at = jnp.sum(codes_f * rr[:, 1:], axis=1)
+        node = node * 2 + (code_at > rr[:, 0]).astype(jnp.int32)
+    return node
 
-    node0 = jnp.zeros(codes.shape[0], jnp.int32)
-    node, _ = lax.scan(step, node0, jnp.arange(depth))
-    return leaf_stats[node]
+
+@functools.partial(jax.jit, static_argnames=("tree_chunk", "row_chunk"))
+def compute_leaf_index(
+    forest: CausalForest, x: jax.Array, tree_chunk: int = 32,
+    row_chunk: int = 65536,
+) -> jax.Array:
+    """Per-(tree, row) leaf indices for a fixed query matrix: (T, n).
+
+    Routing is the only per-tree traversal in CATE scoring; everything
+    else is contractions and reductions. Precomputing it once per
+    (forest, dataset) makes every further
+    ``predict_cate(..., leaf_index=...)`` call — repeated scoring of the
+    same rows, oob or not — routing-free (NEXT.md round-1 #6). Rows are
+    processed in ``row_chunk`` blocks so the per-level (rows, nodes)
+    one-hots stay bounded at the million-row scale, exactly as in
+    :func:`predict_cate`.
+    """
+    codes = binarize(x, forest.bin_edges)
+    n = codes.shape[0]
+    T, depth = forest.n_trees, forest.depth
+    n_chunks = -(-T // tree_chunk)
+    pad = n_chunks * tree_chunk - T
+    feats = jnp.concatenate(
+        [forest.split_feat, jnp.zeros((pad,) + forest.split_feat.shape[1:], jnp.int32)]
+    ).reshape(n_chunks, tree_chunk, depth, -1)
+    bins = jnp.concatenate(
+        [forest.split_bin, jnp.zeros((pad,) + forest.split_bin.shape[1:], jnp.int32)]
+    ).reshape(n_chunks, tree_chunk, depth, -1)
+
+    rb = min(row_chunk, n)
+    n_blocks = -(-n // rb)
+    n_pad = n_blocks * rb
+    codes_b = jnp.pad(codes, ((0, n_pad - n), (0, 0))).reshape(n_blocks, rb, -1)
+
+    def block_fn(codes_blk):
+        idx = lax.map(
+            lambda fb: jax.vmap(lambda f, b: _tree_route(f, b, codes_blk, depth))(*fb),
+            (feats, bins),
+        )
+        return idx.reshape(n_chunks * tree_chunk, rb)
+
+    idx_b = lax.map(block_fn, codes_b)            # (n_blocks, T_pad, rb)
+    idx = jnp.moveaxis(idx_b, 0, 1).reshape(n_chunks * tree_chunk, n_pad)
+    return idx[:T, :n]
 
 
 def _tau_from_sums(S, M):
@@ -409,12 +501,14 @@ def _tau_from_sums(S, M):
     return tau, var > _EPS
 
 
-@functools.partial(jax.jit, static_argnames=("oob", "tree_chunk"))
+@functools.partial(jax.jit, static_argnames=("oob", "tree_chunk", "row_chunk"))
 def predict_cate(
     forest: CausalForest,
     x: jax.Array,
     oob: bool = True,
     tree_chunk: int = 32,
+    row_chunk: int = 65536,
+    leaf_index: jax.Array | None = None,
 ) -> CatePredictions:
     """Forest-weighted CATE τ̂(x) with little-bags variance. The little-
     bag grouping (``forest.ci_group_size``) travels with the forest.
@@ -422,6 +516,15 @@ def predict_cate(
     ``oob=True`` (training matrix only) excludes each tree's own
     subsample from its contributions — the grf semantics for in-sample
     ``predict(forest)`` (``ate_replication.Rmd:259``).
+
+    ``leaf_index`` — the (T, n) routing from :func:`compute_leaf_index`
+    for this exact ``x``: skips tree traversal entirely, so repeated
+    scoring of the same rows is one one-hot contraction per tree.
+    Results are identical with or without it.
+
+    Rows are processed in blocks of ``row_chunk`` (rows are independent
+    in every aggregation), bounding the (rows, nodes) one-hot operands
+    at the million-row scale.
     """
     if oob and x.shape[0] != forest.in_sample.shape[1]:
         raise ValueError(
@@ -432,11 +535,16 @@ def predict_cate(
     codes = binarize(x, forest.bin_edges)
     n = codes.shape[0]
     T, depth = forest.n_trees, forest.depth
+    n_leaves = 1 << depth
     k = forest.ci_group_size
     n_groups = T // k
 
-    def per_tree(feats, bins, leaf_stats, in_row):
-        stats = _tree_leaf_stats(feats, bins, leaf_stats, codes, depth)  # (n,5)
+    def per_tree(feats, bins, leaf_stats, in_row, li, codes_b):
+        node = _tree_route(feats, bins, codes_b, depth) if li is None else li
+        # Leaf payload broadcast as one (rows, L) @ (L, 5) contraction —
+        # a per-row gather from leaf_stats serializes on TPU.
+        leaf_oh = jax.nn.one_hot(node, n_leaves, dtype=jnp.float32)
+        stats = jnp.matmul(leaf_oh, leaf_stats, precision=_PREC)  # (rows, 5)
         cnt = stats[:, 0]
         valid = cnt > 0
         if oob:
@@ -460,36 +568,93 @@ def predict_cate(
     feats_g = reshape_groups(forest.split_feat[: n_groups * k])
     bins_g = reshape_groups(forest.split_bin[: n_groups * k])
     stats_g = reshape_groups(forest.leaf_stats[: n_groups * k])
-    in_g = reshape_groups(forest.in_sample[: n_groups * k])
 
-    def chunk_fn(args):
-        feats, bins, stats, inr = args  # (gc, k, …)
-        m, valid = jax.vmap(jax.vmap(per_tree))(feats, bins, stats, inr)
-        # m: (gc, k, n, 5); per-tree tau for the within-group variance.
-        tau_t, ok_t = _tau_from_sums(m, m[..., 0])          # (gc, k, n)
-        S_g = m.sum(axis=1)                                  # (gc, n, 5)
-        M_g = m[..., 0].sum(axis=1)                          # (gc, n)
-        tau_g, ok_g = _tau_from_sums(S_g, M_g)               # (gc, n)
-        # Within-group variance of the per-tree estimates.
-        okf = ok_t.astype(jnp.float32)
-        nv = jnp.maximum(okf.sum(axis=1), 1.0)
-        mean_t = (tau_t * okf).sum(axis=1) / nv
-        var_w = ((tau_t - mean_t[:, None]) ** 2 * okf).sum(axis=1) / jnp.maximum(
-            nv - 1.0, 1.0
+    # Row blocking: pad rows to a whole number of blocks and put the
+    # block axis first on every per-row array (padded rows compute
+    # garbage that is sliced away at the end; real rows are unaffected
+    # because every aggregation is per-row).
+    rb = min(row_chunk, n)
+    n_blocks = -(-n // rb)
+    n_pad = n_blocks * rb
+
+    codes_b = jnp.pad(codes, ((0, n_pad - n), (0, 0))).reshape(n_blocks, rb, -1)
+    if oob:
+        # in_sample is per TRAINING row — only meaningful (and only
+        # shape-compatible) when the query rows are the training rows.
+        in_b = jnp.pad(
+            reshape_groups(forest.in_sample[: n_groups * k]),
+            ((0, 0), (0, 0), (0, 0), (0, n_pad - n)),
         )
-        return S_g.sum(axis=0), M_g.sum(axis=0), tau_g, ok_g, var_w
+        in_b = jnp.moveaxis(
+            in_b.reshape(n_chunks, group_chunk, k, n_blocks, rb), 3, 0
+        )
+    else:
+        in_b = None
+    if leaf_index is None:
+        li_b = None
+    else:
+        li_b = jnp.pad(
+            reshape_groups(leaf_index[: n_groups * k]),
+            ((0, 0), (0, 0), (0, 0), (0, n_pad - n)),
+        )
+        li_b = jnp.moveaxis(li_b.reshape(n_chunks, group_chunk, k, n_blocks, rb), 3, 0)
 
-    S_c, M_c, tau_g, ok_g, var_w = lax.map(
-        chunk_fn, (feats_g, bins_g, stats_g, in_g)
-    )
-    S = S_c.sum(axis=0)            # (n, 5)
-    M = M_c.sum(axis=0)            # (n,)
+    def block_fn(xs):
+        codes_blk, in_blk, li_blk = xs  # (rb, p), (n_chunks, gc, k, rb), …
+
+        def chunk_fn(args):
+            feats, bins, stats, inr, li = args  # (gc, k, …)
+            vargs = [feats, bins, stats]
+            if inr is not None:
+                vargs.append(inr)
+            if li is not None:
+                vargs.append(li)
+
+            def one(f, b, s, *rest):
+                rest = list(rest)
+                i = rest.pop(0) if inr is not None else None
+                l = rest.pop(0) if li is not None else None
+                return per_tree(f, b, s, i, l, codes_blk)
+
+            m, valid = jax.vmap(jax.vmap(one))(*vargs)
+            # m: (gc, k, rb, 5); per-tree tau for within-group variance.
+            tau_t, ok_t = _tau_from_sums(m, m[..., 0])          # (gc, k, rb)
+            S_g = m.sum(axis=1)                                  # (gc, rb, 5)
+            M_g = m[..., 0].sum(axis=1)                          # (gc, rb)
+            tau_g, ok_g = _tau_from_sums(S_g, M_g)               # (gc, rb)
+            okf = ok_t.astype(jnp.float32)
+            nv = jnp.maximum(okf.sum(axis=1), 1.0)
+            mean_t = (tau_t * okf).sum(axis=1) / nv
+            var_w = ((tau_t - mean_t[:, None]) ** 2 * okf).sum(axis=1) / jnp.maximum(
+                nv - 1.0, 1.0
+            )
+            return S_g.sum(axis=0), M_g.sum(axis=0), tau_g, ok_g, var_w
+
+        S_c, M_c, tau_g, ok_g, var_w = lax.map(
+            chunk_fn, (feats_g, bins_g, stats_g, in_blk, li_blk)
+        )
+        G = n_chunks * group_chunk
+        return (
+            S_c.sum(axis=0),                    # (rb, 5)
+            M_c.sum(axis=0),                    # (rb,)
+            tau_g.reshape(G, rb),
+            ok_g.reshape(G, rb),
+            var_w.reshape(G, rb),
+        )
+
+    S_b, M_b, tau_gb, ok_gb, var_wb = lax.map(block_fn, (codes_b, in_b, li_b))
+
+    def unblock(a):  # (n_blocks, …, rb) with rows last two -> (…, n)
+        a = jnp.moveaxis(a, 0, -2)
+        return a.reshape(*a.shape[:-2], n_pad)[..., :n]
+
+    S = S_b.reshape(n_pad, 5)[:n]
+    M = M_b.reshape(n_pad)[:n]
     tau, _ = _tau_from_sums(S, M)
 
-    tau_g = tau_g.reshape(n_chunks * group_chunk, n)
-    ok_g = ok_g.reshape(n_chunks * group_chunk, n)[:n_groups].astype(jnp.float32)
-    tau_g = tau_g[:n_groups]
-    var_w = var_w.reshape(n_chunks * group_chunk, n)[:n_groups]
+    tau_g = unblock(tau_gb)[:n_groups]
+    ok_g = unblock(ok_gb)[:n_groups].astype(jnp.float32)
+    var_w = unblock(var_wb)[:n_groups]
 
     # Bootstrap of little bags: V_between − V_within/k, truncated at 0.
     ng = jnp.maximum(ok_g.sum(axis=0), 1.0)

@@ -148,6 +148,32 @@ def pick_chunk(total: int, chunk: int) -> int:
     return chunk
 
 
+# HBM budget for the largest per-level matmul operand of one vmapped
+# tree chunk (the (rows, max_nodes) f32 node one-hots). 4 GB leaves
+# room on a 16 GB chip for the other operands and XLA temporaries.
+_CHUNK_BYTES_BUDGET = 4 << 30
+
+
+def auto_tree_chunk(
+    n_rows: int,
+    depth: int,
+    cap: int,
+    trees_per_unit: int = 1,
+    leaf_onehot: bool = False,
+) -> int:
+    """Trees to grow per compiled chunk: as many as fit the HBM budget,
+    capped at ``cap``. The dominant operand is the deepest level's
+    (rows, 2^(depth−1)) routing one-hot — or, when the engine also
+    builds an honest-leaf one-hot (``leaf_onehot=True``), the
+    (rows, 2^depth) leaf payload contraction. ``trees_per_unit`` scales
+    for little-bag groups. ``n_rows`` must be the rows the grower
+    actually streams (full n for the 'onehot' backend, the subsample
+    for the gathered backends)."""
+    width = 1 << (depth if leaf_onehot else depth - 1)
+    per_tree = 4 * n_rows * width * trees_per_unit
+    return max(1, min(cap, _CHUNK_BYTES_BUDGET // max(per_tree, 1)))
+
+
 class ForestPredictions(NamedTuple):
     prob: jax.Array   # mean leaf probability over trees
     vote: jax.Array   # fraction of trees voting class 1 (randomForest "prob")
@@ -161,21 +187,24 @@ def fit_forest_classifier(
     depth: int = 9,
     mtry: int | None = None,
     n_bins: int = 64,
-    tree_chunk: int = 32,
+    tree_chunk: int | None = None,
     hist_backend: str = "auto",
 ) -> Forest:
     """Fit a classification forest of ``n_trees`` depth-``depth`` trees.
 
     mtry defaults to floor(sqrt(p)) (randomForest's classification
-    default). Trees are grown in chunks of ``tree_chunk``: one jitted
-    chunk executable (compiled once), driven by a host loop — bounded
-    device-program size and memory, chunk-level progress/retry points
-    (parallel/retry.py), identical numbers to a monolithic run since
-    every chunk owns its fold-in keys.
+    default). Trees are grown in chunks of ``tree_chunk`` (default:
+    auto-sized to the HBM budget, ≤32): one jitted chunk executable
+    (compiled once), driven by a host loop — bounded device-program size
+    and memory, chunk-level progress/retry points (parallel/retry.py),
+    identical numbers to a monolithic run since every chunk owns its
+    fold-in keys.
     """
     n, p = x.shape
     if mtry is None:
         mtry = max(1, int(np.sqrt(p)))
+    if tree_chunk is None:
+        tree_chunk = auto_tree_chunk(n, depth, cap=32)
     hist_backend = resolve_hist_backend(hist_backend)
     edges = quantile_bins(x, n_bins)
     codes = binarize(x, edges)  # (n, p) int32
@@ -226,24 +255,40 @@ def _grow_chunk(tree_keys, codes, yf, xb_onehot, *, depth, mtry, n_bins, hist_ba
         ck, gk = jax.random.split(tree_key)
         counts = _poisson1_counts(ck, (n,))
 
-        def level_step(node_of_row, lk, level_nodes):
+        def hists_for(ids, n_nodes, weights):
+            """(len(weights), n_nodes, p, n_bins) histograms; rows with
+            id −1 contribute nothing."""
             if hist_backend == "onehot":
-                node_oh = jax.nn.one_hot(node_of_row, level_nodes, dtype=jnp.float32)
-                hist_c = jnp.matmul(
-                    (node_oh * counts[:, None]).T, xb_onehot, precision=_PREC
-                ).reshape(level_nodes, p, n_bins)
-                hist_y = jnp.matmul(
-                    (node_oh * (counts * yf)[:, None]).T, xb_onehot, precision=_PREC
-                ).reshape(level_nodes, p, n_bins)
+                node_oh = jax.nn.one_hot(ids, n_nodes, dtype=jnp.float32)
+                return jnp.stack([
+                    jnp.matmul(
+                        (node_oh * wv[:, None]).T, xb_onehot, precision=_PREC
+                    ).reshape(n_nodes, p, n_bins)
+                    for wv in weights
+                ])
+            return bin_histogram(
+                codes, ids, jnp.stack(weights),
+                max_nodes=n_nodes, n_bins=n_bins, backend=hist_backend,
+            )
+
+        def level_step(carry, lk, level_nodes):
+            node_of_row, prev_hist = carry
+            # Histogram subtraction (the LightGBM sibling trick): both
+            # weight vectors (counts, counts·y) are level-invariant, so
+            # each level computes histograms for LEFT children only —
+            # right children come free as parent − left. Halves the
+            # histogram matmul work for every level past the root.
+            if prev_hist is None:
+                hist = hists_for(node_of_row, level_nodes, (counts, counts * yf))
             else:
-                hist_c, hist_y = bin_histogram(
-                    codes,
-                    node_of_row,
-                    jnp.stack([counts, counts * yf]),
-                    max_nodes=level_nodes,
-                    n_bins=n_bins,
-                    backend=hist_backend,
+                half = level_nodes // 2
+                left_id = jnp.where(node_of_row % 2 == 0, node_of_row // 2, -1)
+                hist_left = hists_for(left_id, half, (counts, counts * yf))
+                hist_right = prev_hist - hist_left
+                hist = jnp.stack([hist_left, hist_right], axis=2).reshape(
+                    2, level_nodes, p, n_bins
                 )
+            hist_c, hist_y = hist[0], hist[1]
 
             cl = jnp.cumsum(hist_c, axis=2)
             yl = jnp.cumsum(hist_y, axis=2)
@@ -273,34 +318,46 @@ def _grow_chunk(tree_keys, codes, yf, xb_onehot, *, depth, mtry, n_bins, hist_ba
                 has_split, (best % n_bins).astype(jnp.int32), n_bins - 1
             )
 
-            row_feat = best_feat[node_of_row]
-            row_bin = best_bin[node_of_row]
-            code_at_feat = jnp.take_along_axis(codes, row_feat[:, None], axis=1)[:, 0]
+            # Route rows through one (rows, M) node one-hot matmul —
+            # per-row gathers (bf[node], take_along_axis) serialize on
+            # TPU and dominate tree wall-clock; the broadcast-as-matmul
+            # rides the MXU. Small ints in f32 → comparisons exact.
+            node_oh = jax.nn.one_hot(node_of_row, level_nodes, dtype=jnp.float32)
+            route_tab = jnp.concatenate(
+                [
+                    best_bin.astype(jnp.float32)[:, None],
+                    jax.nn.one_hot(best_feat, p, dtype=jnp.float32),
+                ],
+                axis=1,
+            )  # (M, 1 + p)
+            row_route = jnp.matmul(node_oh, route_tab, precision=_PREC)
+            row_bin = row_route[:, 0]
+            code_at_feat = jnp.sum(codes.astype(jnp.float32) * row_route[:, 1:], axis=1)
             node_of_row = node_of_row * 2 + (code_at_feat > row_bin).astype(jnp.int32)
-            return node_of_row, (best_feat, best_bin)
+            return (node_of_row, hist), (best_feat, best_bin)
 
         # Levels are unrolled as a Python loop so level l only computes
         # histograms for its 2^l live nodes (a lax.scan would force every
         # level to the padded final width — ~depth/2× wasted FLOPs).
         # Split tables are padded back to max_nodes for a uniform layout.
         level_keys = jax.random.split(gk, depth)
-        node_of_row = jnp.zeros(n, jnp.int32)
+        carry = (jnp.zeros(n, jnp.int32), None)
         feats_l, bins_l = [], []
         for level in range(depth):
             level_nodes = min(1 << level, max_nodes)
-            node_of_row, (bf, bb) = level_step(
-                node_of_row, level_keys[level], level_nodes
-            )
+            carry, (bf, bb) = level_step(carry, level_keys[level], level_nodes)
             pad = max_nodes - level_nodes
             feats_l.append(jnp.pad(bf, (0, pad)))
             bins_l.append(jnp.pad(bb, (0, pad), constant_values=n_bins - 1))
+        node_of_row = carry[0]
         feats = jnp.stack(feats_l)
         bins = jnp.stack(bins_l)
 
         # Leaf stats at depth D (bootstrap-weighted), parent-filled where
-        # empty by falling back to the overall rate. segment_sum, not a
-        # (n, 2^D) one-hot matmul: at reference scale the one-hot is
-        # gigabytes per vmapped tree chunk.
+        # empty by falling back to the overall rate. segment_sum here,
+        # not the one-hot matmul used per level: at depth 9 the (n, 2^D)
+        # one-hot is ~100 MB per tree — gigabytes under the tree vmap —
+        # and this runs once per tree, not once per level.
         leaf_c = jax.ops.segment_sum(counts, node_of_row, num_segments=n_leaves)
         leaf_y = jax.ops.segment_sum(counts * yf, node_of_row, num_segments=n_leaves)
         overall = jnp.sum(counts * yf) / jnp.maximum(jnp.sum(counts), 1e-12)
@@ -454,7 +511,7 @@ def fit_forest_regressor(
     depth: int = 9,
     mtry: int | None = None,
     n_bins: int = 64,
-    tree_chunk: int = 32,
+    tree_chunk: int | None = None,
     hist_backend: str = "auto",
 ) -> Forest:
     """Regression forest — same engine as the classifier (the split

@@ -20,6 +20,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 _PREC = lax.Precision.HIGHEST
@@ -103,3 +104,41 @@ def ols_no_intercept_1d(x: jax.Array, y: jax.Array) -> tuple[jax.Array, jax.Arra
 
 def add_intercept(x: jax.Array) -> jax.Array:
     return jnp.concatenate([jnp.ones((x.shape[0], 1), x.dtype), x], axis=1)
+
+
+def alias_filter(cols, *, with_intercept: bool = True, tol: float = 1e-7):
+    """Indices of the columns R's ``lm`` would keep (pivoted-QR aliasing).
+
+    R's ``lm.fit`` runs LINPACK ``dqrdc2``, which walks columns left to
+    right and aliases (reports NA for) any column whose R-diagonal falls
+    below ``tol`` relative to the column's own norm — i.e. any column
+    numerically dependent on *kept earlier* columns, with left-to-right
+    preference. This reproduces that rule with sequential modified
+    Gram–Schmidt in float64 (host-side: selection logic, not TPU
+    compute). ``with_intercept=True`` seeds the basis with the constant
+    column (R models have an implicit leading intercept), so constant
+    columns alias away as they do in ``lm``.
+    """
+    a = np.asarray(cols, dtype=np.float64)
+    n = a.shape[0]
+    basis: list[np.ndarray] = []
+    if with_intercept:
+        basis.append(np.full(n, 1.0 / np.sqrt(n)))
+    keep: list[int] = []
+    for j in range(a.shape[1]):
+        v = a[:, j]
+        norm0 = np.linalg.norm(v)
+        if norm0 == 0.0:
+            continue
+        r = v.copy()
+        for q in basis:
+            r -= (q @ r) * q
+        # Twice-is-enough re-orthogonalization keeps the test sharp when
+        # columns are nearly dependent.
+        for q in basis:
+            r -= (q @ r) * q
+        rnorm = np.linalg.norm(r)
+        if rnorm > tol * norm0:
+            keep.append(j)
+            basis.append(r / rnorm)
+    return np.asarray(keep, dtype=np.int64)

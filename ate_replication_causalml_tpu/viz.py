@@ -12,9 +12,26 @@ glance. Matplotlib renders to PNG next to the result table.
 
 from __future__ import annotations
 
-from typing import Iterable, Sequence
+from typing import Iterable, NamedTuple, Sequence
 
 from ate_replication_causalml_tpu.estimators.base import EstimatorResult
+
+
+class PointrangeMark(NamedTuple):
+    """One plotted row: what the chart actually drew (testable without
+    parsing pixels — a blank-axes regression has an empty mark list)."""
+
+    method: str
+    ate: float
+    lower: float
+    upper: float
+    y: float
+
+
+class PointrangeChart(NamedTuple):
+    figure: object                                  # matplotlib Figure
+    marks: list[PointrangeMark]                     # one per method row
+    oracle_band: tuple[float, float, float] | None  # (lower, upper, ate)
 
 # Brand-neutral defaults validated for the light surface.
 _SURFACE = "#fcfcfb"
@@ -35,8 +52,8 @@ def pointrange_figure(
 
     ``oracle`` (the unbiased RCT difference-in-means,
     ``ate_replication.Rmd:130``) renders as a vertical line + CI band
-    behind the marks. Returns the matplotlib Figure; saves PNG when
-    ``path`` is given.
+    behind the marks. Returns a :class:`PointrangeChart` carrying the
+    Figure plus the plotted arrays; saves PNG when ``path`` is given.
     """
     # Agg canvas bound to this figure only — never touches the process-
     # global backend (a notebook user's interactive backend stays live).
@@ -52,13 +69,20 @@ def pointrange_figure(
     ax.set_facecolor(_SURFACE)
 
     ys = range(n - 1, -1, -1)  # first method on top
+    band = None
     if oracle is not None:
-        ax.axvspan(oracle.lower_ci, oracle.upper_ci, color=_ORACLE, alpha=0.12, lw=0)
-        ax.axvline(oracle.ate, color=_ORACLE, lw=2, label=f"RCT oracle ({oracle.ate:.3f})")
+        band = (float(oracle.lower_ci), float(oracle.upper_ci), float(oracle.ate))
+        ax.axvspan(band[0], band[1], color=_ORACLE, alpha=0.12, lw=0)
+        ax.axvline(band[2], color=_ORACLE, lw=2, label=f"RCT oracle ({band[2]:.3f})")
+    marks = []
     for y, r in zip(ys, rows):
         ax.plot([r.lower_ci, r.upper_ci], [y, y], color=_ESTIMATE, lw=2,
                 solid_capstyle="round", zorder=3)
         ax.plot([r.ate], [y], "o", color=_ESTIMATE, ms=7, zorder=4)
+        marks.append(PointrangeMark(
+            method=r.method, ate=float(r.ate),
+            lower=float(r.lower_ci), upper=float(r.upper_ci), y=float(y),
+        ))
     ax.set_yticks(list(ys))
     ax.set_yticklabels([r.method for r in rows], fontsize=9, color=_INK)
     ax.set_xlabel("ATE (95% CI)", fontsize=9, color=_INK_2)
@@ -73,7 +97,7 @@ def pointrange_figure(
     fig.tight_layout()
     if path is not None:
         fig.savefig(path, facecolor=_SURFACE)
-    return fig
+    return PointrangeChart(figure=fig, marks=marks, oracle_band=band)
 
 
 def notebook_figures(
@@ -92,7 +116,17 @@ def notebook_figures(
 
     def save(name, subset, title):
         p = os.path.join(outdir, f"{name}.png")
-        pointrange_figure(subset, oracle=oracle, title=title, path=p)
+        chart = pointrange_figure(subset, oracle=oracle, title=title, path=p)
+        # A silently blank chart must fail loudly at render time, not in
+        # review: every requested method row must have been drawn, plus
+        # the oracle band.
+        drawn = [m.method for m in chart.marks]
+        want = [r.method for r in subset]
+        if drawn != want or chart.oracle_band is None:
+            raise RuntimeError(
+                f"figure {name!r} did not draw what was requested: "
+                f"drawn={drawn} wanted={want} band={chart.oracle_band}"
+            )
         paths.append(p)
 
     naive = [by_method[m] for m in ("naive",) if m in by_method]

@@ -48,10 +48,34 @@ def make_panel(key, n):
     return x, w, y
 
 
-def bench_forest():
+def _forest_fit_flops(n, trees, depth, s_frac=0.5, nuisance_trees=500,
+                      nuisance_depth=9, p=21, n_bins=64):
+    """Analytic FLOP count of the fit's MXU work (histogram einsums +
+    node-broadcast matmuls), for the MFU diagnostic. Per tree per level
+    l the K=2 histogram contraction is 2·2·rows·2^l·(p·n_bins); the
+    moment/route broadcasts add 2·rows·2^l·(5+3+1+p+1). The classifier
+    engine histograms only left children past the root (sibling
+    subtraction) — half the histogram term."""
+    pb = p * n_bins
+
+    def per_tree(rows, depth, subtract):
+        tot = 0.0
+        for level in range(depth):
+            m = 1 << level
+            hist_m = m if (level == 0 or not subtract) else m / 2
+            tot += 2.0 * rows * (2 * hist_m * pb + m * (5 + 3 + 1 + p + 1))
+        return tot
+
+    return (
+        trees * per_tree(n * s_frac, depth, False)
+        + 2 * nuisance_trees * per_tree(n, nuisance_depth, True)
+    )
+
+
+def bench_forest(n=FOREST_ROWS):
     """Causal-forest throughput: full grf-equivalent fit (2x500-tree
-    nuisance forests + 2000 honest gradient-split trees) at FOREST_ROWS,
-    reported as sec/1M rows."""
+    nuisance forests + 2000 honest gradient-split trees) at ``n`` rows,
+    reported as sec/1M rows (pass --rows to measure at 1M directly)."""
     from ate_replication_causalml_tpu.data.frame import CausalFrame
     from ate_replication_causalml_tpu.models.causal_forest import (
         average_treatment_effect,
@@ -60,7 +84,6 @@ def bench_forest():
 
     key = jax.random.key(0)
     kx, kw, ky = jax.random.split(key, 3)
-    n = FOREST_ROWS
     x = jax.random.normal(kx, (n, 21), dtype=jnp.float32)
     tau = 1.0 + (x[:, 0] > 0)
     w = (jax.random.uniform(kw, (n,)) < jax.nn.sigmoid(0.8 * x[:, 1])).astype(jnp.float32)
@@ -81,11 +104,16 @@ def bench_forest():
     eff = average_treatment_effect(fitted)
     ate, se = float(eff.estimate), float(eff.std_err)  # device sync HERE
     sec_per_1m = steady_s * 1e6 / n
+    flops = _forest_fit_flops(n, FOREST_TREES, 8)
+    # v5e (lite) peak ≈ 197 TFLOP/s bf16 / ≈49 TFLOP/s f32 MXU; report
+    # against the f32 peak since the engine runs f32 histograms.
+    mfu = flops / steady_s / 49.2e12
     # Stderr diagnostics first; the required JSON line is the LAST thing
     # printed, so a mid-run failure can never leave two JSON lines.
     print(
         f"# rows={n} trees={FOREST_TREES} first={compile_s:.1f}s steady={steady_s:.1f}s "
-        f"ate={ate:.4f} se={se:.4f} (true 1.5)",
+        f"ate={ate:.4f} se={se:.4f} (true 1.5) "
+        f"fit_matmul_flops={flops:.3e} mfu_f32~{mfu * 100:.1f}%",
         file=sys.stderr,
     )
     print(
@@ -102,7 +130,10 @@ def bench_forest():
 
 def main():
     if "--forest" in sys.argv:
-        return bench_forest()
+        rows = FOREST_ROWS
+        if "--rows" in sys.argv:
+            rows = int(sys.argv[sys.argv.index("--rows") + 1])
+        return bench_forest(rows)
     from ate_replication_causalml_tpu.estimators.aipw import _outcome_model_mu, aipw_tau
     from ate_replication_causalml_tpu.ops.bootstrap import aipw_bootstrap_taus_poisson, sd
     from ate_replication_causalml_tpu.ops.glm import logistic_glm

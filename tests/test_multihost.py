@@ -1,5 +1,14 @@
-"""Multi-host scaffolding tests (single-process paths; the multi-
-process path is exercised on real pods where jax.distributed works)."""
+"""Multi-host tests: single-process argument/mesh paths plus a real
+2-process ``jax.distributed`` bootstrap with a local coordinator and a
+cross-process collective (SURVEY.md §5.8 — the NCCL/MPI-world
+equivalent, exercised on CPU exactly as it would run across pod
+hosts)."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
 
 import jax
 import jax.numpy as jnp
@@ -7,6 +16,69 @@ import numpy as np
 
 from ate_replication_causalml_tpu.parallel.mesh import BOOT_AXIS, DATA_AXIS
 from ate_replication_causalml_tpu.parallel.multihost import init_multihost, make_pod_mesh
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = textwrap.dedent(
+    """
+    import sys
+    proc_id, port = int(sys.argv[1]), sys.argv[2]
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from ate_replication_causalml_tpu.parallel.multihost import init_multihost
+
+    ok = init_multihost(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=2,
+        process_id=proc_id,
+    )
+    assert ok, "init_multihost returned False in a 2-process world"
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.local_device_count() == 2 and jax.device_count() == 4
+
+    # Cross-process collective: a global row-sharded array whose sum
+    # requires an all-reduce spanning both processes.
+    mesh = Mesh(np.asarray(jax.devices()), ("d",))
+    sharding = NamedSharding(mesh, P("d"))
+    data = np.arange(8.0, dtype=np.float32)
+    arr = jax.make_array_from_callback((8,), sharding, lambda idx: data[idx])
+    total = jax.jit(lambda a: a.sum(), out_shardings=NamedSharding(mesh, P()))(arr)
+    np.testing.assert_allclose(np.asarray(total), 28.0)
+    print(f"CHILD_OK {proc_id}", flush=True)
+    """
+)
+
+
+def test_two_process_distributed_bootstrap_and_psum():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _CHILD, str(pid), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise AssertionError(f"2-process run hung; partial output: {outs}")
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {pid} failed:\n{out}"
+        assert f"CHILD_OK {pid}" in out, out
 
 
 def test_init_single_process_noop():

@@ -1,0 +1,96 @@
+"""Figure content assertions (VERDICT round 1, weak #5): the charts must
+contain the drawn data, not merely exist as non-empty PNG files."""
+
+import numpy as np
+import pytest
+
+from ate_replication_causalml_tpu.estimators.base import EstimatorResult
+from ate_replication_causalml_tpu.viz import notebook_figures, pointrange_figure
+
+
+def _row(method, ate, half=0.02):
+    return EstimatorResult(
+        method=method, ate=ate, lower_ci=ate - half, upper_ci=ate + half
+    )
+
+
+ORACLE = _row("naive", 0.095, 0.011)
+ROWS = [
+    _row("naive", 0.003, 0.027),
+    _row("Direct Method", 0.078),
+    _row("Doubly Robust", 0.080),
+]
+
+
+def test_pointrange_marks_carry_plotted_arrays():
+    chart = pointrange_figure(ROWS, oracle=ORACLE)
+    assert [m.method for m in chart.marks] == [r.method for r in ROWS]
+    for mark, r in zip(chart.marks, ROWS):
+        assert mark.ate == pytest.approx(float(r.ate))
+        assert mark.lower == pytest.approx(float(r.lower_ci))
+        assert mark.upper == pytest.approx(float(r.upper_ci))
+    lo, hi, center = chart.oracle_band
+    assert (lo, hi, center) == pytest.approx(
+        (float(ORACLE.lower_ci), float(ORACLE.upper_ci), float(ORACLE.ate))
+    )
+
+
+def test_pointrange_axes_actually_drawn():
+    """Introspect the matplotlib artists: every CI segment and point
+    marker must exist on the axes with the right coordinates — a
+    refactor that fills the metadata but draws nothing must fail."""
+    chart = pointrange_figure(ROWS, oracle=ORACLE)
+    ax = chart.figure.axes[0]
+    segments = []   # (xdata, ydata) of 2-point CI lines
+    points = []     # (x, y) of single-point markers
+    for line in ax.lines:
+        x, y = np.asarray(line.get_xdata(), float), np.asarray(line.get_ydata(), float)
+        if x.size == 2 and y.size == 2 and y[0] == y[1]:
+            segments.append((tuple(x), y[0]))
+        elif x.size == 1:
+            points.append((x[0], y[0]))
+    for mark in chart.marks:
+        assert ((mark.lower, mark.upper), mark.y) in [
+            (s, yy) for s, yy in segments
+        ] or any(
+            np.allclose(s, (mark.lower, mark.upper)) and yy == mark.y
+            for s, yy in segments
+        )
+        assert any(
+            np.isclose(px, mark.ate) and np.isclose(py, mark.y) for px, py in points
+        )
+    # Oracle band: an axvspan patch spanning [lower, upper] and the
+    # center line at the oracle ATE.
+    spans = [p.get_extents() for p in ax.patches]
+    assert any(
+        np.isclose(p.get_x(), chart.oracle_band[0])
+        and np.isclose(p.get_x() + p.get_width(), chart.oracle_band[1])
+        for p in ax.patches
+        if hasattr(p, "get_x")
+    ), f"no oracle band patch found among {len(spans)} patches"
+    # y tick labels are the method names, top-down.
+    labels = [t.get_text() for t in ax.get_yticklabels()]
+    assert labels == [r.method for r in ROWS]
+
+
+def test_notebook_figures_fail_on_blank(tmp_path, monkeypatch):
+    """notebook_figures must raise when a chart comes back with no drawn
+    rows (the blank-axes regression VERDICT asked to make impossible)."""
+    import ate_replication_causalml_tpu.viz as viz
+
+    paths = notebook_figures(ROWS, ORACLE, str(tmp_path))
+    assert len(paths) == 3
+    for p in paths:
+        import os
+
+        assert os.path.getsize(p) > 0
+
+    real = viz.pointrange_figure
+
+    def blank(results, oracle=None, title="", path=None):
+        chart = real([], oracle=oracle, title=title, path=path)
+        return chart
+
+    monkeypatch.setattr(viz, "pointrange_figure", blank)
+    with pytest.raises(RuntimeError, match="did not draw"):
+        notebook_figures(ROWS, ORACLE, str(tmp_path))
