@@ -56,6 +56,7 @@ from jax import lax
 
 from ate_replication_causalml_tpu.data.frame import CausalFrame
 from ate_replication_causalml_tpu.models.forest import (
+    auto_tree_chunk,
     bin_onehot,
     binarize,
     fit_forest_regressor,
@@ -63,6 +64,7 @@ from ate_replication_causalml_tpu.models.forest import (
     pick_chunk,
     quantile_bins,
     resolve_hist_backend,
+    route_rows,
 )
 from ate_replication_causalml_tpu.ops.hist_pallas import bin_histogram
 from ate_replication_causalml_tpu.ops.linalg import _PREC
@@ -180,16 +182,16 @@ def grow_causal_forest(
     mom_stack = _moments_stack(wt, yt)  # (n, 5)
     s = max(2, int(n * sample_fraction))
 
-    if group_chunk is None:
-        from ate_replication_causalml_tpu.models.forest import auto_tree_chunk
-
-        # The honest-leaf payload contraction builds a (rows, 2^depth)
-        # one-hot, and the 'onehot' backend streams full-n rows (mask
-        # path) rather than the s-row subsample.
-        chunk_rows = n if hist_backend == "onehot" else s
-        group_chunk = auto_tree_chunk(
-            chunk_rows, depth, cap=16, trees_per_unit=k, leaf_onehot=True
-        )
+    # The honest-leaf payload contraction builds a (rows, 2^depth)
+    # one-hot, and the 'onehot' backend streams full-n rows (mask path)
+    # rather than the s-row subsample. An explicitly requested chunk is
+    # clamped to the same HBM budget — a chunk that fit the round-1
+    # segment_sum path can OOM the one-hot formulation.
+    chunk_rows = n if hist_backend == "onehot" else s
+    auto_chunk = auto_tree_chunk(
+        chunk_rows, depth, cap=16, trees_per_unit=k, leaf_onehot=True
+    )
+    group_chunk = auto_chunk if group_chunk is None else min(group_chunk, auto_chunk)
     group_chunk = pick_chunk(n_groups, group_chunk)
     n_chunks = -(-n_groups // group_chunk)
     group_keys = jax.random.split(key, n_chunks * group_chunk)
@@ -320,22 +322,9 @@ def _grow_cf_chunk(group_keys, codes, wt, yt, mom_stack, xb_onehot, *,
                 has_split, (best % n_bins).astype(jnp.int32), n_bins - 1
             )
 
-            # Route rows: per-node (bin threshold, feature one-hot) table
-            # broadcast by the same node_oh matmul; the row's split-
-            # feature code is then a (rows, p) · (rows, p) dot — no
-            # take_along_axis. All quantities are small ints in f32, so
-            # the comparisons are exact.
-            route_tab = jnp.concatenate(
-                [
-                    best_bin.astype(jnp.float32)[:, None],
-                    jax.nn.one_hot(best_feat, p, dtype=jnp.float32),
-                ],
-                axis=1,
-            )  # (M, 1 + p)
-            row_route = jnp.matmul(node_oh, route_tab, precision=_PREC)
-            row_bin = row_route[:, 0]
-            code_at_feat = jnp.sum(codes_g.astype(jnp.float32) * row_route[:, 1:], axis=1)
-            node_of_row = node_of_row * 2 + (code_at_feat > row_bin).astype(jnp.int32)
+            node_of_row = route_rows(
+                node_oh, best_feat, best_bin, codes_g.astype(jnp.float32), node_of_row
+            )
             return node_of_row, (best_feat, best_bin)
 
         # Unrolled levels: level l computes moments/histograms only for
@@ -425,22 +414,13 @@ def _tree_route(feats, bins, codes, depth):
     grow loop was converted the same way). All quantities are small
     ints in f32, so comparisons are exact.
     """
-    rows, p = codes.shape
+    rows = codes.shape[0]
     codes_f = codes.astype(jnp.float32)
     node = jnp.zeros(rows, jnp.int32)
     for level in range(depth):
         m = 1 << level
         node_oh = jax.nn.one_hot(node, m, dtype=jnp.float32)
-        tab = jnp.concatenate(
-            [
-                bins[level][:m].astype(jnp.float32)[:, None],
-                jax.nn.one_hot(feats[level][:m], p, dtype=jnp.float32),
-            ],
-            axis=1,
-        )  # (m, 1 + p)
-        rr = jnp.matmul(node_oh, tab, precision=_PREC)
-        code_at = jnp.sum(codes_f * rr[:, 1:], axis=1)
-        node = node * 2 + (code_at > rr[:, 0]).astype(jnp.int32)
+        node = route_rows(node_oh, feats[level][:m], bins[level][:m], codes_f, node)
     return node
 
 
@@ -486,7 +466,11 @@ def compute_leaf_index(
 
     idx_b = lax.map(block_fn, codes_b)            # (n_blocks, T_pad, rb)
     idx = jnp.moveaxis(idx_b, 0, 1).reshape(n_chunks * tree_chunk, n_pad)
-    return idx[:T, :n]
+    # Leaf ids are < 2^depth: store the (T, n) cache in the smallest
+    # integer type (int32 would be 8 GB at 2000 trees × 1M rows — the
+    # exact scale the cache exists for).
+    dtype = jnp.uint8 if depth <= 8 else (jnp.int16 if depth <= 15 else jnp.int32)
+    return idx[:T, :n].astype(dtype)
 
 
 def _tau_from_sums(S, M):

@@ -108,6 +108,35 @@ def codes_fingerprint(codes: jax.Array) -> jax.Array:
     return jnp.sum(codes * mix, dtype=jnp.int32)
 
 
+def route_rows(node_oh, best_feat, best_bin, codes_f, node_of_row):
+    """Route rows one level down via the shared one-hot matmul: per-node
+    (bin threshold, feature one-hot) table broadcast by ``node_oh``,
+    then the row's split-feature code as a (rows, p)·(rows, p) dot — no
+    per-row gathers (they serialize on TPU and dominated tree
+    wall-clock before this formulation). All quantities are small ints
+    in f32, so the comparisons are exact.
+
+    Args:
+      node_oh: (rows, M) f32 one-hot of each row's current node.
+      best_feat/best_bin: (M,) int32 split table for this level.
+      codes_f: (rows, p) f32 bin codes.
+      node_of_row: (rows,) int32 current node ids.
+
+    Returns: (rows,) int32 node ids one level down.
+    """
+    p = codes_f.shape[1]
+    route_tab = jnp.concatenate(
+        [
+            best_bin.astype(jnp.float32)[:, None],
+            jax.nn.one_hot(best_feat, p, dtype=jnp.float32),
+        ],
+        axis=1,
+    )  # (M, 1 + p)
+    row_route = jnp.matmul(node_oh, route_tab, precision=_PREC)
+    code_at_feat = jnp.sum(codes_f * row_route[:, 1:], axis=1)
+    return node_of_row * 2 + (code_at_feat > row_route[:, 0]).astype(jnp.int32)
+
+
 def quantile_bins(x: jax.Array, n_bins: int = 64) -> jax.Array:
     """Per-feature quantile bin edges, (p, n_bins-1). Computed once and
     shared by every tree (the binned representation is what CART's
@@ -203,8 +232,10 @@ def fit_forest_classifier(
     n, p = x.shape
     if mtry is None:
         mtry = max(1, int(np.sqrt(p)))
-    if tree_chunk is None:
-        tree_chunk = auto_tree_chunk(n, depth, cap=32)
+    # Explicit chunks are clamped too: the per-level routing one-hot is
+    # (rows, 2^(depth−1)) per vmapped tree.
+    auto_chunk = auto_tree_chunk(n, depth, cap=32)
+    tree_chunk = auto_chunk if tree_chunk is None else min(tree_chunk, auto_chunk)
     hist_backend = resolve_hist_backend(hist_backend)
     edges = quantile_bins(x, n_bins)
     codes = binarize(x, edges)  # (n, p) int32
@@ -318,22 +349,10 @@ def _grow_chunk(tree_keys, codes, yf, xb_onehot, *, depth, mtry, n_bins, hist_ba
                 has_split, (best % n_bins).astype(jnp.int32), n_bins - 1
             )
 
-            # Route rows through one (rows, M) node one-hot matmul —
-            # per-row gathers (bf[node], take_along_axis) serialize on
-            # TPU and dominate tree wall-clock; the broadcast-as-matmul
-            # rides the MXU. Small ints in f32 → comparisons exact.
             node_oh = jax.nn.one_hot(node_of_row, level_nodes, dtype=jnp.float32)
-            route_tab = jnp.concatenate(
-                [
-                    best_bin.astype(jnp.float32)[:, None],
-                    jax.nn.one_hot(best_feat, p, dtype=jnp.float32),
-                ],
-                axis=1,
-            )  # (M, 1 + p)
-            row_route = jnp.matmul(node_oh, route_tab, precision=_PREC)
-            row_bin = row_route[:, 0]
-            code_at_feat = jnp.sum(codes.astype(jnp.float32) * row_route[:, 1:], axis=1)
-            node_of_row = node_of_row * 2 + (code_at_feat > row_bin).astype(jnp.int32)
+            node_of_row = route_rows(
+                node_oh, best_feat, best_bin, codes.astype(jnp.float32), node_of_row
+            )
             return (node_of_row, hist), (best_feat, best_bin)
 
         # Levels are unrolled as a Python loop so level l only computes

@@ -356,11 +356,11 @@ def _cv_glmnet_impl(
             loss = jnp.sum(test_w[None, :] * (y[None, :] - eta) ** 2, axis=1) / jnp.sum(test_w)
         else:
             loss = jax.vmap(lambda e: _binomial_deviance_loss(y, e, test_w))(eta)
-        return loss
+        return loss, jnp.sum(test_w)
 
     if fold_axis is None:
         fold_ids = jnp.arange(1, nfolds + 1)
-        losses = jax.vmap(fold_fit)(fold_ids)  # (K, L)
+        losses, fold_n = jax.vmap(fold_fit)(fold_ids)  # (K, L), (K,)
     else:
         # Shard the fold batch over the active mesh's ``fold_axis``:
         # each device fits its folds against replicated data; XLA
@@ -376,15 +376,24 @@ def _cv_glmnet_impl(
             lambda ids: jax.vmap(fold_fit)(ids),
             mesh=mesh,
             in_specs=_P(fold_axis),
-            out_specs=_P(fold_axis),
+            out_specs=(_P(fold_axis), _P(fold_axis)),
             check_vma=False,  # fold_fit closes over replicated x/y/path
         )
-        losses = sharded(fold_ids)[:nfolds]
+        losses, fold_n = sharded(fold_ids)
+        losses, fold_n = losses[:nfolds], fold_n[:nfolds]
 
-    # cv.glmnet: cvm = weighted mean over folds (equal fold sizes up to
-    # rounding -> plain mean matches R to O(1/n)); cvsd = sd/sqrt(K).
-    cvm = jnp.mean(losses, axis=0)
-    cvsd = jnp.std(losses, axis=0, ddof=1) / jnp.sqrt(jnp.asarray(nfolds, x.dtype))
+    # cv.glmnet's cvstats: cvm is the fold-size-weighted mean of the
+    # per-fold means, cvsd = sqrt(weighted.mean((cvraw − cvm)², w) /
+    # (K−1)) with w = fold sizes. A plain mean agrees only to O(1/n) —
+    # which can flip the selected λ index near ties, a direct
+    # 1e-4-parity risk for the estimators whose τ̂ depends on λ.
+    wsum = jnp.sum(fold_n)
+    wts = (fold_n / wsum)[:, None]
+    cvm = jnp.sum(wts * losses, axis=0)
+    cvsd = jnp.sqrt(
+        jnp.sum(wts * (losses - cvm[None, :]) ** 2, axis=0)
+        / jnp.asarray(nfolds - 1, x.dtype)
+    )
 
     idx_min = jnp.argmin(cvm)
     bound = cvm[idx_min] + cvsd[idx_min]
