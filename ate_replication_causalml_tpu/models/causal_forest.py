@@ -56,12 +56,15 @@ from jax import lax
 
 from ate_replication_causalml_tpu.data.frame import CausalFrame
 from ate_replication_causalml_tpu.models.forest import (
+    apply_trees_chunked,
     auto_tree_chunk,
     bin_onehot,
     binarize,
+    dispatch_tree_target,
     fit_forest_regressor,
     forest_oob_mean,
     pick_chunk,
+    pick_divisor,
     quantile_bins,
     resolve_hist_backend,
     route_rows,
@@ -175,7 +178,9 @@ def grow_causal_forest(
     mtry = min(mtry, p)
     k = ci_group_size
     n_groups = -(-n_trees // k)
-    hist_backend = resolve_hist_backend(hist_backend)
+    hist_backend = resolve_hist_backend(
+        hist_backend, n_rows=int(n * sample_fraction)
+    )
     edges = quantile_bins(x, n_bins)
     codes = binarize(x, edges)
     xb_onehot = bin_onehot(codes, n_bins) if hist_backend == "onehot" else None
@@ -195,21 +200,32 @@ def grow_causal_forest(
     group_chunk = pick_chunk(n_groups, group_chunk)
     n_chunks = -(-n_groups // group_chunk)
     group_keys = jax.random.split(key, n_chunks * group_chunk)
+    # Superchunking (see forest.py::_DISPATCH_CHUNK_TARGET): several
+    # vmapped group chunks per dispatch via an inner lax.map — the
+    # remote tunnel charges ~80 ms per dispatched executable, which
+    # dominates a chunk-per-dispatch loop at million-row auto chunks.
+    super_ = pick_divisor(
+        n_chunks, max(1, dispatch_tree_target(chunk_rows) // (group_chunk * k))
+    )
+    n_disp = n_chunks // super_  # exact: super_ divides n_chunks
 
     # Elastic host loop over one compiled chunk executable (shared
     # across chunks and fits): bounded device-program size, and a
-    # transient device failure re-runs only that chunk (keys are
+    # transient device failure re-runs only that dispatch (keys are
     # explicit, so the retry is bit-identical — parallel/retry.py).
     def chunk_shard(i: int):
+        kk = group_keys[
+            i * super_ * group_chunk : (i + 1) * super_ * group_chunk
+        ].reshape(super_, group_chunk)
         return _grow_cf_chunk(
-            group_keys[i * group_chunk : (i + 1) * group_chunk],
+            kk,
             codes, wt, yt, mom_stack, xb_onehot,
             depth=depth, mtry=mtry, n_bins=n_bins, min_node=min_node,
             s=s, k=k, honesty=honesty, hist_backend=hist_backend,
         )
 
     chunks = require_all(
-        run_shards(chunk_shard, n_chunks, retriable=(jax.errors.JaxRuntimeError,))
+        run_shards(chunk_shard, n_disp, retriable=(jax.errors.JaxRuntimeError,))
     )
     flat = lambda j: jnp.concatenate(
         [c[j].reshape((-1,) + c[j].shape[2:]) for c in chunks], axis=0
@@ -231,8 +247,11 @@ def grow_causal_forest(
 )
 def _grow_cf_chunk(group_keys, codes, wt, yt, mom_stack, xb_onehot, *,
                    depth, mtry, n_bins, min_node, s, k, honesty, hist_backend):
-    """One compiled chunk of little-bag groups (vmapped), k trees per
-    group sharing a half-sample. Module-level jit — shared executable."""
+    """One compiled dispatch of little-bag groups, k trees per group
+    sharing a half-sample. ``group_keys`` is (gc,) for one vmapped
+    chunk or (S, gc) for a superchunk (S chunks sequentially under
+    lax.map — one dispatch, memory of one chunk). Module-level jit —
+    shared executable."""
     n, p = codes.shape
     max_nodes = 1 << (depth - 1)
     n_leaves = 1 << depth
@@ -371,7 +390,12 @@ def _grow_cf_chunk(group_keys, codes, wt, yt, mom_stack, xb_onehot, *,
             )
         return feats, bins, stats, jnp.broadcast_to(in_mask, (k, n))
 
-    return jax.vmap(grow_group)(group_keys)
+    if group_keys.ndim == 1:
+        return jax.vmap(grow_group)(group_keys)
+    out = lax.map(lambda kk: jax.vmap(grow_group)(kk), group_keys)  # (S, gc, …)
+    return jax.tree_util.tree_map(
+        lambda a: a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:]), out
+    )
 
 
 def fit_causal_forest(
@@ -394,11 +418,16 @@ def fit_causal_forest(
     fy = fit_forest_regressor(
         x, y, ky, n_trees=nuisance_trees, depth=nuisance_depth, hist_backend=hist_backend
     )
+    y_hat = forest_oob_mean(fy, x)
+    # Free each nuisance forest as soon as its OOB estimates exist: the
+    # (T, n) train_leaf/counts arrays are multi-GB at the million-row
+    # scale and the causal grow needs the headroom.
+    del fy
     fw = fit_forest_regressor(
         x, w, kw, n_trees=nuisance_trees, depth=nuisance_depth, hist_backend=hist_backend
     )
-    y_hat = forest_oob_mean(fy, x)
     w_hat = forest_oob_mean(fw, x)
+    del fw
     forest = grow_causal_forest(
         x, w - w_hat, y - y_hat, kc, n_trees=n_trees, depth=depth,
         hist_backend=hist_backend, **grow_kwargs,
@@ -441,36 +470,16 @@ def compute_leaf_index(
     :func:`predict_cate`.
     """
     codes = binarize(x, forest.bin_edges)
-    n = codes.shape[0]
-    T, depth = forest.n_trees, forest.depth
-    n_chunks = -(-T // tree_chunk)
-    pad = n_chunks * tree_chunk - T
-    feats = jnp.concatenate(
-        [forest.split_feat, jnp.zeros((pad,) + forest.split_feat.shape[1:], jnp.int32)]
-    ).reshape(n_chunks, tree_chunk, depth, -1)
-    bins = jnp.concatenate(
-        [forest.split_bin, jnp.zeros((pad,) + forest.split_bin.shape[1:], jnp.int32)]
-    ).reshape(n_chunks, tree_chunk, depth, -1)
-
-    rb = min(row_chunk, n)
-    n_blocks = -(-n // rb)
-    n_pad = n_blocks * rb
-    codes_b = jnp.pad(codes, ((0, n_pad - n), (0, 0))).reshape(n_blocks, rb, -1)
-
-    def block_fn(codes_blk):
-        idx = lax.map(
-            lambda fb: jax.vmap(lambda f, b: _tree_route(f, b, codes_blk, depth))(*fb),
-            (feats, bins),
-        )
-        return idx.reshape(n_chunks * tree_chunk, rb)
-
-    idx_b = lax.map(block_fn, codes_b)            # (n_blocks, T_pad, rb)
-    idx = jnp.moveaxis(idx_b, 0, 1).reshape(n_chunks * tree_chunk, n_pad)
+    depth = forest.depth
     # Leaf ids are < 2^depth: store the (T, n) cache in the smallest
     # integer type (int32 would be 8 GB at 2000 trees × 1M rows — the
     # exact scale the cache exists for).
     dtype = jnp.uint8 if depth <= 8 else (jnp.int16 if depth <= 15 else jnp.int32)
-    return idx[:T, :n].astype(dtype)
+    return apply_trees_chunked(
+        forest.split_feat, forest.split_bin, codes, depth,
+        post=lambda node, _: node.astype(dtype),
+        tree_chunk=tree_chunk, row_chunk=row_chunk,
+    )
 
 
 def _tau_from_sums(S, M):
@@ -612,40 +621,61 @@ def predict_cate(
             var_w = ((tau_t - mean_t[:, None]) ** 2 * okf).sum(axis=1) / jnp.maximum(
                 nv - 1.0, 1.0
             )
-            return S_g.sum(axis=0), M_g.sum(axis=0), tau_g, ok_g, var_w
+            # Little-bags sufficient statistics, reduced over this
+            # chunk's groups — a full (n_groups, rows) per-group tau
+            # matrix is ~4 GB × 3 at 2000 trees × 1M rows and OOMs.
+            # Moments are CENTERED at the chunk's own per-row mean c:
+            # raw Σok·τ_g² suffers catastrophic f32 cancellation when
+            # the CATE level dwarfs the between-group spread; centered
+            # deviations d = τ_g − c keep every accumulated term small.
+            okg = ok_g.astype(jnp.float32)
+            n_j = okg.sum(axis=0)
+            c_j = (okg * tau_g).sum(axis=0) / jnp.maximum(n_j, 1.0)
+            d = tau_g - c_j[None, :]
+            return (
+                S_g.sum(axis=0),                # (rb, 5)
+                M_g.sum(axis=0),                # (rb,)
+                n_j,                            # Σ ok
+                c_j,                            # chunk center
+                (okg * d).sum(axis=0),          # Σ ok·d   (≈0 by choice of c)
+                (okg * d * d).sum(axis=0),      # Σ ok·d²
+                (okg * var_w).sum(axis=0),      # Σ ok·var_w
+            )
 
-        S_c, M_c, tau_g, ok_g, var_w = lax.map(
+        S_c, M_c, n_c, c_c, m_c, q_c, w_c = lax.map(
             chunk_fn, (feats_g, bins_g, stats_g, in_blk, li_blk)
         )
-        G = n_chunks * group_chunk
+        # Combine the chunks' centered moments at the block's weighted
+        # center c_b via the parallel-variance shift rule:
+        #   q@c_b = q@c_j + 2·(c_j − c_b)·m@c_j + (c_j − c_b)²·n_j.
+        A1 = n_c.sum(axis=0)
+        c_b = (n_c * c_c).sum(axis=0) / jnp.maximum(A1, 1.0)
+        shift = c_c - c_b[None, :]
+        M1 = (m_c + n_c * shift).sum(axis=0)
+        Q = (q_c + 2.0 * shift * m_c + n_c * shift * shift).sum(axis=0)
         return (
-            S_c.sum(axis=0),                    # (rb, 5)
-            M_c.sum(axis=0),                    # (rb,)
-            tau_g.reshape(G, rb),
-            ok_g.reshape(G, rb),
-            var_w.reshape(G, rb),
+            S_c.sum(axis=0), M_c.sum(axis=0), A1, c_b, M1, Q, w_c.sum(axis=0)
         )
 
-    S_b, M_b, tau_gb, ok_gb, var_wb = lax.map(block_fn, (codes_b, in_b, li_b))
+    S_b, M_b, A1_b, c_bb, M1_b, Q_b, W1_b = lax.map(block_fn, (codes_b, in_b, li_b))
 
-    def unblock(a):  # (n_blocks, …, rb) with rows last two -> (…, n)
-        a = jnp.moveaxis(a, 0, -2)
-        return a.reshape(*a.shape[:-2], n_pad)[..., :n]
+    def unblock(a):  # (n_blocks, rb, …) -> (n, …)
+        return a.reshape((n_pad,) + a.shape[2:])[:n]
 
-    S = S_b.reshape(n_pad, 5)[:n]
-    M = M_b.reshape(n_pad)[:n]
+    S = unblock(S_b)
+    M = unblock(M_b)
     tau, _ = _tau_from_sums(S, M)
-
-    tau_g = unblock(tau_gb)[:n_groups]
-    ok_g = unblock(ok_gb)[:n_groups].astype(jnp.float32)
-    var_w = unblock(var_wb)[:n_groups]
+    A1, c_b, M1, Q, W1 = (unblock(a) for a in (A1_b, c_bb, M1_b, Q_b, W1_b))
 
     # Bootstrap of little bags: V_between − V_within/k, truncated at 0.
-    ng = jnp.maximum(ok_g.sum(axis=0), 1.0)
-    v_between = ((tau_g - tau[None, :]) ** 2 * ok_g).sum(axis=0) / jnp.maximum(
-        ng - 1.0, 1.0
-    )
-    v_within = (var_w * ok_g).sum(axis=0) / ng
+    # V_between = Σ ok·(τ_g − τ)²/(ng−1): shift the block-centered
+    # moments to the pooled τ (c_b ≈ τ, so the shift terms stay small —
+    # no cancellation). Padded groups carry ok=0 and contribute nothing.
+    ng = jnp.maximum(A1, 1.0)
+    shift = c_b - tau
+    ss_between = Q + 2.0 * shift * M1 + A1 * shift * shift
+    v_between = ss_between / jnp.maximum(ng - 1.0, 1.0)
+    v_within = W1 / ng
     variance = jnp.maximum(v_between - v_within / k, 0.0)
     return CatePredictions(cate=tau, variance=variance)
 

@@ -169,7 +169,9 @@ def bin_onehot(codes: jax.Array, n_bins: int) -> jax.Array:
 def pick_chunk(total: int, chunk: int) -> int:
     """Pick a work-chunk size: prefer the largest divisor of ``total``
     within the budget (zero padding waste); fall back to ceil-padding
-    only when ``total`` has no usable divisor (e.g. prime)."""
+    only when ``total`` has no usable divisor (e.g. prime). Callers of
+    the fallback MUST handle the padded tail — use :func:`pick_divisor`
+    where the loop count is derived by exact division."""
     chunk = max(1, min(chunk, total))
     divisors = [d for d in range(chunk, 0, -1) if total % d == 0]
     if divisors and divisors[0] * 2 >= chunk:
@@ -177,10 +179,39 @@ def pick_chunk(total: int, chunk: int) -> int:
     return chunk
 
 
+def pick_divisor(total: int, cap: int) -> int:
+    """Largest divisor of ``total`` that is ≤ ``cap`` (≥ 1 always).
+    Unlike :func:`pick_chunk` this never returns a non-divisor, so
+    ``total // pick_divisor(total, cap)`` is exact — required where the
+    result sizes a dispatch loop (a floor division with a non-divisor
+    silently drops the tail)."""
+    cap = max(1, min(cap, total))
+    for d in range(cap, 0, -1):
+        if total % d == 0:
+            return d
+    return 1
+
+
 # HBM budget for the largest per-level matmul operand of one vmapped
-# tree chunk (the (rows, max_nodes) f32 node one-hots). 4 GB leaves
-# room on a 16 GB chip for the other operands and XLA temporaries.
-_CHUNK_BYTES_BUDGET = 4 << 30
+# tree chunk (the (rows, max_nodes) f32 node one-hots). Several live
+# operands of comparable size coexist per level (node one-hot, weighted
+# lhs, leaf one-hot), plus persistent forest state — 2 GB for the
+# single largest keeps the whole chunk inside a 16 GB chip.
+_CHUNK_BYTES_BUDGET = 2 << 30
+
+# Trees per dispatched executable AT 100k ROWS: vmapped chunks are
+# grouped into superchunks via an inner lax.map so a fit issues few
+# dispatches (the remote tunnel charges ~80 ms per call with large
+# args) while memory stays bounded by one vmapped chunk. The target
+# scales inversely with rows — a single dispatch that runs for minutes
+# (e.g. 250 trees × ~0.2 s at 1M rows) trips the remote worker's
+# watchdog and kills the process.
+_DISPATCH_CHUNK_TARGET = 256
+
+
+def dispatch_tree_target(n_rows: int) -> int:
+    """Trees per dispatch, scaled so one dispatch stays ~O(10 s)."""
+    return max(16, _DISPATCH_CHUNK_TARGET * 100_000 // max(n_rows, 1))
 
 
 def auto_tree_chunk(
@@ -236,7 +267,7 @@ def fit_forest_classifier(
     # (rows, 2^(depth−1)) per vmapped tree.
     auto_chunk = auto_tree_chunk(n, depth, cap=32)
     tree_chunk = auto_chunk if tree_chunk is None else min(tree_chunk, auto_chunk)
-    hist_backend = resolve_hist_backend(hist_backend)
+    hist_backend = resolve_hist_backend(hist_backend, n_rows=n)
     edges = quantile_bins(x, n_bins)
     codes = binarize(x, edges)  # (n, p) int32
     xb_onehot = bin_onehot(codes, n_bins) if hist_backend == "onehot" else None
@@ -245,19 +276,28 @@ def fit_forest_classifier(
     tree_chunk = pick_chunk(n_trees, tree_chunk)
     n_chunks = -(-n_trees // tree_chunk)  # ceil: padded, sliced after
     tree_keys = jax.random.split(key, n_chunks * tree_chunk)
+    # Superchunking: several vmapped chunks per DISPATCH via an inner
+    # lax.map (sequential → same memory as one chunk). The remote-device
+    # tunnel charges ~80 ms per dispatched executable with large args,
+    # so at small auto chunks (million-row fits) a chunk-per-dispatch
+    # loop pays minutes of pure overhead.
+    super_ = pick_divisor(n_chunks, max(1, dispatch_tree_target(n) // tree_chunk))
+    n_disp = n_chunks // super_  # exact: super_ divides n_chunks
 
     def chunk_shard(i: int):
+        kk = tree_keys[
+            i * super_ * tree_chunk : (i + 1) * super_ * tree_chunk
+        ].reshape(super_, tree_chunk)
         return _grow_chunk(
-            tree_keys[i * tree_chunk : (i + 1) * tree_chunk],
-            codes, yf, xb_onehot,
+            kk, codes, yf, xb_onehot,
             depth=depth, mtry=mtry, n_bins=n_bins, hist_backend=hist_backend,
         )
 
     # Elastic host loop (parallel/retry.py): a transient device failure
-    # (dropped tunnel, preemption) re-runs only that chunk; keys are
-    # explicit so the retried chunk is bit-identical.
+    # (dropped tunnel, preemption) re-runs only that dispatch; keys are
+    # explicit so the retried dispatch is bit-identical.
     chunks = require_all(
-        run_shards(chunk_shard, n_chunks, retriable=(jax.errors.JaxRuntimeError,))
+        run_shards(chunk_shard, n_disp, retriable=(jax.errors.JaxRuntimeError,))
     )
     cat = lambda j: jnp.concatenate([c[j] for c in chunks], axis=0)[:n_trees]
     return Forest(
@@ -275,9 +315,11 @@ def fit_forest_classifier(
     jax.jit, static_argnames=("depth", "mtry", "n_bins", "hist_backend")
 )
 def _grow_chunk(tree_keys, codes, yf, xb_onehot, *, depth, mtry, n_bins, hist_backend):
-    """One compiled chunk of trees (vmapped). Module-level jit: the
-    executable is shared by every chunk of every forest with the same
-    shapes/statics — the host loop in the fitters adds no recompiles."""
+    """One compiled dispatch of trees. ``tree_keys`` is either (tc,) —
+    one vmapped chunk — or (S, tc) — a superchunk: S vmapped chunks run
+    sequentially under lax.map (memory of one chunk, one dispatch).
+    Module-level jit: the executable is shared by every dispatch of
+    every forest with the same shapes/statics."""
     n, p = codes.shape
     max_nodes = 1 << (depth - 1)
     n_leaves = 1 << depth
@@ -381,27 +423,103 @@ def _grow_chunk(tree_keys, codes, yf, xb_onehot, *, depth, mtry, n_bins, hist_ba
         leaf_y = jax.ops.segment_sum(counts * yf, node_of_row, num_segments=n_leaves)
         overall = jnp.sum(counts * yf) / jnp.maximum(jnp.sum(counts), 1e-12)
         leaf_value = jnp.where(leaf_c > 0, leaf_y / jnp.maximum(leaf_c, 1e-12), overall)
-        return feats, bins, leaf_value, counts, leaf_value[node_of_row]
+        # Bootstrap counts persist only for the OOB mask (count == 0);
+        # uint8 storage is exact for Poisson(1)/multinomial draws and
+        # 4× smaller than f32 — (T, n) at a 500-tree × 1M-row nuisance
+        # fit is 2 GB in f32.
+        return feats, bins, leaf_value, counts.astype(jnp.uint8), leaf_value[node_of_row]
 
-    return jax.vmap(grow_one)(tree_keys)
+    if tree_keys.ndim == 1:
+        return jax.vmap(grow_one)(tree_keys)
+    out = lax.map(lambda kk: jax.vmap(grow_one)(kk), tree_keys)  # (S, tc, …)
+    return jax.tree_util.tree_map(
+        lambda a: a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:]), out
+    )
 
 
-@jax.jit
-def forest_apply(forest: Forest, codes: jax.Array) -> jax.Array:
+def apply_trees_chunked(
+    split_feat, split_bin, codes, depth, post, tree_aux=None,
+    tree_chunk: int = 32, row_chunk: int = 65536,
+):
+    """Tiled tree application: route every (tree, row) pair with
+    per-level one-hot matmuls (``route_rows``) in bounded
+    (tree chunk × row block) tiles, then map ``post(node, aux_t)`` per
+    tile. The SINGLE implementation of chunked routing — forest_apply
+    and the causal forest's ``compute_leaf_index`` both consume it.
+
+    Per-row gathers serialize on TPU, and unbounded (rows, nodes)
+    one-hots would not fit HBM at the million-row scale — hence both the
+    matmul routing and the tiling.
+
+    Args:
+      split_feat/split_bin: (T, depth, max_nodes) int32 split tables.
+      codes: (n, p) int32 bin codes of the query rows.
+      post: ``(node_ids (rb,), aux_t) -> (rb,) array`` per-tile output
+        (e.g. leaf-value contraction, or the ids themselves).
+      tree_aux: optional per-tree array (T, …) passed to ``post``.
+
+    Returns: (T, n) stacked ``post`` outputs.
+    """
+    n = codes.shape[0]
+    codes_f = codes.astype(jnp.float32)
+    T = split_feat.shape[0]
+    t_chunks = -(-T // tree_chunk)
+    t_pad = t_chunks * tree_chunk
+
+    def pad_trees(a):
+        return jnp.concatenate(
+            [a, jnp.zeros((t_pad - T,) + a.shape[1:], a.dtype)]
+        ).reshape(t_chunks, tree_chunk, *a.shape[1:])
+
+    feats_c = pad_trees(split_feat)
+    bins_c = pad_trees(split_bin)
+    aux_c = None if tree_aux is None else pad_trees(tree_aux)
+
+    rb = min(row_chunk, n)
+    n_blocks = -(-n // rb)
+    n_pad = n_blocks * rb
+    codes_b = jnp.pad(codes_f, ((0, n_pad - n), (0, 0))).reshape(n_blocks, rb, -1)
+
+    def block_fn(codes_blk):
+        def one_tree(feats, bins, aux):
+            node = jnp.zeros(rb, jnp.int32)
+            for level in range(depth):
+                m = 1 << level
+                node_oh = jax.nn.one_hot(node, m, dtype=jnp.float32)
+                node = route_rows(node_oh, feats[level][:m], bins[level][:m],
+                                  codes_blk, node)
+            return post(node, aux)
+
+        def chunk(fba):
+            feats, bins, aux = fba
+            if aux is None:
+                return jax.vmap(lambda f, b: one_tree(f, b, None))(feats, bins)
+            return jax.vmap(one_tree)(feats, bins, aux)
+
+        return lax.map(chunk, (feats_c, bins_c, aux_c)).reshape(t_pad, rb)
+
+    vals = lax.map(block_fn, codes_b)  # (n_blocks, t_pad, rb)
+    vals = jnp.moveaxis(vals, 0, 1).reshape(t_pad, n_pad)
+    return vals[:T, :n]
+
+
+@functools.partial(jax.jit, static_argnames=("tree_chunk", "row_chunk"))
+def forest_apply(
+    forest: Forest,
+    codes: jax.Array,
+    tree_chunk: int = 32,
+    row_chunk: int = 65536,
+) -> jax.Array:
     """Leaf value of every (tree, row): (T, n)."""
-
-    def one_tree(feats, bins, leaf_value):
-        def step(node, level):
-            f = feats[level][node]
-            b = bins[level][node]
-            code = jnp.take_along_axis(codes, f[:, None], axis=1)[:, 0]
-            return node * 2 + (code > b).astype(jnp.int32), None
-
-        node0 = jnp.zeros(codes.shape[0], jnp.int32)
-        node, _ = lax.scan(step, node0, jnp.arange(forest.depth))
-        return leaf_value[node]
-
-    return jax.vmap(one_tree)(forest.split_feat, forest.split_bin, forest.leaf_value)
+    return apply_trees_chunked(
+        forest.split_feat, forest.split_bin, codes, forest.depth,
+        post=lambda node, lv: jnp.matmul(
+            jax.nn.one_hot(node, lv.shape[0], dtype=jnp.float32), lv,
+            precision=_PREC,
+        ),
+        tree_aux=forest.leaf_value,
+        tree_chunk=tree_chunk, row_chunk=row_chunk,
+    )
 
 
 def predict_forest(forest: Forest, x: jax.Array, oob: bool = False) -> ForestPredictions:
@@ -490,19 +608,28 @@ def fit_forest_sharded(
             "hist_backend='onehot' is not supported on the sharded path "
             "(the shared bin one-hot is not built here); use 'auto'/'xla'/'pallas'"
         )
-    hist_backend = resolve_hist_backend(hist_backend, allow_onehot=False)
+    hist_backend = resolve_hist_backend(hist_backend, allow_onehot=False, n_rows=n)
     axis_size = mesh.shape[axis_name]
-    per_dev = -(-n_trees // axis_size)
+    # Per-device trees grow in HBM-budgeted vmapped chunks under an
+    # inner lax.map (same memory bound as the host-loop fitter); pad
+    # per_dev up to whole chunks, sliced off below.
+    tree_chunk = pick_chunk(
+        max(1, -(-n_trees // axis_size)), auto_tree_chunk(n, depth, cap=32)
+    )
+    per_dev = -(-n_trees // (axis_size * tree_chunk)) * tree_chunk
     edges = quantile_bins(x, n_bins)
     codes = binarize(x, edges)
     yf = y.astype(jnp.float32)
     tree_keys = jax.random.split(key, axis_size * per_dev)
 
-    grow = jax.shard_map(
-        functools.partial(
-            _grow_chunk, xb_onehot=None,
+    def device_body(keys, codes, yf):
+        return _grow_chunk(
+            keys.reshape(per_dev // tree_chunk, tree_chunk), codes, yf, None,
             depth=depth, mtry=mtry, n_bins=n_bins, hist_backend=hist_backend,
-        ),
+        )
+
+    grow = jax.shard_map(
+        device_body,
         mesh=mesh,
         in_specs=(P(axis_name), P(), P()),
         out_specs=P(axis_name),

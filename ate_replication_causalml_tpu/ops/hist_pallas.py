@@ -48,21 +48,32 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
-def resolve_hist_backend(backend: str, allow_onehot: bool = True) -> str:
+# Row count above which the streaming Pallas kernel beats the XLA
+# contraction on TPU. Measured on v5-lite (p=21, 64 bins, in-situ grow
+# chunks): at 100k rows XLA wins (9.8 vs 10.9 ms/tree, whole causal
+# tree); at 1M rows the kernel wins (159 vs 211 ms/tree, classifier) —
+# the XLA path's scatter-built bin one-hot and its HBM materialization
+# grow with rows while the kernel streams codes through VMEM.
+_PALLAS_ROWS_THRESHOLD = 400_000
+
+
+def resolve_hist_backend(
+    backend: str, allow_onehot: bool = True, n_rows: int | None = None
+) -> str:
     """The single place the 'auto' policy lives.
 
-    Measured on TPU v5-lite (n=100k, p=21, 64 bins, 32-tree chunks):
-    the chunked-XLA contraction runs ~36 ms/tree vs ~55 ms/tree for the
-    Pallas kernel, and the kernel's VMEM-resident accumulator
-    (K·max_nodes × p·n_bins f32) exhausts scoped VMEM for deep trees
-    under tree-vmap. So 'auto' is the XLA path everywhere — the fastest
-    *and* the memory-robust choice; the kernel remains selectable
-    (``backend="pallas"``) and bit-exact (tests/test_hist_pallas.py)
-    for platforms/shapes where a fused kernel wins. On CPU the forest
-    engines pass ``allow_onehot=True`` to use the shared one-hot matmul
-    (fastest at reference scale)."""
+    On TPU, 'auto' picks the XLA contraction at reference-like row
+    counts and the streaming Pallas kernel past ``_PALLAS_ROWS_THRESHOLD``
+    (see measurement note above); pass ``n_rows`` to enable the switch —
+    without it 'auto' stays on the XLA path, which is within ~25% either
+    way. Both are bit-exact to each other (tests/test_hist_pallas.py)
+    and remain explicitly selectable. On CPU the forest engines pass
+    ``allow_onehot=True`` to use the shared one-hot matmul (fastest at
+    reference scale)."""
     if backend == "auto":
         if jax.default_backend() == "tpu":
+            if n_rows is not None and n_rows >= _PALLAS_ROWS_THRESHOLD:
+                return "pallas"
             return "xla"
         return "onehot" if allow_onehot else "xla"
     return backend

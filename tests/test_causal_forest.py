@@ -182,3 +182,40 @@ def test_leaf_index_cache_matches_and_skips_routing(monkeypatch):
     assert calls["n"] == 0
     predict_cate(fitted.forest, new_x, oob=False)
     assert calls["n"] > 0
+
+
+def test_little_bags_variance_stable_at_large_cate_level():
+    """V_between is accumulated as centered moments: with a CATE level
+    that dwarfs the between-group spread (tau ~ 50), naive raw-moment
+    accumulation (sum ok*tau_g^2 - ...) cancels catastrophically in f32
+    and collapses the variance; the centered path must keep it sane and
+    comparable to the same problem at tau ~ 0.5."""
+    rng = np.random.default_rng(11)
+    n, p = 1500, 5
+    x = rng.normal(size=(n, p))
+    w = (rng.random(n) < 0.5).astype(np.float64)
+    noise = rng.normal(size=n) * 0.3
+    frames = {}
+    for name, level in (("small", 0.5), ("large", 50.0)):
+        y = 0.4 * x[:, 1] + (level + 0.2 * (x[:, 0] > 0)) * w + noise
+        frames[name] = CausalFrame(
+            x=jnp.asarray(x, jnp.float32),
+            w=jnp.asarray(w, jnp.float32),
+            y=jnp.asarray(y, jnp.float32),
+        )
+    variances = {}
+    for name, frame in frames.items():
+        fitted = _fit_small(frame, n_trees=64)
+        cate = predict_cate(fitted.forest, fitted.x, oob=True)
+        v = np.asarray(cate.variance)
+        assert np.isfinite(v).all()
+        variances[name] = v
+    # The large-level problem is the same randomization with y shifted
+    # by 50*w; its little-bags variance must not collapse toward zero
+    # (the f32 cancellation signature). The truncation max(.,0) zeroes
+    # ~2/3 of rows at these tree counts in BOTH cases — compare the
+    # positive fraction and the mean, not the median.
+    frac_small = (variances["small"] > 0).mean()
+    frac_large = (variances["large"] > 0).mean()
+    assert frac_large > 0.5 * frac_small > 0.0, (frac_small, frac_large)
+    assert variances["large"].mean() > 0.1 * variances["small"].mean() > 0.0

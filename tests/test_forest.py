@@ -128,3 +128,20 @@ def test_chernozhukov_residual_regression(prep_small):
         key=jax.random.key(7),
     )
     assert np.isfinite(float(tau)) and float(se) > 0
+
+
+def test_superchunk_never_drops_trees(monkeypatch):
+    """Regression: a non-divisor superchunk size once silently dropped
+    trailing chunks (480 of 500 trees at exactly 100k rows). With
+    pick_divisor the dispatch loop must cover every requested tree for
+    awkward chunk/target combinations."""
+    import ate_replication_causalml_tpu.models.forest as fm
+
+    x, y = _classification_problem(n=300)
+    # Force the historically-failing arithmetic: chunks of 20 (25 chunks
+    # for 500 trees) with a dispatch target of 12 chunks.
+    monkeypatch.setattr(fm, "auto_tree_chunk", lambda *a, **k: 20)
+    monkeypatch.setattr(fm, "dispatch_tree_target", lambda n_rows: 12 * 20)
+    forest = fm.fit_forest_classifier(x, y, jax.random.key(3), n_trees=500, depth=4)
+    assert forest.n_trees == 500
+    assert np.isfinite(np.asarray(forest.leaf_value)).all()
