@@ -328,6 +328,12 @@ def run_sweep(
 def main(argv: Iterable[str] | None = None) -> SweepReport:
     import argparse
 
+    from ate_replication_causalml_tpu.utils.compile_cache import (
+        enable_persistent_cache,
+    )
+
+    enable_persistent_cache()
+
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--out", default="results", help="output directory")
     ap.add_argument("--csv", default=None,

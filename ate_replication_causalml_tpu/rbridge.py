@@ -31,6 +31,13 @@ import jax.numpy as jnp
 
 from ate_replication_causalml_tpu.data.frame import CausalFrame
 from ate_replication_causalml_tpu.data.schema import DatasetSchema
+from ate_replication_causalml_tpu.utils.compile_cache import enable_persistent_cache
+
+# The reticulate session imports this module once (tpu_init); fresh R
+# sessions would otherwise recompile the forest executables from
+# scratch through the remote compile service.
+enable_persistent_cache()
+
 from ate_replication_causalml_tpu.estimators import (
     EstimatorResult,
 )

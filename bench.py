@@ -20,6 +20,10 @@ import time
 import jax
 import jax.numpy as jnp
 
+from ate_replication_causalml_tpu.utils.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
 N_ROWS = 1_000_000
 N_BOOT = 10_000
 CHUNK = 25

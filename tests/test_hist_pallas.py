@@ -147,3 +147,23 @@ def test_causal_forest_identical_across_backends():
     np.testing.assert_allclose(
         np.asarray(got.leaf_stats), np.asarray(ref.leaf_stats), atol=1e-4
     )
+
+
+def test_resolve_backend_row_aware_policy(monkeypatch):
+    """'auto' picks the streaming kernel only on TPU and only past the
+    measured row threshold; explicit choices always pass through."""
+    import ate_replication_causalml_tpu.ops.hist_pallas as hp
+
+    monkeypatch.setattr(hp.jax, "default_backend", lambda: "cpu")
+    assert hp.resolve_hist_backend("auto") == "onehot"
+    assert hp.resolve_hist_backend("auto", allow_onehot=False) == "xla"
+    assert hp.resolve_hist_backend("auto", n_rows=10**7) == "onehot"
+
+    monkeypatch.setattr(hp.jax, "default_backend", lambda: "tpu")
+    assert hp.resolve_hist_backend("auto") == "xla"
+    assert hp.resolve_hist_backend("auto", n_rows=100_000) == "xla"
+    assert hp.resolve_hist_backend(
+        "auto", n_rows=hp._PALLAS_ROWS_THRESHOLD
+    ) == "pallas"
+    for explicit in ("xla", "pallas", "pallas_bf16", "pallas_interpret", "onehot"):
+        assert hp.resolve_hist_backend(explicit, n_rows=10**7) == explicit
