@@ -18,15 +18,15 @@ def _default_cache_dir() -> str:
     env = os.environ.get("ATE_COMPILE_CACHE")
     if env:
         return env
-    # Repo checkout: cache beside the package (gitignored). Installed
-    # package (site-packages is often read-only): user cache dir.
+    # Repo checkout (detected by a repo marker, not mere writability —
+    # a venv's site-packages parent is writable too): cache beside the
+    # package, gitignored. Installed package: user cache dir.
     repo_root = os.path.dirname(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     )
-    candidate = os.path.join(repo_root, ".jax_cache_tpu")
-    probe_root = repo_root if os.path.isdir(repo_root) else None
-    if probe_root and os.access(probe_root, os.W_OK):
-        return candidate
+    is_checkout = os.path.exists(os.path.join(repo_root, ".git"))
+    if is_checkout and os.access(repo_root, os.W_OK):
+        return os.path.join(repo_root, ".jax_cache_tpu")
     return os.path.join(
         os.path.expanduser("~"), ".cache", "ate_replication_causalml_tpu",
         "jax_cache",
@@ -40,17 +40,15 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
     configuration failed — with a visible warning, never silently."""
     import jax
 
-    existing = jax.config.jax_compilation_cache_dir
-    if existing:
-        # Respect a cache already configured by the embedding process
-        # (e.g. the test suite's conftest dir) — don't silently retarget.
-        return existing
-
     cache_dir = cache_dir or _default_cache_dir()
     try:
+        existing = jax.config.jax_compilation_cache_dir
+        if existing:
+            # Respect a cache already configured by the embedding process
+            # (e.g. the test suite's conftest dir) — don't retarget.
+            return existing
         jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except (AttributeError, ValueError) as e:  # unknown flag after upgrade
+    except (AttributeError, ValueError) as e:  # flag renamed/removed
         warnings.warn(
             f"persistent compilation cache disabled ({e}); first calls will "
             "be compile-dominated",
@@ -58,4 +56,15 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
             stacklevel=2,
         )
         return None
+    try:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except (AttributeError, ValueError) as e:
+        # Cache dir IS active at this point — report the partial state
+        # accurately rather than claiming the cache is off.
+        warnings.warn(
+            f"compilation cache enabled at {cache_dir}, but the min-compile-"
+            f"time threshold could not be set ({e}); JAX's default applies",
+            RuntimeWarning,
+            stacklevel=2,
+        )
     return cache_dir

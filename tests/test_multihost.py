@@ -48,6 +48,31 @@ _CHILD = textwrap.dedent(
     arr = jax.make_array_from_callback((8,), sharding, lambda idx: data[idx])
     total = jax.jit(lambda a: a.sum(), out_shardings=NamedSharding(mesh, P()))(arr)
     np.testing.assert_allclose(np.asarray(total), 28.0)
+
+    # The framework's own multi-host path: the sharded AIPW bootstrap
+    # with the boot axis spanning BOTH processes (the reference's serial
+    # B-loop, ate_functions.R:192-194, as DCN-style fan-out). Every
+    # process computes the identical SE because replicate keys fold in
+    # the global axis index and the taus are all_gathered.
+    import jax.numpy as jnp
+    from ate_replication_causalml_tpu.ops.bootstrap import aipw_bootstrap_se_sharded
+    from ate_replication_causalml_tpu.parallel.mesh import use_mesh
+
+    n = 4096
+    p = jnp.full((n,), 0.4)
+    w = (jax.random.uniform(jax.random.key(6), (n,)) < p).astype(jnp.float32)
+    y = (jax.random.uniform(jax.random.key(7), (n,)) < 0.5).astype(jnp.float32)
+    mu0 = jnp.full((n,), 0.45)
+    mu1 = jnp.full((n,), 0.55)
+    boot_mesh = Mesh(np.asarray(jax.devices()), ("boot",))
+    with use_mesh(boot_mesh):
+        se = aipw_bootstrap_se_sharded(
+            w, y, p, mu0, mu1, key=jax.random.key(8), n_boot=64,
+            axis_name="boot",
+        )
+    se = float(se)
+    assert 0.0 < se < 1.0, se
+    print(f"CHILD_SE {proc_id} {se:.10f}", flush=True)
     print(f"CHILD_OK {proc_id}", flush=True)
     """
 )
@@ -79,6 +104,16 @@ def test_two_process_distributed_bootstrap_and_psum():
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {pid} failed:\n{out}"
         assert f"CHILD_OK {pid}" in out, out
+    # Both processes computed the identical bootstrap SE (the replicate
+    # keys and the all_gather are global, not per-process).
+    import re
+
+    ses = {}
+    for out in outs:
+        m = re.search(r"CHILD_SE (\d) ([0-9.]+)", out)
+        assert m, out
+        ses[m.group(1)] = m.group(2)
+    assert ses["0"] == ses["1"], ses
 
 
 def test_init_single_process_noop():
