@@ -172,6 +172,8 @@ def grow_causal_forest(
     honest I (grow) / J (estimate) halves.
     """
     n, p = x.shape
+    if n_bins > 256:
+        raise ValueError(f"n_bins={n_bins} > 256: bin codes must stay exact in bf16 routing")
     if mtry is None:
         # grf's default: min(ceil(sqrt(p) + 20), p)
         mtry = min(int(np.ceil(np.sqrt(p))) + 20, p)
