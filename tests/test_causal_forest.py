@@ -219,3 +219,15 @@ def test_little_bags_variance_stable_at_large_cate_level():
     frac_large = (variances["large"] > 0).mean()
     assert frac_large > 0.5 * frac_small > 0.0, (frac_small, frac_large)
     assert variances["large"].mean() > 0.1 * variances["small"].mean() > 0.0
+
+
+def test_deep_trees_supported():
+    """grf grows unbounded-depth trees (min_node-limited); the level-wise
+    engine must handle depths past the default 8 — shapes, leaf one-hot
+    chunk budgeting, and prediction all at depth 10."""
+    frame, _, ate_true = _heterogeneous_problem(n=1000)
+    fitted = _fit_small(frame, n_trees=24, depth=10, nuisance_trees=40)
+    assert fitted.forest.depth == 10
+    assert fitted.forest.leaf_stats.shape[1] == 1 << 10
+    eff = average_treatment_effect(fitted)
+    assert abs(float(eff.estimate) - ate_true) < 0.8
