@@ -172,8 +172,6 @@ def grow_causal_forest(
     honest I (grow) / J (estimate) halves.
     """
     n, p = x.shape
-    if n_bins > 256:
-        raise ValueError(f"n_bins={n_bins} > 256: bin codes must stay exact in bf16 routing")
     if mtry is None:
         # grf's default: min(ceil(sqrt(p) + 20), p)
         mtry = min(int(np.ceil(np.sqrt(p))) + 20, p)
@@ -573,26 +571,19 @@ def predict_cate(
     n_pad = n_blocks * rb
 
     codes_b = jnp.pad(codes, ((0, n_pad - n), (0, 0))).reshape(n_blocks, rb, -1)
-    if oob:
-        # in_sample is per TRAINING row — only meaningful (and only
-        # shape-compatible) when the query rows are the training rows.
-        in_b = jnp.pad(
-            reshape_groups(forest.in_sample[: n_groups * k]),
-            ((0, 0), (0, 0), (0, 0), (0, n_pad - n)),
+
+    def block_tree_rows(a):
+        """(T, n) per-(tree, row) array → (n_blocks, n_chunks, gc, k, rb)
+        with the row-block axis leading, rows padded to n_pad."""
+        a = jnp.pad(reshape_groups(a), ((0, 0),) * 3 + ((0, n_pad - n),))
+        return jnp.moveaxis(
+            a.reshape(n_chunks, group_chunk, k, n_blocks, rb), 3, 0
         )
-        in_b = jnp.moveaxis(
-            in_b.reshape(n_chunks, group_chunk, k, n_blocks, rb), 3, 0
-        )
-    else:
-        in_b = None
-    if leaf_index is None:
-        li_b = None
-    else:
-        li_b = jnp.pad(
-            reshape_groups(leaf_index[: n_groups * k]),
-            ((0, 0), (0, 0), (0, 0), (0, n_pad - n)),
-        )
-        li_b = jnp.moveaxis(li_b.reshape(n_chunks, group_chunk, k, n_blocks, rb), 3, 0)
+
+    # in_sample is per TRAINING row — only meaningful (and only
+    # shape-compatible) when the query rows are the training rows.
+    in_b = block_tree_rows(forest.in_sample[: n_groups * k]) if oob else None
+    li_b = None if leaf_index is None else block_tree_rows(leaf_index[: n_groups * k])
 
     def block_fn(xs):
         codes_blk, in_blk, li_blk = xs  # (rb, p), (n_chunks, gc, k, rb), …

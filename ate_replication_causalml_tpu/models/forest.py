@@ -189,22 +189,8 @@ def bin_onehot(codes: jax.Array, n_bins: int) -> jax.Array:
     )
 
 
-def pick_chunk(total: int, chunk: int) -> int:
-    """Pick a work-chunk size: prefer the largest divisor of ``total``
-    within the budget (zero padding waste); fall back to ceil-padding
-    only when ``total`` has no usable divisor (e.g. prime). Callers of
-    the fallback MUST handle the padded tail — use :func:`pick_divisor`
-    where the loop count is derived by exact division."""
-    chunk = max(1, min(chunk, total))
-    divisors = [d for d in range(chunk, 0, -1) if total % d == 0]
-    if divisors and divisors[0] * 2 >= chunk:
-        return divisors[0]
-    return chunk
-
-
 def pick_divisor(total: int, cap: int) -> int:
     """Largest divisor of ``total`` that is ≤ ``cap`` (≥ 1 always).
-    Unlike :func:`pick_chunk` this never returns a non-divisor, so
     ``total // pick_divisor(total, cap)`` is exact — required where the
     result sizes a dispatch loop (a floor division with a non-divisor
     silently drops the tail)."""
@@ -213,6 +199,17 @@ def pick_divisor(total: int, cap: int) -> int:
         if total % d == 0:
             return d
     return 1
+
+
+def pick_chunk(total: int, chunk: int) -> int:
+    """Pick a work-chunk size: prefer the largest divisor of ``total``
+    within the budget (zero padding waste); fall back to ceil-padding
+    only when ``total`` has no usable divisor (e.g. prime). Callers of
+    the fallback MUST handle the padded tail — use :func:`pick_divisor`
+    where the loop count is derived by exact division."""
+    chunk = max(1, min(chunk, total))
+    d = pick_divisor(total, chunk)
+    return d if d * 2 >= chunk else chunk
 
 
 # HBM budget for the largest per-level matmul operand of one vmapped
@@ -284,10 +281,9 @@ def fit_forest_classifier(
     fold-in keys.
     """
     n, p = x.shape
-    if n_bins > 256:
-        raise ValueError(f"n_bins={n_bins} > 256: bin codes must stay exact in bf16 routing")
     if mtry is None:
         mtry = max(1, int(np.sqrt(p)))
+    # (n_bins ≤ 256 is enforced at the binarize() chokepoint.)
     # Explicit chunks are clamped too: the per-level routing one-hot is
     # (rows, 2^(depth−1)) per vmapped tree.
     auto_chunk = auto_tree_chunk(n, depth, cap=32)
