@@ -179,7 +179,7 @@ def grow_causal_forest(
     k = ci_group_size
     n_groups = -(-n_trees // k)
     hist_backend = resolve_hist_backend(
-        hist_backend, n_rows=int(n * sample_fraction)
+        hist_backend, n_rows=int(n * sample_fraction), n_bins=n_bins
     )
     edges = quantile_bins(x, n_bins)
     codes = binarize(x, edges)

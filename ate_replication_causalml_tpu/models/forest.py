@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import weakref
 from typing import NamedTuple
 
 import jax
@@ -288,7 +289,7 @@ def fit_forest_classifier(
     # (rows, 2^(depth−1)) per vmapped tree.
     auto_chunk = auto_tree_chunk(n, depth, cap=32)
     tree_chunk = auto_chunk if tree_chunk is None else min(tree_chunk, auto_chunk)
-    hist_backend = resolve_hist_backend(hist_backend, n_rows=n)
+    hist_backend = resolve_hist_backend(hist_backend, n_rows=n, n_bins=n_bins)
     edges = quantile_bins(x, n_bins)
     codes = binarize(x, edges)  # (n, p) int32
     xb_onehot = bin_onehot(codes, n_bins) if hist_backend == "onehot" else None
@@ -543,6 +544,27 @@ def forest_apply(
     )
 
 
+# predict_forest's OOB fingerprint verdicts, keyed by (id(x),
+# id(train_fp)). jax arrays are unhashable, so weak KEYS are out;
+# entries are evicted by weakref.finalize when either object dies
+# (guarding against id reuse) and the dict is capped as a backstop.
+# A stale hit can at worst SKIP a defense-in-depth check, never corrupt.
+_FP_VERIFIED: dict = {}
+_FP_VERIFIED_CAP = 256
+
+
+def _remember_fp_verified(x, fp) -> None:
+    if len(_FP_VERIFIED) >= _FP_VERIFIED_CAP:
+        _FP_VERIFIED.clear()
+    key = (id(x), id(fp))
+    _FP_VERIFIED[key] = True
+    try:
+        weakref.finalize(x, _FP_VERIFIED.pop, key, None)
+        weakref.finalize(fp, _FP_VERIFIED.pop, key, None)
+    except TypeError:
+        pass  # not weakref-able on this backend: cap bounds the dict
+
+
 def predict_forest(forest: Forest, x: jax.Array, oob: bool = False) -> ForestPredictions:
     """Forest predictions for rows ``x``.
 
@@ -556,11 +578,22 @@ def predict_forest(forest: Forest, x: jax.Array, oob: bool = False) -> ForestPre
     order (a same-shape different matrix is indistinguishable and would
     silently get training predictions); row-count mismatches raise.
     """
+    if oob and x.shape[0] != forest.counts.shape[1]:
+        # Precise message first: a wrong-size matrix is not a
+        # "permuted rows" problem.
+        raise ValueError(
+            "oob=True is only valid for the training matrix: forest was "
+            f"fit on {forest.counts.shape[1]} rows, got {x.shape[0]}"
+        )
     if oob and forest.train_leaf is not None:
         # Guard against a same-shape matrix that is not the training
         # matrix (checked only when everything involved is concrete —
         # inside a trace of either x or the forest the fingerprint is
-        # symbolic and the caller owns the contract).
+        # symbolic and the caller owns the contract). The verdict is
+        # memoized per (x, train_fp) object pair so repeat OOB calls
+        # (e.g. both nuisance predictions of a causal-forest fit) don't
+        # re-binarize or re-sync; identity keying can at worst SKIP a
+        # defense-in-depth check after heavy gc churn, never corrupt.
         concrete = lambda a: not isinstance(a, jax.core.Tracer)
         if (
             forest.train_fp is not None
@@ -568,24 +601,21 @@ def predict_forest(forest: Forest, x: jax.Array, oob: bool = False) -> ForestPre
             and concrete(forest.train_fp)
             and concrete(forest.bin_edges)
         ):
-            fp = codes_fingerprint(binarize(x, forest.bin_edges))
-            if int(fp) != int(forest.train_fp):
-                raise ValueError(
-                    "oob=True with recorded training leaves, but x does not "
-                    "fingerprint as the training matrix (permuted or altered "
-                    "rows?); pass oob=False for new data"
-                )
+            if (id(x), id(forest.train_fp)) not in _FP_VERIFIED:
+                fp = codes_fingerprint(binarize(x, forest.bin_edges))
+                if int(fp) != int(forest.train_fp):
+                    raise ValueError(
+                        "oob=True with recorded training leaves, but x does "
+                        "not fingerprint as the training matrix (permuted or "
+                        "altered rows?); pass oob=False for new data"
+                    )
+                _remember_fp_verified(x, forest.train_fp)
         leaf_vals = forest.train_leaf  # (T, n) — recorded during growth
     else:
         codes = binarize(x, forest.bin_edges)
         leaf_vals = forest_apply(forest, codes)  # (T, n)
     votes = (leaf_vals > 0.5).astype(jnp.float32)
     if oob:
-        if x.shape[0] != forest.counts.shape[1]:
-            raise ValueError(
-                "oob=True is only valid for the training matrix: forest was "
-                f"fit on {forest.counts.shape[1]} rows, got {x.shape[0]}"
-            )
         mask = (forest.counts == 0).astype(jnp.float32)  # (T, n)
         denom = jnp.maximum(mask.sum(axis=0), 1.0)
         prob = (leaf_vals * mask).sum(axis=0) / denom
@@ -629,7 +659,9 @@ def fit_forest_sharded(
             "hist_backend='onehot' is not supported on the sharded path "
             "(the shared bin one-hot is not built here); use 'auto'/'xla'/'pallas'"
         )
-    hist_backend = resolve_hist_backend(hist_backend, allow_onehot=False, n_rows=n)
+    hist_backend = resolve_hist_backend(
+        hist_backend, allow_onehot=False, n_rows=n, n_bins=n_bins
+    )
     axis_size = mesh.shape[axis_name]
     # Per-device trees grow in HBM-budgeted vmapped chunks under an
     # inner lax.map (same memory bound as the host-loop fitter); pad

@@ -58,7 +58,10 @@ _PALLAS_ROWS_THRESHOLD = 400_000
 
 
 def resolve_hist_backend(
-    backend: str, allow_onehot: bool = True, n_rows: int | None = None
+    backend: str,
+    allow_onehot: bool = True,
+    n_rows: int | None = None,
+    n_bins: int | None = None,
 ) -> str:
     """The single place the 'auto' policy lives.
 
@@ -66,13 +69,21 @@ def resolve_hist_backend(
     counts and the streaming Pallas kernel past ``_PALLAS_ROWS_THRESHOLD``
     (see measurement note above); pass ``n_rows`` to enable the switch —
     without it 'auto' stays on the XLA path, which is within ~25% either
-    way. Both are bit-exact to each other (tests/test_hist_pallas.py)
-    and remain explicitly selectable. On CPU the forest engines pass
+    way. The kernel only supports ``n_bins ≤ 128`` (one feature per
+    128-lane block minimum), so 'auto' also needs ``n_bins`` to choose
+    it — wider binnings stay on XLA, which handles any width. Both are
+    bit-exact to each other (tests/test_hist_pallas.py) and remain
+    explicitly selectable. On CPU the forest engines pass
     ``allow_onehot=True`` to use the shared one-hot matmul (fastest at
     reference scale)."""
     if backend == "auto":
         if jax.default_backend() == "tpu":
-            if n_rows is not None and n_rows >= _PALLAS_ROWS_THRESHOLD:
+            if (
+                n_rows is not None
+                and n_rows >= _PALLAS_ROWS_THRESHOLD
+                and n_bins is not None
+                and n_bins <= _LANES
+            ):
                 return "pallas"
             return "xla"
         return "onehot" if allow_onehot else "xla"

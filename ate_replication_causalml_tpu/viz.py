@@ -116,10 +116,10 @@ def notebook_figures(
 
     def save(name, subset, title):
         p = os.path.join(outdir, f"{name}.png")
-        chart = pointrange_figure(subset, oracle=oracle, title=title, path=p)
-        # A silently blank chart must fail loudly at render time, not in
-        # review: every requested method row must have been drawn, plus
-        # the oracle band.
+        # Render WITHOUT saving, validate, then write: a blank chart
+        # must fail loudly — and must not overwrite the last good PNG
+        # at this path before the check runs.
+        chart = pointrange_figure(subset, oracle=oracle, title=title)
         drawn = [m.method for m in chart.marks]
         want = [r.method for r in subset]
         if drawn != want or chart.oracle_band is None:
@@ -127,6 +127,7 @@ def notebook_figures(
                 f"figure {name!r} did not draw what was requested: "
                 f"drawn={drawn} wanted={want} band={chart.oracle_band}"
             )
+        chart.figure.savefig(p, facecolor=_SURFACE)
         paths.append(p)
 
     naive = [by_method[m] for m in ("naive",) if m in by_method]

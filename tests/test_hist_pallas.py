@@ -161,9 +161,17 @@ def test_resolve_backend_row_aware_policy(monkeypatch):
 
     monkeypatch.setattr(hp.jax, "default_backend", lambda: "tpu")
     assert hp.resolve_hist_backend("auto") == "xla"
-    assert hp.resolve_hist_backend("auto", n_rows=100_000) == "xla"
+    assert hp.resolve_hist_backend("auto", n_rows=100_000, n_bins=64) == "xla"
+    assert hp.resolve_hist_backend(
+        "auto", n_rows=hp._PALLAS_ROWS_THRESHOLD, n_bins=64
+    ) == "pallas"
+    # The kernel caps at 128 bins; wider binnings stay on XLA even at
+    # large row counts (where round-1 'auto' would have crashed).
+    assert hp.resolve_hist_backend(
+        "auto", n_rows=hp._PALLAS_ROWS_THRESHOLD, n_bins=200
+    ) == "xla"
     assert hp.resolve_hist_backend(
         "auto", n_rows=hp._PALLAS_ROWS_THRESHOLD
-    ) == "pallas"
+    ) == "xla"  # n_bins unknown -> no kernel
     for explicit in ("xla", "pallas", "pallas_bf16", "pallas_interpret", "onehot"):
-        assert hp.resolve_hist_backend(explicit, n_rows=10**7) == explicit
+        assert hp.resolve_hist_backend(explicit, n_rows=10**7, n_bins=64) == explicit
