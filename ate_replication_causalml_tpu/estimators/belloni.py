@@ -55,6 +55,7 @@ def belloni(
     foldid_xw=None,
     foldid_xy=None,
     key: jax.Array | None = None,
+    fold_axis: str | None = None,
     compat: str = "r",
     method: str = "Belloni et.al",
 ) -> EstimatorResult:
@@ -63,8 +64,10 @@ def belloni(
     kxw, kxy = jax.random.split(key)
     x_big = interaction_expand(frame.x)
 
-    cv_xw = cv_glmnet(x_big, frame.w, family="gaussian", foldid=foldid_xw, key=kxw)
-    cv_xy = cv_glmnet(x_big, frame.y, family="gaussian", foldid=foldid_xy, key=kxy)
+    cv_xw = cv_glmnet(x_big, frame.w, family="gaussian", foldid=foldid_xw, key=kxw,
+                      fold_axis=fold_axis)
+    cv_xy = cv_glmnet(x_big, frame.y, family="gaussian", foldid=foldid_xy, key=kxy,
+                      fold_axis=fold_axis)
 
     lam = cv_xw.lambda_min
     c_xw = _interp_coef_at(cv_xw.path.lambdas, cv_xw.path.coefs, lam)

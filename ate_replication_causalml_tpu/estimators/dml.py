@@ -28,13 +28,22 @@ from ate_replication_causalml_tpu.models.forest import fit_forest_classifier, pr
 from ate_replication_causalml_tpu.ops.linalg import ols_no_intercept_1d
 
 
-def _rf_prob_on_full(frame: CausalFrame, train_idx, target: jax.Array, key, n_trees, depth):
+def _rf_prob_on_full(frame: CausalFrame, train_idx, target: jax.Array, key, n_trees,
+                     depth, mesh=None):
     """Train a classification forest on ``train_idx`` rows, return vote
-    fractions on the FULL sample (``ate_functions.R:352-357``)."""
+    fractions on the FULL sample (``ate_functions.R:352-357``). With a
+    ``mesh``, trees shard over its tree axis (the nuisance forests are
+    the DML hot loop, SURVEY.md §3.4)."""
     sub = frame.take(train_idx)
-    forest = fit_forest_classifier(
-        sub.x, target[jnp.asarray(train_idx)], key, n_trees=n_trees, depth=depth
-    )
+    tgt = target[jnp.asarray(train_idx)]
+    if mesh is not None:
+        from ate_replication_causalml_tpu.models.forest import fit_forest_sharded
+
+        forest = fit_forest_sharded(
+            sub.x, tgt, key, mesh, n_trees=n_trees, depth=depth
+        )
+    else:
+        forest = fit_forest_classifier(sub.x, tgt, key, n_trees=n_trees, depth=depth)
     return predict_forest(forest, frame.x).vote
 
 
@@ -45,13 +54,14 @@ def chernozhukov(
     n_trees: int = 100,
     depth: int = 9,
     key: jax.Array | None = None,
+    mesh=None,
 ) -> tuple[jax.Array, jax.Array]:
     """One DML cross-fit; returns (tau_hat, se_hat)."""
     if key is None:
         key = jax.random.key(123)  # the seed the reference *meant* to set
     k1, k2 = jax.random.split(key)
-    ew = _rf_prob_on_full(frame, idx1, frame.w, k1, n_trees, depth)
-    ey = _rf_prob_on_full(frame, idx2, frame.y, k2, n_trees, depth)
+    ew = _rf_prob_on_full(frame, idx1, frame.w, k1, n_trees, depth, mesh=mesh)
+    ey = _rf_prob_on_full(frame, idx2, frame.y, k2, n_trees, depth, mesh=mesh)
     w_resid = frame.w - ew
     y_resid = frame.y - ey
     return ols_no_intercept_1d(w_resid, y_resid)
@@ -63,6 +73,7 @@ def double_ml(
     depth: int = 9,
     key: jax.Array | None = None,
     se_mode: str = "r",
+    mesh=None,
     method: str = "Double Machine Learning",
 ) -> EstimatorResult:
     """2-fold DML with the reference's deterministic split and averaging."""
@@ -75,8 +86,8 @@ def double_ml(
     idx1 = np.arange(half)
     idx2 = np.arange(half, n)
     ka, kb = jax.random.split(key)
-    tau1, se1 = chernozhukov(frame, idx1, idx2, n_trees, depth, ka)
-    tau2, se2 = chernozhukov(frame, idx2, idx1, n_trees, depth, kb)
+    tau1, se1 = chernozhukov(frame, idx1, idx2, n_trees, depth, ka, mesh=mesh)
+    tau2, se2 = chernozhukov(frame, idx2, idx1, n_trees, depth, kb, mesh=mesh)
     tau = (tau1 + tau2) / 2.0
     if se_mode == "r":
         # The reference averages the two fold SEs (ate_functions.R:383).

@@ -37,11 +37,13 @@ def ate_condmean_lasso(
     frame: CausalFrame,
     foldid=None,
     key: jax.Array | None = None,
+    fold_axis: str | None = None,
     method: str = "Single-equation LASSO",
 ) -> EstimatorResult:
     x = _xw_design(frame)
     pfac = jnp.concatenate([jnp.ones(frame.p, x.dtype), jnp.zeros(1, x.dtype)])
-    cv = cv_glmnet(x, frame.y, family="gaussian", penalty_factor=pfac, foldid=foldid, key=key)
+    cv = cv_glmnet(x, frame.y, family="gaussian", penalty_factor=pfac, foldid=foldid,
+                   key=key, fold_axis=fold_axis)
     _, coefs = cv.coef_at("1se")
     return EstimatorResult.point_only(method, coefs[-1])
 
@@ -50,19 +52,23 @@ def ate_lasso(
     frame: CausalFrame,
     foldid=None,
     key: jax.Array | None = None,
+    fold_axis: str | None = None,
     method: str = "Usual LASSO",
 ) -> EstimatorResult:
     x = _xw_design(frame)
-    cv = cv_glmnet(x, frame.y, family="gaussian", foldid=foldid, key=key)
+    cv = cv_glmnet(x, frame.y, family="gaussian", foldid=foldid, key=key,
+                   fold_axis=fold_axis)
     _, coefs = cv.coef_at("1se")
     return EstimatorResult.point_only(method, coefs[-1])
 
 
 def prop_score_lasso(
-    frame: CausalFrame, foldid=None, key: jax.Array | None = None
+    frame: CausalFrame, foldid=None, key: jax.Array | None = None,
+    fold_axis: str | None = None,
 ) -> jax.Array:
     """LASSO-logit propensity vector at lambda.1se, in-sample."""
-    cv = cv_glmnet(frame.x, frame.w, family="binomial", foldid=foldid, key=key)
+    cv = cv_glmnet(frame.x, frame.w, family="binomial", foldid=foldid, key=key,
+                   fold_axis=fold_axis)
     idx = cv.index_1se
     eta = predict_path(cv.path, frame.x, idx)
     return jax.nn.sigmoid(eta)

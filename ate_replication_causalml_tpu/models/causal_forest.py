@@ -65,6 +65,7 @@ from ate_replication_causalml_tpu.models.forest import (
     forest_oob_mean,
     pick_chunk,
     pick_divisor,
+    plan_tree_dispatch,
     quantile_bins,
     resolve_hist_backend,
     route_rows,
@@ -229,6 +230,118 @@ def grow_causal_forest(
     )
     flat = lambda j: jnp.concatenate(
         [c[j].reshape((-1,) + c[j].shape[2:]) for c in chunks], axis=0
+    )[: n_groups * k]
+    return CausalForest(
+        split_feat=flat(0),
+        split_bin=flat(1),
+        leaf_stats=flat(2),
+        in_sample=flat(3),
+        bin_edges=edges,
+        ci_group_size=k,
+    )
+
+
+def grow_causal_forest_sharded(
+    x: jax.Array,
+    wt: jax.Array,
+    yt: jax.Array,
+    key: jax.Array,
+    mesh,
+    n_trees: int = 2000,
+    depth: int = 8,
+    mtry: int | None = None,
+    n_bins: int = 64,
+    min_node: int = 5,
+    sample_fraction: float = 0.5,
+    ci_group_size: int = 2,
+    honesty: bool = True,
+    axis_name: str = "tree",
+    group_chunk: int | None = None,
+    hist_backend: str = "auto",
+) -> CausalForest:
+    """Mesh-parallel causal-forest grow: little-bag groups shard over the
+    mesh's tree axis (SURVEY.md §2.4 — the expert-parallel analogue of
+    grf's std::thread tree growing, ``ate_replication.Rmd:250-255``).
+
+    Every device grows its own slice of the group-key array with the
+    same per-chunk executable as the host loop (``_grow_cf_chunk``), so
+    per-device HBM stays bounded by one vmapped chunk and the per-device
+    groups of one dispatch are capped by ``dispatch_tree_target`` (one
+    dispatch's wall-clock is per-DEVICE work — an uncapped 1M-row grow
+    would run minutes inside one executable). Numbers are NOT identical
+    to :func:`grow_causal_forest` (keys partition differently across
+    devices) but the forest is statistically equivalent — asserted in
+    tests/test_parallel.py.
+    """
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    n, p = x.shape
+    if mtry is None:
+        mtry = min(int(np.ceil(np.sqrt(p))) + 20, p)
+    mtry = min(mtry, p)
+    k = ci_group_size
+    n_groups = -(-n_trees // k)
+    s = max(2, int(n * sample_fraction))
+    if hist_backend == "onehot":
+        raise ValueError(
+            "hist_backend='onehot' is not supported on the sharded path "
+            "(the shared bin one-hot is not built here); use 'auto'/'xla'/'pallas'"
+        )
+    hist_backend = resolve_hist_backend(
+        hist_backend, allow_onehot=False, n_rows=s, n_bins=n_bins
+    )
+    axis_size = mesh.shape[axis_name]
+    per_dev_groups = -(-n_groups // axis_size)
+    auto_chunk, chunks_per_disp, n_disp = plan_tree_dispatch(
+        s, depth, per_dev_groups, cap=16, trees_per_unit=k, leaf_onehot=True
+    )
+    if group_chunk is not None and group_chunk < auto_chunk:
+        # An explicit (smaller) chunk re-plans the dispatch split so the
+        # watchdog budget still holds per dispatched executable.
+        group_chunk = pick_chunk(per_dev_groups, group_chunk)
+        n_chunks = -(-per_dev_groups // group_chunk)
+        chunks_per_disp = min(
+            max(1, dispatch_tree_target(s) // (group_chunk * k)), n_chunks
+        )
+        n_disp = -(-n_chunks // chunks_per_disp)
+    else:
+        group_chunk = auto_chunk
+    per_disp_dev = chunks_per_disp * group_chunk
+
+    edges = quantile_bins(x, n_bins)
+    codes = binarize(x, edges)
+    mom_stack = _moments_stack(wt, yt)
+    group_keys = jax.random.split(
+        key, n_disp * axis_size * per_disp_dev
+    ).reshape(n_disp, axis_size * per_disp_dev)
+
+    def device_body(keys, codes, wt, yt, mom_stack):
+        return _grow_cf_chunk(
+            keys.reshape(chunks_per_disp, group_chunk),
+            codes, wt, yt, mom_stack, None,
+            depth=depth, mtry=mtry, n_bins=n_bins, min_node=min_node,
+            s=s, k=k, honesty=honesty, hist_backend=hist_backend,
+        )
+
+    grow = jax.jit(jax.shard_map(
+        device_body,
+        mesh=mesh,
+        in_specs=(P(axis_name), P(), P(), P(), P()),
+        out_specs=P(axis_name),
+    ))
+    key_sharding = NamedSharding(mesh, P(axis_name))
+
+    def dispatch(i: int):
+        return grow(
+            jax.device_put(group_keys[i], key_sharding), codes, wt, yt, mom_stack
+        )
+
+    parts = require_all(
+        run_shards(dispatch, n_disp, retriable=(jax.errors.JaxRuntimeError,))
+    )
+    flat = lambda j: jnp.concatenate(
+        [c[j].reshape((-1,) + c[j].shape[2:]) for c in parts], axis=0
     )[: n_groups * k]
     return CausalForest(
         split_feat=flat(0),
@@ -406,32 +519,62 @@ def fit_causal_forest(
     nuisance_trees: int = 500,
     nuisance_depth: int = 9,
     hist_backend: str = "auto",
+    mesh=None,
+    axis_name: str = "tree",
     **grow_kwargs,
 ) -> FittedCausalForest:
     """End-to-end grf-equivalent fit: OOB nuisance forests for Ŷ, Ŵ,
     then the honest causal forest on the residuals
-    (``ate_replication.Rmd:250-255``)."""
+    (``ate_replication.Rmd:250-255``).
+
+    With ``mesh`` given, both nuisance fits and the causal grow shard
+    trees/little-bag groups over the mesh's ``axis_name`` axis — the
+    whole flagship fit scales across chips (grf scales the same work
+    across std::threads)."""
     if key is None:
         key = jax.random.key(12345)  # the seed grf is given (Rmd:255)
     ky, kw, kc = jax.random.split(key, 3)
     x, w, y = frame.x, frame.w, frame.y
-    fy = fit_forest_regressor(
-        x, y, ky, n_trees=nuisance_trees, depth=nuisance_depth, hist_backend=hist_backend
-    )
+    if mesh is not None:
+        if hist_backend == "onehot":
+            raise ValueError(
+                "hist_backend='onehot' is single-device only (the shared "
+                "bin one-hot is not built on the sharded path); use "
+                "'auto', 'xla' or 'pallas' with a mesh"
+            )
+        from ate_replication_causalml_tpu.models.forest import (
+            fit_forest_regressor_sharded,
+        )
+
+        fit_reg = functools.partial(
+            fit_forest_regressor_sharded, mesh=mesh, axis_name=axis_name,
+            n_trees=nuisance_trees, depth=nuisance_depth,
+            hist_backend=hist_backend,
+        )
+    else:
+        fit_reg = functools.partial(
+            fit_forest_regressor, n_trees=nuisance_trees, depth=nuisance_depth,
+            hist_backend=hist_backend,
+        )
+    fy = fit_reg(x, y, ky)
     y_hat = forest_oob_mean(fy, x)
     # Free each nuisance forest as soon as its OOB estimates exist: the
     # (T, n) train_leaf/counts arrays are multi-GB at the million-row
     # scale and the causal grow needs the headroom.
     del fy
-    fw = fit_forest_regressor(
-        x, w, kw, n_trees=nuisance_trees, depth=nuisance_depth, hist_backend=hist_backend
-    )
+    fw = fit_reg(x, w, kw)
     w_hat = forest_oob_mean(fw, x)
     del fw
-    forest = grow_causal_forest(
-        x, w - w_hat, y - y_hat, kc, n_trees=n_trees, depth=depth,
-        hist_backend=hist_backend, **grow_kwargs,
-    )
+    if mesh is not None:
+        forest = grow_causal_forest_sharded(
+            x, w - w_hat, y - y_hat, kc, mesh, n_trees=n_trees, depth=depth,
+            axis_name=axis_name, hist_backend=hist_backend, **grow_kwargs,
+        )
+    else:
+        forest = grow_causal_forest(
+            x, w - w_hat, y - y_hat, kc, n_trees=n_trees, depth=depth,
+            hist_backend=hist_backend, **grow_kwargs,
+        )
     return FittedCausalForest(forest=forest, y_hat=y_hat, w_hat=w_hat, x=x, y=y, w=w)
 
 

@@ -235,6 +235,36 @@ def dispatch_tree_target(n_rows: int) -> int:
     return max(16, _DISPATCH_CHUNK_TARGET * 100_000 // max(n_rows, 1))
 
 
+def plan_tree_dispatch(
+    n_rows: int,
+    depth: int,
+    per_dev_total: int,
+    cap: int = 32,
+    trees_per_unit: int = 1,
+    leaf_onehot: bool = False,
+) -> tuple[int, int, int]:
+    """Dispatch plan for a per-device tree workload: (chunk,
+    chunks_per_disp, n_disp). ``chunk`` units vmap together within the
+    HBM budget (:func:`auto_tree_chunk`); ``chunks_per_disp`` chunks run
+    sequentially inside one dispatched executable, capped so the
+    per-device trees of one dispatch stay within
+    :func:`dispatch_tree_target` (the remote-worker watchdog budget —
+    devices run in parallel, so a dispatch's wall-clock is its
+    per-DEVICE work); ``n_disp`` dispatches cover ``per_dev_total``
+    units. Shared by the host-loop and shard_map fitters; unit-tested at
+    the million-row scale in tests/test_parallel.py."""
+    chunk = pick_chunk(
+        per_dev_total,
+        auto_tree_chunk(n_rows, depth, cap=cap, trees_per_unit=trees_per_unit,
+                        leaf_onehot=leaf_onehot),
+    )
+    n_chunks = -(-per_dev_total // chunk)
+    chunks_per_disp = min(
+        max(1, dispatch_tree_target(n_rows) // (chunk * trees_per_unit)), n_chunks
+    )
+    return chunk, chunks_per_disp, -(-n_chunks // chunks_per_disp)
+
+
 def auto_tree_chunk(
     n_rows: int,
     depth: int,
@@ -258,6 +288,24 @@ def auto_tree_chunk(
 class ForestPredictions(NamedTuple):
     prob: jax.Array   # mean leaf probability over trees
     vote: jax.Array   # fraction of trees voting class 1 (randomForest "prob")
+
+
+def _is_binary01(y) -> bool:
+    """Host-side check that a concrete target is exactly {0, 1}-valued.
+
+    Decides two fit-time policies: binary targets keep the histogram
+    weights integer (so 'auto' may upgrade to the bit-exact bf16 kernel)
+    and need no per-tree centering; continuous targets are centered per
+    tree so the sibling histogram subtraction never cancels a large
+    outcome level against itself in f32 (ADVICE r2: a level >> spread
+    regression target loses relative precision on small right children).
+    Under a trace the answer is unknowable — fall back to the safe
+    continuous policy (center, no bf16).
+    """
+    if isinstance(y, jax.core.Tracer):
+        return False
+    yv = np.asarray(y)
+    return bool(np.all((yv == 0) | (yv == 1)))
 
 
 def fit_forest_classifier(
@@ -289,7 +337,10 @@ def fit_forest_classifier(
     # (rows, 2^(depth−1)) per vmapped tree.
     auto_chunk = auto_tree_chunk(n, depth, cap=32)
     tree_chunk = auto_chunk if tree_chunk is None else min(tree_chunk, auto_chunk)
-    hist_backend = resolve_hist_backend(hist_backend, n_rows=n, n_bins=n_bins)
+    y01 = _is_binary01(y)
+    hist_backend = resolve_hist_backend(
+        hist_backend, n_rows=n, n_bins=n_bins, integer_weights=y01
+    )
     edges = quantile_bins(x, n_bins)
     codes = binarize(x, edges)  # (n, p) int32
     xb_onehot = bin_onehot(codes, n_bins) if hist_backend == "onehot" else None
@@ -313,6 +364,7 @@ def fit_forest_classifier(
         return _grow_chunk(
             kk, codes, yf, xb_onehot,
             depth=depth, mtry=mtry, n_bins=n_bins, hist_backend=hist_backend,
+            center=not y01,
         )
 
     # Elastic host loop (parallel/retry.py): a transient device failure
@@ -334,14 +386,24 @@ def fit_forest_classifier(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("depth", "mtry", "n_bins", "hist_backend")
+    jax.jit, static_argnames=("depth", "mtry", "n_bins", "hist_backend", "center")
 )
-def _grow_chunk(tree_keys, codes, yf, xb_onehot, *, depth, mtry, n_bins, hist_backend):
+def _grow_chunk(tree_keys, codes, yf, xb_onehot, *, depth, mtry, n_bins, hist_backend,
+                center=False):
     """One compiled dispatch of trees. ``tree_keys`` is either (tc,) —
     one vmapped chunk — or (S, tc) — a superchunk: S vmapped chunks run
     sequentially under lax.map (memory of one chunk, one dispatch).
     Module-level jit: the executable is shared by every dispatch of
-    every forest with the same shapes/statics."""
+    every forest with the same shapes/statics.
+
+    ``center=True`` (continuous targets) subtracts each tree's
+    bootstrap-weighted mean from y before histogram accumulation and
+    re-adds it at the leaves: the split criterion is invariant to a
+    per-tree shift (the parent totals it adds are constant within each
+    node's argmin domain), but the f32 sibling subtraction
+    parent − left no longer cancels a large outcome level against
+    itself on small right children. Binary targets skip it so the
+    histogram weights stay integer (bf16-kernel eligible)."""
     n, p = codes.shape
     max_nodes = 1 << (depth - 1)
     n_leaves = 1 << depth
@@ -349,6 +411,9 @@ def _grow_chunk(tree_keys, codes, yf, xb_onehot, *, depth, mtry, n_bins, hist_ba
     def grow_one(tree_key):
         ck, gk = jax.random.split(tree_key)
         counts = _poisson1_counts(ck, (n,))
+        mu = jnp.sum(counts * yf) / jnp.maximum(jnp.sum(counts), 1e-12)
+        yt = yf - mu if center else yf
+        base = mu if center else 0.0
 
         def hists_for(ids, n_nodes, weights):
             """(len(weights), n_nodes, p, n_bins) histograms; rows with
@@ -374,11 +439,11 @@ def _grow_chunk(tree_keys, codes, yf, xb_onehot, *, depth, mtry, n_bins, hist_ba
             # right children come free as parent − left. Halves the
             # histogram matmul work for every level past the root.
             if prev_hist is None:
-                hist = hists_for(node_of_row, level_nodes, (counts, counts * yf))
+                hist = hists_for(node_of_row, level_nodes, (counts, counts * yt))
             else:
                 half = level_nodes // 2
                 left_id = jnp.where(node_of_row % 2 == 0, node_of_row // 2, -1)
-                hist_left = hists_for(left_id, half, (counts, counts * yf))
+                hist_left = hists_for(left_id, half, (counts, counts * yt))
                 hist_right = prev_hist - hist_left
                 hist = jnp.stack([hist_left, hist_right], axis=2).reshape(
                     2, level_nodes, p, n_bins
@@ -387,8 +452,8 @@ def _grow_chunk(tree_keys, codes, yf, xb_onehot, *, depth, mtry, n_bins, hist_ba
 
             cl = jnp.cumsum(hist_c, axis=2)
             yl = jnp.cumsum(hist_y, axis=2)
-            ct, yt = cl[:, :, -1:], yl[:, :, -1:]
-            cr, yr = ct - cl, yt - yl
+            ct, ytot = cl[:, :, -1:], yl[:, :, -1:]
+            cr, yr = ct - cl, ytot - yl
             eps = 1e-12
             # Universal split score: minimizing -(S_L²/c_L + S_R²/c_R) is
             # the SSE-reduction criterion for a regression target and is
@@ -442,14 +507,14 @@ def _grow_chunk(tree_keys, codes, yf, xb_onehot, *, depth, mtry, n_bins, hist_ba
         # one-hot is ~100 MB per tree — gigabytes under the tree vmap —
         # and this runs once per tree, not once per level.
         leaf_c = jax.ops.segment_sum(counts, node_of_row, num_segments=n_leaves)
-        leaf_y = jax.ops.segment_sum(counts * yf, node_of_row, num_segments=n_leaves)
-        overall = jnp.sum(counts * yf) / jnp.maximum(jnp.sum(counts), 1e-12)
-        leaf_value = jnp.where(leaf_c > 0, leaf_y / jnp.maximum(leaf_c, 1e-12), overall)
+        leaf_y = jax.ops.segment_sum(counts * yt, node_of_row, num_segments=n_leaves)
+        leaf_value = jnp.where(leaf_c > 0, base + leaf_y / jnp.maximum(leaf_c, 1e-12), mu)
         # Bootstrap counts persist only for the OOB mask (count == 0);
-        # uint8 storage is exact for Poisson(1)/multinomial draws and
-        # 4× smaller than f32 — (T, n) at a 500-tree × 1M-row nuisance
-        # fit is 2 GB in f32.
-        return feats, bins, leaf_value, counts.astype(jnp.uint8), leaf_value[node_of_row]
+        # uint8 storage is 4× smaller than f32 — (T, n) at a 500-tree ×
+        # 1M-row nuisance fit is 2 GB in f32. Counts > 255 clamp to 255:
+        # the mask only distinguishes 0 from >0, so the clamp can never
+        # flip an in-bag row to OOB the way a wrapping cast could.
+        return feats, bins, leaf_value, jnp.minimum(counts, 255).astype(jnp.uint8), leaf_value[node_of_row]
 
     if tree_keys.ndim == 1:
         return jax.vmap(grow_one)(tree_keys)
@@ -548,21 +613,31 @@ def forest_apply(
 # id(train_fp)). jax arrays are unhashable, so weak KEYS are out;
 # entries are evicted by weakref.finalize when either object dies
 # (guarding against id reuse) and the dict is capped as a backstop.
-# A stale hit can at worst SKIP a defense-in-depth check, never corrupt.
+# The stored value is a (shape, dtype) sanity tuple checked on lookup,
+# so even a stale id-reused hit must also collide on shape+dtype to
+# skip the (defense-in-depth) check. A stale hit can at worst SKIP that
+# check, never corrupt.
 _FP_VERIFIED: dict = {}
 _FP_VERIFIED_CAP = 256
 
 
 def _remember_fp_verified(x, fp) -> None:
-    if len(_FP_VERIFIED) >= _FP_VERIFIED_CAP:
-        _FP_VERIFIED.clear()
     key = (id(x), id(fp))
-    _FP_VERIFIED[key] = True
     try:
         weakref.finalize(x, _FP_VERIFIED.pop, key, None)
         weakref.finalize(fp, _FP_VERIFIED.pop, key, None)
     except TypeError:
-        pass  # not weakref-able on this backend: cap bounds the dict
+        # Not weakref-able on this backend: an identity key could
+        # silently survive gc + id reuse, so skip memoization entirely
+        # (repeat calls just re-verify the fingerprint).
+        return
+    if len(_FP_VERIFIED) >= _FP_VERIFIED_CAP:
+        _FP_VERIFIED.clear()
+    _FP_VERIFIED[key] = (x.shape, x.dtype)
+
+
+def _fp_already_verified(x, fp) -> bool:
+    return _FP_VERIFIED.get((id(x), id(fp))) == (x.shape, x.dtype)
 
 
 def predict_forest(forest: Forest, x: jax.Array, oob: bool = False) -> ForestPredictions:
@@ -601,7 +676,7 @@ def predict_forest(forest: Forest, x: jax.Array, oob: bool = False) -> ForestPre
             and concrete(forest.train_fp)
             and concrete(forest.bin_edges)
         ):
-            if (id(x), id(forest.train_fp)) not in _FP_VERIFIED:
+            if not _fp_already_verified(x, forest.train_fp):
                 fp = codes_fingerprint(binarize(x, forest.bin_edges))
                 if int(fp) != int(forest.train_fp):
                     raise ValueError(
@@ -647,6 +722,15 @@ def fit_forest_sharded(
     a consumer needs them replicated). Numbers are NOT identical to
     :func:`fit_forest_classifier` (keys are partitioned differently),
     but the ensemble is statistically equivalent.
+
+    Scale safety mirrors the host-loop fitter: per-device trees grow in
+    HBM-budgeted vmapped chunks (``auto_tree_chunk``), and the per-device
+    trees of ONE dispatched executable are capped by
+    ``dispatch_tree_target`` — devices run in parallel, so one
+    dispatch's wall-clock is its per-DEVICE tree count × per-tree time,
+    and an uncapped 1M-row fit would run minutes inside a single
+    executable (remote-worker watchdog territory). Multiple dispatches
+    run under the elastic host loop (parallel/retry.py).
     """
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
@@ -659,46 +743,72 @@ def fit_forest_sharded(
             "hist_backend='onehot' is not supported on the sharded path "
             "(the shared bin one-hot is not built here); use 'auto'/'xla'/'pallas'"
         )
+    y01 = _is_binary01(y)
     hist_backend = resolve_hist_backend(
-        hist_backend, allow_onehot=False, n_rows=n, n_bins=n_bins
+        hist_backend, allow_onehot=False, n_rows=n, n_bins=n_bins,
+        integer_weights=y01,
     )
     axis_size = mesh.shape[axis_name]
-    # Per-device trees grow in HBM-budgeted vmapped chunks under an
-    # inner lax.map (same memory bound as the host-loop fitter); pad
-    # per_dev up to whole chunks, sliced off below.
-    tree_chunk = pick_chunk(
-        max(1, -(-n_trees // axis_size)), auto_tree_chunk(n, depth, cap=32)
-    )
-    per_dev = -(-n_trees // (axis_size * tree_chunk)) * tree_chunk
+    per_dev_total = -(-n_trees // axis_size)
+    tree_chunk, chunks_per_disp, n_disp = plan_tree_dispatch(n, depth, per_dev_total)
+    per_disp_dev = chunks_per_disp * tree_chunk
+
     edges = quantile_bins(x, n_bins)
     codes = binarize(x, edges)
     yf = y.astype(jnp.float32)
-    tree_keys = jax.random.split(key, axis_size * per_dev)
+    tree_keys = jax.random.split(key, n_disp * axis_size * per_disp_dev).reshape(
+        n_disp, axis_size * per_disp_dev
+    )
 
     def device_body(keys, codes, yf):
         return _grow_chunk(
-            keys.reshape(per_dev // tree_chunk, tree_chunk), codes, yf, None,
+            keys.reshape(chunks_per_disp, tree_chunk), codes, yf, None,
             depth=depth, mtry=mtry, n_bins=n_bins, hist_backend=hist_backend,
+            center=not y01,
         )
 
-    grow = jax.shard_map(
+    grow = jax.jit(jax.shard_map(
         device_body,
         mesh=mesh,
         in_specs=(P(axis_name), P(), P()),
         out_specs=P(axis_name),
+    ))
+    key_sharding = NamedSharding(mesh, P(axis_name))
+
+    def dispatch(i: int):
+        return grow(jax.device_put(tree_keys[i], key_sharding), codes, yf)
+
+    parts = require_all(
+        run_shards(dispatch, n_disp, retriable=(jax.errors.JaxRuntimeError,))
     )
-    keys_sharded = jax.device_put(
-        tree_keys, NamedSharding(mesh, P(axis_name))
-    )
-    feats, bins, leaf_values, counts, train_leaf = grow(keys_sharded, codes, yf)
+    cat = lambda j: jnp.concatenate([c[j] for c in parts], axis=0)[:n_trees]
     return Forest(
-        split_feat=feats[:n_trees],
-        split_bin=bins[:n_trees],
-        leaf_value=leaf_values[:n_trees],
-        counts=counts[:n_trees],
+        split_feat=cat(0),
+        split_bin=cat(1),
+        leaf_value=cat(2),
+        counts=cat(3),
         bin_edges=edges,
-        train_leaf=train_leaf[:n_trees],
+        train_leaf=cat(4),
         train_fp=codes_fingerprint(codes),
+    )
+
+
+def fit_forest_regressor_sharded(
+    x: jax.Array,
+    y: jax.Array,
+    key: jax.Array,
+    mesh,
+    n_trees: int = 500,
+    depth: int = 9,
+    mtry: int | None = None,
+    **kwargs,
+) -> Forest:
+    """Tree-sharded regression forest: the sharded engine with
+    randomForest's regression mtry default (max(1, floor(p/3)))."""
+    if mtry is None:
+        mtry = max(1, x.shape[1] // 3)
+    return fit_forest_sharded(
+        x, y, key, mesh, n_trees=n_trees, depth=depth, mtry=mtry, **kwargs
     )
 
 
@@ -742,11 +852,20 @@ def rf_oob_propensity(
     key: jax.Array | None = None,
     n_trees: int = 500,
     depth: int = 9,
+    mesh=None,
     **kwargs,
 ) -> jax.Array:
     """The reference's AIPW propensity: classification forest of W on X,
-    OOB vote fractions (``ate_functions.R:169-174``)."""
+    OOB vote fractions (``ate_functions.R:169-174``). With a ``mesh``,
+    trees shard over its tree axis."""
     if key is None:
         key = jax.random.key(12325)  # the seed the reference *meant* to set
-    forest = fit_forest_classifier(frame.x, frame.w, key, n_trees=n_trees, depth=depth, **kwargs)
+    if mesh is not None:
+        forest = fit_forest_sharded(
+            frame.x, frame.w, key, mesh, n_trees=n_trees, depth=depth, **kwargs
+        )
+    else:
+        forest = fit_forest_classifier(
+            frame.x, frame.w, key, n_trees=n_trees, depth=depth, **kwargs
+        )
     return predict_forest(forest, frame.x, oob=True).vote

@@ -41,7 +41,12 @@ def _build() -> None:
         "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
         "-o", tmp_path, _SRC,
     ]
-    lockfile = open(f"{_LIB_PATH}.lock", "w")  # noqa: SIM115 — held across build
+    # Open in append mode (no truncation — another holder may have the
+    # fd) and best-effort unlink after release: correctness never rests
+    # on the lock (the atomic rename below does that), so a racing
+    # unlink/reopen at worst runs one redundant compile.
+    lock_path = f"{_LIB_PATH}.lock"
+    lockfile = open(lock_path, "a")  # noqa: SIM115 — held across build
     try:
         try:
             import fcntl
@@ -53,8 +58,11 @@ def _build() -> None:
         os.replace(tmp_path, _LIB_PATH)
     finally:
         lockfile.close()
-        if os.path.exists(tmp_path):
-            os.unlink(tmp_path)
+        for leftover in (tmp_path, lock_path):
+            try:
+                os.unlink(leftover)
+            except OSError:
+                pass
 
 
 def load_library(rebuild: bool = False):

@@ -382,25 +382,7 @@ def _cv_glmnet_impl(
         losses, fold_n = sharded(fold_ids)
         losses, fold_n = losses[:nfolds], fold_n[:nfolds]
 
-    # cv.glmnet's cvstats: cvm is the fold-size-weighted mean of the
-    # per-fold means, cvsd = sqrt(weighted.mean((cvraw − cvm)², w) /
-    # (K−1)) with w = fold sizes. A plain mean agrees only to O(1/n) —
-    # which can flip the selected λ index near ties, a direct
-    # 1e-4-parity risk for the estimators whose τ̂ depends on λ.
-    wsum = jnp.sum(fold_n)
-    wts = (fold_n / wsum)[:, None]
-    cvm = jnp.sum(wts * losses, axis=0)
-    cvsd = jnp.sqrt(
-        jnp.sum(wts * (losses - cvm[None, :]) ** 2, axis=0)
-        / jnp.asarray(nfolds - 1, x.dtype)
-    )
-
-    idx_min = jnp.argmin(cvm)
-    bound = cvm[idx_min] + cvsd[idx_min]
-    # lambda.1se: the LARGEST lambda (smallest index; path is decreasing)
-    # with cvm <= bound.
-    ok = cvm <= bound
-    idx_1se = jnp.argmax(ok)  # first True along the decreasing path
+    cvm, cvsd, idx_min, idx_1se = cv_select(losses, fold_n, nfolds)
     return CvGlmnetResult(
         path=full,
         cvm=cvm,
@@ -410,6 +392,36 @@ def _cv_glmnet_impl(
         index_min=idx_min,
         index_1se=idx_1se,
     )
+
+
+def cv_select(losses: jax.Array, fold_n: jax.Array, nfolds: int):
+    """cv.glmnet's λ-selection rules, isolated so an independent oracle
+    can test them (tests/test_lasso.py transcribes glmnet's published
+    ``cvstats``/``getOptcv`` R code over random inputs):
+
+      * ``cvstats``: cvm is the fold-size-weighted mean of the per-fold
+        losses, cvsd = sqrt(weighted.mean((cvraw − cvm)², w)/(K−1)) with
+        w = fold test sizes. A plain mean agrees only to O(1/n) — which
+        can flip the selected λ index near ties, a direct 1e-4-parity
+        risk for the estimators whose τ̂ depends on λ.
+      * ``getOptcv``: lambda.min is the LARGEST λ with cvm ≤ min(cvm),
+        lambda.1se the largest λ with cvm ≤ cvm[min] + cvsd[min]; the
+        path is decreasing so both are FIRST indices along it.
+
+    Args: losses (K, L) per-fold losses; fold_n (K,) test sizes.
+    Returns: (cvm (L,), cvsd (L,), idx_min, idx_1se).
+    """
+    wts = (fold_n / jnp.sum(fold_n))[:, None]
+    cvm = jnp.sum(wts * losses, axis=0)
+    cvsd = jnp.sqrt(
+        jnp.sum(wts * (losses - cvm[None, :]) ** 2, axis=0)
+        / jnp.asarray(nfolds - 1, losses.dtype)
+    )
+    # argmin/argmax return the first occurrence — the largest λ among
+    # exact ties, matching R's max(lambda[cvm <= cvmin]).
+    idx_min = jnp.argmin(cvm)
+    idx_1se = jnp.argmax(cvm <= cvm[idx_min] + cvsd[idx_min])
+    return cvm, cvsd, idx_min, idx_1se
 
 
 def predict_path(path: ElnetPath, x: jax.Array, index) -> jax.Array:

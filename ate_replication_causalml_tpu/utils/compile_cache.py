@@ -14,6 +14,23 @@ import os
 import warnings
 
 
+def _host_tag() -> str:
+    """Short host-CPU fingerprint. XLA:CPU AOT cache entries embed the
+    COMPILE machine's feature set; loading one produced in a container
+    with different CPU flags SIGILLs/segfaults (observed in the test
+    suite). Keying the cache dir by the host's flags makes stale
+    cross-machine entries unreachable instead of fatal."""
+    import hashlib
+    import platform
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            sig = next(l for l in f if l.startswith("flags"))
+    except (OSError, StopIteration):
+        sig = platform.processor() or platform.machine()
+    return hashlib.sha1(sig.encode()).hexdigest()[:10]
+
+
 def _default_cache_dir() -> str:
     env = os.environ.get("ATE_COMPILE_CACHE")
     if env:
@@ -26,10 +43,10 @@ def _default_cache_dir() -> str:
     )
     is_checkout = os.path.exists(os.path.join(repo_root, ".git"))
     if is_checkout and os.access(repo_root, os.W_OK):
-        return os.path.join(repo_root, ".jax_cache_tpu")
+        return os.path.join(repo_root, f".jax_cache_tpu-{_host_tag()}")
     return os.path.join(
         os.path.expanduser("~"), ".cache", "ate_replication_causalml_tpu",
-        "jax_cache",
+        f"jax_cache-{_host_tag()}",
     )
 
 

@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# Fetch the Gerber–Green–Larimer 2008 social-pressure dataset (the
+# one-voter-per-household "NEIGH" processed file) that the reference
+# notebook reads (`/root/reference/ate_replication.Rmd:30-33`) but
+# gitignores (`/root/reference/.gitignore:6`).
+#
+# Source: gsbDBI/ExperimentData (public), Social/ProcessedData/.
+# Usage:  scripts/fetch_ggl.sh [dest-dir]   (default: data/)
+# Then:   python -m ate_replication_causalml_tpu.pipeline \
+#             --csv data/socialpresswgeooneperhh_NEIGH.csv --out results/
+#
+# Expected shape (from the published run): 344,084 rows; after
+# set.seed(1991) sampling of 50,000 and bias injection the driver must
+# print 41,062 dropped (ate_replication.md:118).
+set -euo pipefail
+
+DEST_DIR="${1:-data}"
+FILE="socialpresswgeooneperhh_NEIGH.csv"
+URL="https://raw.githubusercontent.com/gsbDBI/ExperimentData/master/Social/ProcessedData/${FILE}"
+
+mkdir -p "${DEST_DIR}"
+DEST="${DEST_DIR}/${FILE}"
+
+if [ -s "${DEST}" ]; then
+    echo "already present: ${DEST}"
+else
+    echo "fetching ${URL}"
+    if command -v curl >/dev/null 2>&1; then
+        curl -fL --retry 3 -o "${DEST}.part" "${URL}"
+    elif command -v wget >/dev/null 2>&1; then
+        wget -O "${DEST}.part" "${URL}"
+    else
+        echo "error: neither curl nor wget available" >&2
+        exit 2
+    fi
+    mv "${DEST}.part" "${DEST}"
+fi
+
+# Integrity: the upstream repo publishes no checksum, so validate shape
+# instead — header must contain the GGL schema columns the prep stage
+# consumes (SURVEY.md §2.2), and the row count must be ~344k.
+head -1 "${DEST}" | tr ',' '\n' | grep -qx "treat_neighbors" || {
+    echo "error: ${DEST} header missing treat_neighbors — wrong file?" >&2
+    exit 3
+}
+ROWS=$(($(wc -l < "${DEST}") - 1))
+echo "ok: ${DEST} (${ROWS} data rows; expected ~344084)"

@@ -17,7 +17,17 @@ jax.config.update("jax_num_cpu_devices", 8)
 # Persistent XLA compilation cache: the forest/estimator graphs take
 # 10-20 s each to compile on CPU and dominate suite wall-clock; steady-
 # state execution is <1 s. Cached executables survive across processes.
-jax.config.update("jax_compilation_cache_dir", os.path.join(os.path.dirname(__file__), ".jax_cache"))
+# The directory is keyed by a host-CPU fingerprint: XLA:CPU AOT results
+# embed the COMPILE machine's feature set, and loading one compiled in
+# a different container (different CPU flags) SIGILLs/segfaults mid-
+# suite (observed: "+prefer-no-gather is not supported on the host
+# machine ... could lead to execution errors such as SIGILL").
+from ate_replication_causalml_tpu.utils.compile_cache import _host_tag  # noqa: E402
+
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(__file__), f".jax_cache-{_host_tag()}"),
+)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 # Strict-precision mode for R-parity tests; the TPU production path runs

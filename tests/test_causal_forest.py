@@ -21,16 +21,18 @@ from ate_replication_causalml_tpu.models.causal_forest import (
 RNG = np.random.default_rng(7)
 
 
-def _heterogeneous_problem(n=3000, p=6, confounded=True):
+def _heterogeneous_problem(n=3000, p=6, confounded=True, rng=None):
     """τ(x) = 1 + 2·1{x0>0}; confounded propensity if requested."""
-    x = RNG.normal(size=(n, p))
+    if rng is None:
+        rng = RNG
+    x = rng.normal(size=(n, p))
     tau = 1.0 + 2.0 * (x[:, 0] > 0)
     if confounded:
         e = 1 / (1 + np.exp(-(0.8 * x[:, 1])))
     else:
         e = np.full(n, 0.5)
-    w = (RNG.random(n) < e).astype(np.float64)
-    y = 0.5 * x[:, 1] + tau * w + RNG.normal(size=n) * 0.5
+    w = (rng.random(n) < e).astype(np.float64)
+    y = 0.5 * x[:, 1] + tau * w + rng.normal(size=n) * 0.5
     frame = CausalFrame(
         x=jnp.asarray(x, jnp.float32),
         w=jnp.asarray(w, jnp.float32),
@@ -45,10 +47,24 @@ def _fit_small(frame, n_trees=200, **kw):
     return fit_causal_forest(frame, key=jax.random.key(0), n_trees=n_trees, **kw)
 
 
-def test_cate_recovers_heterogeneity():
-    frame, tau_true, _ = _heterogeneous_problem()
+import pytest
+
+
+@pytest.fixture(scope="module")
+def std_case():
+    """ONE standard confounded problem + ONE 200-tree fit + its OOB CATE,
+    shared by every read-only assertion in this module (VERDICT r2 #8:
+    fitting dominates suite wall-clock; the fit is deterministic, so
+    sharing changes nothing about what is tested)."""
+    frame, tau_true, ate_true = _heterogeneous_problem(
+        rng=np.random.default_rng(77))
     fitted = _fit_small(frame)
     cate = predict_cate(fitted.forest, fitted.x, oob=True)
+    return frame, tau_true, ate_true, fitted, cate
+
+
+def test_cate_recovers_heterogeneity(std_case):
+    frame, tau_true, _, fitted, cate = std_case
     pred = np.asarray(cate.cate)
     # Group means on each side of the x0 split should separate cleanly.
     lo = pred[np.asarray(frame.x[:, 0]) <= 0].mean()
@@ -57,20 +73,26 @@ def test_cate_recovers_heterogeneity():
     assert abs(lo - 1.0) < 0.6 and abs(hi - 3.0) < 0.6, (lo, hi)
 
 
-def test_average_effect_unconfounded_and_confounded():
-    for confounded in (False, True):
-        frame, _, ate_true = _heterogeneous_problem(confounded=confounded)
-        fitted = _fit_small(frame)
-        eff = average_treatment_effect(fitted)
-        est, se = float(eff.estimate), float(eff.std_err)
-        assert se > 0
-        assert abs(est - ate_true) < max(4 * se, 0.25), (est, ate_true, se)
+def test_average_effect_unconfounded_and_confounded(std_case):
+    # Confounded side: the shared fit. Unconfounded side: its own
+    # (cheaper) fit — the pair demonstrates AIPW under both designs.
+    _, _, ate_true_c, fitted_c, cate_c = std_case
+    eff = average_treatment_effect(fitted_c, cate=cate_c)
+    est, se = float(eff.estimate), float(eff.std_err)
+    assert se > 0
+    assert abs(est - ate_true_c) < max(4 * se, 0.25), (est, ate_true_c, se)
+
+    frame, _, ate_true = _heterogeneous_problem(
+        n=1500, confounded=False, rng=np.random.default_rng(78))
+    fitted = _fit_small(frame, n_trees=100)
+    eff = average_treatment_effect(fitted)
+    est, se = float(eff.estimate), float(eff.std_err)
+    assert se > 0
+    assert abs(est - ate_true) < max(4 * se, 0.25), (est, ate_true, se)
 
 
-def test_little_bags_variance_positive_and_calibrated():
-    frame, _, _ = _heterogeneous_problem(n=2000)
-    fitted = _fit_small(frame)
-    cate = predict_cate(fitted.forest, fitted.x, oob=True)
+def test_little_bags_variance_positive_and_calibrated(std_case):
+    frame, _, _, fitted, cate = std_case
     var = np.asarray(cate.variance)
     assert np.all(var >= 0)
     assert np.isfinite(var).all()
@@ -80,9 +102,8 @@ def test_little_bags_variance_positive_and_calibrated():
     assert var.mean() < float(jnp.var(frame.y))
 
 
-def test_oob_excludes_in_sample_trees():
-    frame, _, _ = _heterogeneous_problem(n=600)
-    fitted = _fit_small(frame, n_trees=20)
+def test_oob_excludes_in_sample_trees(std_case):
+    _, _, _, fitted, _ = std_case
     ins = np.asarray(fitted.forest.in_sample)
     # Half-sampling: each tree sees ~half the rows.
     frac = ins.mean(axis=1)

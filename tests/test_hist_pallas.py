@@ -175,3 +175,40 @@ def test_resolve_backend_row_aware_policy(monkeypatch):
     ) == "xla"  # n_bins unknown -> no kernel
     for explicit in ("xla", "pallas", "pallas_bf16", "pallas_interpret", "onehot"):
         assert hp.resolve_hist_backend(explicit, n_rows=10**7, n_bins=64) == explicit
+
+
+def test_bf16_kernel_bit_exact_for_integer_weights(case):
+    """The bf16 MXU path must be BIT-exact against f32 whenever every
+    weight is integer-valued in [-256, 256] — the condition under which
+    'auto' upgrades integer-weight forests to pallas_bf16."""
+    codes, node, _, max_nodes, n_bins = case
+    rng = np.random.default_rng(3)
+    counts = rng.poisson(1.0, case[0].shape[0]).astype(np.float32)
+    y01 = rng.integers(0, 2, counts.shape[0]).astype(np.float32)
+    weights = np.stack([counts, counts * y01])
+    args = (jnp.asarray(codes), jnp.asarray(node), jnp.asarray(weights))
+    kw = dict(max_nodes=max_nodes, n_bins=n_bins, tile=256, interpret=True)
+    f32 = bin_histogram_pallas(*args, **kw)
+    bf16 = bin_histogram_pallas(*args, bf16=True, **kw)
+    np.testing.assert_array_equal(np.asarray(f32), np.asarray(bf16))
+    truth = _numpy_hist(codes, node, weights, max_nodes, n_bins)
+    np.testing.assert_array_equal(np.asarray(bf16), truth.astype(np.float32))
+
+
+def test_resolve_backend_bf16_upgrade(monkeypatch):
+    """integer_weights=True upgrades the large-row TPU kernel pick to the
+    (bit-exact there, measured faster) bf16 kernel — and nothing else."""
+    import ate_replication_causalml_tpu.ops.hist_pallas as hp
+
+    monkeypatch.setattr(hp.jax, "default_backend", lambda: "tpu")
+    big = hp._PALLAS_ROWS_THRESHOLD
+    assert hp.resolve_hist_backend(
+        "auto", n_rows=big, n_bins=64, integer_weights=True) == "pallas_bf16"
+    assert hp.resolve_hist_backend(
+        "auto", n_rows=big, n_bins=64, integer_weights=False) == "pallas"
+    # Below the threshold / off-TPU the flag changes nothing.
+    assert hp.resolve_hist_backend(
+        "auto", n_rows=1000, n_bins=64, integer_weights=True) == "xla"
+    monkeypatch.setattr(hp.jax, "default_backend", lambda: "cpu")
+    assert hp.resolve_hist_backend(
+        "auto", n_rows=big, n_bins=64, integer_weights=True) == "onehot"

@@ -165,3 +165,125 @@ def test_r_compat_foldid():
     assert sorted(np.unique(fid)) == list(range(1, 11))
     counts = np.bincount(fid)[1:]
     assert counts.max() - counts.min() <= 1
+
+
+# ── λ-SELECTION oracle (VERDICT r2 #2 fallback) ──────────────────────
+# No R toolchain exists in this image (no Rscript, no network, installs
+# forbidden), so the selection rules that decide WHICH λ the LASSO
+# estimators use are validated against an independent line-by-line
+# NumPy transcription of glmnet's published R code (cvstats, getOptcv,
+# lambda.interp — glmnet 4.x R sources, identical rules in the 2018
+# releases the reference pins), plus a hand-computed fixture.
+
+
+def _oracle_cvstats(cvraw, wts, nfolds):
+    """glmnet::cvstats transcription:
+    cvm  = apply(cvraw, 2, weighted.mean, w=wts)
+    cvsd = sqrt(apply(scale(cvraw, cvm, FALSE)^2, 2, weighted.mean,
+                      w=wts) / (nfolds-1))"""
+    cvm = np.average(cvraw, axis=0, weights=wts)
+    cvsd = np.sqrt(
+        np.average((cvraw - cvm[None, :]) ** 2, axis=0, weights=wts)
+        / (nfolds - 1)
+    )
+    return cvm, cvsd
+
+
+def _oracle_getoptcv(lambdas, cvm, cvsd):
+    """glmnet::getOptcv transcription:
+    cvmin = min(cvm); idmin = cvm <= cvmin
+    lambda.min = max(lambda[idmin]); idmin = match(lambda.min, lambda)
+    semin = (cvm + cvsd)[idmin]; id1se = cvm <= semin
+    lambda.1se = max(lambda[id1se])"""
+    cvmin = np.min(cvm)
+    lam_min = np.max(lambdas[cvm <= cvmin])
+    idmin = int(np.nonzero(lambdas == lam_min)[0][0])
+    semin = (cvm + cvsd)[idmin]
+    lam_1se = np.max(lambdas[cvm <= semin])
+    id1se = int(np.nonzero(lambdas == lam_1se)[0][0])
+    return idmin, id1se
+
+
+def _oracle_lambda_interp_coef(lambdas, coefs, s):
+    """glmnet::lambda.interp + coef combination transcription: clamp s
+    into the path range, map to the normalized decreasing grid, approx()
+    the fractional coordinate, and blend coef[left]*frac +
+    coef[right]*(1-frac)."""
+    lam = np.asarray(lambdas, float)
+    k = len(lam)
+    s = min(max(float(s), lam[-1]), lam[0])
+    sfrac = (lam[0] - s) / (lam[0] - lam[k - 1])
+    lam_n = (lam[0] - lam) / (lam[0] - lam[k - 1])
+    coord = np.interp(sfrac, lam_n, np.arange(1, k + 1))  # R approx, 1-based
+    left = int(np.floor(coord)) - 1
+    right = int(np.ceil(coord)) - 1
+    if left == right or abs(lam_n[left] - lam_n[right]) < np.finfo(float).eps:
+        frac = 1.0
+    else:
+        frac = (sfrac - lam_n[right]) / (lam_n[left] - lam_n[right])
+    return frac * coefs[left] + (1.0 - frac) * coefs[right]
+
+
+def test_cv_select_matches_glmnet_transcription():
+    from ate_replication_causalml_tpu.ops.lasso import cv_select
+
+    rng = np.random.default_rng(0)
+    for trial in range(50):
+        K = int(rng.integers(3, 11))
+        L = int(rng.integers(5, 40))
+        losses = rng.uniform(0.5, 2.0, (K, L))
+        # Inject exact ties along the path in some trials (the near-tie
+        # regime where selection rules disagree if anything is off).
+        if trial % 3 == 0:
+            losses[:, L // 2] = losses[:, L // 3]
+        fold_n = rng.integers(5, 50, K).astype(float)
+        lambdas = np.sort(rng.uniform(0.01, 1.0, L))[::-1].copy()
+
+        cvm, cvsd, idx_min, idx_1se = cv_select(
+            jnp.asarray(losses), jnp.asarray(fold_n), K
+        )
+        o_cvm, o_cvsd = _oracle_cvstats(losses, fold_n, K)
+        np.testing.assert_allclose(np.asarray(cvm), o_cvm, rtol=1e-12)
+        np.testing.assert_allclose(np.asarray(cvsd), o_cvsd, rtol=1e-12)
+        o_min, o_1se = _oracle_getoptcv(lambdas, np.asarray(cvm), np.asarray(cvsd))
+        assert int(idx_min) == o_min, f"trial {trial}"
+        assert int(idx_1se) == o_1se, f"trial {trial}"
+
+
+def test_cv_select_fold_weighting_hand_fixture():
+    """Hand-computed fixture: 3 folds, sizes (10, 20, 70), 2 λs.
+    cvm[0] = .1·1 + .2·2 + .7·0.5 = 0.85
+    cvm[1] = .1·0.9 + .2·0.8 + .7·0.9 = 0.88  → idx_min = 0.
+    An UNWEIGHTED mean would give (1+2+.5)/3 = 1.1667 vs
+    (.9+.8+.9)/3 = 0.8667 → idx_min = 1: the fold weighting decides."""
+    from ate_replication_causalml_tpu.ops.lasso import cv_select
+
+    losses = np.array([[1.0, 0.9], [2.0, 0.8], [0.5, 0.9]])
+    fold_n = np.array([10.0, 20.0, 70.0])
+    cvm, cvsd, idx_min, _ = cv_select(jnp.asarray(losses), jnp.asarray(fold_n), 3)
+    np.testing.assert_allclose(np.asarray(cvm), [0.85, 0.88], rtol=1e-12)
+    assert int(idx_min) == 0
+    # cvsd[0]: weighted mean of (1-.85, 2-.85, .5-.85)² = .1·.0225 +
+    # .2·1.3225 + .7·.1225 = .3525; /(K-1) = .17625; sqrt ≈ .4198214.
+    np.testing.assert_allclose(float(cvsd[0]), np.sqrt(0.17625), rtol=1e-12)
+
+
+def test_lambda_interp_matches_glmnet_transcription():
+    from ate_replication_causalml_tpu.estimators.belloni import _interp_coef_at
+
+    rng = np.random.default_rng(1)
+    L, p = 20, 4
+    lambdas = np.sort(rng.uniform(0.01, 2.0, L))[::-1].copy()
+    coefs = rng.normal(size=(L, p))
+    # On-path, between-path, and out-of-range query points.
+    queries = np.concatenate([
+        lambdas[[0, 7, L - 1]],
+        (lambdas[:-1] + lambdas[1:]) / 2,
+        [lambdas[0] * 1.5, lambdas[-1] * 0.5],
+    ])
+    for s in queries:
+        got = np.asarray(_interp_coef_at(jnp.asarray(lambdas), jnp.asarray(coefs),
+                                         jnp.asarray(s)))
+        want = _oracle_lambda_interp_coef(lambdas, coefs, s)
+        np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-12,
+                                   err_msg=f"s={s}")

@@ -158,3 +158,83 @@ def test_fold_sharded_cv_glmnet_matches_vmap():
     _, coef_p = plain.coef_at("min")
     _, coef_s = sharded.coef_at("min")
     np.testing.assert_allclose(np.asarray(coef_p), np.asarray(coef_s), rtol=1e-10, atol=1e-12)
+
+
+def test_tree_sharded_causal_forest_matches_host():
+    """VERDICT r2 #3: the flagship causal-forest grow shards little-bag
+    groups over the mesh tree axis. Key partitioning differs from the
+    host loop, so assert statistical equivalence (CATE quality + pooled
+    ATE) and finite AIPW, not bit equality."""
+    import jax.numpy as jnp
+
+    from ate_replication_causalml_tpu.data.frame import CausalFrame
+    from ate_replication_causalml_tpu.models.causal_forest import (
+        average_treatment_effect,
+        fit_causal_forest,
+        grow_causal_forest,
+        grow_causal_forest_sharded,
+        predict_cate,
+    )
+    from ate_replication_causalml_tpu.parallel.mesh import TREE_AXIS, make_mesh
+
+    rng = np.random.default_rng(5)
+    n = 2048
+    x = jnp.asarray(rng.normal(size=(n, 8)), jnp.float32)
+    tau_true = 0.5 * np.asarray(x[:, 0] > 0)
+    w = (rng.random(n) < 0.5).astype(np.float32)
+    y = (0.3 * np.asarray(x[:, 1]) + tau_true * w
+         + rng.normal(size=n) * 0.5).astype(np.float32)
+    wj, yj = jnp.asarray(w), jnp.asarray(y)
+    wt, yt = wj - wj.mean(), yj - yj.mean()
+
+    mesh = make_mesh((TREE_AXIS,))
+    host = grow_causal_forest(x, wt, yt, jax.random.key(1), n_trees=64, depth=5)
+    shrd = grow_causal_forest_sharded(
+        x, wt, yt, jax.random.key(1), mesh, n_trees=64, depth=5)
+    assert shrd.n_trees == host.n_trees == 64
+    ch = predict_cate(host, x, oob=True)
+    cs = predict_cate(shrd, x, oob=True)
+    assert np.isfinite(np.asarray(cs.cate)).all()
+    assert np.isfinite(np.asarray(cs.variance)).all()
+    # Same signal recovery as the host loop.
+    corr_s = np.corrcoef(np.asarray(cs.cate), tau_true)[0, 1]
+    corr_h = np.corrcoef(np.asarray(ch.cate), tau_true)[0, 1]
+    assert corr_s > 0.8 and abs(corr_s - corr_h) < 0.1
+    assert abs(float(cs.cate.mean()) - float(ch.cate.mean())) < 0.02
+
+    # End-to-end mesh fit: nuisances + grow sharded, AIPW finite and
+    # near the truth.
+    fit = fit_causal_forest(
+        CausalFrame(x=x, w=wj, y=yj), n_trees=32, depth=5,
+        nuisance_trees=24, nuisance_depth=5, mesh=mesh)
+    eff = average_treatment_effect(fit)
+    assert np.isfinite(float(eff.estimate)) and float(eff.std_err) > 0
+    assert abs(float(eff.estimate) - 0.25) < 5 * float(eff.std_err)
+
+
+def test_dispatch_plan_bounded_at_million_rows():
+    """VERDICT r2 #4: the sharded fitters must never pack more per-device
+    trees into one dispatched executable than the watchdog budget allows
+    at the 1M-row scale (a single dispatch runs per-DEVICE work)."""
+    from ate_replication_causalml_tpu.models.forest import (
+        auto_tree_chunk,
+        dispatch_tree_target,
+        plan_tree_dispatch,
+    )
+
+    n_rows = 1_000_000
+    target = dispatch_tree_target(n_rows)
+    # Classifier geometry (depth 9, 500 trees over 8 devices).
+    chunk, cpd, n_disp = plan_tree_dispatch(n_rows, 9, -(-500 // 8))
+    assert chunk <= auto_tree_chunk(n_rows, 9, cap=32)     # HBM bound
+    assert chunk * cpd <= max(target, chunk)               # watchdog bound
+    assert n_disp * cpd * chunk >= -(-500 // 8)            # covers the work
+    # Causal-forest geometry (depth 8, little bags of 2, honest leaf
+    # one-hot, half-sample rows).
+    s = n_rows // 2
+    chunk, cpd, n_disp = plan_tree_dispatch(
+        s, 8, -(-1000 // 8), cap=16, trees_per_unit=2, leaf_onehot=True)
+    assert chunk <= auto_tree_chunk(s, 8, cap=16, trees_per_unit=2,
+                                    leaf_onehot=True)
+    assert chunk * cpd * 2 <= max(dispatch_tree_target(s), chunk * 2)
+    assert n_disp * cpd * chunk >= -(-1000 // 8)
