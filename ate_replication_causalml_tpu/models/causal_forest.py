@@ -629,12 +629,14 @@ def _tau_from_sums(S, M):
     """α-weighted residual-on-residual regression from accumulated
     normalized moments S (…, 5) over M valid trees: the 2×2 local
     least-squares solve (intercept + slope) grf performs with forest
-    kernel weights."""
+    kernel weights. Returns (tau, var) — ``var`` is the pooled Var(w̃)
+    under the forest weights, i.e. the (intercept-profiled) Hessian of
+    the local moment condition; ``var > _EPS`` is the validity mask."""
     Mc = jnp.maximum(M, 1.0)
     mw, my, mww, mwy = (S[..., i] / Mc for i in (1, 2, 3, 4))
     var = mww - mw * mw
     tau = jnp.where(var > _EPS, (mwy - mw * my) / jnp.maximum(var, _EPS), 0.0)
-    return tau, var > _EPS
+    return tau, var
 
 
 @functools.partial(jax.jit, static_argnames=("oob", "tree_chunk", "row_chunk"))
@@ -746,73 +748,88 @@ def predict_cate(
                 return per_tree(f, b, s, i, l, codes_blk)
 
             m, valid = jax.vmap(jax.vmap(one))(*vargs)
-            # m: (gc, k, rb, 5); per-tree tau for within-group variance.
-            tau_t, ok_t = _tau_from_sums(m, m[..., 0])          # (gc, k, rb)
-            S_g = m.sum(axis=1)                                  # (gc, rb, 5)
-            M_g = m[..., 0].sum(axis=1)                          # (gc, rb)
-            tau_g, ok_g = _tau_from_sums(S_g, M_g)               # (gc, rb)
-            okf = ok_t.astype(jnp.float32)
-            nv = jnp.maximum(okf.sum(axis=1), 1.0)
-            mean_t = (tau_t * okf).sum(axis=1) / nv
-            var_w = ((tau_t - mean_t[:, None]) ** 2 * okf).sum(axis=1) / jnp.maximum(
-                nv - 1.0, 1.0
-            )
-            # Little-bags sufficient statistics, reduced over this
-            # chunk's groups — a full (n_groups, rows) per-group tau
-            # matrix is ~4 GB × 3 at 2000 trees × 1M rows and OOMs.
-            # Moments are CENTERED at the chunk's own per-row mean c:
-            # raw Σok·τ_g² suffers catastrophic f32 cancellation when
-            # the CATE level dwarfs the between-group spread; centered
-            # deviations d = τ_g − c keep every accumulated term small.
-            okg = ok_g.astype(jnp.float32)
-            n_j = okg.sum(axis=0)
-            c_j = (okg * tau_g).sum(axis=0) / jnp.maximum(n_j, 1.0)
-            d = tau_g - c_j[None, :]
+            # m: (gc, k, rb, 5) per-tree normalized moments. The
+            # little-bags variance is grf's SANDWICH form: evaluate the
+            # (intercept-profiled) score ψ_t = A_t − τ̂·B_t at the pooled
+            # τ̂, take between/within-group variance of ψ, divide by the
+            # pooled Hessian² — never solve τ per group (a 2-tree group
+            # with near-zero Var(w̃) would explode; exactly what grf's
+            # compute_variance avoids by working on ψ values).
+            mw, my, mww, mwy = (m[..., i] for i in (1, 2, 3, 4))
+            A_t = mwy - mw * my                 # per-tree Cov(w̃,ỹ)
+            B_t = mww - mw * mw                 # per-tree Var(w̃)
+            # grf counts only groups whose EVERY tree produced a valid
+            # (nonempty, oob-allowed) prediction.
+            ok_g = valid.all(axis=1).astype(jnp.float32)   # (gc, rb)
+            A_g = A_t.mean(axis=1)
+            B_g = B_t.mean(axis=1)
+            # ψ is linear in τ: accumulate at the CHUNK's own pooled τ_c
+            # (scores near a solution are ~0, so every accumulated term
+            # is small — no f32 cancellation at large CATE levels) and
+            # shift to the global τ̂ afterwards via ψ(τ̂)=ψ(τ_c)−δ·B.
+            S_sum = m.sum(axis=(0, 1))                     # (rb, 5)
+            M_sum = m[..., 0].sum(axis=(0, 1))             # (rb,)
+            tau_c, _ = _tau_from_sums(S_sum, M_sum)        # (rb,)
+            P_t = A_t - tau_c[None, None, :] * B_t
+            P_g = A_g - tau_c[None, :] * B_g
+            devP = (P_t - P_g[:, None, :]) * ok_g[:, None, :]
+            devB = (B_t - B_g[:, None, :]) * ok_g[:, None, :]
             return (
-                S_g.sum(axis=0),                # (rb, 5)
-                M_g.sum(axis=0),                # (rb,)
-                n_j,                            # Σ ok
-                c_j,                            # chunk center
-                (okg * d).sum(axis=0),          # Σ ok·d   (≈0 by choice of c)
-                (okg * d * d).sum(axis=0),      # Σ ok·d²
-                (okg * var_w).sum(axis=0),      # Σ ok·var_w
+                S_sum,
+                M_sum,
+                tau_c,
+                ok_g.sum(axis=0),                          # groups counted
+                (ok_g * P_g).sum(axis=0),                  # Σψ_g
+                (ok_g * B_g).sum(axis=0),                  # ΣB_g
+                (ok_g * P_g * P_g).sum(axis=0),            # Σψ_g²
+                (ok_g * B_g * B_g).sum(axis=0),            # ΣB_g²
+                (ok_g * P_g * B_g).sum(axis=0),            # Σψ_gB_g
+                (devP * devP).sum(axis=(0, 1)),            # within SSψ
+                (devP * devB).sum(axis=(0, 1)),            # within SSψB
+                (devB * devB).sum(axis=(0, 1)),            # within SSB
             )
 
-        S_c, M_c, n_c, c_c, m_c, q_c, w_c = lax.map(
-            chunk_fn, (feats_g, bins_g, stats_g, in_blk, li_blk)
-        )
-        # Combine the chunks' centered moments at the block's weighted
-        # center c_b via the parallel-variance shift rule:
-        #   q@c_b = q@c_j + 2·(c_j − c_b)·m@c_j + (c_j − c_b)²·n_j.
-        A1 = n_c.sum(axis=0)
-        c_b = (n_c * c_c).sum(axis=0) / jnp.maximum(A1, 1.0)
-        shift = c_c - c_b[None, :]
-        M1 = (m_c + n_c * shift).sum(axis=0)
-        Q = (q_c + 2.0 * shift * m_c + n_c * shift * shift).sum(axis=0)
-        return (
-            S_c.sum(axis=0), M_c.sum(axis=0), A1, c_b, M1, Q, w_c.sum(axis=0)
-        )
+        outs = lax.map(chunk_fn, (feats_g, bins_g, stats_g, in_blk, li_blk))
+        (S_c, M_c, tau_c, gn_c, gP_c, gB_c, gPP_c, gBB_c, gPB_c,
+         w2_c, wPB_c, wBB_c) = outs
+        # Global pooled τ̂ and Hessian for this row block (chunks cover
+        # every group, so this is the forest-wide solve).
+        S_b = S_c.sum(axis=0)
+        M_b = M_c.sum(axis=0)
+        tau_b, H_b = _tau_from_sums(S_b, M_b)              # (rb,), (rb,)
+        # Shift each chunk's ψ-moments from its τ_c to τ̂ (δ is tiny).
+        d = tau_b[None, :] - tau_c                         # (n_chunks, rb)
+        gn = gn_c.sum(axis=0)
+        SP = (gP_c - d * gB_c).sum(axis=0)
+        SP2 = (gPP_c - 2.0 * d * gPB_c + d * d * gBB_c).sum(axis=0)
+        ssw = (w2_c - 2.0 * d * wPB_c + d * d * wBB_c).sum(axis=0)
+        return S_b, M_b, tau_b, H_b, gn, SP, SP2, ssw
 
-    S_b, M_b, A1_b, c_bb, M1_b, Q_b, W1_b = lax.map(block_fn, (codes_b, in_b, li_b))
+    S_b, M_b, tau_b, H_b, gn_b, SP_b, SP2_b, ssw_b = lax.map(
+        block_fn, (codes_b, in_b, li_b)
+    )
 
     def unblock(a):  # (n_blocks, rb, …) -> (n, …)
         return a.reshape((n_pad,) + a.shape[2:])[:n]
 
-    S = unblock(S_b)
-    M = unblock(M_b)
-    tau, _ = _tau_from_sums(S, M)
-    A1, c_b, M1, Q, W1 = (unblock(a) for a in (A1_b, c_bb, M1_b, Q_b, W1_b))
+    tau = unblock(tau_b)
+    H = unblock(H_b)
+    gn, SP, SP2, ssw = (unblock(a) for a in (gn_b, SP_b, SP2_b, ssw_b))
 
-    # Bootstrap of little bags: V_between − V_within/k, truncated at 0.
-    # V_between = Σ ok·(τ_g − τ)²/(ng−1): shift the block-centered
-    # moments to the pooled τ (c_b ≈ τ, so the shift terms stay small —
-    # no cancellation). Padded groups carry ok=0 and contribute nothing.
-    ng = jnp.maximum(A1, 1.0)
-    shift = c_b - tau
-    ss_between = Q + 2.0 * shift * M1 + A1 * shift * shift
-    v_between = ss_between / jnp.maximum(ng - 1.0, 1.0)
-    v_within = W1 / ng
-    variance = jnp.maximum(v_between - v_within / k, 0.0)
+    # Bootstrap of little bags, sandwich form (grf ≤0.10
+    # compute_variance with the intercept profiled out):
+    #   Var(τ̂) = max(V_between(ψ) − V_within(ψ)/k, 0) / H²
+    # with ψ evaluated at the pooled τ̂ and H the pooled Var(w̃).
+    ngr = jnp.maximum(gn, 1.0)
+    mean_psi = SP / ngr
+    v_between = jnp.maximum(SP2 - gn * mean_psi * mean_psi, 0.0) / jnp.maximum(
+        gn - 1.0, 1.0
+    )
+    v_within = ssw / jnp.maximum(gn * (k - 1.0), 1.0)
+    var_psi = jnp.maximum(v_between - v_within / k, 0.0)
+    variance = jnp.where(
+        H > _EPS, var_psi / jnp.maximum(H, _EPS) ** 2, 0.0
+    )
     return CatePredictions(cate=tau, variance=variance)
 
 

@@ -49,12 +49,21 @@ def _round_up(x: int, m: int) -> int:
 
 
 # Row count above which the streaming Pallas kernel beats the XLA
-# contraction on TPU. Measured on v5-lite (p=21, 64 bins, in-situ grow
-# chunks): at 100k rows XLA wins (9.8 vs 10.9 ms/tree, whole causal
-# tree); at 1M rows the kernel wins (159 vs 211 ms/tree, classifier) —
-# the XLA path's scatter-built bin one-hot and its HBM materialization
-# grow with rows while the kernel streams codes through VMEM.
-_PALLAS_ROWS_THRESHOLD = 400_000
+# contraction on TPU. Round-3 within-ONE-window sweep (v5-lite,
+# `bench.py --hist-ab`: whole classifier-tree ms/tree, p=21, 64 bins,
+# depth 9 — round 2's 400k figure mixed windows with 4× tunnel
+# variance):
+#
+#   rows   9k   30k   100k   200k   400k    1M
+#   xla    4.5  6.8   23.3   62.7   187.7  798.6
+#   pallas 4.6  8.4   23.2   41.7    82.6  205.0
+#   bf16   6.2 10.1   22.1   41.3    80.3  201.6
+#
+# Crossover ≈ 100k (a wash there; kernel 1.5× at 200k, 3.9× at 1M —
+# the XLA path's scatter-built bin one-hot grows superlinearly in HBM
+# cost while the kernel streams codes through VMEM). bf16 only wins
+# past the crossover, which is exactly where 'auto' can pick it.
+_PALLAS_ROWS_THRESHOLD = 150_000
 
 
 def resolve_hist_backend(
@@ -68,20 +77,21 @@ def resolve_hist_backend(
 
     On TPU, 'auto' picks the XLA contraction at reference-like row
     counts and the streaming Pallas kernel past ``_PALLAS_ROWS_THRESHOLD``
-    (see measurement note above); pass ``n_rows`` to enable the switch —
-    without it 'auto' stays on the XLA path, which is within ~25% either
-    way. The kernel only supports ``n_bins ≤ 128`` (one feature per
-    128-lane block minimum), so 'auto' also needs ``n_bins`` to choose
-    it — wider binnings stay on XLA, which handles any width. Both are
-    bit-exact to each other (tests/test_hist_pallas.py) and remain
-    explicitly selectable. On CPU the forest engines pass
-    ``allow_onehot=True`` to use the shared one-hot matmul (fastest at
-    reference scale).
+    (see the measured crossover table above). Pass ``n_rows`` to enable
+    the switch — without it 'auto' stays on the XLA path, which is fine
+    at reference scale but ~4× slower than the kernel by 1M rows, so
+    large-row callers should always pass it. The kernel only supports
+    ``n_bins ≤ 128`` (one feature per 128-lane block minimum), so 'auto'
+    also needs ``n_bins`` to choose it — wider binnings stay on XLA,
+    which handles any width. Both are bit-exact to each other
+    (tests/test_hist_pallas.py) and remain explicitly selectable. On CPU
+    the forest engines pass ``allow_onehot=True`` to use the shared
+    one-hot matmul (fastest at reference scale).
 
     ``integer_weights=True`` declares every weight vector integer-valued
     in [-256, 256] (the classifier forests: Poisson counts and counts·y
-    with y ∈ {0,1}) — there the bf16 kernel is bit-exact and measured
-    faster at 1M rows (154 vs 159 ms/tree, RESULTS.md), so 'auto'
+    with y ∈ {0,1}) — there the bf16 kernel is bit-exact and the fastest
+    backend everywhere past the crossover (see table), so 'auto'
     upgrades the kernel pick to ``pallas_bf16``. The caller owns the
     declaration; it is asserted nowhere on the device path."""
     if backend == "auto":

@@ -256,7 +256,10 @@ def main():
     if "--sharded" in sys.argv:
         return bench_sharded()
     if "--hist-ab" in sys.argv:
-        return bench_hist_ab()
+        rows = N_ROWS
+        if "--rows" in sys.argv:
+            rows = int(sys.argv[sys.argv.index("--rows") + 1])
+        return bench_hist_ab(rows)
     if "--forest" in sys.argv:
         rows = FOREST_ROWS
         if "--rows" in sys.argv:

@@ -228,7 +228,7 @@ def test_cv_select_matches_glmnet_transcription():
     from ate_replication_causalml_tpu.ops.lasso import cv_select
 
     rng = np.random.default_rng(0)
-    for trial in range(50):
+    for trial in range(20):
         K = int(rng.integers(3, 11))
         L = int(rng.integers(5, 40))
         losses = rng.uniform(0.5, 2.0, (K, L))

@@ -68,9 +68,22 @@ def test_full_sweep_and_resume(tmp_path):
     assert report2.incorrect_cf_ate == report.incorrect_cf_ate
 
 
+# Checkpoint/config-mechanics tests only exercise the driver plumbing —
+# they run a MICRO sweep (separate shapes compile once into the
+# persistent cache; execution is seconds) so the full-size TINY sweep
+# runs exactly once per suite (VERDICT r2 #8).
+MICRO = dataclasses.replace(
+    TINY,
+    prep=PrepConfig(n_obs=1200),
+    synthetic_pool=3000,
+    dr_trees=16, dml_trees=16, cf_trees=16, cf_nuisance_trees=16,
+    forest_depth=4, balance_iters=600,
+)
+
+
 def test_changed_config_invalidates_checkpoint(tmp_path):
     out = str(tmp_path / "sweep")
-    run_sweep(TINY, outdir=out, plots=False, log=lambda s: None)
+    run_sweep(MICRO, outdir=out, plots=False, log=lambda s: None)
     # report.json must be strict JSON (the no-SE LASSO rows carry NaN
     # internally; on disk they must be null).
     import json as _json
@@ -79,7 +92,7 @@ def test_changed_config_invalidates_checkpoint(tmp_path):
     assert "NaN" not in txt
     _json.loads(txt)
 
-    changed = dataclasses.replace(TINY, dr_trees=TINY.dr_trees + 1)
+    changed = dataclasses.replace(MICRO, dr_trees=MICRO.dr_trees + 1)
     logs = []
     run_sweep(changed, outdir=out, plots=False, log=logs.append)
     assert not any("[resume]" in l for l in logs)
@@ -88,5 +101,5 @@ def test_changed_config_invalidates_checkpoint(tmp_path):
 
 
 def test_sweep_no_outdir_runs_in_memory():
-    report = run_sweep(TINY, outdir=None, plots=False, log=lambda s: None)
+    report = run_sweep(MICRO, outdir=None, plots=False, log=lambda s: None)
     assert len(report.results) == len(EXPECTED_METHODS)
