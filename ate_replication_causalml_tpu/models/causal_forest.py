@@ -69,8 +69,10 @@ from ate_replication_causalml_tpu.models.forest import (
     quantile_bins,
     resolve_hist_backend,
     route_rows,
+    route_rows_blocked,
+    select_split,
 )
-from ate_replication_causalml_tpu.ops.hist_pallas import bin_histogram
+from ate_replication_causalml_tpu.ops.hist_pallas import bin_histogram, node_sums
 from ate_replication_causalml_tpu.ops.linalg import _PREC
 from ate_replication_causalml_tpu.parallel.retry import require_all, run_shards
 
@@ -179,8 +181,12 @@ def grow_causal_forest(
     mtry = min(mtry, p)
     k = ci_group_size
     n_groups = -(-n_trees // k)
+    # allow_lossy_bf16: on 'auto', the streaming grower's five float
+    # channels are rounded to bf16 before exact f32 accumulation —
+    # ≤0.4% input rounding against a 64-bin quantile discretization,
+    # split-selection-neutral, ~4× MXU. Pass "pallas" for full f32.
     hist_backend = resolve_hist_backend(
-        hist_backend, n_rows=int(n * sample_fraction), n_bins=n_bins
+        hist_backend, n_rows=n, n_bins=n_bins, allow_lossy_bf16=True
     )
     edges = quantile_bins(x, n_bins)
     codes = binarize(x, edges)
@@ -192,10 +198,16 @@ def grow_causal_forest(
     # one-hot, and the 'onehot' backend streams full-n rows (mask path)
     # rather than the s-row subsample. An explicitly requested chunk is
     # clamped to the same HBM budget — a chunk that fit the round-1
-    # segment_sum path can OOM the one-hot formulation.
-    chunk_rows = n if hist_backend == "onehot" else s
+    # segment_sum path can OOM the one-hot formulation. The streaming
+    # (Pallas) backends also run mask mode but have no leaf one-hot and
+    # route row-blocked, so their chunk follows the kernel tree cap
+    # (5 ρ-decomposition channels — see grow_one_streaming).
+    streaming = hist_backend.startswith("pallas")
+    chunk_rows = n if (hist_backend == "onehot" or streaming) else s
     auto_chunk = auto_tree_chunk(
-        chunk_rows, depth, cap=16, trees_per_unit=k, leaf_onehot=True
+        chunk_rows, depth, cap=16, trees_per_unit=k,
+        leaf_onehot=not streaming, streaming=streaming, p=p, n_bins=n_bins,
+        kernel_weights=5,
     )
     group_chunk = auto_chunk if group_chunk is None else min(group_chunk, auto_chunk)
     group_chunk = pick_chunk(n_groups, group_chunk)
@@ -289,12 +301,17 @@ def grow_causal_forest_sharded(
             "(the shared bin one-hot is not built here); use 'auto'/'xla'/'pallas'"
         )
     hist_backend = resolve_hist_backend(
-        hist_backend, allow_onehot=False, n_rows=s, n_bins=n_bins
+        hist_backend, allow_onehot=False, n_rows=n, n_bins=n_bins,
+        allow_lossy_bf16=True,
     )
     axis_size = mesh.shape[axis_name]
     per_dev_groups = -(-n_groups // axis_size)
+    streaming = hist_backend.startswith("pallas")
+    plan_rows = n if streaming else s  # mask mode streams full n
     auto_chunk, chunks_per_disp, n_disp = plan_tree_dispatch(
-        s, depth, per_dev_groups, cap=16, trees_per_unit=k, leaf_onehot=True
+        plan_rows, depth, per_dev_groups, cap=16, trees_per_unit=k,
+        leaf_onehot=not streaming, streaming=streaming, p=p, n_bins=n_bins,
+        kernel_weights=5,
     )
     if group_chunk is not None and group_chunk < auto_chunk:
         # An explicit (smaller) chunk re-plans the dispatch split so the
@@ -302,7 +319,7 @@ def grow_causal_forest_sharded(
         group_chunk = pick_chunk(per_dev_groups, group_chunk)
         n_chunks = -(-per_dev_groups // group_chunk)
         chunks_per_disp = min(
-            max(1, dispatch_tree_target(s) // (group_chunk * k)), n_chunks
+            max(1, dispatch_tree_target(plan_rows) // (group_chunk * k)), n_chunks
         )
         n_disp = -(-n_chunks // chunks_per_disp)
     else:
@@ -369,6 +386,95 @@ def _grow_cf_chunk(group_keys, codes, wt, yt, mom_stack, xb_onehot, *,
     max_nodes = 1 << (depth - 1)
     n_leaves = 1 << depth
 
+    def grow_one_streaming(codes_g, mom_g, gw, ew, split_key):
+        """Streaming (Pallas) grow: the ρ-decomposed level pipeline.
+
+        GRF's pseudo-outcome is a per-NODE linear combination of five
+        level-invariant row quantities:
+
+          ρ = w̃ỹ − w̄ỹ − ȳw̃ + w̄ȳ − τ(w̃² − 2w̄w̃ + w̄²)
+
+        so Σ_cell gw·ρ composes from the histograms of the five channels
+        gw·[1, w̃, ỹ, w̃², w̃ỹ] with per-node coefficients (w̄, ȳ, τ).
+        Compared to the direct formulation (per-level moments matmul,
+        (w̄,ȳ,τ) broadcast, per-row ρ, then a 2-channel histogram) this
+        needs ONE kernel call per level and no other row sweeps, the
+        channels are level-invariant so sibling subtraction halves the
+        kernel matmul (impossible for the direct ρ channel, which
+        changes every level), per-node moments fall out of the
+        histogram's bin marginal for free, and the honest-leaf payload
+        is a node-sum kernel call instead of a (rows, 2^depth) one-hot
+        contraction. Routing is row-blocked (route_rows_blocked), so no
+        (rows, M) one-hot ever reaches HBM — which lets whole chunks of
+        little-bag groups share one codes stream and batch through the
+        kernel's tree axis (ops/hist_pallas.py::_pallas_batched_vmappable).
+
+        Numerically safe because w̃, ỹ are locally-centered residuals
+        (means ≈ 0 by construction — fit_causal_forest always passes
+        w−ŵ, y−ŷ), so the uncentered channel sums carry no catastrophic
+        cancellation. Split selection is algebraically identical to the
+        direct path; f32 rounding can flip exact ties only
+        (equivalence asserted statistically in tests).
+        """
+        p_feat = codes_g.shape[1]
+        ch = gw[None, :] * mom_g.T  # (5, rows), level-invariant
+        node_of_row = jnp.zeros(codes_g.shape[0], jnp.int32)
+        prev_hist = None
+        feats_l, bins_l = [], []
+        for level in range(depth):
+            level_nodes = min(1 << level, max_nodes)
+            if prev_hist is None:
+                hist = bin_histogram(
+                    codes_g, node_of_row, ch, max_nodes=level_nodes,
+                    n_bins=n_bins, backend=hist_backend,
+                )
+            else:
+                half = level_nodes // 2
+                left_id = jnp.where(node_of_row % 2 == 0, node_of_row // 2, -1)
+                hist_left = bin_histogram(
+                    codes_g, left_id, ch, max_nodes=half, n_bins=n_bins,
+                    backend=hist_backend,
+                )
+                hist = jnp.stack([hist_left, prev_hist - hist_left], axis=2
+                                 ).reshape(5, level_nodes, p_feat, n_bins)
+            prev_hist = hist
+            # Per-node totals = the bin marginal of any one feature.
+            mom_nodes = hist[:, :, 0, :].sum(axis=2).T        # (m, 5)
+            wbar, ybar, tau = _node_tau(mom_nodes)
+            s_cum = jnp.cumsum(hist, axis=3)                   # (5, m, p, b)
+            bc = lambda v: v[:, None, None]
+            cl = s_cum[0]
+            rl = (
+                s_cum[4]
+                - bc(wbar) * s_cum[2]
+                + bc(2.0 * tau * wbar - ybar) * s_cum[1]
+                + bc(wbar * ybar - tau * wbar * wbar) * s_cum[0]
+                - bc(tau) * s_cum[3]
+            )
+            ct, rt = cl[:, :, -1:], rl[:, :, -1:]
+            cr, rr = ct - cl, rt - rl
+            score = -(
+                rl * rl / jnp.maximum(cl, _EPS) + rr * rr / jnp.maximum(cr, _EPS)
+            )
+            score = jnp.where((cl >= min_node) & (cr >= min_node), score, jnp.inf)
+            best_feat, best_bin = select_split(
+                score, split_key[level], level_nodes, p_feat, n_bins, mtry
+            )
+            node_of_row = route_rows_blocked(
+                node_of_row, best_feat, best_bin, codes_g
+            )
+            pad = max_nodes - level_nodes
+            feats_l.append(jnp.pad(best_feat, (0, pad)))
+            bins_l.append(jnp.pad(best_bin, (0, pad), constant_values=n_bins - 1))
+        # Leaf payloads feed predictions directly — keep them full f32
+        # even when the split search runs the lossy-bf16 kernel (the
+        # payload is one node-sum call per tree, not the bottleneck).
+        leaf_backend = "pallas" if hist_backend == "pallas_bf16" else hist_backend
+        leaf_stats = node_sums(
+            node_of_row, ew[None, :] * mom_g.T, n_leaves, backend=leaf_backend
+        )  # (L, 5)
+        return jnp.stack(feats_l), jnp.stack(bins_l), leaf_stats
+
     def grow_one(codes_g, wt_g, yt_g, mom_g, oh_g, base, idx, tree_key):
         """Grow one honest tree.
 
@@ -391,6 +497,8 @@ def _grow_cf_chunk(group_keys, codes, wt, yt, mom_stack, xb_onehot, *,
         else:
             gw = ew = base
         split_key = jax.random.split(tree_key, depth + 1)[1:]
+        if hist_backend.startswith("pallas"):
+            return grow_one_streaming(codes_g, mom_g, gw, ew, split_key)
 
         def level_step(node_of_row, lk, level_nodes):
             # TPU-first level pipeline: every per-node → per-row lookup
@@ -441,19 +549,9 @@ def _grow_cf_chunk(group_keys, codes, wt, yt, mom_stack, xb_onehot, *,
                 rl * rl / jnp.maximum(cl, _EPS) + rr * rr / jnp.maximum(cr, _EPS)
             )
             score = jnp.where((cl >= min_node) & (cr >= min_node), score, jnp.inf)
-
-            feat_scores = jax.random.uniform(lk, (level_nodes, p))
-            kth = jnp.sort(feat_scores, axis=1)[:, mtry - 1 : mtry]
-            score = jnp.where((feat_scores <= kth)[:, :, None], score, jnp.inf)
-
-            flat = score.reshape(level_nodes, p * n_bins)
-            best = jnp.argmin(flat, axis=1)
-            has_split = jnp.isfinite(jnp.min(flat, axis=1))
-            best_feat = jnp.where(has_split, (best // n_bins).astype(jnp.int32), 0)
-            best_bin = jnp.where(
-                has_split, (best % n_bins).astype(jnp.int32), n_bins - 1
+            best_feat, best_bin = select_split(
+                score, lk, level_nodes, p, n_bins, mtry
             )
-
             node_of_row = route_rows(
                 node_oh, best_feat, best_bin, codes_g.astype(jnp.float32), node_of_row
             )
@@ -491,7 +589,13 @@ def _grow_cf_chunk(group_keys, codes, wt, yt, mom_stack, xb_onehot, *,
         vone = jax.vmap(
             grow_one, in_axes=(None, None, None, None, None, None, None, 0)
         )
-        if hist_backend == "onehot":
+        if hist_backend == "onehot" or hist_backend.startswith("pallas"):
+            # Mask mode: every tree streams the SHARED full-n codes with
+            # subsample-masked weights. For the streaming backends this
+            # is what lets a whole chunk of little-bag groups collapse
+            # into tree-batched kernel calls (per-group gathered codes
+            # would fence batching at k trees); the honest partition is
+            # identical either way (same keys, same in_mask).
             feats, bins, stats = vone(
                 codes, wt, yt, mom_stack, xb_onehot,
                 in_mask.astype(jnp.float32), None, tree_keys,

@@ -151,6 +151,76 @@ def route_rows(node_oh, best_feat, best_bin, codes_f, node_of_row):
     return node_of_row * 2 + (code_at_feat > row_route[:, 0]).astype(jnp.int32)
 
 
+# Row-block size for gather-free routing without the full (rows, M)
+# one-hot in HBM (route_rows_blocked): 128k rows × 256 nodes in bf16 is
+# 64 MB per tree per block — an 8-tree vmapped chunk keeps ~512 MB of
+# transient block one-hots, and 1M rows need only 8 lax.map iterations.
+_ROUTE_BLOCK = 131072
+
+
+def route_rows_blocked(
+    node_of_row, best_feat, best_bin, codes, row_block: int = _ROUTE_BLOCK
+):
+    """:func:`route_rows` from raw node ids, with rows processed in
+    ``lax.map`` blocks so the (rows, M) routing one-hot never
+    materializes in HBM — the operand that capped million-row tree
+    chunks at 2 vmapped trees (auto_tree_chunk's budget) and with it the
+    tree-batched histogram kernel's amortization.
+
+    EXACT: routing is integer compares (one-hot selection of integer bin
+    codes/thresholds), so blocking cannot change a single route —
+    asserted against the unblocked path in tests/test_forest.py.
+
+    Args:
+      node_of_row: (rows,) int32 current node ids.
+      best_feat/best_bin: (M,) int32 split table for this level.
+      codes: (rows, p) int bin codes (any integer dtype; cast per block).
+    """
+    m = best_feat.shape[0]
+    n = node_of_row.shape[0]
+    # Build the block one-hot directly in the routing matmul's dtype
+    # (bf16 on TPU — exact for 0/1; see route_rows) instead of f32 +
+    # cast: halves the largest transient.
+    dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+
+    def blk(args):
+        ids, cd = args
+        oh = jax.nn.one_hot(ids, m, dtype=dt)
+        return route_rows(oh, best_feat, best_bin, cd.astype(jnp.float32), ids)
+
+    if n <= row_block:
+        return blk((node_of_row, codes))
+    n_blocks = -(-n // row_block)
+    n_pad = n_blocks * row_block
+    ids_b = jnp.pad(node_of_row, (0, n_pad - n)).reshape(n_blocks, row_block)
+    codes_b = jnp.pad(codes, ((0, n_pad - n), (0, 0))).reshape(
+        n_blocks, row_block, -1
+    )
+    out = lax.map(blk, (ids_b, codes_b))
+    return out.reshape(n_pad)[:n]
+
+
+def select_split(score, lk, level_nodes, p, n_bins, mtry):
+    """Pick each node's best (feature, bin) from the masked score tensor
+    with randomForest's per-node mtry feature subsampling. Shared by the
+    classifier level loop and BOTH causal formulations (direct and
+    ρ-decomposed streaming) — the ≥0.95 split-agreement contract between
+    them rides on these staying semantically identical. Nodes with no
+    finite score fall back to (feature 0, bin n_bins−1): every row
+    routes left."""
+    feat_scores = jax.random.uniform(lk, (level_nodes, p))
+    kth = jnp.sort(feat_scores, axis=1)[:, mtry - 1 : mtry]
+    score = jnp.where((feat_scores <= kth)[:, :, None], score, jnp.inf)
+    flat = score.reshape(level_nodes, p * n_bins)
+    best = jnp.argmin(flat, axis=1)
+    has_split = jnp.isfinite(jnp.min(flat, axis=1))
+    best_feat = jnp.where(has_split, (best // n_bins).astype(jnp.int32), 0)
+    best_bin = jnp.where(
+        has_split, (best % n_bins).astype(jnp.int32), n_bins - 1
+    )
+    return best_feat, best_bin
+
+
 def quantile_bins(x: jax.Array, n_bins: int = 64) -> jax.Array:
     """Per-feature quantile bin edges, (p, n_bins-1). Computed once and
     shared by every tree (the binned representation is what CART's
@@ -242,6 +312,10 @@ def plan_tree_dispatch(
     cap: int = 32,
     trees_per_unit: int = 1,
     leaf_onehot: bool = False,
+    streaming: bool = False,
+    p: int = 21,
+    n_bins: int = 64,
+    kernel_weights: int = 2,
 ) -> tuple[int, int, int]:
     """Dispatch plan for a per-device tree workload: (chunk,
     chunks_per_disp, n_disp). ``chunk`` units vmap together within the
@@ -256,7 +330,8 @@ def plan_tree_dispatch(
     chunk = pick_chunk(
         per_dev_total,
         auto_tree_chunk(n_rows, depth, cap=cap, trees_per_unit=trees_per_unit,
-                        leaf_onehot=leaf_onehot),
+                        leaf_onehot=leaf_onehot, streaming=streaming,
+                        p=p, n_bins=n_bins, kernel_weights=kernel_weights),
     )
     n_chunks = -(-per_dev_total // chunk)
     chunks_per_disp = min(
@@ -271,6 +346,10 @@ def auto_tree_chunk(
     cap: int,
     trees_per_unit: int = 1,
     leaf_onehot: bool = False,
+    streaming: bool = False,
+    p: int = 21,
+    n_bins: int = 64,
+    kernel_weights: int = 2,
 ) -> int:
     """Trees to grow per compiled chunk: as many as fit the HBM budget,
     capped at ``cap``. The dominant operand is the deepest level's
@@ -279,10 +358,34 @@ def auto_tree_chunk(
     (rows, 2^depth) leaf payload contraction. ``trees_per_unit`` scales
     for little-bag groups. ``n_rows`` must be the rows the grower
     actually streams (full n for the 'onehot' backend, the subsample
-    for the gathered backends)."""
+    for the gathered backends).
+
+    ``streaming=True`` (the Pallas histogram backends): routing runs
+    row-blocked (:func:`route_rows_blocked`), so the one-hot operand is
+    (row_block, width) per tree instead of (rows, width) — at the
+    million-row scale this raises the chunk from 2 trees to the kernel's
+    own VMEM tree cap, which is what lets the tree-batched histogram
+    kernel amortize its fixed per-row-stream work (the measured ~90% of
+    kernel time; ops/hist_pallas.py). The chunk is additionally capped
+    at one kernel tree-batch so each grow level is exactly one batched
+    kernel call."""
     width = 1 << (depth if leaf_onehot else depth - 1)
-    per_tree = 4 * n_rows * width * trees_per_unit
-    return max(1, min(cap, _CHUNK_BYTES_BUDGET // max(per_tree, 1)))
+    rows_eff = min(n_rows, _ROUTE_BLOCK) if streaming else n_rows
+    per_tree = 4 * rows_eff * width * trees_per_unit
+    chunk = max(1, min(cap, _CHUNK_BYTES_BUDGET // max(per_tree, 1)))
+    if streaming:
+        from ate_replication_causalml_tpu.ops.hist_pallas import batched_tree_cap
+
+        # Largest per-level histogram either streaming engine requests:
+        # both sibling-subtract (left children only), so the deepest
+        # kernel call covers 2^(depth-2) nodes.
+        kernel_nodes = 1 << max(0, depth - 2)
+        chunk = min(
+            chunk,
+            max(1, batched_tree_cap(kernel_nodes, kernel_weights, p=p,
+                                    n_bins=n_bins) // trees_per_unit),
+        )
+    return chunk
 
 
 class ForestPredictions(NamedTuple):
@@ -332,15 +435,20 @@ def fit_forest_classifier(
     n, p = x.shape
     if mtry is None:
         mtry = max(1, int(np.sqrt(p)))
-    # (n_bins ≤ 256 is enforced at the binarize() chokepoint.)
-    # Explicit chunks are clamped too: the per-level routing one-hot is
-    # (rows, 2^(depth−1)) per vmapped tree.
-    auto_chunk = auto_tree_chunk(n, depth, cap=32)
-    tree_chunk = auto_chunk if tree_chunk is None else min(tree_chunk, auto_chunk)
     y01 = _is_binary01(y)
     hist_backend = resolve_hist_backend(
         hist_backend, n_rows=n, n_bins=n_bins, integer_weights=y01
     )
+    # (n_bins ≤ 256 is enforced at the binarize() chokepoint.)
+    # Explicit chunks are clamped too: the per-level routing one-hot is
+    # (rows, 2^(depth−1)) per vmapped tree — or one row block of it on
+    # the streaming (Pallas) backends, where routing is row-blocked and
+    # the chunk instead matches the kernel's tree-batch cap.
+    auto_chunk = auto_tree_chunk(
+        n, depth, cap=32, streaming=hist_backend.startswith("pallas"),
+        p=p, n_bins=n_bins,
+    )
+    tree_chunk = auto_chunk if tree_chunk is None else min(tree_chunk, auto_chunk)
     edges = quantile_bins(x, n_bins)
     codes = binarize(x, edges)  # (n, p) int32
     xb_onehot = bin_onehot(codes, n_bins) if hist_backend == "onehot" else None
@@ -465,23 +573,24 @@ def _grow_chunk(tree_keys, codes, yf, xb_onehot, *, depth, mtry, n_bins, hist_ba
                 yl * yl / jnp.maximum(cl, eps) + yr * yr / jnp.maximum(cr, eps)
             )
             score = jnp.where((cl > 0) & (cr > 0), score, jnp.inf)
-
-            feat_scores = jax.random.uniform(lk, (level_nodes, p))
-            kth = jnp.sort(feat_scores, axis=1)[:, mtry - 1 : mtry]
-            score = jnp.where((feat_scores <= kth)[:, :, None], score, jnp.inf)
-
-            flat = score.reshape(level_nodes, p * n_bins)
-            best = jnp.argmin(flat, axis=1)
-            has_split = jnp.isfinite(jnp.min(flat, axis=1))
-            best_feat = jnp.where(has_split, (best // n_bins).astype(jnp.int32), 0)
-            best_bin = jnp.where(
-                has_split, (best % n_bins).astype(jnp.int32), n_bins - 1
+            best_feat, best_bin = select_split(
+                score, lk, level_nodes, p, n_bins, mtry
             )
 
-            node_oh = jax.nn.one_hot(node_of_row, level_nodes, dtype=jnp.float32)
-            node_of_row = route_rows(
-                node_oh, best_feat, best_bin, codes.astype(jnp.float32), node_of_row
-            )
+            if hist_backend.startswith("pallas"):
+                # Row-blocked routing: no (rows, M) one-hot in HBM, so
+                # the tree chunk can be the kernel's batch width.
+                node_of_row = route_rows_blocked(
+                    node_of_row, best_feat, best_bin, codes
+                )
+            else:
+                node_oh = jax.nn.one_hot(
+                    node_of_row, level_nodes, dtype=jnp.float32
+                )
+                node_of_row = route_rows(
+                    node_oh, best_feat, best_bin, codes.astype(jnp.float32),
+                    node_of_row,
+                )
             return (node_of_row, hist), (best_feat, best_bin)
 
         # Levels are unrolled as a Python loop so level l only computes
@@ -750,7 +859,10 @@ def fit_forest_sharded(
     )
     axis_size = mesh.shape[axis_name]
     per_dev_total = -(-n_trees // axis_size)
-    tree_chunk, chunks_per_disp, n_disp = plan_tree_dispatch(n, depth, per_dev_total)
+    tree_chunk, chunks_per_disp, n_disp = plan_tree_dispatch(
+        n, depth, per_dev_total, streaming=hist_backend.startswith("pallas"),
+        p=p, n_bins=n_bins,
+    )
     per_disp_dev = chunks_per_disp * tree_chunk
 
     edges = quantile_bins(x, n_bins)

@@ -72,6 +72,7 @@ def resolve_hist_backend(
     n_rows: int | None = None,
     n_bins: int | None = None,
     integer_weights: bool = False,
+    allow_lossy_bf16: bool = False,
 ) -> str:
     """The single place the 'auto' policy lives.
 
@@ -93,7 +94,14 @@ def resolve_hist_backend(
     with y ∈ {0,1}) — there the bf16 kernel is bit-exact and the fastest
     backend everywhere past the crossover (see table), so 'auto'
     upgrades the kernel pick to ``pallas_bf16``. The caller owns the
-    declaration; it is asserted nowhere on the device path."""
+    declaration; it is asserted nowhere on the device path.
+
+    ``allow_lossy_bf16=True`` upgrades to the bf16 kernel even for
+    FLOAT weights: inputs are rounded to bf16 (≤0.4% relative) before
+    exact f32 accumulation. Only the causal grower opts in (its
+    split-selection statistics tolerate input rounding far coarser than
+    its own quantile binning — see grow_one_streaming), and only for
+    ``backend="auto"``; an explicit ``"pallas"`` always stays f32."""
     if backend == "auto":
         if jax.default_backend() == "tpu":
             if (
@@ -102,10 +110,32 @@ def resolve_hist_backend(
                 and n_bins is not None
                 and n_bins <= _LANES
             ):
-                return "pallas_bf16" if integer_weights else "pallas"
+                if integer_weights or allow_lossy_bf16:
+                    return "pallas_bf16"
+                return "pallas"
             return "xla"
         return "onehot" if allow_onehot else "xla"
     return backend
+
+
+def _build_bin_oh(codes_ref, bw, f_pb, n_bins, in_dtype):
+    """Tile-local bin one-hot, (TILE, bw·LANES): one 128-lane block per
+    ``f_pb`` features, concatenated along lanes. Each feature is
+    compared only against its own block's 128 lanes — ~10× less VPU
+    compare work at the GGL shape than full-width compares — and each
+    block's lane iota is local, so the compare constant is just
+    code + f·n_bins < 128. Shared by both kernels (they must stay
+    bit-identical; tests assert it)."""
+    tile = codes_ref.shape[1]
+    lane_iota = lax.broadcasted_iota(jnp.int32, (tile, _LANES), 1)
+    pieces = []
+    for g in range(bw):
+        oh_g = jnp.zeros((tile, _LANES), in_dtype)
+        for f in range(f_pb):  # static unroll — f_pb = LANES // n_bins
+            flat = codes_ref[0, :, g * f_pb + f : g * f_pb + f + 1] + f * n_bins
+            oh_g = oh_g + (lane_iota == flat).astype(in_dtype)
+        pieces.append(oh_g)
+    return pieces[0] if bw == 1 else jnp.concatenate(pieces, axis=1)
 
 
 def _hist_kernel(codes_ref, node_ref, w_ref, out_ref, *, n_weights, max_nodes,
@@ -129,20 +159,7 @@ def _hist_kernel(codes_ref, node_ref, w_ref, out_ref, *, n_weights, max_nodes,
     def _zero():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    # Bin one-hot per 128-lane block, concatenated along lanes. Each
-    # feature is compared only against its own block's 128 lanes —
-    # pb_pad/LANES (~10× at the GGL shape) less VPU compare work than
-    # v1's full-width compares — and each block's lane iota is local, so
-    # the compare constant is just code + f·n_bins < 128.
-    lane_iota = lax.broadcasted_iota(jnp.int32, (tile, _LANES), 1)
-    pieces = []
-    for g in range(bw):
-        oh_g = jnp.zeros((tile, _LANES), in_dtype)
-        for f in range(f_pb):  # static unroll — f_pb = LANES // n_bins
-            flat = codes_ref[0, :, g * f_pb + f : g * f_pb + f + 1] + f * n_bins
-            oh_g = oh_g + (lane_iota == flat).astype(in_dtype)
-        pieces.append(oh_g)
-    bin_oh = pieces[0] if bw == 1 else jnp.concatenate(pieces, axis=1)
+    bin_oh = _build_bin_oh(codes_ref, bw, f_pb, n_bins, in_dtype)
 
     # Node one-hot: (TILE, max_nodes). Padded rows carry node=-1 → all 0,
     # which also kills the padded rows' garbage bin one-hot.
@@ -160,6 +177,66 @@ def _hist_kernel(codes_ref, node_ref, w_ref, out_ref, *, n_weights, max_nodes,
         lhs,
         bin_oh,
         dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _hist_kernel_batched(codes_ref, node_ref, w_ref, out_ref, *, n_weights,
+                         n_trees, max_nodes, bw, f_pb, n_bins, in_dtype):
+    """One grid step of the TREE-BATCHED kernel: fold one row tile into
+    one feature group's histograms for ``n_trees`` trees at once.
+
+    Motivation (round-3 on-chip ablation, scripts/profile_grow.py): at
+    1M rows the per-level kernel cost is ~90% LEVEL-INVARIANT fixed work
+    — the bin one-hot VPU build, the codes DMA, and per-grid-step
+    overheads — not the MXU matmul (a level-0 single-node histogram
+    measured ~21 ms vs ~0.2 ms of matmul FLOPs; bf16's 4× MXU peak moved
+    the total ~2%). Trees in a grow chunk share ``codes``, so batching
+    them into one kernel call amortizes ALL of that fixed work T-fold:
+    bin_oh is built once per tile and contracted against every tree's
+    weighted node one-hots in a single MXU dot.
+
+    Layout notes vs the unbatched kernel: nodes arrive as (tile, T) and
+    weights as (tile, T·K) blocks — row-tile on the SUBLANE axis — so
+    per-tree column slices are natural (tile, 1) strips; the unbatched
+    kernel's (K, tile) weight block needed a lane→sublane relayout every
+    step.
+
+    codes_ref: (1, TILE, bw·f_pb) int32 — this group's features only
+    node_ref:  (T, TILE)  int32         — node id per (tree, row); pad -1
+    w_ref:     (T·K, TILE) f32          — weights, tree-major; pad 0
+    out_ref:   (1, T·K·max_nodes, bw·LANES) f32
+    """
+    @pl.when(pl.program_id(1) == 0)
+    def _zero():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    tile = codes_ref.shape[1]
+    bin_oh = _build_bin_oh(codes_ref, bw, f_pb, n_bins, in_dtype)
+
+    # TRANSPOSED lhs build: the weighted node one-hots live (nodes, TILE)
+    # — rows on the LANE axis — so each tree's node-id strip and each
+    # weight vector is a natural (1, TILE) sublane slice broadcast DOWN
+    # sublanes (cheap replication), never a single-lane slice broadcast
+    # ACROSS 128 lanes (a Mosaic relayout per (tree, channel) per step —
+    # measured as the dominant dtype-insensitive kernel cost at 1M rows).
+    # The dot contracts lhsᵀ's lane axis against bin_oh's sublane axis —
+    # the natural A·B MXU form.
+    node_iota_t = lax.broadcasted_iota(jnp.int32, (max_nodes, tile), 0)
+    lhs_parts = []
+    for t in range(n_trees):  # static unroll — T is a chunk-sized constant
+        node_row = node_ref[t : t + 1, :]                       # (1, TILE)
+        node_oh_t = (node_row == node_iota_t).astype(in_dtype)  # (M, TILE)
+        for k in range(n_weights):
+            w_row = w_ref[t * n_weights + k : t * n_weights + k + 1, :]
+            lhs_parts.append(node_oh_t * w_row.astype(in_dtype))
+    lhs_t = (
+        lhs_parts[0] if len(lhs_parts) == 1 else jnp.concatenate(lhs_parts, axis=0)
+    )  # (T·K·max_nodes, TILE)
+    out_ref[0] += lax.dot_general(
+        lhs_t,
+        bin_oh,
+        dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
 
@@ -258,6 +335,255 @@ def bin_histogram_pallas(
     return out[:, :, :p, :]
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_nodes", "n_bins", "tile", "bw", "interpret", "bf16"),
+)
+def bin_histogram_pallas_batched(
+    codes: jax.Array,
+    node_of_row: jax.Array,
+    weights: jax.Array,
+    *,
+    max_nodes: int,
+    n_bins: int,
+    tile: int | None = None,
+    bw: int | None = None,
+    interpret: bool = False,
+    bf16: bool = False,
+) -> jax.Array:
+    """Tree-batched histograms: T trees sharing one ``codes`` stream.
+
+    Args:
+      codes: (n, p) int32 bin codes in [0, n_bins); n_bins ≤ 128.
+      node_of_row: (T, n) int32 per-tree node ids; ids outside
+        [0, max_nodes) contribute nothing.
+      weights: (T, K, n) f32 per-tree weight vectors.
+
+    Returns:
+      (T, K, max_nodes, p, n_bins) f32 — bit-identical to T separate
+      :func:`bin_histogram_pallas` calls (same tile order, same per-
+      element f32 accumulation; asserted in tests/test_hist_pallas.py).
+
+    The batched grid does T× more MXU work per step but builds the bin
+    one-hot ONCE per row tile — the measured dominant cost at large n —
+    so per-tree cost drops by nearly the fixed-work share (ablation:
+    scripts/profile_grow.py). VMEM bounds T: the output block is
+    T·K·max_nodes × bw·128 f32 and the lhs operand tile × T·K·max_nodes;
+    callers size T via :func:`batched_tree_cap`.
+    """
+    n, p = codes.shape
+    n_trees, k_w = weights.shape[0], weights.shape[1]
+    if n_bins > _LANES:
+        raise ValueError(f"n_bins={n_bins} > {_LANES} unsupported")
+    f_pb = _LANES // n_bins
+    p_blocks = -(-p // f_pb)
+    if bw is None:
+        bw = p_blocks
+    bw = min(bw, p_blocks)
+    p_groups = -(-p_blocks // bw)
+    p_pad = p_groups * bw * f_pb
+    if tile is None:
+        # Fixed 2048 rows per grid step. Larger tiles (4096-16384) were
+        # tried to amortize per-step costs further, but Mosaic's compile
+        # of the unrolled compare/concat body stalls for minutes at
+        # those widths on the remote compile service (measured twice,
+        # round 3) — the tree batching is where the amortization comes
+        # from, not the tile.
+        tile = 2048
+    n_pad = _round_up(max(n, tile), tile)
+
+    codes = jnp.pad(codes, ((0, n_pad - n), (0, p_pad - p)))
+    codes_b = codes.reshape(n_pad, p_groups, bw * f_pb).transpose(1, 0, 2)
+    # Lane-major row layouts: node (T, n), weights (T·K, n) — rows on
+    # lanes, so the kernel's per-tree strips are sublane slices.
+    node_tn = jnp.pad(
+        node_of_row.astype(jnp.int32), ((0, 0), (0, n_pad - n)),
+        constant_values=-1,
+    )
+    w_tkn = jnp.pad(
+        weights.astype(jnp.float32).reshape(n_trees * k_w, n),
+        ((0, 0), (0, n_pad - n)),
+    )
+
+    grid = (p_groups, n_pad // tile)
+    out = pl.pallas_call(
+        functools.partial(
+            _hist_kernel_batched, n_weights=k_w, n_trees=n_trees,
+            max_nodes=max_nodes, bw=bw, f_pb=f_pb, n_bins=n_bins,
+            in_dtype=jnp.bfloat16 if bf16 else jnp.float32,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, tile, bw * f_pb), lambda j, i: (j, i, 0)),
+            pl.BlockSpec((n_trees, tile), lambda j, i: (0, i)),
+            pl.BlockSpec((n_trees * k_w, tile), lambda j, i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, n_trees * k_w * max_nodes, bw * _LANES), lambda j, i: (j, 0, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (p_groups, n_trees * k_w * max_nodes, bw * _LANES), jnp.float32
+        ),
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_BUDGET),
+    )(codes_b, node_tn, w_tkn)
+    out = out.reshape(p_groups, n_trees * k_w * max_nodes, bw, _LANES)[
+        ..., : f_pb * n_bins
+    ]
+    out = out.transpose(1, 0, 2, 3).reshape(
+        n_trees, k_w, max_nodes, p_pad, n_bins
+    )
+    return out[:, :, :, :p, :]
+
+
+def kernel_lanes(p: int, n_bins: int) -> int:
+    """Lane width of the kernel's histogram block: feature blocks of
+    ``LANES // n_bins`` features, each 128 lanes (1408 at the GGL shape
+    p=21, 64 bins)."""
+    f_pb = max(1, _LANES // n_bins)
+    return -(-p // f_pb) * _LANES
+
+
+def batched_tree_cap(max_nodes: int, n_weights: int, tile: int = 2048,
+                     p: int = 21, n_bins: int = 64) -> int:
+    """Largest tree batch T whose kernel working set fits the scoped-VMEM
+    budget: out block (T·K·M, lanes) f32 + lhs (tile, T·K·M) f32 + bin
+    one-hot (tile, lanes), with ~2× headroom for Mosaic temps. ``p`` and
+    ``n_bins`` size the lane axis — the default is the GGL shape; pass
+    the real values for wider feature sets or the estimate undercounts
+    VMEM."""
+    lanes = kernel_lanes(p, n_bins)
+    per_tree = 4 * n_weights * max_nodes * (lanes + tile)
+    fixed = 4 * tile * lanes
+    return max(1, (_VMEM_BUDGET // 2 - fixed) // max(per_tree, 1))
+
+
+@functools.lru_cache(maxsize=None)
+def _pallas_batched_vmappable(max_nodes: int, n_bins: int, bf16: bool,
+                              interpret: bool):
+    """The tree-batched kernel as a `custom_vmap` callable.
+
+    The forest growers call :func:`bin_histogram` per tree under
+    ``jax.vmap`` (and the causal grower under TWO nested vmaps: groups ×
+    little-bag trees). A plain vmap of ``pallas_call`` prepends a grid
+    dimension — every tree re-streams codes and rebuilds the bin one-hot,
+    which the round-3 ablation measured as ~90% of kernel time at 1M
+    rows. This wrapper gives vmap a custom rule instead: each vmap level
+    FLATTENS its batch axis into the kernel's tree axis, so any nest of
+    vmaps collapses to one tree-batched kernel call (chunked to the
+    VMEM cap). Grower code stays untouched — the batching transform is
+    where the optimization lives.
+
+    When ``codes`` itself is batched (the causal grower's per-group
+    subsample gathers), streams can't be shared; the rule falls back to
+    a per-slice Python loop, preserving per-slice tree batching.
+    """
+    from jax import custom_batching
+
+    def impl(codes, node, weights):
+        t = node.shape[0]
+        cap = batched_tree_cap(
+            max_nodes, weights.shape[1], p=codes.shape[1], n_bins=n_bins
+        )
+        outs = [
+            bin_histogram_pallas_batched(
+                codes, node[s : s + cap], weights[s : s + cap],
+                max_nodes=max_nodes, n_bins=n_bins, bf16=bf16,
+                interpret=interpret,
+            )
+            for s in range(0, t, cap)
+        ]
+        return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
+
+    @custom_batching.custom_vmap
+    def g(codes, node, weights):
+        return impl(codes, node, weights)
+
+    @g.def_vmap
+    def _rule(axis_size, in_batched, codes, node, weights):  # noqa: ANN001
+        codes_b, node_b, w_b = in_batched
+        if codes_b:
+            out = jnp.stack([
+                g(
+                    codes[i],
+                    node[i] if node_b else node,
+                    weights[i] if w_b else weights,
+                )
+                for i in range(axis_size)
+            ])
+            return out, True
+        if not node_b:
+            node = jnp.broadcast_to(node[None], (axis_size,) + node.shape)
+        if not w_b:
+            weights = jnp.broadcast_to(weights[None], (axis_size,) + weights.shape)
+        b, t = node.shape[0], node.shape[1]
+        out = g(
+            codes,
+            node.reshape(b * t, node.shape[2]),
+            weights.reshape(b * t, weights.shape[2], weights.shape[3]),
+        )
+        return out.reshape((b, t) + out.shape[1:]), True
+
+    return g
+
+
+def bin_histogram_batched(
+    codes: jax.Array,
+    node_of_row: jax.Array,
+    weights: jax.Array,
+    *,
+    max_nodes: int,
+    n_bins: int,
+    backend: str = "auto",
+) -> jax.Array:
+    """Tree-batched dispatch with the same contract as :func:`bin_histogram`
+    lifted over a leading tree axis: node_of_row (T, n), weights
+    (T, K, n) → (T, K, max_nodes, p, n_bins)."""
+    backend = resolve_hist_backend(
+        backend, allow_onehot=False, n_rows=codes.shape[0], n_bins=n_bins
+    )
+    if backend in ("pallas", "pallas_bf16", "pallas_interpret"):
+        g = _pallas_batched_vmappable(
+            max_nodes, n_bins, backend == "pallas_bf16",
+            backend == "pallas_interpret",
+        )
+        return g(codes, node_of_row, weights)
+    if backend == "xla":
+        return jax.vmap(
+            lambda ids, w: bin_histogram_xla(
+                codes, ids, w, max_nodes=max_nodes, n_bins=n_bins
+            )
+        )(node_of_row, weights)
+    raise ValueError(f"unknown histogram backend {backend!r}")
+
+
+def node_sums(
+    ids: jax.Array,
+    weights: jax.Array,
+    num_nodes: int,
+    backend: str = "auto",
+) -> jax.Array:
+    """Per-node weighted sums, (num_nodes, K): the degenerate histogram
+    with one constant feature. On the streaming backends this reuses the
+    batched kernel (codes ≡ 0, n_bins = 128 → a single lane block and
+    ONE iota compare per tile), so node reductions — honest-leaf
+    payloads, per-level moments — need no (rows, nodes) one-hot in HBM
+    and no serialized segment_sum. Vmapping over trees batches through
+    the kernel's tree axis like every other dispatch."""
+    n = ids.shape[0]
+    backend = resolve_hist_backend(backend, allow_onehot=False, n_rows=n,
+                                   n_bins=128)
+    if backend.startswith("pallas"):
+        codes0 = jnp.zeros((n, 1), jnp.int32)
+        h = bin_histogram(
+            codes0, ids, weights, max_nodes=num_nodes, n_bins=128,
+            backend=backend,
+        )  # (K, M, 1, 128); only bin 0 is populated
+        return h[:, :, 0, 0].T
+    oh = jax.nn.one_hot(ids, num_nodes, dtype=jnp.float32)
+    return jnp.matmul(oh.T, weights.T)  # (M, K)
+
+
 @functools.partial(jax.jit, static_argnames=("max_nodes", "n_bins", "row_chunk"))
 def bin_histogram_xla(
     codes: jax.Array,
@@ -328,20 +654,16 @@ def bin_histogram(
     ``hist_backend`` argument.
     """
     backend = resolve_hist_backend(backend, allow_onehot=False)
-    if backend == "pallas":
-        return bin_histogram_pallas(
-            codes, node_of_row, weights, max_nodes=max_nodes, n_bins=n_bins
+    if backend in ("pallas", "pallas_bf16", "pallas_interpret"):
+        # Through the custom_vmap wrapper: callers vmap this per tree
+        # (nested vmaps in the causal grower), and the rule collapses
+        # every vmap level into the kernel's tree axis — one tree-batched
+        # kernel call per grow level instead of a per-tree grid sweep.
+        g = _pallas_batched_vmappable(
+            max_nodes, n_bins, backend == "pallas_bf16",
+            backend == "pallas_interpret",
         )
-    if backend == "pallas_bf16":
-        return bin_histogram_pallas(
-            codes, node_of_row, weights, max_nodes=max_nodes, n_bins=n_bins,
-            bf16=True,
-        )
-    if backend == "pallas_interpret":
-        return bin_histogram_pallas(
-            codes, node_of_row, weights, max_nodes=max_nodes, n_bins=n_bins,
-            interpret=True,
-        )
+        return g(codes, node_of_row[None], weights[None])[0]
     if backend == "xla":
         return bin_histogram_xla(
             codes, node_of_row, weights, max_nodes=max_nodes, n_bins=n_bins

@@ -15,19 +15,30 @@ import warnings
 
 
 def _host_tag() -> str:
-    """Short host-CPU fingerprint. XLA:CPU AOT cache entries embed the
+    """Short host fingerprint. XLA:CPU AOT cache entries embed the
     COMPILE machine's feature set; loading one produced in a container
     with different CPU flags SIGILLs/segfaults (observed in the test
-    suite). Keying the cache dir by the host's flags makes stale
-    cross-machine entries unreachable instead of fatal."""
+    suite). The feature set XLA embeds also includes jaxlib-version-
+    dependent tuning flags (e.g. ``+prefer-no-gather``) that /proc/
+    cpuinfo can't see — a jaxlib upgrade made same-host entries fatal
+    in round 3 — so the tag keys on the jax/jaxlib versions too."""
     import hashlib
     import platform
+
+    import jax
 
     try:
         with open("/proc/cpuinfo") as f:
             sig = next(l for l in f if l.startswith("flags"))
     except (OSError, StopIteration):
         sig = platform.processor() or platform.machine()
+    try:
+        import jaxlib
+
+        sig += jaxlib.__version__
+    except Exception:  # noqa: BLE001 — version probe only
+        pass
+    sig += jax.__version__
     return hashlib.sha1(sig.encode()).hexdigest()[:10]
 
 
@@ -54,9 +65,19 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
     """Point JAX's persistent compilation cache at ``cache_dir``
     (default: repo-local when writable, else ``~/.cache/...``;
     overridable via ``ATE_COMPILE_CACHE``). Returns the dir, or None if
-    configuration failed — with a visible warning, never silently."""
+    configuration failed — with a visible warning, never silently.
+
+    ``ATE_NO_COMPILE_CACHE=1`` makes this a no-op: the CPU backend's
+    cache (de)serializer segfaults on this image's jaxlib late in long
+    processes (round 3 — crashes in put_/get_executable_and_time, and a
+    crashed write leaves a truncated entry that crashes the next read).
+    The test suite sets the kill switch so library imports (rbridge,
+    pipeline) can't re-enable the cache mid-suite; TPU entry points keep
+    it (only the XLA:CPU serializer has misbehaved)."""
     import jax
 
+    if os.environ.get("ATE_NO_COMPILE_CACHE") == "1":
+        return None
     cache_dir = cache_dir or _default_cache_dir()
     try:
         existing = jax.config.jax_compilation_cache_dir

@@ -1,0 +1,291 @@
+"""On-chip stage ablation for the 1M-row forest grow (round-3 perf work).
+
+Per NEXT.md "Hardware lessons": per-op microbenchmarks are invalid over
+the tunnel (~80 ms per dispatched executable), so every stage is timed
+as a jitted lax.fori_loop of R repeats inside ONE dispatch, synced with
+float(...). A tiny carry-dependent perturbation keeps XLA from hoisting
+the loop body.
+
+Stages (classifier shape: n rows, depth 9, p=21, 64 bins, K=2 weights):
+  hist[l]   — the Pallas histogram kernel at level l (left-children ids)
+  route[l]  — node one-hot + route_rows at level l
+  score[l]  — cumsum + criterion + argmin at level l (expected trivial)
+  leaf      — depth-9 segment_sum leaf stats
+  full      — the real _grow_chunk, per tree, for cross-checking
+
+Usage: python scripts/profile_grow.py [--rows 1000000] [--trees 8]
+"""
+
+import argparse
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ate_replication_causalml_tpu.utils.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+from ate_replication_causalml_tpu.models.forest import (  # noqa: E402
+    _grow_chunk,
+    binarize,
+    quantile_bins,
+    route_rows,
+)
+from ate_replication_causalml_tpu.ops.bootstrap import _poisson1_counts  # noqa: E402
+from ate_replication_causalml_tpu.ops.hist_pallas import (  # noqa: E402
+    bin_histogram_pallas,
+)
+
+R = 8  # repeats inside one dispatch
+
+
+def timed(fn, *args):
+    out = fn(*args)
+    _ = float(jax.tree_util.tree_leaves(out)[0].ravel()[0])  # compile+sync
+    t0 = time.perf_counter()
+    out = fn(*args)
+    _ = float(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+    return (time.perf_counter() - t0) / R
+
+
+def grow_no_hist(args):
+    """The classifier grow loop with the histogram stage replaced by a
+    fake derived from per-node counts only — measures everything ELSE
+    (route, score, leaf stats, RNG) at the real vmap width."""
+    import functools
+
+    from ate_replication_causalml_tpu.models.forest import (
+        auto_tree_chunk,
+        binarize,
+        quantile_bins,
+        route_rows_blocked,
+    )
+    from ate_replication_causalml_tpu.ops.bootstrap import _poisson1_counts
+
+    n, p, n_bins, depth = args.rows, 21, 64, args.depth
+    kx, ky = jax.random.split(jax.random.key(0))
+    x = jax.random.normal(kx, (n, p), dtype=jnp.float32)
+    y = (jax.random.uniform(ky, (n,)) < 0.4).astype(jnp.float32)
+    edges = quantile_bins(x, n_bins)
+    codes = binarize(x, edges)
+    tc = min(args.trees, auto_tree_chunk(n, depth, cap=32, streaming=True))
+
+    @functools.partial(jax.jit, static_argnames=())
+    def grow(keys):
+        def one(tree_key):
+            ck, gk = jax.random.split(tree_key)
+            counts = _poisson1_counts(ck, (n,))
+            level_keys = jax.random.split(gk, depth)
+            ids = jnp.zeros(n, jnp.int32)
+            feats_l = []
+            for level in range(depth):
+                m = 1 << level
+                # FAKE hist: constant per (node,feat,bin) from count sum —
+                # keeps shapes + scoring live without the kernel.
+                tot = counts.sum()
+                hist = jnp.broadcast_to(
+                    tot / (m * p * n_bins), (2, m, p, n_bins)
+                )
+                cl = jnp.cumsum(hist[0], axis=2)
+                yl = jnp.cumsum(hist[1], axis=2)
+                ct, yt2 = cl[:, :, -1:], yl[:, :, -1:]
+                score = -(yl * yl / jnp.maximum(cl, 1e-12)
+                          + (yt2 - yl) ** 2 / jnp.maximum(ct - cl, 1e-12))
+                fs = jax.random.uniform(level_keys[level], (m, p))
+                kth = jnp.sort(fs, axis=1)[:, 3:4]
+                score = jnp.where((fs <= kth)[:, :, None], score, jnp.inf)
+                flat = score.reshape(m, p * n_bins)
+                best = jnp.argmin(flat, axis=1)
+                bf = (best // n_bins).astype(jnp.int32)
+                bb = (best % n_bins).astype(jnp.int32)
+                feats_l.append(bf)
+                ids = route_rows_blocked(ids, bf, bb, codes)
+            leaf_c = jax.ops.segment_sum(counts, ids, num_segments=1 << depth)
+            return leaf_c.sum() + sum(f.sum() for f in feats_l)
+
+        return jax.vmap(one)(keys).sum()
+
+    keys = jax.random.split(jax.random.key(7), tc)
+    _ = float(grow(keys))
+    t0 = time.perf_counter()
+    _ = float(grow(keys))
+    dt = (time.perf_counter() - t0) / tc
+    print(f"no-hist grow: {dt * 1e3:8.2f} ms/tree (chunk of {tc}, "
+          f"rows={n} depth={depth})", file=sys.stderr)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--depth", type=int, default=9)
+    ap.add_argument("--trees", type=int, default=8)
+    ap.add_argument("--bf16", action="store_true")
+    ap.add_argument("--no-hist", action="store_true")
+    args = ap.parse_args()
+    if args.no_hist:
+        return grow_no_hist(args)
+    n, p, n_bins = args.rows, 21, 64
+    depth = args.depth
+
+    key = jax.random.key(0)
+    kx, ky, kc = jax.random.split(key, 3)
+    x = jax.random.normal(kx, (n, p), dtype=jnp.float32)
+    y = (jax.random.uniform(ky, (n,)) < 0.4).astype(jnp.float32)
+    edges = quantile_bins(x, n_bins)
+    codes = binarize(x, edges)
+    codes_f = codes.astype(jnp.float32)
+    counts = _poisson1_counts(kc, (n,))
+    weights = jnp.stack([counts, counts * y])
+
+    # Realistic per-level node ids: uniform over the level's nodes.
+    node_ids = {
+        l: jax.random.randint(jax.random.key(l + 1), (n,), 0, 1 << l, jnp.int32)
+        for l in range(depth)
+    }
+
+    def rep(body):
+        """Run body R times inside one jit; carry-perturbed against LICM."""
+
+        @jax.jit
+        def go(*a):
+            def it(i, acc):
+                return acc + body(acc * 1e-30, *a)
+
+            return lax.fori_loop(0, R, it, jnp.zeros((), jnp.float32))
+
+        return go
+
+    print(f"# rows={n} depth={depth} p={p} bins={n_bins} "
+          f"bf16={args.bf16} R={R}", file=sys.stderr)
+
+    # --- hist per level (left-children semantics past root: half nodes)
+    hist_ms = []
+    for l in range(depth):
+        m = max(1, (1 << l) // 2) if l > 0 else 1
+        ids = jnp.where(node_ids[l] % 2 == 0, node_ids[l] // 2, -1) if l else node_ids[l]
+
+        def body(eps, ids, w):
+            h = bin_histogram_pallas(
+                codes, ids, w + eps, max_nodes=m, n_bins=n_bins, bf16=args.bf16
+            )
+            return h.ravel()[0]
+
+        t = timed(rep(body), ids, weights)
+        hist_ms.append(t * 1e3)
+        print(f"hist  level {l} (m={m:3d}): {t * 1e3:8.2f} ms", file=sys.stderr)
+
+    # --- route per level
+    route_ms = []
+    for l in range(depth):
+        m = 1 << l
+        bf = jax.random.randint(jax.random.key(100 + l), (m,), 0, p, jnp.int32)
+        bb = jax.random.randint(jax.random.key(200 + l), (m,), 0, n_bins, jnp.int32)
+
+        def body(eps, ids, bf, bb):
+            oh = jax.nn.one_hot(ids, m, dtype=jnp.float32)
+            nxt = route_rows(oh + eps, bf, bb, codes_f, ids)
+            return nxt.sum().astype(jnp.float32)
+
+        t = timed(rep(body), node_ids[l], bf, bb)
+        route_ms.append(t * 1e3)
+        print(f"route level {l} (m={m:3d}): {t * 1e3:8.2f} ms", file=sys.stderr)
+
+    # --- score per level (cumsum + criterion + argmin on (m, p, bins))
+    score_ms = []
+    for l in range(depth):
+        m = 1 << l
+        h = jax.random.uniform(jax.random.key(300 + l), (2, m, p, n_bins))
+
+        def body(eps, h):
+            hc, hy = h[0] + eps, h[1]
+            cl = jnp.cumsum(hc, axis=2)
+            ylc = jnp.cumsum(hy, axis=2)
+            ct, yt = cl[:, :, -1:], ylc[:, :, -1:]
+            cr, yr = ct - cl, yt - ylc
+            sc = -(ylc * ylc / jnp.maximum(cl, 1e-12) + yr * yr / jnp.maximum(cr, 1e-12))
+            flat = sc.reshape(m, p * n_bins)
+            return jnp.argmin(flat, axis=1).sum().astype(jnp.float32)
+
+        t = timed(rep(body), h)
+        score_ms.append(t * 1e3)
+        print(f"score level {l} (m={m:3d}): {t * 1e3:8.2f} ms", file=sys.stderr)
+
+    # --- causal-grow extras: per-level moments + broadcast (the node
+    # one-hot matmuls of _grow_cf_chunk) and the honest-leaf payload.
+    wt = jax.random.normal(jax.random.key(401), (n,)) * 0.4
+    yt = jax.random.normal(jax.random.key(402), (n,))
+    mom = jnp.stack([jnp.ones_like(wt), wt, yt, wt * wt, wt * yt], axis=1)
+    mo_ms = []
+    for l in range(depth):
+        m = 1 << l
+
+        def body(eps, ids, mom):
+            oh = jax.nn.one_hot(ids, m, dtype=jnp.float32) + eps
+            node_mom = jnp.matmul(oh.T, mom)                 # (m, 5)
+            back = jnp.matmul(oh, node_mom[:, 1:4])          # (rows, 3)
+            return back.ravel()[0] + node_mom.ravel()[0]
+
+        t = timed(rep(body), node_ids[l], mom)
+        mo_ms.append(t * 1e3)
+        print(f"moment level {l} (m={m:3d}): {t * 1e3:8.2f} ms", file=sys.stderr)
+
+    def payload_body(eps, ids, mom):
+        oh = jax.nn.one_hot(ids, 1 << depth, dtype=jnp.float32) + eps
+        return jnp.matmul(oh.T, mom).ravel()[0]
+
+    ids_pay = jax.random.randint(jax.random.key(998), (n,), 0, 1 << depth, jnp.int32)
+    t_pay = timed(rep(payload_body), ids_pay, mom)
+    print(f"leaf payload onehot (m={1 << depth}): {t_pay * 1e3:8.2f} ms",
+          file=sys.stderr)
+    print(f"# causal extras ms/tree: moments={sum(mo_ms):.1f} "
+          f"payload={t_pay * 1e3:.1f}", file=sys.stderr)
+
+    # --- leaf segment_sum at depth
+    ids_leaf = jax.random.randint(jax.random.key(999), (n,), 0, 1 << depth, jnp.int32)
+
+    def leaf_body(eps, ids, c):
+        s = jax.ops.segment_sum(c + eps, ids, num_segments=1 << depth)
+        return s.ravel()[0]
+
+    t_leaf = timed(rep(leaf_body), ids_leaf, counts)
+    print(f"leaf  segsum (m={1 << depth}): {t_leaf * 1e3:8.2f} ms", file=sys.stderr)
+
+    tot = sum(hist_ms) + sum(route_ms) + sum(score_ms) + t_leaf * 1e3
+    print(
+        f"# stage totals ms/tree: hist={sum(hist_ms):.1f} "
+        f"route={sum(route_ms):.1f} score={sum(score_ms):.1f} "
+        f"leaf={t_leaf * 1e3:.1f} sum={tot:.1f}",
+        file=sys.stderr,
+    )
+
+    # --- full real grow chunk for cross-check (vmap width respects the
+    # HBM budget: auto_tree_chunk; extra trees run as superchunks).
+    from ate_replication_causalml_tpu.models.forest import auto_tree_chunk
+
+    vw = min(args.trees, auto_tree_chunk(n, depth, cap=32))
+    tc = (args.trees // vw) * vw
+    keys = jax.random.split(jax.random.key(7), tc).reshape(tc // vw, vw)
+    backend = "pallas_bf16" if args.bf16 else "pallas"
+
+    def full():
+        out = _grow_chunk(
+            keys, codes, y, None, depth=depth, mtry=4, n_bins=n_bins,
+            hist_backend=backend, center=False,
+        )
+        return out
+
+    out = full()
+    _ = float(out[2].sum())
+    t0 = time.perf_counter()
+    out = full()
+    _ = float(out[2].sum())
+    t_full = (time.perf_counter() - t0) / tc
+    print(f"full grow chunk: {t_full * 1e3:8.2f} ms/tree (chunk of {tc})",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -102,6 +102,123 @@ def test_vmap_over_trees(case):
         np.testing.assert_allclose(np.asarray(got[t]), truth, rtol=0, atol=1e-4)
 
 
+def test_batched_kernel_matches_per_tree(case):
+    """bin_histogram_pallas_batched must be BIT-identical to T separate
+    per-tree kernel calls (same tile order, same f32 accumulation) and
+    match numpy truth — the grow chunks rely on this to keep goldens."""
+    from ate_replication_causalml_tpu.ops.hist_pallas import (
+        bin_histogram_batched,
+        bin_histogram_pallas_batched,
+    )
+
+    codes, node, weights, max_nodes, n_bins = case
+    rng = np.random.default_rng(2)
+    T = 3
+    nodes_t = np.stack(
+        [node] + [rng.integers(-1, max_nodes, node.shape[0]).astype(np.int32)
+                  for _ in range(T - 1)]
+    )
+    weights_t = np.stack(
+        [weights] + [rng.normal(size=weights.shape).astype(np.float32)
+                     for _ in range(T - 1)]
+    )
+    got = bin_histogram_pallas_batched(
+        jnp.asarray(codes), jnp.asarray(nodes_t), jnp.asarray(weights_t),
+        max_nodes=max_nodes, n_bins=n_bins, tile=256, interpret=True,
+    )
+    per_tree = jnp.stack([
+        bin_histogram_pallas(
+            jnp.asarray(codes), jnp.asarray(nodes_t[t]), jnp.asarray(weights_t[t]),
+            max_nodes=max_nodes, n_bins=n_bins, tile=256, interpret=True,
+        )
+        for t in range(T)
+    ])
+    # Float weights: identical up to f32 summation rounding (the CPU
+    # interpret backend may re-associate the wider batched matmul; on
+    # the MXU both shapes accumulate in the same systolic order).
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(per_tree), rtol=1e-6, atol=1e-5
+    )
+    # Integer weights: every partial sum is exact in f32 → BIT-identical
+    # regardless of association order.
+    w_int = jnp.asarray(np.abs(weights_t).round())
+    got_i = bin_histogram_pallas_batched(
+        jnp.asarray(codes), jnp.asarray(nodes_t), w_int,
+        max_nodes=max_nodes, n_bins=n_bins, tile=256, interpret=True,
+    )
+    per_i = jnp.stack([
+        bin_histogram_pallas(
+            jnp.asarray(codes), jnp.asarray(nodes_t[t]), w_int[t],
+            max_nodes=max_nodes, n_bins=n_bins, tile=256, interpret=True,
+        )
+        for t in range(T)
+    ])
+    assert jnp.array_equal(got_i, per_i)
+    for t in range(T):
+        truth = _numpy_hist(codes, nodes_t[t], weights_t[t], max_nodes, n_bins)
+        np.testing.assert_allclose(np.asarray(got[t]), truth, rtol=0, atol=1e-4)
+    # The dispatch wrapper's XLA path agrees too (used on CPU/test hosts).
+    got_xla = bin_histogram_batched(
+        jnp.asarray(codes), jnp.asarray(nodes_t), jnp.asarray(weights_t),
+        max_nodes=max_nodes, n_bins=n_bins, backend="xla",
+    )
+    np.testing.assert_allclose(np.asarray(got_xla), np.asarray(got), rtol=0, atol=1e-4)
+
+
+def test_custom_vmap_collapses_to_batched(case):
+    """vmap (and nested vmap) over the pallas dispatch must produce the
+    same numbers as per-tree calls — the rule flattens every vmap level
+    into the kernel's tree axis (the growers rely on this transform)."""
+    codes, node, weights, max_nodes, n_bins = case
+    rng = np.random.default_rng(3)
+    codes_j = jnp.asarray(codes)
+    nodes_t = jnp.asarray(
+        np.stack([node] + [rng.integers(0, max_nodes, node.shape[0]).astype(np.int32)
+                           for _ in range(3)])
+    )
+    weights_t = jnp.asarray(
+        rng.poisson(1.0, (4,) + weights.shape).astype(np.float32)
+    )
+
+    def one(nd, w):
+        return bin_histogram(
+            codes_j, nd, w, max_nodes=max_nodes, n_bins=n_bins,
+            backend="pallas_interpret",
+        )
+
+    got = jax.vmap(one)(nodes_t, weights_t)
+    want = jnp.stack([one(nodes_t[t], weights_t[t]) for t in range(4)])
+    assert jnp.array_equal(got, want)  # integer weights → exact
+
+    # Nested vmap (the causal grower: groups × little-bag trees).
+    nodes_g = nodes_t.reshape(2, 2, -1)
+    weights_g = weights_t.reshape(2, 2, *weights.shape)
+    got_nested = jax.vmap(jax.vmap(one))(nodes_g, weights_g)
+    assert jnp.array_equal(got_nested.reshape(got.shape), want)
+
+    # Batched codes (per-group gathers): falls back to per-slice loops.
+    codes_g = jnp.stack([codes_j, codes_j[::-1]])
+
+    def one_c(cd, nd, w):
+        return bin_histogram(
+            cd, nd, w, max_nodes=max_nodes, n_bins=n_bins,
+            backend="pallas_interpret",
+        )
+
+    got_c = jax.vmap(one_c)(codes_g, nodes_g[0], weights_g[0])
+    want_c = jnp.stack([one_c(codes_g[i], nodes_g[0, i], weights_g[0, i])
+                        for i in range(2)])
+    assert jnp.array_equal(got_c, want_c)
+
+
+def test_batched_tree_cap_positive():
+    from ate_replication_causalml_tpu.ops.hist_pallas import batched_tree_cap
+
+    assert batched_tree_cap(128, 2) >= 8   # classifier/causal deepest level
+    assert batched_tree_cap(256, 2) >= 4
+    assert batched_tree_cap(1 << 12, 2) >= 1  # degenerate: never zero
+
+
 def test_forest_identical_across_backends():
     """Same key → bit-identical splits and leaves whether the level
     histograms come from the Pallas kernel (interpret), the chunked-XLA
@@ -130,8 +247,18 @@ def test_forest_identical_across_backends():
         )
 
 
-def test_causal_forest_identical_across_backends():
-    from ate_replication_causalml_tpu.models.causal_forest import grow_causal_forest
+def test_causal_forest_equivalent_across_backends():
+    """The streaming (Pallas) causal grower uses the ρ-DECOMPOSED level
+    pipeline (5 level-invariant channels composed with per-node
+    coefficients — see grow_one_streaming) which is algebraically
+    identical to the direct onehot/xla formulation but not bit-identical:
+    f32 rounding can flip exact-tie splits. Contract: same keys → near-
+    total split agreement and matching honest leaf statistics wherever
+    the routing agrees."""
+    from ate_replication_causalml_tpu.models.causal_forest import (
+        grow_causal_forest,
+        predict_cate,
+    )
 
     rng = np.random.default_rng(4)
     n = 300
@@ -142,11 +269,62 @@ def test_causal_forest_identical_across_backends():
     kw = dict(n_trees=4, depth=4, n_bins=16, group_chunk=2)
     ref = grow_causal_forest(x, wt, yt, key, hist_backend="onehot", **kw)
     got = grow_causal_forest(x, wt, yt, key, hist_backend="pallas_interpret", **kw)
-    np.testing.assert_array_equal(np.asarray(got.split_feat), np.asarray(ref.split_feat))
-    np.testing.assert_array_equal(np.asarray(got.split_bin), np.asarray(ref.split_bin))
-    np.testing.assert_allclose(
-        np.asarray(got.leaf_stats), np.asarray(ref.leaf_stats), atol=1e-4
+    agree = np.mean(
+        (np.asarray(got.split_feat) == np.asarray(ref.split_feat))
+        & (np.asarray(got.split_bin) == np.asarray(ref.split_bin))
     )
+    assert agree >= 0.95, f"split agreement {agree:.3f}"
+    cate_ref = predict_cate(ref, x, oob=False).cate
+    cate_got = predict_cate(got, x, oob=False).cate
+    # Tie flips move a handful of rows between sibling leaves; the
+    # forest-level prediction must stay essentially the same.
+    err = float(jnp.abs(cate_got - cate_ref).mean())
+    scale = float(jnp.abs(cate_ref).mean()) + 1e-6
+    assert err / scale < 0.05, (err, scale)
+
+
+def test_rho_composition_matches_direct():
+    """Unit check of the streaming grower's algebra: Σ_cell gw·ρ composed
+    from the five channel histograms equals the directly-computed
+    ρ-weighted histogram (ρ from the same node's w̄, ȳ, τ)."""
+    from ate_replication_causalml_tpu.ops.hist_pallas import bin_histogram_xla
+
+    rng = np.random.default_rng(6)
+    n, p, n_bins, m = 2000, 4, 8, 4
+    codes = jnp.asarray(rng.integers(0, n_bins, (n, p)), jnp.int32)
+    ids = jnp.asarray(rng.integers(0, m, n), jnp.int32)
+    gw = jnp.asarray(rng.poisson(1.0, n), jnp.float32)
+    wt = jnp.asarray(rng.normal(size=n) * 0.5, jnp.float32)
+    yt = jnp.asarray(rng.normal(size=n), jnp.float32)
+
+    ch = jnp.stack([jnp.ones_like(wt), wt, yt, wt * wt, wt * yt]) * gw[None, :]
+    hist5 = bin_histogram_xla(codes, ids, ch, max_nodes=m, n_bins=n_bins)
+    tot = np.asarray(hist5[:, :, 0, :].sum(axis=2))  # (5, m)
+    c, sw, sy, sww, swy = tot
+    wbar = sw / np.maximum(c, 1.0)
+    ybar = sy / np.maximum(c, 1.0)
+    varw = c * sww - sw * sw
+    tau = np.where(varw > 1e-12, (c * swy - sw * sy) / np.maximum(varw, 1e-12), 0.0)
+
+    h = np.asarray(hist5)
+    bc = lambda v: v[:, None, None]
+    rho_hist_composed = (
+        h[4] - bc(wbar) * h[2] + bc(2 * tau * wbar - ybar) * h[1]
+        + bc(wbar * ybar - tau * wbar**2) * h[0] - bc(tau) * h[3]
+    )
+
+    # Direct: per-row ρ with each row's node coefficients.
+    ids_np = np.asarray(ids)
+    wc = np.asarray(wt) - wbar[ids_np]
+    yc = np.asarray(yt) - ybar[ids_np]
+    rho = wc * (yc - wc * tau[ids_np])
+    direct = np.asarray(
+        bin_histogram_xla(
+            codes, ids, jnp.asarray((np.asarray(gw) * rho)[None, :], jnp.float32),
+            max_nodes=m, n_bins=n_bins,
+        )
+    )[0]
+    np.testing.assert_allclose(rho_hist_composed, direct, rtol=2e-4, atol=2e-4)
 
 
 def test_resolve_backend_row_aware_policy(monkeypatch):
