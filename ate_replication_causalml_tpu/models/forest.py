@@ -45,6 +45,7 @@ from ate_replication_causalml_tpu.data.frame import CausalFrame
 from ate_replication_causalml_tpu.ops.bootstrap import _poisson1_counts
 from ate_replication_causalml_tpu.ops.hist_pallas import (
     bin_histogram,
+    node_sums,
     resolve_hist_backend,
 )
 from ate_replication_causalml_tpu.ops.linalg import _PREC
@@ -611,12 +612,27 @@ def _grow_chunk(tree_keys, codes, yf, xb_onehot, *, depth, mtry, n_bins, hist_ba
         bins = jnp.stack(bins_l)
 
         # Leaf stats at depth D (bootstrap-weighted), parent-filled where
-        # empty by falling back to the overall rate. segment_sum here,
-        # not the one-hot matmul used per level: at depth 9 the (n, 2^D)
-        # one-hot is ~100 MB per tree — gigabytes under the tree vmap —
-        # and this runs once per tree, not once per level.
-        leaf_c = jax.ops.segment_sum(counts, node_of_row, num_segments=n_leaves)
-        leaf_y = jax.ops.segment_sum(counts * yt, node_of_row, num_segments=n_leaves)
+        # empty by falling back to the overall rate. Streaming backends
+        # use the node-sum kernel (scatter-free, batches over the tree
+        # vmap like every other dispatch, always f32 — leaf values feed
+        # predictions); the dense backends keep segment_sum: the
+        # (n, 2^D) one-hot alternative is ~100 MB per tree at depth 9 —
+        # gigabytes under the tree vmap — and this runs once per tree.
+        if hist_backend.startswith("pallas"):
+            leaf_backend = (
+                "pallas_interpret" if hist_backend == "pallas_interpret"
+                else "pallas"
+            )
+            ls = node_sums(
+                node_of_row, jnp.stack([counts, counts * yt]), n_leaves,
+                backend=leaf_backend,
+            )  # (L, 2)
+            leaf_c, leaf_y = ls[:, 0], ls[:, 1]
+        else:
+            leaf_c = jax.ops.segment_sum(counts, node_of_row, num_segments=n_leaves)
+            leaf_y = jax.ops.segment_sum(
+                counts * yt, node_of_row, num_segments=n_leaves
+            )
         leaf_value = jnp.where(leaf_c > 0, base + leaf_y / jnp.maximum(leaf_c, 1e-12), mu)
         # Bootstrap counts persist only for the OOB mask (count == 0);
         # uint8 storage is 4× smaller than f32 — (T, n) at a 500-tree ×

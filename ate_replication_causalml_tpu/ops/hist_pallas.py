@@ -49,21 +49,23 @@ def _round_up(x: int, m: int) -> int:
 
 
 # Row count above which the streaming Pallas kernel beats the XLA
-# contraction on TPU. Round-3 within-ONE-window sweep (v5-lite,
-# `bench.py --hist-ab`: whole classifier-tree ms/tree, p=21, 64 bins,
-# depth 9 — round 2's 400k figure mixed windows with 4× tunnel
-# variance):
+# contraction on TPU. Re-measured after the TREE-BATCHED kernel landed
+# (round-3 second pass; within-ONE-window, `bench.py --hist-ab`: whole
+# classifier-tree ms/tree, p=21, 64 bins, depth 9):
 #
-#   rows   9k   30k   100k   200k   400k    1M
-#   xla    4.5  6.8   23.3   62.7   187.7  798.6
-#   pallas 4.6  8.4   23.2   41.7    82.6  205.0
-#   bf16   6.2 10.1   22.1   41.3    80.3  201.6
+#   rows    9k   15k   30k   60k   100k   200k    1M
+#   xla     5.3  4.9   6.1   8.4   23.3   64.1   ~800 (pre-batching)
+#   pallas  4.5  5.2   5.3   7.9    9.7   19.2    —
+#   bf16    4.7  4.8   4.5   6.7   10.1   19.1   82.8 (whole tree)
 #
-# Crossover ≈ 100k (a wash there; kernel 1.5× at 200k, 3.9× at 1M —
-# the XLA path's scatter-built bin one-hot grows superlinearly in HBM
-# cost while the kernel streams codes through VMEM). bf16 only wins
-# past the crossover, which is exactly where 'auto' can pick it.
-_PALLAS_ROWS_THRESHOLD = 150_000
+# The batched kernel is at-or-better EVERYWHERE measured — including
+# the reference's own ~9k-row biased sample (the pre-batching table had
+# XLA winning below ~100k; batching amortized the kernel's fixed
+# per-row-stream work across the tree chunk). The threshold now only
+# guards the untested sub-9k regime; the XLA path's scatter-built bin
+# one-hot still degrades superlinearly with rows, so the kernel's edge
+# grows with n (2.3× at 100k, 3.4× at 200k, ~10× at 1M).
+_PALLAS_ROWS_THRESHOLD = 8_192
 
 
 def resolve_hist_backend(
@@ -76,12 +78,13 @@ def resolve_hist_backend(
 ) -> str:
     """The single place the 'auto' policy lives.
 
-    On TPU, 'auto' picks the XLA contraction at reference-like row
-    counts and the streaming Pallas kernel past ``_PALLAS_ROWS_THRESHOLD``
-    (see the measured crossover table above). Pass ``n_rows`` to enable
-    the switch — without it 'auto' stays on the XLA path, which is fine
-    at reference scale but ~4× slower than the kernel by 1M rows, so
-    large-row callers should always pass it. The kernel only supports
+    On TPU, 'auto' picks the tree-batched streaming Pallas kernel from
+    ``_PALLAS_ROWS_THRESHOLD`` (~8k — at-or-better than the XLA
+    contraction at every measured size, ~10× by 1M rows; see the table
+    above) and the XLA contraction only below it (the untested sub-9k
+    regime). Pass ``n_rows`` to enable the switch — without it 'auto'
+    stays on the XLA path, which degrades superlinearly with rows, so
+    every sizable caller should pass it. The kernel only supports
     ``n_bins ≤ 128`` (one feature per 128-lane block minimum), so 'auto'
     also needs ``n_bins`` to choose it — wider binnings stay on XLA,
     which handles any width. Both are bit-exact to each other

@@ -339,10 +339,15 @@ def test_resolve_backend_row_aware_policy(monkeypatch):
 
     monkeypatch.setattr(hp.jax, "default_backend", lambda: "tpu")
     assert hp.resolve_hist_backend("auto") == "xla"
-    assert hp.resolve_hist_backend("auto", n_rows=100_000, n_bins=64) == "xla"
+    assert hp.resolve_hist_backend(
+        "auto", n_rows=hp._PALLAS_ROWS_THRESHOLD - 1, n_bins=64
+    ) == "xla"
     assert hp.resolve_hist_backend(
         "auto", n_rows=hp._PALLAS_ROWS_THRESHOLD, n_bins=64
     ) == "pallas"
+    # Reference-scale (~9k-row biased sample) and up runs the kernel
+    # since the tree-batched rewrite.
+    assert hp.resolve_hist_backend("auto", n_rows=9_000, n_bins=64) == "pallas"
     # The kernel caps at 128 bins; wider binnings stay on XLA even at
     # large row counts (where round-1 'auto' would have crashed).
     assert hp.resolve_hist_backend(
