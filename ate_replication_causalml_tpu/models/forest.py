@@ -619,9 +619,10 @@ def _grow_chunk(tree_keys, codes, yf, xb_onehot, *, depth, mtry, n_bins, hist_ba
         # (n, 2^D) one-hot alternative is ~100 MB per tree at depth 9 —
         # gigabytes under the tree vmap — and this runs once per tree.
         if hist_backend.startswith("pallas"):
+            # Same rule as the causal grower: leaf payloads stay f32
+            # even when split search runs the bf16 kernel.
             leaf_backend = (
-                "pallas_interpret" if hist_backend == "pallas_interpret"
-                else "pallas"
+                "pallas" if hist_backend == "pallas_bf16" else hist_backend
             )
             ls = node_sums(
                 node_of_row, jnp.stack([counts, counts * yt]), n_leaves,

@@ -62,7 +62,10 @@ def _round_up(x: int, m: int) -> int:
 # the reference's own ~9k-row biased sample (the pre-batching table had
 # XLA winning below ~100k; batching amortized the kernel's fixed
 # per-row-stream work across the tree chunk). The threshold now only
-# guards the untested sub-9k regime; the XLA path's scatter-built bin
+# guards the untested sub-9k regime — and it bounds the rows the kernel
+# actually streams: both streaming growers run mask mode on the FULL n
+# they resolve with (the causal subsample is zero-weighted, not
+# gathered). The XLA path's scatter-built bin
 # one-hot still degrades superlinearly with rows, so the kernel's edge
 # grows with n (2.3× at 100k, 3.4× at 200k, ~10× at 1M).
 _PALLAS_ROWS_THRESHOLD = 8_192

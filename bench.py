@@ -164,7 +164,7 @@ def bench_hist_ab(n=N_ROWS, trees=32, depth=9):
         print(f"# {backend}: {results[backend]:.1f} ms/tree "
               f"({trees} trees, {n} rows, depth {depth})", file=sys.stderr)
     print(json.dumps({
-        "metric": "hist_bf16_over_xla_ms_per_tree_1m_rows",
+        "metric": f"hist_bf16_over_xla_ms_per_tree_{n}_rows",
         "value": round(results["pallas_bf16"], 1),
         "unit": "ms/tree",
         "vs_baseline": round(results["xla"] / results["pallas_bf16"], 3),
