@@ -71,6 +71,7 @@ from ate_replication_causalml_tpu.models.forest import (
     route_rows,
     route_rows_blocked,
     select_split,
+    streaming_level_loop,
 )
 from ate_replication_causalml_tpu.ops.hist_pallas import bin_histogram, node_sums
 from ate_replication_causalml_tpu.ops.linalg import _PREC
@@ -181,13 +182,12 @@ def grow_causal_forest(
     mtry = min(mtry, p)
     k = ci_group_size
     n_groups = -(-n_trees // k)
-    # allow_lossy_bf16: on 'auto', the streaming grower's five float
-    # channels are rounded to bf16 before exact f32 accumulation —
-    # ≤0.4% input rounding against a 64-bin quantile discretization,
-    # split-selection-neutral, ~4× MXU. Pass "pallas" for full f32.
-    hist_backend = resolve_hist_backend(
-        hist_backend, n_rows=n, n_bins=n_bins, allow_lossy_bf16=True
-    )
+    # 'auto' keeps the five ρ-decomposition channels in FULL f32: the
+    # lossy-bf16 upgrade (resolve_hist_backend(allow_lossy_bf16=True))
+    # was measured at ≤1% post-transpose — the kernel is not MXU-bound —
+    # so the input rounding buys nothing. Explicit "pallas_bf16" remains
+    # available.
+    hist_backend = resolve_hist_backend(hist_backend, n_rows=n, n_bins=n_bins)
     edges = quantile_bins(x, n_bins)
     codes = binarize(x, edges)
     xb_onehot = bin_onehot(codes, n_bins) if hist_backend == "onehot" else None
@@ -301,8 +301,7 @@ def grow_causal_forest_sharded(
             "(the shared bin one-hot is not built here); use 'auto'/'xla'/'pallas'"
         )
     hist_backend = resolve_hist_backend(
-        hist_backend, allow_onehot=False, n_rows=n, n_bins=n_bins,
-        allow_lossy_bf16=True,
+        hist_backend, allow_onehot=False, n_rows=n, n_bins=n_bins
     )
     axis_size = mesh.shape[axis_name]
     per_dev_groups = -(-n_groups // axis_size)
@@ -418,26 +417,8 @@ def _grow_cf_chunk(group_keys, codes, wt, yt, mom_stack, xb_onehot, *,
         """
         p_feat = codes_g.shape[1]
         ch = gw[None, :] * mom_g.T  # (5, rows), level-invariant
-        node_of_row = jnp.zeros(codes_g.shape[0], jnp.int32)
-        prev_hist = None
-        feats_l, bins_l = [], []
-        for level in range(depth):
-            level_nodes = min(1 << level, max_nodes)
-            if prev_hist is None:
-                hist = bin_histogram(
-                    codes_g, node_of_row, ch, max_nodes=level_nodes,
-                    n_bins=n_bins, backend=hist_backend,
-                )
-            else:
-                half = level_nodes // 2
-                left_id = jnp.where(node_of_row % 2 == 0, node_of_row // 2, -1)
-                hist_left = bin_histogram(
-                    codes_g, left_id, ch, max_nodes=half, n_bins=n_bins,
-                    backend=hist_backend,
-                )
-                hist = jnp.stack([hist_left, prev_hist - hist_left], axis=2
-                                 ).reshape(5, level_nodes, p_feat, n_bins)
-            prev_hist = hist
+
+        def tables_fn(hist, level, perm):
             # Per-node totals = the bin marginal of any one feature.
             mom_nodes = hist[:, :, 0, :].sum(axis=2).T        # (m, 5)
             wbar, ybar, tau = _node_tau(mom_nodes)
@@ -457,23 +438,27 @@ def _grow_cf_chunk(group_keys, codes, wt, yt, mom_stack, xb_onehot, *,
                 rl * rl / jnp.maximum(cl, _EPS) + rr * rr / jnp.maximum(cr, _EPS)
             )
             score = jnp.where((cl >= min_node) & (cr >= min_node), score, jnp.inf)
-            best_feat, best_bin = select_split(
-                score, split_key[level], level_nodes, p_feat, n_bins, mtry
+            return select_split(
+                score, split_key[level], 1 << level, p_feat, n_bins, mtry,
+                perm=perm,
             )
-            node_of_row = route_rows_blocked(
-                node_of_row, best_feat, best_bin, codes_g
-            )
-            pad = max_nodes - level_nodes
-            feats_l.append(jnp.pad(best_feat, (0, pad)))
-            bins_l.append(jnp.pad(best_bin, (0, pad), constant_values=n_bins - 1))
+
+        feats, bins, node_int = streaming_level_loop(
+            codes_g, depth, n_bins,
+            hist_fn=lambda ids, m: bin_histogram(
+                codes_g, ids, ch, max_nodes=m, n_bins=n_bins,
+                backend=hist_backend,
+            ),
+            tables_fn=tables_fn,
+        )
         # Leaf payloads feed predictions directly — keep them full f32
         # even when the split search runs the lossy-bf16 kernel (the
         # payload is one node-sum call per tree, not the bottleneck).
         leaf_backend = "pallas" if hist_backend == "pallas_bf16" else hist_backend
         leaf_stats = node_sums(
-            node_of_row, ew[None, :] * mom_g.T, n_leaves, backend=leaf_backend
+            node_int, ew[None, :] * mom_g.T, n_leaves, backend=leaf_backend
         )  # (L, 5)
-        return jnp.stack(feats_l), jnp.stack(bins_l), leaf_stats
+        return feats, bins, leaf_stats
 
     def grow_one(codes_g, wt_g, yt_g, mom_g, oh_g, base, idx, tree_key):
         """Grow one honest tree.

@@ -201,15 +201,101 @@ def route_rows_blocked(
     return out.reshape(n_pad)[:n]
 
 
-def select_split(score, lk, level_nodes, p, n_bins, mtry):
+@functools.lru_cache(maxsize=None)
+def bitrev_perm(level: int) -> tuple[int, ...]:
+    """Bit-reversal permutation of ``2^level`` node ids (an involution).
+
+    The streaming growers index per-level histograms by BIT-REVERSED
+    node ids: a node's rev id has its child-side bit as the MSB, so the
+    left children of every level occupy rev ids [0, m/2) and the
+    full-level histogram assembles as one CONTIGUOUS concatenation of
+    [left, parent−left] — the interleaved (2k/2k+1) assembly the dense
+    path uses was a strided transposed-layout DMA that a device trace
+    measured at ~12 ms/tree at the million-row scale, half the entire
+    grow. Interleaved ids still exist per row (for the stored Forest
+    layout and leaf indexing); this permutation converts the per-level
+    (m,)-sized tables and mtry draws between the two numberings."""
+    m = 1 << level
+    out = [0] * m
+    for r in range(m):
+        v = 0
+        for i in range(level):
+            v |= ((r >> i) & 1) << (level - 1 - i)
+        out[r] = v
+    return tuple(out)
+
+
+def streaming_level_loop(codes, depth, n_bins, hist_fn, tables_fn):
+    """The ONE bit-reversed level loop shared by both streaming growers
+    (classifier/regression and ρ-decomposed causal) — the rev-id
+    bookkeeping is identical and must stay so, hence one site.
+
+    Per level: full-level histograms assemble as a CONTIGUOUS
+    ``concat([left, parent − left])`` in rev node order (sibling
+    subtraction without the strided interleave DMA — see
+    :func:`bitrev_perm`); splits are chosen by ``tables_fn`` (rev
+    order), rows route row-blocked with rev tables, and both id streams
+    advance: interleaved ``node_int`` (the stored 2k/2k+1 layout) and
+    ``node_rev`` (b·2^level + rev — the new side bit becomes the MSB).
+
+    Args:
+      codes: (n, p) int32 bin codes.
+      hist_fn: (ids, m) → (K, m, p, n_bins) histogram of rows at the
+        given rev node ids (−1 contributes nothing).
+      tables_fn: (hist_full, level, perm) → (bf_rev, bb_rev) split
+        tables in rev order (``perm`` = that level's bit reversal, for
+        re-mapping per-node randomness).
+
+    Returns: (feats (depth, 2^(depth−1)), bins (same), node_int (n,))
+    with split tables converted to the stored interleaved layout.
+    """
+    n = codes.shape[0]
+    max_nodes = 1 << (depth - 1)
+    node_int = jnp.zeros(n, jnp.int32)
+    node_rev = jnp.zeros(n, jnp.int32)
+    prev = None
+    feats_l, bins_l = [], []
+    for level in range(depth):
+        m = 1 << level
+        if prev is None:
+            hist = hist_fn(node_rev, 1)
+        else:
+            # Left children's rev id == their parent's rev id.
+            left_id = jnp.where(node_int % 2 == 0, node_rev, -1)
+            hist_left = hist_fn(left_id, m // 2)
+            hist = jnp.concatenate([hist_left, prev - hist_left], axis=1)
+        prev = hist
+        perm = bitrev_perm(level)
+        bf_rev, bb_rev = tables_fn(hist, level, perm)
+        routed = route_rows_blocked(node_rev, bf_rev, bb_rev, codes)
+        bit = routed - 2 * node_rev
+        node_int = node_int * 2 + bit
+        node_rev = node_rev + bit * m
+        perm_a = jnp.asarray(perm, jnp.int32)
+        pad = max_nodes - m
+        feats_l.append(jnp.pad(bf_rev[perm_a], (0, pad)))
+        bins_l.append(
+            jnp.pad(bb_rev[perm_a], (0, pad), constant_values=n_bins - 1)
+        )
+    return jnp.stack(feats_l), jnp.stack(bins_l), node_int
+
+
+def select_split(score, lk, level_nodes, p, n_bins, mtry, perm=None):
     """Pick each node's best (feature, bin) from the masked score tensor
     with randomForest's per-node mtry feature subsampling. Shared by the
     classifier level loop and BOTH causal formulations (direct and
     ρ-decomposed streaming) — the ≥0.95 split-agreement contract between
     them rides on these staying semantically identical. Nodes with no
     finite score fall back to (feature 0, bin n_bins−1): every row
-    routes left."""
+    routes left.
+
+    ``perm`` (the bit-reversal permutation): when the score rows are in
+    REV node order, it re-maps the per-node random draws so node q still
+    receives the same mtry subset as in interleaved order — the
+    numbering is an internal layout choice, not a statistical one."""
     feat_scores = jax.random.uniform(lk, (level_nodes, p))
+    if perm is not None:
+        feat_scores = feat_scores[jnp.asarray(perm, jnp.int32)]
     kth = jnp.sort(feat_scores, axis=1)[:, mtry - 1 : mtry]
     score = jnp.where((feat_scores <= kth)[:, :, None], score, jnp.inf)
     flat = score.reshape(level_nodes, p * n_bins)
@@ -236,15 +322,22 @@ def binarize(x: jax.Array, edges: jax.Array) -> jax.Array:
     The single chokepoint for the n_bins ≤ 256 invariant: every grower
     and predictor routes codes produced here through ``route_rows``,
     whose bf16 broadcast is exact only for integers ≤ 256.
+
+    Computed as a compare-count — code = #{edges < x}, identical to
+    ``searchsorted(side="left")`` for non-NaN input (the pipeline
+    na.omits upstream) — which XLA fuses into one reduction sweep. The
+    vmapped-searchsorted formulation lowered to a serialized binary-
+    search while-loop that a device trace measured at 1.13 s per
+    million-row fit, more than the entire 32-tree grow it fed.
     """
     n_bins = edges.shape[1] + 1
     if n_bins > 256:
         raise ValueError(
             f"n_bins={n_bins} > 256: bin codes must stay exact in bf16 routing"
         )
-    return jax.vmap(
-        lambda col, e: jnp.searchsorted(e, col, side="left"), in_axes=(1, 0), out_axes=1
-    )(x, edges).astype(jnp.int32)
+    return jnp.sum(
+        x[:, :, None] > edges[None, :, :], axis=2, dtype=jnp.int32
+    )
 
 
 def bin_onehot(codes: jax.Array, n_bins: int) -> jax.Array:
@@ -540,25 +633,10 @@ def _grow_chunk(tree_keys, codes, yf, xb_onehot, *, depth, mtry, n_bins, hist_ba
                 max_nodes=n_nodes, n_bins=n_bins, backend=hist_backend,
             )
 
-        def level_step(carry, lk, level_nodes):
-            node_of_row, prev_hist = carry
-            # Histogram subtraction (the LightGBM sibling trick): both
-            # weight vectors (counts, counts·y) are level-invariant, so
-            # each level computes histograms for LEFT children only —
-            # right children come free as parent − left. Halves the
-            # histogram matmul work for every level past the root.
-            if prev_hist is None:
-                hist = hists_for(node_of_row, level_nodes, (counts, counts * yt))
-            else:
-                half = level_nodes // 2
-                left_id = jnp.where(node_of_row % 2 == 0, node_of_row // 2, -1)
-                hist_left = hists_for(left_id, half, (counts, counts * yt))
-                hist_right = prev_hist - hist_left
-                hist = jnp.stack([hist_left, hist_right], axis=2).reshape(
-                    2, level_nodes, p, n_bins
-                )
+        def split_tables(hist, lk, level_nodes, perm=None):
+            """Scores a full-level (2, m, p, bins) histogram and picks
+            per-node splits; rows may be in rev node order (``perm``)."""
             hist_c, hist_y = hist[0], hist[1]
-
             cl = jnp.cumsum(hist_c, axis=2)
             yl = jnp.cumsum(hist_y, axis=2)
             ct, ytot = cl[:, :, -1:], yl[:, :, -1:]
@@ -574,42 +652,68 @@ def _grow_chunk(tree_keys, codes, yf, xb_onehot, *, depth, mtry, n_bins, hist_ba
                 yl * yl / jnp.maximum(cl, eps) + yr * yr / jnp.maximum(cr, eps)
             )
             score = jnp.where((cl > 0) & (cr > 0), score, jnp.inf)
-            best_feat, best_bin = select_split(
-                score, lk, level_nodes, p, n_bins, mtry
-            )
-
-            if hist_backend.startswith("pallas"):
-                # Row-blocked routing: no (rows, M) one-hot in HBM, so
-                # the tree chunk can be the kernel's batch width.
-                node_of_row = route_rows_blocked(
-                    node_of_row, best_feat, best_bin, codes
-                )
-            else:
-                node_oh = jax.nn.one_hot(
-                    node_of_row, level_nodes, dtype=jnp.float32
-                )
-                node_of_row = route_rows(
-                    node_oh, best_feat, best_bin, codes.astype(jnp.float32),
-                    node_of_row,
-                )
-            return (node_of_row, hist), (best_feat, best_bin)
+            return select_split(score, lk, level_nodes, p, n_bins, mtry,
+                                perm=perm)
 
         # Levels are unrolled as a Python loop so level l only computes
         # histograms for its 2^l live nodes (a lax.scan would force every
         # level to the padded final width — ~depth/2× wasted FLOPs).
         # Split tables are padded back to max_nodes for a uniform layout.
         level_keys = jax.random.split(gk, depth)
-        carry = (jnp.zeros(n, jnp.int32), None)
         feats_l, bins_l = [], []
-        for level in range(depth):
-            level_nodes = min(1 << level, max_nodes)
-            carry, (bf, bb) = level_step(carry, level_keys[level], level_nodes)
+
+        def emit(bf, bb, level_nodes):
             pad = max_nodes - level_nodes
             feats_l.append(jnp.pad(bf, (0, pad)))
             bins_l.append(jnp.pad(bb, (0, pad), constant_values=n_bins - 1))
-        node_of_row = carry[0]
-        feats = jnp.stack(feats_l)
-        bins = jnp.stack(bins_l)
+
+        if hist_backend.startswith("pallas"):
+            # Bit-reversed streaming loop — see streaming_level_loop
+            # (shared with the causal grower; the rev-id bookkeeping
+            # must stay identical between them).
+            weights2 = jnp.stack([counts, counts * yt])
+            feats, bins, node_of_row = streaming_level_loop(
+                codes, depth, n_bins,
+                hist_fn=lambda ids, m: bin_histogram(
+                    codes, ids, weights2, max_nodes=m, n_bins=n_bins,
+                    backend=hist_backend,
+                ),
+                tables_fn=lambda hist, level, perm: split_tables(
+                    hist, level_keys[level], 1 << level, perm=perm
+                ),
+            )
+        else:
+            node_of_row, prev = jnp.zeros(n, jnp.int32), None
+            for level in range(depth):
+                level_nodes = min(1 << level, max_nodes)
+                # Histogram subtraction (the LightGBM sibling trick):
+                # both weight vectors are level-invariant, so each level
+                # computes histograms for LEFT children only — right
+                # children come free as parent − left.
+                if prev is None:
+                    hist = hists_for(
+                        node_of_row, level_nodes, (counts, counts * yt)
+                    )
+                else:
+                    half = level_nodes // 2
+                    left_id = jnp.where(
+                        node_of_row % 2 == 0, node_of_row // 2, -1
+                    )
+                    hist_left = hists_for(left_id, half, (counts, counts * yt))
+                    hist = jnp.stack(
+                        [hist_left, prev - hist_left], axis=2
+                    ).reshape(2, level_nodes, p, n_bins)
+                prev = hist
+                bf, bb = split_tables(hist, level_keys[level], level_nodes)
+                node_oh = jax.nn.one_hot(
+                    node_of_row, level_nodes, dtype=jnp.float32
+                )
+                node_of_row = route_rows(
+                    node_oh, bf, bb, codes.astype(jnp.float32), node_of_row
+                )
+                emit(bf, bb, level_nodes)
+            feats = jnp.stack(feats_l)
+            bins = jnp.stack(bins_l)
 
         # Leaf stats at depth D (bootstrap-weighted), parent-filled where
         # empty by falling back to the overall rate. Streaming backends

@@ -104,10 +104,11 @@ def resolve_hist_backend(
 
     ``allow_lossy_bf16=True`` upgrades to the bf16 kernel even for
     FLOAT weights: inputs are rounded to bf16 (≤0.4% relative) before
-    exact f32 accumulation. Only the causal grower opts in (its
-    split-selection statistics tolerate input rounding far coarser than
-    its own quantile binning — see grow_one_streaming), and only for
-    ``backend="auto"``; an explicit ``"pallas"`` always stays f32."""
+    exact f32 accumulation — statistically tolerable for split search
+    (coarser than the quantile binning itself). No caller opts in today:
+    after the transposed-lhs rewrite the kernel is not MXU-bound, so the
+    rounding was measured to buy ≤1% — kept for a future MXU-bound
+    regime (wider feature sets, more channels)."""
     if backend == "auto":
         if jax.default_backend() == "tpu":
             if (
@@ -128,9 +129,10 @@ def _build_bin_oh(codes_ref, bw, f_pb, n_bins, in_dtype):
     """Tile-local bin one-hot, (TILE, bw·LANES): one 128-lane block per
     ``f_pb`` features, concatenated along lanes. Each feature is
     compared only against its own block's 128 lanes — ~10× less VPU
-    compare work at the GGL shape than full-width compares — and each
-    block's lane iota is local, so the compare constant is just
-    code + f·n_bins < 128. Shared by both kernels (they must stay
+    compare work at the GGL shape than full-width compares. The kernel
+    wrappers pre-offset the codes (code + (f mod f_pb)·n_bins, one
+    fused XLA add per kernel call) so the per-step work is exactly one
+    compare + accumulate per feature. Shared by both kernels (they must stay
     bit-identical; tests assert it)."""
     tile = codes_ref.shape[1]
     lane_iota = lax.broadcasted_iota(jnp.int32, (tile, _LANES), 1)
@@ -138,7 +140,7 @@ def _build_bin_oh(codes_ref, bw, f_pb, n_bins, in_dtype):
     for g in range(bw):
         oh_g = jnp.zeros((tile, _LANES), in_dtype)
         for f in range(f_pb):  # static unroll — f_pb = LANES // n_bins
-            flat = codes_ref[0, :, g * f_pb + f : g * f_pb + f + 1] + f * n_bins
+            flat = codes_ref[0, :, g * f_pb + f : g * f_pb + f + 1]
             oh_g = oh_g + (lane_iota == flat).astype(in_dtype)
         pieces.append(oh_g)
     return pieces[0] if bw == 1 else jnp.concatenate(pieces, axis=1)
@@ -302,6 +304,12 @@ def bin_histogram_pallas(
     n_pad = _round_up(max(n, tile), tile)
 
     codes = jnp.pad(codes, ((0, n_pad - n), (0, p_pad - p)))
+    # Pre-offset each feature's codes by its within-block lane base
+    # (f mod f_pb)*n_bins — once here instead of per grid step in the
+    # kernel's unrolled compare loop (pad-feature columns offset too;
+    # their spurious one-hot lanes are sliced off below, as before).
+    lane_off = (jnp.arange(p_pad, dtype=jnp.int32) % f_pb) * n_bins
+    codes = codes + lane_off[None, :]
     # (p_groups, n, bw·f_pb): each grid step DMAs one contiguous
     # (tile, bw·f_pb) slab of its own feature group (Mosaic requires the
     # block's trailing dim to be lane-aligned or the full array dim).
@@ -399,6 +407,12 @@ def bin_histogram_pallas_batched(
     n_pad = _round_up(max(n, tile), tile)
 
     codes = jnp.pad(codes, ((0, n_pad - n), (0, p_pad - p)))
+    # Pre-offset each feature's codes by its within-block lane base
+    # (f mod f_pb)*n_bins — once here instead of per grid step in the
+    # kernel's unrolled compare loop (pad-feature columns offset too;
+    # their spurious one-hot lanes are sliced off below, as before).
+    lane_off = (jnp.arange(p_pad, dtype=jnp.int32) % f_pb) * n_bins
+    codes = codes + lane_off[None, :]
     codes_b = codes.reshape(n_pad, p_groups, bw * f_pb).transpose(1, 0, 2)
     # Lane-major row layouts: node (T, n), weights (T·K, n) — rows on
     # lanes, so the kernel's per-tree strips are sublane slices.
