@@ -660,12 +660,6 @@ def _grow_chunk(tree_keys, codes, yf, xb_onehot, *, depth, mtry, n_bins, hist_ba
         # level to the padded final width — ~depth/2× wasted FLOPs).
         # Split tables are padded back to max_nodes for a uniform layout.
         level_keys = jax.random.split(gk, depth)
-        feats_l, bins_l = [], []
-
-        def emit(bf, bb, level_nodes):
-            pad = max_nodes - level_nodes
-            feats_l.append(jnp.pad(bf, (0, pad)))
-            bins_l.append(jnp.pad(bb, (0, pad), constant_values=n_bins - 1))
 
         if hist_backend.startswith("pallas"):
             # Bit-reversed streaming loop — see streaming_level_loop
@@ -683,6 +677,15 @@ def _grow_chunk(tree_keys, codes, yf, xb_onehot, *, depth, mtry, n_bins, hist_ba
                 ),
             )
         else:
+            feats_l, bins_l = [], []
+
+            def emit(bf, bb, level_nodes):
+                pad = max_nodes - level_nodes
+                feats_l.append(jnp.pad(bf, (0, pad)))
+                bins_l.append(
+                    jnp.pad(bb, (0, pad), constant_values=n_bins - 1)
+                )
+
             node_of_row, prev = jnp.zeros(n, jnp.int32), None
             for level in range(depth):
                 level_nodes = min(1 << level, max_nodes)

@@ -252,6 +252,18 @@ def _hist_kernel_batched(codes_ref, node_ref, w_ref, out_ref, *, n_weights,
 _VMEM_BUDGET = 100 * 1024 * 1024  # raise Mosaic's 16 MB scoped default
 
 
+def _offset_codes(codes, n, p, n_pad, p_pad, f_pb, n_bins):
+    """Pad codes to (n_pad, p_pad) and pre-offset each feature's codes by
+    its within-block lane base (f mod f_pb)·n_bins — once here instead of
+    per grid step in the kernel's unrolled compare loop (pad-feature
+    columns offset too; their spurious one-hot lanes are sliced off by
+    the wrappers). Shared by both kernel wrappers, which must stay
+    bit-identical (tests assert it)."""
+    codes = jnp.pad(codes, ((0, n_pad - n), (0, p_pad - p)))
+    lane_off = (jnp.arange(p_pad, dtype=jnp.int32) % f_pb) * n_bins
+    return codes + lane_off[None, :]
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("max_nodes", "n_bins", "tile", "bw", "interpret", "bf16"),
@@ -303,13 +315,7 @@ def bin_histogram_pallas(
     p_pad = p_groups * bw * f_pb
     n_pad = _round_up(max(n, tile), tile)
 
-    codes = jnp.pad(codes, ((0, n_pad - n), (0, p_pad - p)))
-    # Pre-offset each feature's codes by its within-block lane base
-    # (f mod f_pb)*n_bins — once here instead of per grid step in the
-    # kernel's unrolled compare loop (pad-feature columns offset too;
-    # their spurious one-hot lanes are sliced off below, as before).
-    lane_off = (jnp.arange(p_pad, dtype=jnp.int32) % f_pb) * n_bins
-    codes = codes + lane_off[None, :]
+    codes = _offset_codes(codes, n, p, n_pad, p_pad, f_pb, n_bins)
     # (p_groups, n, bw·f_pb): each grid step DMAs one contiguous
     # (tile, bw·f_pb) slab of its own feature group (Mosaic requires the
     # block's trailing dim to be lane-aligned or the full array dim).
@@ -406,13 +412,7 @@ def bin_histogram_pallas_batched(
         tile = 2048
     n_pad = _round_up(max(n, tile), tile)
 
-    codes = jnp.pad(codes, ((0, n_pad - n), (0, p_pad - p)))
-    # Pre-offset each feature's codes by its within-block lane base
-    # (f mod f_pb)*n_bins — once here instead of per grid step in the
-    # kernel's unrolled compare loop (pad-feature columns offset too;
-    # their spurious one-hot lanes are sliced off below, as before).
-    lane_off = (jnp.arange(p_pad, dtype=jnp.int32) % f_pb) * n_bins
-    codes = codes + lane_off[None, :]
+    codes = _offset_codes(codes, n, p, n_pad, p_pad, f_pb, n_bins)
     codes_b = codes.reshape(n_pad, p_groups, bw * f_pb).transpose(1, 0, 2)
     # Lane-major row layouts: node (T, n), weights (T·K, n) — rows on
     # lanes, so the kernel's per-tree strips are sublane slices.

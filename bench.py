@@ -104,7 +104,15 @@ def bench_forest(n=FOREST_ROWS):
         return time.perf_counter() - t0, fitted
 
     compile_s, fitted = one_fit(1)
-    steady_s, fitted = one_fit(2)
+    # Steady = best of two warm fits: the tunnel worker has a transient
+    # degraded mode (measured 2026-07-31: the identical 1M fit at 303 s
+    # and 89 s within one hour, with 100k fits and the kernel A/B
+    # unaffected in between) — a single sample can record a 3-4× outlier
+    # as THE throughput number. Two samples minutes apart make that
+    # vanishingly unlikely; both are printed.
+    steady_a, fitted = one_fit(2)
+    steady_b, fitted = one_fit(3)
+    steady_s = min(steady_a, steady_b)
     eff = average_treatment_effect(fitted)
     ate, se = float(eff.estimate), float(eff.std_err)  # device sync HERE
     sec_per_1m = steady_s * 1e6 / n
@@ -115,7 +123,8 @@ def bench_forest(n=FOREST_ROWS):
     # Stderr diagnostics first; the required JSON line is the LAST thing
     # printed, so a mid-run failure can never leave two JSON lines.
     print(
-        f"# rows={n} trees={FOREST_TREES} first={compile_s:.1f}s steady={steady_s:.1f}s "
+        f"# rows={n} trees={FOREST_TREES} first={compile_s:.1f}s "
+        f"steady={steady_s:.1f}s (runs {steady_a:.1f}/{steady_b:.1f}) "
         f"ate={ate:.4f} se={se:.4f} (true 1.5) "
         f"fit_matmul_flops={flops:.3e} mfu_f32~{mfu * 100:.1f}%",
         file=sys.stderr,

@@ -1,0 +1,102 @@
+"""Stage-timed 1M-row causal-forest fit: localize wall-clock by stage.
+
+Same shapes/keys as `bench.py --forest --rows N` (identical data
+construction), but each stage is synced and timed separately:
+nuisance-Y fit, OOB(Y), nuisance-W fit, OOB(W), causal grow, CATE+AIPW.
+Run twice: first pass includes compiles, second is steady.
+
+Usage: python scripts/stage_time_1m.py [--rows 1000000] [--trees 2000]
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+
+from ate_replication_causalml_tpu.utils.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+from ate_replication_causalml_tpu.models.causal_forest import (  # noqa: E402
+    average_treatment_effect,
+    grow_causal_forest,
+    FittedCausalForest,
+)
+from ate_replication_causalml_tpu.models.forest import (  # noqa: E402
+    fit_forest_regressor,
+    forest_oob_mean,
+)
+from ate_replication_causalml_tpu.data.frame import CausalFrame  # noqa: E402
+
+
+def make(n):
+    key = jax.random.key(0)
+    kx, kw, ky = jax.random.split(key, 3)
+    x = jax.random.normal(kx, (n, 21), dtype=jnp.float32)
+    tau = 1.0 + (x[:, 0] > 0)
+    w = (jax.random.uniform(kw, (n,)) < jax.nn.sigmoid(0.8 * x[:, 1])).astype(
+        jnp.float32
+    )
+    y = 0.5 * x[:, 1] + tau * w + 0.5 * jax.random.normal(ky, (n,))
+    return CausalFrame(x=x, w=w, y=y.astype(jnp.float32))
+
+
+def run(frame, n_trees, seed, label):
+    x, w, y = frame.x, frame.w, frame.y
+    ky, kw_, kc = jax.random.split(jax.random.key(seed), 3)
+    t = {}
+
+    t0 = time.perf_counter()
+    fy = fit_forest_regressor(x, y, ky, n_trees=500, depth=9)
+    _ = float(fy.train_leaf.sum())
+    t["fit_y"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    y_hat = forest_oob_mean(fy, x)
+    _ = float(y_hat.sum())
+    t["oob_y"] = time.perf_counter() - t0
+    del fy
+
+    t0 = time.perf_counter()
+    fw = fit_forest_regressor(x, w, kw_, n_trees=500, depth=9)
+    _ = float(fw.train_leaf.sum())
+    t["fit_w"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    w_hat = forest_oob_mean(fw, x)
+    _ = float(w_hat.sum())
+    t["oob_w"] = time.perf_counter() - t0
+    del fw
+
+    t0 = time.perf_counter()
+    forest = grow_causal_forest(x, w - w_hat, y - y_hat, kc, n_trees=n_trees, depth=8)
+    _ = float(forest.leaf_stats.sum())
+    t["grow"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    fitted = FittedCausalForest(forest=forest, y_hat=y_hat, w_hat=w_hat, x=x, y=y, w=w)
+    eff = average_treatment_effect(fitted)
+    ate, se = float(eff.estimate), float(eff.std_err)
+    t["cate_aipw"] = time.perf_counter() - t0
+
+    total = sum(t.values())
+    stages = " ".join(f"{k}={v:.1f}s" for k, v in t.items())
+    print(f"# [{label}] total={total:.1f}s {stages} ate={ate:.4f} se={se:.4f}")
+    return total
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--trees", type=int, default=2000)
+    ap.add_argument("--once", action="store_true", help="skip the steady pass")
+    args = ap.parse_args()
+    frame = make(args.rows)
+    run(frame, args.trees, 1, "first")
+    if not args.once:
+        run(frame, args.trees, 2, "steady")
+
+
+if __name__ == "__main__":
+    main()
