@@ -909,6 +909,14 @@ def predict_cate(
     # compute_variance with the intercept profiled out):
     #   Var(τ̂) = max(V_between(ψ) − V_within(ψ)/k, 0) / H²
     # with ψ evaluated at the pooled τ̂ and H the pooled Var(w̃).
+    # Known df divergence from grf (documented, not replicated): grf
+    # normalizes the between-group variance by num_groups while this
+    # uses the unbiased gn−1, and grf's half-sample "Bayes debiasing"
+    # correction is skipped by both (grf only applies it when
+    # ci_group_size > 1 subsampling leaves it well-defined). At the
+    # notebook's 1000 groups the ratio is 999/1000 — far below the
+    # little-bags estimator's own Monte-Carlo noise; a true-R grf
+    # comparison at small group counts should divide by gn here.
     ngr = jnp.maximum(gn, 1.0)
     mean_psi = SP / ngr
     v_between = jnp.maximum(SP2 - gn * mean_psi * mean_psi, 0.0) / jnp.maximum(
