@@ -25,7 +25,6 @@ argument; the produced estimate is what the reference's call computes.
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -34,7 +33,7 @@ import numpy as np
 from ate_replication_causalml_tpu.data.frame import CausalFrame
 from ate_replication_causalml_tpu.estimators.base import EstimatorResult
 from ate_replication_causalml_tpu.ops.lasso import cv_glmnet, predict_path
-from ate_replication_causalml_tpu.ops.qp import balance_qp
+from ate_replication_causalml_tpu.ops.qp import balance_qp_x64
 
 
 def approx_balance(
@@ -46,21 +45,34 @@ def approx_balance(
 ) -> jax.Array:
     """Balancing weights over rows of ``x`` toward covariate mean ``target``
     (balanceHD ``approx.balance``): argmin zeta*||g||^2 +
-    (1-zeta)*||X^T g - target||_inf^2 over the (capped) simplex."""
-    return balance_qp(x, target, zeta=zeta, ub=ub, max_iters=max_iters).gamma
+    (1-zeta)*||X^T g - target||_inf^2 over the (capped) simplex.
+
+    Solved in f64 (see :func:`~..ops.qp.balance_qp_x64`: f32 ADMM floors
+    three orders of magnitude short of quadprog's stationarity)."""
+    return approx_balance_sol(x, target, zeta=zeta, ub=ub, max_iters=max_iters)[0]
 
 
-@functools.partial(jax.jit, static_argnames=("zeta", "max_iters"))
-def _arm_mu_var(x_arm, y_arm, target, key, zeta, max_iters):
-    """One arm's counterfactual mean and variance contribution.
+def approx_balance_sol(x, target, zeta=0.5, ub=jnp.inf, max_iters=4000):
+    """(gamma_f32, worst_resid, iters) from the f64 balance QP —
+    ``worst_resid`` is max(primal, dual), the quantity the stopping rule
+    tests, so callers' inexactness warnings can't be silenced by
+    one-sided convergence."""
+    qp = balance_qp_x64(
+        x, target, zeta=zeta, ub=float(ub), max_iters=max_iters
+    )
+    worst = jnp.maximum(qp.primal_resid, qp.dual_resid)
+    return jnp.asarray(qp.gamma, jnp.float32), worst, qp.iters
+
+
+@jax.jit
+def _arm_mu_var(x_arm, y_arm, target, key, gamma):
+    """One arm's counterfactual mean and variance contribution, given its
+    precomputed balancing weights.
 
     ``x_arm``/``y_arm`` are the arm's rows (compressed host-side — the
     two arms have different n, so each arm gets its own compiled
     instance; both are one-shot fits).
     """
-    qp = balance_qp(x_arm, target, zeta=zeta, max_iters=max_iters)
-    gamma = qp.gamma
-
     # Elastic net outcome regression on the arm (balanceHD fits the
     # outcome model with an elastic-net penalty, alpha=0.9 default),
     # lambda by 10-fold CV.
@@ -76,7 +88,7 @@ def _arm_mu_var(x_arm, y_arm, target, key, zeta, max_iters):
     df = jnp.sum(jnp.abs(beta) > 0) + 1.0
     sigma2 = jnp.sum(resid**2) / jnp.maximum(n_arm - df, 1.0)
     var = sigma2 * jnp.sum(gamma**2)
-    return mu, var, qp.primal_resid, qp.iters
+    return mu, var
 
 
 def residual_balance_ate(
@@ -96,16 +108,18 @@ def residual_balance_ate(
     target = jnp.mean(x, axis=0)
 
     treated = np.asarray(w) > 0.5
-    mu1, var1, rp1, it1 = _arm_mu_var(x[treated], y[treated], target, k1, zeta, max_iters)
-    mu0, var0, rp0, it0 = _arm_mu_var(x[~treated], y[~treated], target, k0, zeta, max_iters)
+    g1, rp1, it1 = approx_balance_sol(x[treated], target, zeta=zeta, max_iters=max_iters)
+    g0, rp0, it0 = approx_balance_sol(x[~treated], target, zeta=zeta, max_iters=max_iters)
+    mu1, var1 = _arm_mu_var(x[treated], y[treated], target, k1, g1)
+    mu0, var0 = _arm_mu_var(x[~treated], y[~treated], target, k0, g0)
     for arm, rp, it in (("treated", rp1, it1), ("control", rp0, it0)):
         if int(it) >= max_iters and float(rp) > 1e-5:
             import warnings
 
             warnings.warn(
-                f"balance QP ({arm} arm) hit max_iters={max_iters} with primal "
-                f"residual {float(rp):.2e}; weights may be inexact — raise "
-                "max_iters for wide covariate sets",
+                f"balance QP ({arm} arm) hit max_iters={max_iters} with "
+                f"worst residual {float(rp):.2e}; weights may be inexact — "
+                "raise max_iters for wide covariate sets",
                 RuntimeWarning,
                 stacklevel=2,
             )
