@@ -34,9 +34,26 @@ from ate_replication_causalml_tpu.ops.glm import logistic_glm, predict_proba
 from ate_replication_causalml_tpu.ops.linalg import add_intercept
 
 
-def aipw_tau(w, y, p, mu0, mu1) -> jax.Array:
-    """The AIPW combination (``ate_functions.R:184-186``)."""
-    return bt._aipw_tau(w, y, p, mu0, mu1)
+def aipw_tau(w, y, p, mu0, mu1, compat: str = "r") -> jax.Array:
+    """The AIPW combination (``ate_functions.R:183-185``).
+
+    ``compat="r"`` reproduces the reference's published formula, which
+    ADDS the control augmentation term — a sign quirk: standard AIPW
+    subtracts it, and the reference's own sandwich influence function
+    (``ate_functions.R:197``) uses the standard convention. The "r"
+    estimator is consistent when both nuisances are correct but is NOT
+    doubly robust. ``compat="fixed"`` is textbook AIPW (doubly robust;
+    property-tested in tests/test_estimators_e2e.py). See
+    ``ops.bootstrap._aipw_tau``."""
+    return bt._aipw_tau(w, y, p, mu0, mu1, _control_sign(compat))
+
+
+def _control_sign(compat: str) -> float:
+    if compat == "r":
+        return 1.0
+    if compat == "fixed":
+        return -1.0
+    raise ValueError(f"compat must be 'r' or 'fixed', got {compat!r}")
 
 
 @jax.jit
@@ -84,18 +101,31 @@ def _aipw_result(
     key: jax.Array | None,
     boot_indices,
     sharded: bool,
+    compat: str = "r",
 ) -> EstimatorResult:
     w, y = frame.w, frame.y
+    cs = _control_sign(compat)
     mu0, mu1 = _outcome_model_mu(frame.x, w, y)
-    tau = aipw_tau(w, y, p, mu0, mu1)
+    tau = aipw_tau(w, y, p, mu0, mu1, compat=compat)
     if bootstrap_se:
         if boot_indices is not None:
-            se = bt.aipw_bootstrap_se(w, y, p, mu0, mu1, indices=jnp.asarray(boot_indices))
+            se = bt.aipw_bootstrap_se(
+                w, y, p, mu0, mu1, indices=jnp.asarray(boot_indices),
+                control_sign=cs,
+            )
         elif sharded:
-            se = bt.aipw_bootstrap_se_sharded(w, y, p, mu0, mu1, key=key, n_boot=n_boot)
+            se = bt.aipw_bootstrap_se_sharded(
+                w, y, p, mu0, mu1, key=key, n_boot=n_boot, control_sign=cs
+            )
         else:
-            se = bt.aipw_bootstrap_se(w, y, p, mu0, mu1, key=key, n_boot=n_boot)
+            se = bt.aipw_bootstrap_se(
+                w, y, p, mu0, mu1, key=key, n_boot=n_boot, control_sign=cs
+            )
     else:
+        # The sandwich influence function is the STANDARD (minus-sign)
+        # one in the reference too — under compat="r" the pairing of the
+        # "+" point estimate with the "-" IF is itself part of the
+        # published behavior being reproduced.
         se = aipw_sandwich_se(w, y, p, mu0, mu1, tau)
     return EstimatorResult.from_point_se(method, tau, se)
 
@@ -108,13 +138,18 @@ def doubly_robust_glm(
     boot_indices=None,
     sharded: bool = False,
     method: str = "Doubly Robust with logistic regression PS",
+    compat: str = "r",
 ) -> EstimatorResult:
     """AIPW with in-sample GLM propensity, no clipping
-    (``ate_functions.R:211-264``)."""
+    (``ate_functions.R:211-264``). ``compat``: see :func:`aipw_tau`."""
+    _control_sign(compat)  # reject typos before the nuisance fit
     p = logistic_glm(add_intercept(frame.x), frame.w).fitted
     if bootstrap_se and key is None and boot_indices is None:
         key = jax.random.key(0)
-    return _aipw_result(frame, p, method, bootstrap_se, n_boot, key, boot_indices, sharded)
+    return _aipw_result(
+        frame, p, method, bootstrap_se, n_boot, key, boot_indices, sharded,
+        compat,
+    )
 
 
 def doubly_robust(
@@ -126,6 +161,7 @@ def doubly_robust(
     boot_indices=None,
     sharded: bool = False,
     method: str = "Doubly Robust with Random Forest PS",
+    compat: str = "r",
 ) -> EstimatorResult:
     """AIPW with a pluggable propensity model and the reference's
     clip-to-interior rule (``ate_functions.R:149-207``). The canonical
@@ -133,7 +169,11 @@ def doubly_robust(
     uses ``randomForest`` OOB votes); see ``models.forest`` once the
     forest engine lands — any callable ``CausalFrame -> (n,) probs``
     works."""
+    _control_sign(compat)  # reject typos before the forest fit
     p = clip_propensity(jnp.asarray(propensity_fn(frame)))
     if bootstrap_se and key is None and boot_indices is None:
         key = jax.random.key(0)
-    return _aipw_result(frame, p, method, bootstrap_se, n_boot, key, boot_indices, sharded)
+    return _aipw_result(
+        frame, p, method, bootstrap_se, n_boot, key, boot_indices, sharded,
+        compat,
+    )

@@ -43,27 +43,39 @@ def bootstrap_indices(key: jax.Array, n: int, n_boot: int) -> jax.Array:
     return jax.random.randint(key, (n_boot, n), 0, n, dtype=jnp.int32)
 
 
-def _aipw_tau(w, y, p, mu0, mu1):
-    """The AIPW combination (``ate_functions.R:184-186``):
+def _aipw_tau(w, y, p, mu0, mu1, control_sign=1.0):
+    """The reference's AIPW combination (``ate_functions.R:183-185``):
     ``mean(w(y-mu1)/p + (1-w)(y-mu0)/(1-p)) + mean(mu1 - mu0)`` with
-    R's ``na.rm=TRUE`` on the first mean."""
-    est1 = w * (y - mu1) / p + (1.0 - w) * (y - mu0) / (1.0 - p)
+    R's ``na.rm=TRUE`` on the first mean.
+
+    **Reference sign quirk** (discovered by the double-robustness
+    property test): standard AIPW SUBTRACTS the control augmentation —
+    ``w(y-mu1)/p − (1-w)(y-mu0)/(1-p) + mean(mu1-mu0)`` — but the
+    reference ADDS it, while its own sandwich influence function
+    (``ate_functions.R:197``) uses the standard minus convention. The
+    published estimator is therefore consistent only when BOTH nuisances
+    are correct (each augmentation term is then mean-zero either way)
+    and loses the double-robustness protection the method is named for.
+    ``control_sign``: +1.0 reproduces the reference (``compat="r"``,
+    the default everywhere — the 1e-4 parity contract needs it), −1.0
+    is textbook AIPW (``compat="fixed"``)."""
+    est1 = w * (y - mu1) / p + control_sign * (1.0 - w) * (y - mu0) / (1.0 - p)
     est2 = mu1 - mu0
     return jnp.nanmean(est1) + jnp.mean(est2)
 
 
-def _replicate(idx, w, y, p, mu0, mu1):
+def _replicate(idx, w, y, p, mu0, mu1, control_sign=1.0):
     """One bootstrap replicate (``ate_functions.R:267-283``): gather the
     five precomputed vectors, recompute the AIPW combination."""
-    return _aipw_tau(w[idx], y[idx], p[idx], mu0[idx], mu1[idx])
+    return _aipw_tau(w[idx], y[idx], p[idx], mu0[idx], mu1[idx], control_sign)
 
 
 @functools.partial(jax.jit, static_argnames=())
-def aipw_bootstrap_taus(indices, w, y, p, mu0, mu1):
+def aipw_bootstrap_taus(indices, w, y, p, mu0, mu1, control_sign=1.0):
     """All replicates at once: vmap over the (B, n) index matrix."""
-    return jax.vmap(_replicate, in_axes=(0, None, None, None, None, None))(
-        indices, w, y, p, mu0, mu1
-    )
+    return jax.vmap(
+        _replicate, in_axes=(0, None, None, None, None, None, None)
+    )(indices, w, y, p, mu0, mu1, control_sign)
 
 
 def sd(x: jax.Array) -> jax.Array:
@@ -88,6 +100,7 @@ def aipw_bootstrap_se(
     indices=None,
     style: str = "auto",
     chunk: int | None = None,
+    control_sign: float = 1.0,
 ) -> jax.Array:
     """Bootstrap SE of the AIPW estimator, single-device path.
 
@@ -97,7 +110,7 @@ def aipw_bootstrap_se(
     'poisson'.
     """
     if indices is not None:
-        taus = aipw_bootstrap_taus(indices, w, y, p, mu0, mu1)
+        taus = aipw_bootstrap_taus(indices, w, y, p, mu0, mu1, control_sign)
         return sd(taus)
     if key is None:
         raise ValueError("provide either key= or indices=")
@@ -109,9 +122,15 @@ def aipw_bootstrap_se(
         while n_boot % chunk:
             chunk -= 1
     if style == "poisson":
-        taus = aipw_bootstrap_taus_poisson(w, y, p, mu0, mu1, key=key, n_boot=n_boot, chunk=chunk)
+        taus = aipw_bootstrap_taus_poisson(
+            w, y, p, mu0, mu1, key=key, n_boot=n_boot, chunk=chunk,
+            control_sign=control_sign,
+        )
     elif style == "multinomial":
-        taus = aipw_bootstrap_taus_chunked(w, y, p, mu0, mu1, key=key, n_boot=n_boot, chunk=chunk)
+        taus = aipw_bootstrap_taus_chunked(
+            w, y, p, mu0, mu1, key=key, n_boot=n_boot, chunk=chunk,
+            control_sign=control_sign,
+        )
     else:
         raise ValueError(f"unknown bootstrap style {style!r}")
     return sd(taus)
@@ -143,7 +162,8 @@ def _poisson1_counts(key: jax.Array, shape) -> jax.Array:
 
 
 def aipw_bootstrap_taus_poisson(
-    w, y, p, mu0, mu1, *, key: jax.Array, n_boot: int, chunk: int = 25
+    w, y, p, mu0, mu1, *, key: jax.Array, n_boot: int, chunk: int = 25,
+    control_sign: float = 1.0,
 ) -> jax.Array:
     """Poisson-bootstrap replicate taus (the large-n fast path).
 
@@ -160,7 +180,7 @@ def aipw_bootstrap_taus_poisson(
     if n_boot % chunk:
         raise ValueError(f"n_boot={n_boot} must be a multiple of chunk={chunk}")
     w, y, p, mu0, mu1 = map(jnp.asarray, (w, y, p, mu0, mu1))
-    est1 = w * (y - mu1) / p + (1.0 - w) * (y - mu0) / (1.0 - p)
+    est1 = w * (y - mu1) / p + control_sign * (1.0 - w) * (y - mu0) / (1.0 - p)
     notnan = ~jnp.isnan(est1)
     e1 = jnp.where(notnan, est1, 0.0)
     fin = notnan.astype(e1.dtype)
@@ -179,7 +199,8 @@ def aipw_bootstrap_taus_poisson(
 
 
 def aipw_bootstrap_taus_chunked(
-    w, y, p, mu0, mu1, *, key: jax.Array, n_boot: int, chunk: int = 32
+    w, y, p, mu0, mu1, *, key: jax.Array, n_boot: int, chunk: int = 32,
+    control_sign: float = 1.0,
 ) -> jax.Array:
     """All replicate taus with bounded memory: ``lax.map`` over chunks of
     replicates, each chunk drawing its own (chunk, n) index block.
@@ -198,7 +219,7 @@ def aipw_bootstrap_taus_chunked(
         raise ValueError(f"n_boot={n_boot} must be a multiple of chunk={chunk}")
     w, y, p, mu0, mu1 = map(jnp.asarray, (w, y, p, mu0, mu1))
     n = w.shape[0]
-    est1 = w * (y - mu1) / p + (1.0 - w) * (y - mu0) / (1.0 - p)
+    est1 = w * (y - mu1) / p + control_sign * (1.0 - w) * (y - mu0) / (1.0 - p)
     est2 = mu1 - mu0
     keys = jax.random.split(key, n_boot // chunk)
 
@@ -223,6 +244,7 @@ def aipw_bootstrap_se_sharded(
     axis_name: str = "boot",
     chunk: int | None = None,
     style: str = "auto",
+    control_sign: float = 1.0,
 ) -> jax.Array:
     """Mesh-parallel bootstrap SE: replicates sharded over ``axis_name``.
 
@@ -253,7 +275,10 @@ def aipw_bootstrap_se_sharded(
 
     def shard_fn(key, w, y, p, mu0, mu1):
         my_key = jax.random.fold_in(key[0], jax.lax.axis_index(axis_name))
-        taus = taus_fn(w, y, p, mu0, mu1, key=my_key, n_boot=per_dev, chunk=local_chunk)
+        taus = taus_fn(
+            w, y, p, mu0, mu1, key=my_key, n_boot=per_dev,
+            chunk=local_chunk, control_sign=control_sign,
+        )
         return jax.lax.all_gather(taus, axis_name, tiled=True)
 
     fn = jax.shard_map(

@@ -138,7 +138,11 @@ def prop_score_lasso(dataset, treatment_var="W", outcome_var="Y", covariates=Non
 
 
 def doubly_robust(dataset, treatment_var="W", outcome_var="Y", num_trees=100,
-                  bootstrap_se=False, seed=12325):
+                  bootstrap_se=False, seed=12325, compat="r"):
+    """``compat="r"`` (default) reproduces the reference's published
+    sign-quirked AIPW combination (``ate_functions.R:183`` adds the
+    control augmentation); ``"fixed"`` is textbook doubly-robust AIPW
+    — see ``estimators.aipw.aipw_tau``."""
     from ate_replication_causalml_tpu.models.forest import rf_oob_propensity
 
     frame = frame_from_columns(dataset, treatment_var, outcome_var)
@@ -149,15 +153,18 @@ def doubly_robust(dataset, treatment_var="W", outcome_var="Y", num_trees=100,
         ),
         bootstrap_se=bool(bootstrap_se),
         key=jax.random.key(int(seed) + 1),
+        compat=compat,
     )
     return _row(res)
 
 
 def doubly_robust_glm(dataset, treatment_var="W", outcome_var="Y",
-                      bootstrap_se=False, seed=0):
+                      bootstrap_se=False, seed=0, compat="r"):
+    """``compat``: see :func:`doubly_robust`."""
     frame = frame_from_columns(dataset, treatment_var, outcome_var)
     res = E.doubly_robust_glm(
-        frame, bootstrap_se=bool(bootstrap_se), key=jax.random.key(int(seed))
+        frame, bootstrap_se=bool(bootstrap_se), key=jax.random.key(int(seed)),
+        compat=compat,
     )
     return _row(res)
 

@@ -8,6 +8,7 @@ estimators must land near the oracle.
 
 import jax
 import numpy as np
+import pytest
 
 from ate_replication_causalml_tpu.estimators.aipw import (
     aipw_sandwich_se,
@@ -100,6 +101,71 @@ def test_aipw_core_matches_numpy(prep_small):
     se = float(aipw_sandwich_se(w, y, p, mu0, mu1, tau))
     ii = (w * y) / p - mu1 * (w - p) / p - (((1 - w) * y / (1 - p)) + (mu0 * (w - p) / (1 - p))) - want
     np.testing.assert_allclose(se, np.sqrt((ii**2).sum() / n**2), atol=1e-12)
+
+
+def _dr_property_data():
+    """Confounded DGP with an ASYMMETRIC confounder (E[x] != 0), so the
+    reference's sign quirk cannot cancel by symmetry."""
+    rng = np.random.default_rng(42)
+    n, tau = 200_000, 0.3
+    x1 = rng.normal(size=n) + 0.7
+    p_true = 1.0 / (1.0 + np.exp(-(0.8 * x1 - 0.4)))
+    w = (rng.uniform(size=n) < p_true).astype(np.float64)
+    # E[Y | x, w] = 0.5*x1 + tau*w — confounded through x1.
+    y = 0.5 * x1 + tau * w + 0.1 * rng.normal(size=n)
+    mu0_true = 0.5 * x1
+    mu1_true = 0.5 * x1 + tau
+    mu_wrong = np.zeros(n)               # ignores the confounder
+    p_wrong = np.full(n, w.mean())       # ignores the confounder
+    return tau, x1, p_true, w, y, mu0_true, mu1_true, mu_wrong, p_wrong
+
+
+def test_aipw_double_robustness_property_fixed_mode():
+    """The defining AIPW property (SURVEY.md §4): with ``compat="fixed"``
+    (textbook AIPW) the combination stays consistent when EITHER
+    nuisance is misspecified, as long as the other is correct.
+    Closed-form nuisances, no fitting — this pins the combination
+    formula itself. The doubly-wrong case is the negative control:
+    if it were not visibly biased the property test would prove
+    nothing."""
+    tau, _, p_true, w, y, mu0_t, mu1_t, mu_w, p_w = _dr_property_data()
+    j = jax.numpy.asarray
+    n = w.shape[0]
+    f = lambda p, m0, m1: float(
+        aipw_tau(j(w), j(y), j(p), j(m0), j(m1), compat="fixed")
+    )
+    se = 3.0 / np.sqrt(n)  # generous MC tolerance
+    assert abs(f(p_true, mu_w, mu_w) - tau) < se      # p right, mu wrong
+    assert abs(f(p_w, mu0_t, mu1_t) - tau) < se       # mu right, p wrong
+    assert abs(f(p_w, mu_w, mu_w) - tau) > 0.05       # both wrong: biased
+    naive = y[w == 1].mean() - y[w == 0].mean()
+    assert abs(naive - tau) > 0.05                    # confounding is real
+    # Both nuisances right: consistent too, of course.
+    assert abs(f(p_true, mu0_t, mu1_t) - tau) < se
+
+
+def test_aipw_reference_sign_quirk_pinned():
+    """The reference's published combination ADDS the control
+    augmentation (``ate_functions.R:183``) where standard AIPW
+    subtracts it. Pin the quirk's observable consequences so nobody
+    'fixes' compat="r" into silent parity breakage: (a) with both
+    nuisances correct the r-formula is still consistent (each
+    augmentation term is mean-zero); (b) with only the propensity
+    correct it is NOT (double robustness lost) — while the fixed mode
+    is; (c) the two modes differ by exactly twice the control
+    augmentation term."""
+    tau, _, p_true, w, y, mu0_t, mu1_t, mu_w, _ = _dr_property_data()
+    j = jax.numpy.asarray
+    n = w.shape[0]
+    se = 3.0 / np.sqrt(n)
+    r = lambda p, m0, m1: float(aipw_tau(j(w), j(y), j(p), j(m0), j(m1)))
+    assert abs(r(p_true, mu0_t, mu1_t) - tau) < se        # both right: ok
+    est_r_bad = r(p_true, mu_w, mu_w)
+    assert abs(est_r_bad - tau) > 0.05, est_r_bad          # NOT doubly robust
+    # Exact algebraic relation between the modes:
+    fixed = float(aipw_tau(j(w), j(y), j(p_true), j(mu_w), j(mu_w), compat="fixed"))
+    ctrl = np.mean((1.0 - w) * (y - mu_w) / (1.0 - p_true))
+    assert est_r_bad - fixed == pytest.approx(2.0 * ctrl, rel=1e-5)
 
 
 def test_clip_propensity():
