@@ -25,7 +25,7 @@ Layer map (SURVEY.md §7.1):
   L5 ``pipeline``   — notebook-equivalent driver + plots + checkpointing
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from ate_replication_causalml_tpu.estimators.base import EstimatorResult, ResultTable
 

@@ -32,6 +32,7 @@ from typing import Callable, Iterable
 import jax
 import numpy as np
 
+from ate_replication_causalml_tpu import __version__
 from ate_replication_causalml_tpu.data.frame import CausalFrame
 from ate_replication_causalml_tpu.data.pipeline import (
     PrepConfig,
@@ -199,10 +200,13 @@ def run_sweep(
         os.makedirs(outdir, exist_ok=True)
     # Resume is only valid for the same config + data source + device
     # topology (mesh and single-device runs are statistically equivalent
-    # but not bit-identical).
+    # but not bit-identical) + framework version: estimator code changes
+    # between versions silently resurface stale rows otherwise (observed
+    # in round 3 — a QP-solver upgrade resumed the pre-upgrade numbers).
     mesh_devices = jax.device_count() if config.use_mesh else 1
     fingerprint = (
         f"{config!r}|csv={csv_path or 'synthetic'}|devices={mesh_devices}"
+        f"|version={__version__}"
     )
     ckpt = _Checkpoint(
         os.path.join(outdir, "results.jsonl") if outdir else None,
