@@ -28,10 +28,11 @@ from ate_replication_causalml_tpu.models.forest import fit_forest_classifier, pr
 from ate_replication_causalml_tpu.ops.linalg import ols_no_intercept_1d
 
 
-def _rf_prob_on_full(frame: CausalFrame, train_idx, target: jax.Array, key, n_trees,
-                     depth, mesh=None):
-    """Train a classification forest on ``train_idx`` rows, return vote
-    fractions on the FULL sample (``ate_functions.R:352-357``). With a
+def _fit_nuisance_forest(frame: CausalFrame, train_idx, target: jax.Array, key,
+                         n_trees, depth, mesh=None):
+    """Classification forest of ``target`` on X over ``train_idx`` rows —
+    the one nuisance fit both cross-fitting modes share (a divergence
+    here would silently give them different nuisance models). With a
     ``mesh``, trees shard over its tree axis (the nuisance forests are
     the DML hot loop, SURVEY.md §3.4)."""
     sub = frame.take(train_idx)
@@ -39,11 +40,16 @@ def _rf_prob_on_full(frame: CausalFrame, train_idx, target: jax.Array, key, n_tr
     if mesh is not None:
         from ate_replication_causalml_tpu.models.forest import fit_forest_sharded
 
-        forest = fit_forest_sharded(
-            sub.x, tgt, key, mesh, n_trees=n_trees, depth=depth
-        )
-    else:
-        forest = fit_forest_classifier(sub.x, tgt, key, n_trees=n_trees, depth=depth)
+        return fit_forest_sharded(sub.x, tgt, key, mesh, n_trees=n_trees, depth=depth)
+    return fit_forest_classifier(sub.x, tgt, key, n_trees=n_trees, depth=depth)
+
+
+def _rf_prob_on_full(frame: CausalFrame, train_idx, target: jax.Array, key, n_trees,
+                     depth, mesh=None):
+    """Vote fractions on the FULL sample (``ate_functions.R:352-357`` —
+    in-sample for the training fold: the reference's partial
+    cross-fitting)."""
+    forest = _fit_nuisance_forest(frame, train_idx, target, key, n_trees, depth, mesh)
     return predict_forest(forest, frame.x).vote
 
 
@@ -67,18 +73,46 @@ def chernozhukov(
     return ols_no_intercept_1d(w_resid, y_resid)
 
 
+def _rf_prob_oof(frame: CausalFrame, train_idx, pred_idx, target, key, n_trees,
+                 depth, mesh=None):
+    """Train on ``train_idx``, predict vote fractions ONLY on ``pred_idx``
+    (the held-out fold) — the proper cross-fitting primitive."""
+    forest = _fit_nuisance_forest(frame, train_idx, target, key, n_trees, depth, mesh)
+    return predict_forest(forest, frame.x[jnp.asarray(pred_idx)]).vote
+
+
 def double_ml(
     frame: CausalFrame,
     n_trees: int = 100,
     depth: int = 9,
     key: jax.Array | None = None,
     se_mode: str = "r",
+    crossfit: str = "r",
     mesh=None,
     method: str = "Double Machine Learning",
 ) -> EstimatorResult:
-    """2-fold DML with the reference's deterministic split and averaging."""
+    """2-fold DML with the reference's deterministic split.
+
+    ``crossfit="r"`` (default) reproduces the reference's PARTIAL
+    cross-fitting: each nuisance forest predicts on the full sample,
+    in-sample for the fold it was trained on (``ate_functions.R:352-357``
+    — the W-model sees fold 1 at train AND predict time), and the two
+    fold estimates are averaged with ``se_mode`` ("r" = averaged SEs,
+    the reference's anti-conservative choice; "pooled" available).
+
+    ``crossfit="full"`` is textbook DML (Chernozhukov et al. 2018):
+    BOTH nuisances for each fold are trained on the other fold only —
+    out-of-fold predictions everywhere (4 forest fits, the same count
+    as the "r" path's two chernozhukov calls) — stitched into
+    full-sample residuals, with one pooled no-intercept OLS giving
+    (tau, se).
+    ``se_mode`` is ignored in this mode (there is one regression, no SE
+    averaging quirk to choose between).
+    """
     if se_mode not in ("r", "pooled"):
         raise ValueError(f"se_mode must be 'r' or 'pooled', got {se_mode!r}")
+    if crossfit not in ("r", "full"):
+        raise ValueError(f"crossfit must be 'r' or 'full', got {crossfit!r}")
     if key is None:
         key = jax.random.key(123)
     n = frame.n
@@ -86,6 +120,18 @@ def double_ml(
     idx1 = np.arange(half)
     idx2 = np.arange(half, n)
     ka, kb = jax.random.split(key)
+    if crossfit == "full":
+        kw1, ky1 = jax.random.split(ka)
+        kw2, ky2 = jax.random.split(kb)
+        ew = jnp.zeros(n)
+        ey = jnp.zeros(n)
+        # Fold k's nuisances come from the OTHER fold's rows only.
+        ew = ew.at[idx1].set(_rf_prob_oof(frame, idx2, idx1, frame.w, kw1, n_trees, depth, mesh))
+        ew = ew.at[idx2].set(_rf_prob_oof(frame, idx1, idx2, frame.w, kw2, n_trees, depth, mesh))
+        ey = ey.at[idx1].set(_rf_prob_oof(frame, idx2, idx1, frame.y, ky1, n_trees, depth, mesh))
+        ey = ey.at[idx2].set(_rf_prob_oof(frame, idx1, idx2, frame.y, ky2, n_trees, depth, mesh))
+        tau, se = ols_no_intercept_1d(frame.w - ew, frame.y - ey)
+        return EstimatorResult.from_point_se(method, tau, se)
     tau1, se1 = chernozhukov(frame, idx1, idx2, n_trees, depth, ka, mesh=mesh)
     tau2, se2 = chernozhukov(frame, idx2, idx1, n_trees, depth, kb, mesh=mesh)
     tau = (tau1 + tau2) / 2.0
