@@ -82,6 +82,16 @@ MICRO = dataclasses.replace(
 
 
 def test_changed_config_invalidates_checkpoint(tmp_path):
+    """One MICRO sweep writes a real checkpoint; the invalidation
+    mechanics are then asserted directly on ``_Checkpoint`` with the
+    fingerprints ``run_sweep`` itself would construct (a changed config
+    reprs differently, so its fingerprint differs) — a second full
+    sweep only re-exercised the estimator stages the first one already
+    covered, at ~2 min of XLA compiles (suite wall-clock, VERDICT r2
+    #8). The resume-on-match leg runs end-to-end in
+    ``test_full_sweep_and_resume``."""
+    from ate_replication_causalml_tpu.pipeline import _Checkpoint
+
     out = str(tmp_path / "sweep")
     run_sweep(MICRO, outdir=out, plots=False, log=lambda s: None)
     # report.json must be strict JSON (the no-SE LASSO rows carry NaN
@@ -93,11 +103,29 @@ def test_changed_config_invalidates_checkpoint(tmp_path):
     _json.loads(txt)
 
     changed = dataclasses.replace(MICRO, dr_trees=MICRO.dr_trees + 1)
+    assert repr(changed) != repr(MICRO)
+    path = os.path.join(out, "results.jsonl")
+
+    # The on-disk fingerprint embeds the config repr — the link that
+    # makes "changed config => different fingerprint" actually hold for
+    # run_sweep (pipeline.py builds f"{config!r}|csv=...|...").
+    header = _json.loads(open(path).readline())
+    assert repr(MICRO) in header["fingerprint"]
+
+    # Same fingerprint: rows resume.
+    same = _Checkpoint(path, header["fingerprint"], log=lambda s: None)
+    assert same.get("naive") is not None
+
+    # Any differing fingerprint (as a changed config produces, per the
+    # repr assertions above): the checkpoint is set aside, nothing
+    # resumes, a fresh header appears.
     logs = []
-    run_sweep(changed, outdir=out, plots=False, log=logs.append)
-    assert not any("[resume]" in l for l in logs)
+    fresh = _Checkpoint(path, header["fingerprint"] + "|changed", log=logs.append)
     assert any("different config" in l for l in logs)
-    assert os.path.exists(os.path.join(out, "results.jsonl.stale"))
+    assert os.path.exists(path + ".stale")
+    assert fresh.get("naive") is None
+    new_header = _json.loads(open(path).readline())
+    assert new_header["fingerprint"] == header["fingerprint"] + "|changed"
 
 
 def test_sweep_no_outdir_runs_in_memory():
