@@ -123,8 +123,11 @@ def double_ml(
     if crossfit == "full":
         kw1, ky1 = jax.random.split(ka)
         kw2, ky2 = jax.random.split(kb)
-        ew = jnp.zeros(n)
-        ey = jnp.zeros(n)
+        # Accumulate at the frame's precision (f64 under x64 stays f64 —
+        # advisor r3) but never below f32: the votes are fractions, and
+        # an integer-dtype frame must not truncate them.
+        ew = jnp.zeros(n, jnp.result_type(frame.w.dtype, jnp.float32))
+        ey = jnp.zeros(n, jnp.result_type(frame.y.dtype, jnp.float32))
         # Fold k's nuisances come from the OTHER fold's rows only.
         ew = ew.at[idx1].set(_rf_prob_oof(frame, idx2, idx1, frame.w, kw1, n_trees, depth, mesh))
         ew = ew.at[idx2].set(_rf_prob_oof(frame, idx1, idx2, frame.w, kw2, n_trees, depth, mesh))

@@ -146,6 +146,12 @@ def balance_qp(
         # argmin eta*||z - m||_inf^2 + rho/2*||z - v||^2
         return m + prox_sq_inf_norm(v - m, eta / rho_c)
 
+    # Freeze point for rho adaptation: never later than half the
+    # iteration budget, so a short-budget caller (max_iters <= 500)
+    # still gets a fixed-rho tail and the convergence-guarantee
+    # argument in ``body`` applies in every regime.
+    adapt_iters = min(_ADAPT_ITERS, max_iters // 2)
+
     def cond(state):
         _, _, _, _, _, rp, rd, i = state
         return jnp.logical_and(i < max_iters, jnp.maximum(rp, rd) > tol)
@@ -170,11 +176,11 @@ def balance_qp(
         # rho left the notebook-scale arms >1e-4 away after 12k
         # iterations; doubling/halving toward balanced residuals (scaled
         # duals rescaled by rho_old/rho_new) converges the same arms in
-        # a few hundred. Adaptation FREEZES after _ADAPT_ITERS (Boyd's
+        # a few hundred. Adaptation FREEZES after ``adapt_iters`` (Boyd's
         # recipe): with rho eventually fixed, the standard fixed-rho ADMM
         # convergence guarantee applies from that point on — an
         # indefinitely oscillating rho has no such guarantee.
-        adapt = i < _ADAPT_ITERS
+        adapt = i < adapt_iters
         scale = jnp.where(
             adapt & (rp > 10.0 * rd), 2.0,
             jnp.where(adapt & (rd > 10.0 * rp), 0.5, 1.0),
@@ -202,7 +208,7 @@ def balance_qp(
     )
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=32)
 def _balance_qp_jitted_x64(zeta, ub, rho, max_iters, tol):
     return jax.jit(
         functools.partial(

@@ -8,12 +8,17 @@ device: logit outcome model (IRLS), logit propensity, AIPW combination,
 then 10,000 bootstrap replicates of the combination step, chunked +
 sharded over the mesh.
 
-Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": "s", "vs_baseline": N}
-vs_baseline = (60 s target) / measured — >1 means faster than target.
+The default (no-args) mode prints one JSON record per north-star metric
+(VERDICT r3 #2) — the AIPW bootstrap line first, then the 1M-row causal
+forest sec/1M line (min of two warm fits, both samples + MFU in the
+record). The forest line prints LAST so a single-line parse lands on
+the flagship metric:
+  {"metric": ..., "value": N, "unit": "s", "vs_baseline": N, ...}
+vs_baseline = baseline / measured — >1 means faster than target.
 """
 
 import json
+import os
 import sys
 import time
 
@@ -38,6 +43,9 @@ BASELINE_S = 60.0
 FOREST_ROWS = 100_000
 FOREST_TREES = 2_000
 FOREST_BASELINE_S_PER_1M = 6_700.0
+# Default-mode forest scale (smoke override; parsed at import so a
+# malformed value fails before the AIPW stage burns minutes).
+DEFAULT_FOREST_ROWS = int(os.environ.get("ATE_BENCH_FOREST_ROWS", 1_000_000))
 
 
 def make_panel(key, n):
@@ -120,8 +128,9 @@ def bench_forest(n=FOREST_ROWS):
     # v5e (lite) peak ≈ 197 TFLOP/s bf16 / ≈49 TFLOP/s f32 MXU; report
     # against the f32 peak since the engine runs f32 histograms.
     mfu = flops / steady_s / 49.2e12
-    # Stderr diagnostics first; the required JSON line is the LAST thing
-    # printed, so a mid-run failure can never leave two JSON lines.
+    # Stderr diagnostics only — the JSON record is RETURNED, and the
+    # caller (main) owns when it prints: in default mode both metric
+    # records print together only after every stage succeeds.
     print(
         f"# rows={n} trees={FOREST_TREES} first={compile_s:.1f}s "
         f"steady={steady_s:.1f}s (runs {steady_a:.1f}/{steady_b:.1f}) "
@@ -129,16 +138,18 @@ def bench_forest(n=FOREST_ROWS):
         f"fit_matmul_flops={flops:.3e} mfu_f32~{mfu * 100:.1f}%",
         file=sys.stderr,
     )
-    print(
-        json.dumps(
-            {
-                "metric": "causal_forest_2000_trees_sec_per_1m_rows",
-                "value": round(sec_per_1m, 1),
-                "unit": "s",
-                "vs_baseline": round(FOREST_BASELINE_S_PER_1M / sec_per_1m, 2),
-            }
-        )
-    )
+    # Both warm samples ride in the record (advisor r3: min-of-two alone
+    # reports the optimistic sample; downstream readers get the raw pair
+    # and can take the median/max themselves), plus the MFU diagnostic.
+    return {
+        "metric": "causal_forest_2000_trees_sec_per_1m_rows",
+        "value": round(sec_per_1m, 1),
+        "unit": "s",
+        "vs_baseline": round(FOREST_BASELINE_S_PER_1M / sec_per_1m, 2),
+        "samples_s": [round(steady_a, 1), round(steady_b, 1)],
+        "rows": n,
+        "mfu_f32_pct": round(mfu * 100, 1),
+    }
 
 
 def bench_hist_ab(n=N_ROWS, trees=32, depth=9):
@@ -192,7 +203,6 @@ def bench_sharded():
     single-device run on the same silicon. On a pod the same code's
     boot axis rides ICI/DCN. Numbers land in RESULTS.md.
     """
-    import os
     import subprocess
 
     if os.environ.get("_ATE_SHARDED_CHILD") != "1":
@@ -273,7 +283,8 @@ def main():
         rows = FOREST_ROWS
         if "--rows" in sys.argv:
             rows = int(sys.argv[sys.argv.index("--rows") + 1])
-        return bench_forest(rows)
+        print(json.dumps(bench_forest(rows)))
+        return None
     from ate_replication_causalml_tpu.estimators.aipw import _outcome_model_mu, aipw_tau
     from ate_replication_causalml_tpu.ops.bootstrap import aipw_bootstrap_taus_poisson, sd
     from ate_replication_causalml_tpu.ops.glm import logistic_glm
@@ -305,31 +316,37 @@ def main():
     tau, se = float(tau), float(se)
     compile_and_run = time.perf_counter() - t0
 
-    best = float("inf")
+    samples = []
     for rep in range(3):
         t0 = time.perf_counter()
         tau, se = full_aipw_bootstrap(x, w, y, jax.random.key(2 + rep))
         tau, se = float(tau), float(se)
-        best = min(best, time.perf_counter() - t0)
+        samples.append(time.perf_counter() - t0)
+    best = min(samples)
 
-    # Stderr diagnostics first; the required JSON line is the LAST
-    # thing printed (a mid-run failure can never leave two JSON lines).
     print(
         f"# tau={tau:.6f} se={se:.6f} "
         f"first_call={compile_and_run:.1f}s steady={best:.3f}s "
         f"devices={jax.device_count()}",
         file=sys.stderr,
     )
-    print(
-        json.dumps(
-            {
-                "metric": "aipw_bootstrap_se_10k_replicates_1m_rows",
-                "value": round(best, 3),
-                "unit": "s",
-                "vs_baseline": round(BASELINE_S / best, 2),
-            }
-        )
-    )
+    aipw_record = {
+        "metric": "aipw_bootstrap_se_10k_replicates_1m_rows",
+        "value": round(best, 3),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_S / best, 2),
+        "samples_s": [round(s, 3) for s in samples],
+    }
+    # VERDICT r3 #2: the default (driver-run) bench must carry BOTH
+    # north-star metrics. Both stages run to completion BEFORE either
+    # JSON record prints — a mid-run failure (and the __main__ re-exec
+    # retry it triggers) can never leave partial or duplicated records.
+    # The flagship forest record prints LAST so a single-line parse
+    # lands on the sec/1M metric. (Env override exists so a smoke run
+    # doesn't need the full 1M fit.)
+    forest_record = bench_forest(DEFAULT_FOREST_ROWS)
+    print(json.dumps(aipw_record))
+    print(json.dumps(forest_record))
 
 
 if __name__ == "__main__":
@@ -341,7 +358,6 @@ if __name__ == "__main__":
     try:
         main()
     except Exception:  # noqa: BLE001 — re-exec-once guard
-        import os
         import traceback
 
         traceback.print_exc()
