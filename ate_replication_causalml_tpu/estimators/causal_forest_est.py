@@ -49,13 +49,18 @@ def causal_forest_report(
     key: jax.Array | None = None,
     n_trees: int = 2000,
     method_name: str = "Causal Forest(GRF)",
+    variance_compat: str = "unbiased",
     **fit_kwargs,
 ) -> CausalForestReport:
     """One fit, both outputs of the notebook chunk: the incorrect
     mean-of-CATEs ATE/SE demo and the correct AIPW result row — sharing
-    the fitted forest and its CATE predictions."""
+    the fitted forest and its CATE predictions. ``variance_compat``:
+    see :func:`models.causal_forest.predict_cate` (grf's num_groups df
+    vs the unbiased gn−1 default)."""
     fitted = fit_causal_forest(frame, key=key, n_trees=n_trees, **fit_kwargs)
-    cate = predict_cate(fitted.forest, fitted.x, oob=True)
+    cate = predict_cate(
+        fitted.forest, fitted.x, oob=True, variance_compat=variance_compat
+    )
     ate_bad, se_bad = incorrect_forest_ate(cate)
     eff = average_treatment_effect(fitted, cate=cate)
     return CausalForestReport(

@@ -75,6 +75,11 @@ from ate_replication_causalml_tpu.models.forest import (
 )
 from ate_replication_causalml_tpu.ops.hist_pallas import bin_histogram, node_sums
 from ate_replication_causalml_tpu.ops.linalg import _PREC
+from ate_replication_causalml_tpu.ops.tree_pallas import (
+    codes_transposed,
+    route_bits,
+    table_lookup,
+)
 from ate_replication_causalml_tpu.parallel.retry import require_all, run_shards
 
 _EPS = 1e-12
@@ -384,6 +389,11 @@ def _grow_cf_chunk(group_keys, codes, wt, yt, mom_stack, xb_onehot, *,
     n, p = codes.shape
     max_nodes = 1 << (depth - 1)
     n_leaves = 1 << depth
+    if hist_backend.startswith("pallas"):
+        # Shared routing operand for the Pallas route kernel — the
+        # streaming growers always run mask mode on the shared full-n
+        # codes, so one transpose serves every group/tree/level.
+        codes_t = codes_transposed(codes)
 
     def grow_one_streaming(codes_g, mom_g, gw, ew, split_key):
         """Streaming (Pallas) grow: the ρ-decomposed level pipeline.
@@ -450,6 +460,13 @@ def _grow_cf_chunk(group_keys, codes, wt, yt, mom_stack, xb_onehot, *,
                 backend=hist_backend,
             ),
             tables_fn=tables_fn,
+            route_fn=lambda ids, bf, bb: route_bits(
+                codes_t, ids, bf, bb,
+                backend=(
+                    "pallas_interpret"
+                    if hist_backend == "pallas_interpret" else "pallas"
+                ),
+            ),
         )
         # Leaf payloads feed predictions directly — keep them full f32
         # even when the split search runs the lossy-bf16 kernel (the
@@ -463,15 +480,17 @@ def _grow_cf_chunk(group_keys, codes, wt, yt, mom_stack, xb_onehot, *,
     def grow_one(codes_g, wt_g, yt_g, mom_g, oh_g, base, idx, tree_key):
         """Grow one honest tree.
 
-        For the streaming backends (xla/pallas) the caller gathers the
-        group's s-row half-sample (``idx``), so every histogram/moment
-        pass touches s = n·sample_fraction rows and ``base`` is
-        all-ones. For the 'onehot' backend the rows stay full-n with
-        ``base`` the subsample mask (``idx=None``) — gathering would
-        copy the shared (n, p·n_bins) one-hot per vmapped group
-        (gigabytes); masking keeps it shared. The honesty Bernoulli is
-        always drawn in full-n row space and gathered, so every backend
-        sees the same honest partition from the same key.
+        Dispatch (see ``grow_group``): the 'onehot' AND streaming
+        (pallas) backends run MASK mode — rows stay full-n, ``base`` is
+        the subsample mask, ``idx=None`` — because their shared operands
+        (the (n, p·n_bins) one-hot / the kernel codes stream and the
+        chunk-level ``codes_t`` route operand) must stay shared across
+        vmapped groups; gathering would copy them per group AND
+        misalign the full-n route operand. Only the 'xla' backend
+        gathers the group's s-row half-sample (``idx``), with ``base``
+        all-ones. The honesty Bernoulli is always drawn in full-n row
+        space and gathered, so every backend sees the same honest
+        partition from the same key.
         """
         rows = codes_g.shape[0]
         if honesty:
@@ -685,6 +704,21 @@ def _tree_route(feats, bins, codes, depth):
     return node
 
 
+def _tree_route_stream(feats, bins, codes_t, depth, backend="pallas"):
+    """:func:`_tree_route` on the Pallas route kernel — same integer
+    selections bit-for-bit, no (rows, M) one-hot in HBM. ``codes_t`` is
+    the shared :func:`codes_transposed` operand. Vmapping over trees
+    collapses into tree-batched kernel calls per level."""
+    rows = codes_t.shape[1]
+    node = jnp.zeros(rows, jnp.int32)
+    for level in range(depth):
+        m = 1 << level
+        node = node * 2 + route_bits(
+            codes_t, node, feats[level][:m], bins[level][:m], backend=backend
+        )
+    return node
+
+
 @functools.partial(jax.jit, static_argnames=("tree_chunk", "row_chunk"))
 def compute_leaf_index(
     forest: CausalForest, x: jax.Array, tree_chunk: int = 32,
@@ -728,7 +762,12 @@ def _tau_from_sums(S, M):
     return tau, var
 
 
-@functools.partial(jax.jit, static_argnames=("oob", "tree_chunk", "row_chunk"))
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "oob", "tree_chunk", "row_chunk", "row_backend", "variance_compat"
+    ),
+)
 def predict_cate(
     forest: CausalForest,
     x: jax.Array,
@@ -736,6 +775,8 @@ def predict_cate(
     tree_chunk: int = 32,
     row_chunk: int = 65536,
     leaf_index: jax.Array | None = None,
+    row_backend: str | None = None,
+    variance_compat: str = "unbiased",
 ) -> CatePredictions:
     """Forest-weighted CATE τ̂(x) with little-bags variance. The little-
     bag grouping (``forest.ci_group_size``) travels with the forest.
@@ -766,12 +807,41 @@ def predict_cate(
     k = forest.ci_group_size
     n_groups = T // k
 
-    def per_tree(feats, bins, leaf_stats, in_row, li, codes_b):
-        node = _tree_route(feats, bins, codes_b, depth) if li is None else li
-        # Leaf payload broadcast as one (rows, L) @ (L, 5) contraction —
-        # a per-row gather from leaf_stats serializes on TPU.
-        leaf_oh = jax.nn.one_hot(node, n_leaves, dtype=jnp.float32)
-        stats = jnp.matmul(leaf_oh, leaf_stats, precision=_PREC)  # (rows, 5)
+    # On TPU the per-row stages run the Pallas row kernels
+    # (ops/tree_pallas.py): routing without the per-level (rows, M)
+    # one-hot, leaf-payload broadcast without the (rows, L) one-hot.
+    # Both are exact integer/one-nonzero selections — identical output
+    # to the matmul formulations (the CPU/test path below).
+    # ``row_backend``: None = auto ("pallas" on TPU, matmul elsewhere);
+    # "pallas_interpret" lets CPU tests exercise the kernel path;
+    # "matmul" forces the one-hot formulation anywhere.
+    if row_backend is None:
+        row_backend = "pallas" if jax.default_backend() == "tpu" else "matmul"
+    if row_backend not in ("pallas", "pallas_interpret", "matmul"):
+        raise ValueError(
+            "row_backend must be 'pallas', 'pallas_interpret' or 'matmul', "
+            f"got {row_backend!r}"
+        )
+    streaming = row_backend != "matmul"
+
+    def per_tree(feats, bins, leaf_stats, in_row, li, codes_b, codes_t_b):
+        if li is not None:
+            node = li
+        elif streaming:
+            node = _tree_route_stream(
+                feats, bins, codes_t_b, depth, backend=row_backend
+            )
+        else:
+            node = _tree_route(feats, bins, codes_b, depth)
+        if streaming:
+            stats = table_lookup(
+                leaf_stats.T, node, backend=row_backend
+            ).T  # (rows, 5)
+        else:
+            # Leaf payload broadcast as one (rows, L) @ (L, 5)
+            # contraction — a per-row gather serializes on TPU.
+            leaf_oh = jax.nn.one_hot(node, n_leaves, dtype=jnp.float32)
+            stats = jnp.matmul(leaf_oh, leaf_stats, precision=_PREC)  # (rows, 5)
         cnt = stats[:, 0]
         valid = cnt > 0
         if oob:
@@ -821,6 +891,13 @@ def predict_cate(
 
     def block_fn(xs):
         codes_blk, in_blk, li_blk = xs  # (rb, p), (n_chunks, gc, k, rb), …
+        # With a precomputed leaf_index routing is skipped entirely, so
+        # the transposed route operand is never read — don't build it.
+        codes_t_blk = (
+            codes_transposed(codes_blk)
+            if streaming and leaf_index is None
+            else None
+        )
 
         def chunk_fn(args):
             feats, bins, stats, inr, li = args  # (gc, k, …)
@@ -834,7 +911,7 @@ def predict_cate(
                 rest = list(rest)
                 i = rest.pop(0) if inr is not None else None
                 l = rest.pop(0) if li is not None else None
-                return per_tree(f, b, s, i, l, codes_blk)
+                return per_tree(f, b, s, i, l, codes_blk, codes_t_blk)
 
             m, valid = jax.vmap(jax.vmap(one))(*vargs)
             # m: (gc, k, rb, 5) per-tree normalized moments. The
@@ -909,19 +986,22 @@ def predict_cate(
     # compute_variance with the intercept profiled out):
     #   Var(τ̂) = max(V_between(ψ) − V_within(ψ)/k, 0) / H²
     # with ψ evaluated at the pooled τ̂ and H the pooled Var(w̃).
-    # Known df divergence from grf (documented, not replicated): grf
-    # normalizes the between-group variance by num_groups while this
-    # uses the unbiased gn−1, and grf's half-sample "Bayes debiasing"
-    # correction is skipped by both (grf only applies it when
-    # ci_group_size > 1 subsampling leaves it well-defined). At the
-    # notebook's 1000 groups the ratio is 999/1000 — far below the
-    # little-bags estimator's own Monte-Carlo noise; a true-R grf
-    # comparison at small group counts should divide by gn here.
+    # df quirk pair (VERDICT r3 #7): grf normalizes the between-group
+    # variance by num_groups; the default here is the unbiased gn−1.
+    # ``variance_compat="grf"`` reproduces grf's divisor for true-grf
+    # comparisons at small group counts (at the notebook's 1000 groups
+    # the ratio is 999/1000 — far below the estimator's own Monte-Carlo
+    # noise). grf's half-sample "Bayes debiasing" correction is skipped
+    # by both sides (grf only applies it when ci_group_size > 1
+    # subsampling leaves it well-defined).
+    if variance_compat not in ("unbiased", "grf"):
+        raise ValueError(
+            f"variance_compat must be 'unbiased' or 'grf', got {variance_compat!r}"
+        )
     ngr = jnp.maximum(gn, 1.0)
     mean_psi = SP / ngr
-    v_between = jnp.maximum(SP2 - gn * mean_psi * mean_psi, 0.0) / jnp.maximum(
-        gn - 1.0, 1.0
-    )
+    between_df = ngr if variance_compat == "grf" else jnp.maximum(gn - 1.0, 1.0)
+    v_between = jnp.maximum(SP2 - gn * mean_psi * mean_psi, 0.0) / between_df
     v_within = ssw / jnp.maximum(gn * (k - 1.0), 1.0)
     var_psi = jnp.maximum(v_between - v_within / k, 0.0)
     variance = jnp.where(

@@ -49,6 +49,11 @@ from ate_replication_causalml_tpu.ops.hist_pallas import (
     resolve_hist_backend,
 )
 from ate_replication_causalml_tpu.ops.linalg import _PREC
+from ate_replication_causalml_tpu.ops.tree_pallas import (
+    codes_transposed,
+    route_bits,
+    table_lookup,
+)
 from ate_replication_causalml_tpu.parallel.retry import require_all, run_shards
 
 
@@ -225,7 +230,8 @@ def bitrev_perm(level: int) -> tuple[int, ...]:
     return tuple(out)
 
 
-def streaming_level_loop(codes, depth, n_bins, hist_fn, tables_fn):
+def streaming_level_loop(codes, depth, n_bins, hist_fn, tables_fn,
+                         route_fn=None):
     """The ONE bit-reversed level loop shared by both streaming growers
     (classifier/regression and ρ-decomposed causal) — the rev-id
     bookkeeping is identical and must stay so, hence one site.
@@ -245,6 +251,11 @@ def streaming_level_loop(codes, depth, n_bins, hist_fn, tables_fn):
       tables_fn: (hist_full, level, perm) → (bf_rev, bb_rev) split
         tables in rev order (``perm`` = that level's bit reversal, for
         re-mapping per-node randomness).
+      route_fn: optional (ids, bf_rev, bb_rev) → (n,) int32 route bits
+        (1 = right). When given (the device growers pass the Pallas
+        route kernel — ops/tree_pallas.py), it replaces the blocked
+        one-hot-matmul routing; both are exact integer selections and
+        must agree bit-for-bit (asserted in tests/test_tree_pallas.py).
 
     Returns: (feats (depth, 2^(depth−1)), bins (same), node_int (n,))
     with split tables converted to the stored interleaved layout.
@@ -267,8 +278,11 @@ def streaming_level_loop(codes, depth, n_bins, hist_fn, tables_fn):
         prev = hist
         perm = bitrev_perm(level)
         bf_rev, bb_rev = tables_fn(hist, level, perm)
-        routed = route_rows_blocked(node_rev, bf_rev, bb_rev, codes)
-        bit = routed - 2 * node_rev
+        if route_fn is None:
+            routed = route_rows_blocked(node_rev, bf_rev, bb_rev, codes)
+            bit = routed - 2 * node_rev
+        else:
+            bit = route_fn(node_rev, bf_rev, bb_rev)
         node_int = node_int * 2 + bit
         node_rev = node_rev + bit * m
         perm_a = jnp.asarray(perm, jnp.int32)
@@ -609,6 +623,15 @@ def _grow_chunk(tree_keys, codes, yf, xb_onehot, *, depth, mtry, n_bins, hist_ba
     n, p = codes.shape
     max_nodes = 1 << (depth - 1)
     n_leaves = 1 << depth
+    if hist_backend.startswith("pallas"):
+        # Row-side Pallas kernels (ops/tree_pallas.py): the transposed
+        # routing operand is built ONCE per chunk and shared by every
+        # tree/level; "pallas_interpret" (the CPU test mode) threads
+        # through to both kernels.
+        codes_t = codes_transposed(codes)
+        row_backend = (
+            "pallas_interpret" if hist_backend == "pallas_interpret" else "pallas"
+        )
 
     def grow_one(tree_key):
         ck, gk = jax.random.split(tree_key)
@@ -674,6 +697,9 @@ def _grow_chunk(tree_keys, codes, yf, xb_onehot, *, depth, mtry, n_bins, hist_ba
                 ),
                 tables_fn=lambda hist, level, perm: split_tables(
                     hist, level_keys[level], 1 << level, perm=perm
+                ),
+                route_fn=lambda ids, bf, bb: route_bits(
+                    codes_t, ids, bf, bb, backend=row_backend
                 ),
             )
         else:
@@ -742,12 +768,21 @@ def _grow_chunk(tree_keys, codes, yf, xb_onehot, *, depth, mtry, n_bins, hist_ba
                 counts * yt, node_of_row, num_segments=n_leaves
             )
         leaf_value = jnp.where(leaf_c > 0, base + leaf_y / jnp.maximum(leaf_c, 1e-12), mu)
+        # Training-row leaf recording: the plain gather serializes
+        # per row on TPU (a round-4 device trace measured it at
+        # ~8 ms/tree at 1M rows — the largest single op of the fit);
+        # the streaming backends run the table-lookup kernel instead.
+        train_vals = (
+            table_lookup(leaf_value, node_of_row, backend=row_backend)
+            if hist_backend.startswith("pallas")
+            else leaf_value[node_of_row]
+        )
         # Bootstrap counts persist only for the OOB mask (count == 0);
         # uint8 storage is 4× smaller than f32 — (T, n) at a 500-tree ×
         # 1M-row nuisance fit is 2 GB in f32. Counts > 255 clamp to 255:
         # the mask only distinguishes 0 from >0, so the clamp can never
         # flip an in-bag row to OOB the way a wrapping cast could.
-        return feats, bins, leaf_value, jnp.minimum(counts, 255).astype(jnp.uint8), leaf_value[node_of_row]
+        return feats, bins, leaf_value, jnp.minimum(counts, 255).astype(jnp.uint8), train_vals
 
     if tree_keys.ndim == 1:
         return jax.vmap(grow_one)(tree_keys)

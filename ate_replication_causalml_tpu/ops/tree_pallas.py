@@ -1,0 +1,342 @@
+"""Pallas TPU kernels for the per-ROW side of forest growth.
+
+The histogram kernel (ops/hist_pallas.py) removed the per-node
+reduction bottleneck; a round-4 device trace (scripts/trace_fit.py)
+showed the remaining grow time concentrated in two per-row stages that
+still ran as XLA ops:
+
+  * the training-row leaf-value recording ``leaf_value[node_of_row]``
+    (models/forest.py) lowered to a serialized per-row gather —
+    ~8 ms/tree at 1M rows, ~25% of the classifier fit;
+  * per-level routing (route_rows_blocked) built a (rows, nodes)
+    one-hot in HBM per tree per level — ~5 ms/tree in transient
+    HBM traffic, lax.map block overhead and thin matmuls.
+
+Both are row-parallel maps with tiny per-node tables — the exact shape
+Pallas handles well: stream the rows through VMEM in tiles, keep the
+table VMEM-resident across the whole sweep, and emit one output row
+per tree. No accumulation across grid steps, so the grid is trivially
+sequential-safe.
+
+Both kernels are EXACT (integer compares / one-nonzero-product
+selections in f32 — no rounding path), asserted against the XLA
+formulations in tests/test_tree_pallas.py.
+
+Like the histogram kernel, each public entry point is wrapped in
+``jax.custom_batching.custom_vmap``: the growers call them per tree
+under (nested) ``jax.vmap``, and the rule collapses every vmap level
+into the kernel's tree axis so one chunk of trees makes ONE kernel
+call per level (reference context: grf's C++ core routes rows
+per-tree serially, ate_functions.R:169-174 / grf's tree training —
+here the whole chunk rides one codes stream).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ate_replication_causalml_tpu.ops.hist_pallas import (
+    _round_up,
+    _VMEM_BUDGET,
+)
+
+_TILE = 2048
+# Tree-axis chunk for one kernel call. VMEM per tree is tiny for both
+# kernels (tables ≤ (M, p+1) f32, transients (M, TILE)); the cap bounds
+# the unrolled kernel body / compile time, not memory.
+_TREE_CAP = 16
+
+
+def _pad_rows(a, n_pad, value=0):
+    pad = [(0, 0)] * (a.ndim - 1) + [(0, n_pad - a.shape[-1])]
+    return jnp.pad(a, pad, constant_values=value)
+
+
+# ---------------------------------------------------------------------------
+# Leaf-value lookup: out[t, row] = table[t, ids[t, row]]
+# ---------------------------------------------------------------------------
+
+
+def _lookup_kernel(table_ref, ids_ref, out_ref, *, n_trees, n_chan, n_slots):
+    """One row tile: per-tree K-channel table lookup as a one-hot
+    contraction — the one-hot is built ONCE per tree and contracted
+    against all K channel tables in a single dot.
+
+    table_ref: (T·K, Lp) f32 — VMEM-resident across the sweep
+    ids_ref:   (T, TILE) int32 — slot ids; out-of-range (e.g. -1 pad)
+               contributes 0
+    out_ref:   (T·K, TILE) f32
+    """
+    tile = ids_ref.shape[1]
+    slot_iota = lax.broadcasted_iota(jnp.int32, (n_slots, tile), 0)
+    rows = []
+    for t in range(n_trees):  # static unroll — T is the chunk cap
+        oh = (ids_ref[t : t + 1, :] == slot_iota).astype(jnp.float32)
+        rows.append(
+            lax.dot_general(
+                table_ref[t * n_chan : (t + 1) * n_chan, :],
+                oh,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        )
+    out_ref[:] = rows[0] if n_trees == 1 else jnp.concatenate(rows, axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _table_lookup_batched(table, ids, *, interpret=False):
+    """(T, K, L) tables, (T, n) int ids → (T, K, n) f32 values."""
+    n_trees, n_chan, n_slots = table.shape
+    n = ids.shape[1]
+    n_pad = _round_up(max(n, _TILE), _TILE)
+    l_pad = _round_up(n_slots, 128)
+    table = jnp.pad(
+        table.astype(jnp.float32).reshape(n_trees * n_chan, n_slots),
+        ((0, 0), (0, l_pad - n_slots)),
+    )
+    ids = _pad_rows(ids.astype(jnp.int32), n_pad, value=-1)
+    out = pl.pallas_call(
+        functools.partial(
+            _lookup_kernel, n_trees=n_trees, n_chan=n_chan, n_slots=l_pad
+        ),
+        grid=(n_pad // _TILE,),
+        in_specs=[
+            pl.BlockSpec((n_trees * n_chan, l_pad), lambda i: (0, 0)),
+            pl.BlockSpec((n_trees, _TILE), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((n_trees * n_chan, _TILE), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((n_trees * n_chan, n_pad), jnp.float32),
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_BUDGET),
+    )(table, ids)
+    return out.reshape(n_trees, n_chan, n_pad)[:, :, :n]
+
+
+@functools.lru_cache(maxsize=None)
+def _lookup_vmappable(interpret: bool):
+    from jax import custom_batching
+
+    def impl(table, ids):
+        t = table.shape[0]
+        outs = [
+            _table_lookup_batched(
+                table[s : s + _TREE_CAP], ids[s : s + _TREE_CAP],
+                interpret=interpret,
+            )
+            for s in range(0, t, _TREE_CAP)
+        ]
+        return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
+
+    @custom_batching.custom_vmap
+    def g(table, ids):
+        return impl(table, ids)
+
+    @g.def_vmap
+    def _rule(axis_size, in_batched, table, ids):  # noqa: ANN001
+        table_b, ids_b = in_batched
+        if not table_b:
+            table = jnp.broadcast_to(table[None], (axis_size,) + table.shape)
+        if not ids_b:
+            ids = jnp.broadcast_to(ids[None], (axis_size,) + ids.shape)
+        b, t = table.shape[0], table.shape[1]
+        out = g(
+            table.reshape((b * t,) + table.shape[2:]),
+            ids.reshape(b * t, ids.shape[2]),
+        )
+        return out.reshape((b, t) + out.shape[1:]), True
+
+    return g
+
+
+def table_lookup(table: jax.Array, ids: jax.Array, *,
+                 backend: str = "pallas") -> jax.Array:
+    """``table[ids]`` for a small per-tree table, without the per-row
+    gather (serialized on TPU — measured ~8 ms/tree for the 512-leaf
+    lookup at 1M rows, the single largest op of the classifier fit).
+
+    Args:
+      table: (L,) per-tree value table, or (K, L) for K channels looked
+        up through ONE shared one-hot (the causal leaf payload).
+      ids: (n,) int32 slot ids in [0, L); out-of-range yields 0.0.
+      backend: "pallas" | "pallas_interpret" | "gather" (the plain XLA
+        gather — the right choice on CPU, where gathers are cheap).
+
+    Returns (n,) for a 1-D table, (K, n) for a 2-D one.
+
+    Vmappable: under ``jax.vmap`` (any nesting) the batch axes collapse
+    into one tree-batched kernel call, like ``bin_histogram``.
+    """
+    squeeze = table.ndim == 1
+    tab2 = table[None] if squeeze else table
+    if backend == "gather":
+        # In-range is guaranteed by the growers; keep parity with the
+        # kernel's out-of-range→0 contract anyway.
+        n_slots = tab2.shape[-1]
+        valid = (ids >= 0) & (ids < n_slots)
+        out = jnp.where(
+            valid[None, :], tab2[:, jnp.clip(ids, 0, n_slots - 1)], 0.0
+        )
+        return out[0] if squeeze else out
+    g = _lookup_vmappable(backend == "pallas_interpret")
+    out = g(tab2[None], ids[None])[0]
+    return out[0] if squeeze else out
+
+
+# ---------------------------------------------------------------------------
+# Route bits: bit[t, row] = 1[codes[row, feat[t, node]] > thr[t, node]],
+# node = ids[t, row]
+# ---------------------------------------------------------------------------
+
+
+def _route_kernel(codes_t_ref, ids_ref, tab_ref, out_ref, *, n_trees, m_nodes):
+    """One row tile of tree-batched routing.
+
+    codes_t_ref: (F1, TILE) f32 — transposed codes with a trailing
+                 all-ones row (F1 = p + 1)
+    ids_ref:     (T, TILE) int32 — current (rev) node ids
+    tab_ref:     (T·M, F1) f32 — per-node [feature one-hot | −thr]
+    out_ref:     (T, TILE) int32 — route bit (1 = right)
+
+    Per tree: G = tab_t @ codes_t gives every node's margin
+    ``code_at_feat − thr`` for every row; the row's own node is selected
+    by the node one-hot (single nonzero product — exact in f32), and
+    the bit is the sign. One MXU dot + two VPU passes per tree; no
+    (rows, M) one-hot ever leaves VMEM.
+    """
+    tile = ids_ref.shape[1]
+    node_iota = lax.broadcasted_iota(jnp.int32, (m_nodes, tile), 0)
+    rows = []
+    for t in range(n_trees):  # static unroll — T is the chunk cap
+        oh = (ids_ref[t : t + 1, :] == node_iota).astype(jnp.float32)
+        margin = lax.dot_general(
+            tab_ref[t * m_nodes : (t + 1) * m_nodes, :],
+            codes_t_ref[:],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (M, TILE): code − thr if the row were in node m
+        at_node = jnp.sum(oh * margin, axis=0, keepdims=True)  # (1, TILE)
+        rows.append((at_node > 0).astype(jnp.int32))
+    out_ref[:] = rows[0] if n_trees == 1 else jnp.concatenate(rows, axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _route_bits_batched(codes_t, ids, tab, *, interpret=False):
+    """codes_t (F1, n) f32, ids (T, n) int32, tab (T, M, F1) f32 →
+    (T, n) int32 route bits."""
+    n_trees, m_nodes, f1 = tab.shape
+    n = ids.shape[1]
+    n_pad = _round_up(max(n, _TILE), _TILE)
+    codes_t = _pad_rows(codes_t.astype(jnp.float32), n_pad)
+    ids = _pad_rows(ids.astype(jnp.int32), n_pad, value=-1)
+    tab = tab.astype(jnp.float32).reshape(n_trees * m_nodes, f1)
+    out = pl.pallas_call(
+        functools.partial(_route_kernel, n_trees=n_trees, m_nodes=m_nodes),
+        grid=(n_pad // _TILE,),
+        in_specs=[
+            pl.BlockSpec((f1, _TILE), lambda i: (0, i)),
+            pl.BlockSpec((n_trees, _TILE), lambda i: (0, i)),
+            pl.BlockSpec((n_trees * m_nodes, f1), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((n_trees, _TILE), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((n_trees, n_pad), jnp.int32),
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_BUDGET),
+    )(codes_t, ids, tab)
+    return out[:, :n]
+
+
+@functools.lru_cache(maxsize=None)
+def _route_vmappable(interpret: bool):
+    from jax import custom_batching
+
+    def impl(codes_t, ids, tab):
+        t = ids.shape[0]
+        outs = [
+            _route_bits_batched(
+                codes_t, ids[s : s + _TREE_CAP], tab[s : s + _TREE_CAP],
+                interpret=interpret,
+            )
+            for s in range(0, t, _TREE_CAP)
+        ]
+        return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
+
+    @custom_batching.custom_vmap
+    def g(codes_t, ids, tab):
+        return impl(codes_t, ids, tab)
+
+    @g.def_vmap
+    def _rule(axis_size, in_batched, codes_t, ids, tab):  # noqa: ANN001
+        codes_b, ids_b, tab_b = in_batched
+        if codes_b:
+            # Per-slice codes can't share a stream; loop (mirrors the
+            # histogram dispatch's fallback — no caller does this today).
+            out = jnp.stack([
+                g(codes_t[i], ids[i] if ids_b else ids, tab[i] if tab_b else tab)
+                for i in range(axis_size)
+            ])
+            return out, True
+        if not ids_b:
+            ids = jnp.broadcast_to(ids[None], (axis_size,) + ids.shape)
+        if not tab_b:
+            tab = jnp.broadcast_to(tab[None], (axis_size,) + tab.shape)
+        b, t = ids.shape[0], ids.shape[1]
+        out = g(
+            codes_t,
+            ids.reshape(b * t, ids.shape[2]),
+            tab.reshape((b * t,) + tab.shape[2:]),
+        )
+        return out.reshape(b, t, out.shape[1]), True
+
+    return g
+
+
+def codes_transposed(codes: jax.Array) -> jax.Array:
+    """The (p+1, n) f32 routing operand: transposed bin codes plus an
+    all-ones row that carries each node's −threshold through the same
+    MXU dot. Built ONCE per fit and shared by every tree/level (an
+    (n, p)→(p, n) transpose is one relayout; the old per-level blocked
+    routing paid a (rows, M) one-hot build every level instead)."""
+    n = codes.shape[0]
+    return jnp.concatenate(
+        [codes.T.astype(jnp.float32), jnp.ones((1, n), jnp.float32)]
+    )
+
+
+def route_table(best_feat: jax.Array, best_bin: jax.Array, p: int) -> jax.Array:
+    """Per-node routing table (M, p+1): [feature one-hot | −threshold].
+    With ``codes_transposed``'s ones row, ``tab @ codes_t`` yields the
+    margin ``code_at_feat − thr`` whose sign is the route bit — exact,
+    since codes and thresholds are small integers in f32 and the
+    feature selection has a single nonzero product."""
+    feat_oh = jax.nn.one_hot(best_feat, p, dtype=jnp.float32)
+    return jnp.concatenate(
+        [feat_oh, -best_bin.astype(jnp.float32)[:, None]], axis=1
+    )
+
+
+def route_bits(codes_t: jax.Array, ids: jax.Array, best_feat: jax.Array,
+               best_bin: jax.Array, *, backend: str = "pallas") -> jax.Array:
+    """Route bit (0 = left, 1 = right) for every row of one tree level:
+    ``codes[row, feat[ids[row]]] > bin[ids[row]]`` without a (rows, M)
+    one-hot in HBM.
+
+    Args:
+      codes_t: (p+1, n) from :func:`codes_transposed` (shared per fit).
+      ids: (n,) int32 current node ids in [0, M); -1 yields bit 0.
+      best_feat/best_bin: (M,) int32 split tables (rev or interleaved —
+        whatever order ``ids`` indexes).
+      backend: "pallas" | "pallas_interpret".
+
+    Vmappable over trees: batch axes on ``ids``/tables collapse into
+    one tree-batched kernel call per level (codes stay shared).
+    """
+    p = codes_t.shape[0] - 1
+    tab = route_table(best_feat, best_bin, p)
+    g = _route_vmappable(backend == "pallas_interpret")
+    return g(codes_t, ids[None], tab[None])[0]

@@ -175,9 +175,18 @@ def belloni(dataset, treatment_var="W", outcome_var="Y", covariates=None,
     return _row(E.belloni(frame, compat=compat))
 
 
-def double_ml(dataset, treatment_var="W", outcome_var="Y", num_trees=100, seed=123):
+def double_ml(dataset, treatment_var="W", outcome_var="Y", num_trees=100, seed=123,
+              se_mode="r", crossfit="r"):
+    """``se_mode="r"`` reproduces the reference's averaged-SE quirk
+    (``ate_functions.R:383``); ``"pooled"`` treats the folds as
+    independent. ``crossfit="r"`` reproduces its partial cross-fitting
+    (predict-on-full); ``"full"`` is textbook out-of-fold DML — see
+    ``estimators.dml.double_ml``."""
     frame = frame_from_columns(dataset, treatment_var, outcome_var)
-    return _row(E.double_ml(frame, n_trees=int(num_trees), key=jax.random.key(int(seed))))
+    return _row(E.double_ml(
+        frame, n_trees=int(num_trees), key=jax.random.key(int(seed)),
+        se_mode=se_mode, crossfit=crossfit,
+    ))
 
 
 def residual_balance_ATE(dataset, treatment_var="W", outcome_var="Y",
@@ -189,13 +198,15 @@ def residual_balance_ATE(dataset, treatment_var="W", outcome_var="Y",
 
 
 def causal_forest(dataset, treatment_var="W", outcome_var="Y", num_trees=2000,
-                  seed=12345):
+                  seed=12345, variance_compat="unbiased"):
     """The notebook's grf block (``ate_replication.Rmd:249-272``):
     returns the AIPW result row plus the deliberately 'incorrect'
-    mean-CATE ATE/SE demo."""
+    mean-CATE ATE/SE demo. ``variance_compat="grf"`` reproduces grf's
+    num_groups between-group df (default: unbiased gn−1)."""
     frame = frame_from_columns(dataset, treatment_var, outcome_var)
     rep = E.causal_forest_report(frame, key=jax.random.key(int(seed)),
-                                 n_trees=int(num_trees))
+                                 n_trees=int(num_trees),
+                                 variance_compat=variance_compat)
     out = _row(rep.result)
     out["incorrect_ate"] = float(rep.incorrect_ate)
     out["incorrect_se"] = float(rep.incorrect_se)

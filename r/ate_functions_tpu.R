@@ -81,16 +81,21 @@ prop_score_lasso <- function(dataset, treatment_var = "W", outcome_var = "Y",
                                         covariates))
 }
 
+# compat = "r" reproduces the reference's sign-quirked AIPW combination
+# (ate_functions.R:183 adds the control augmentation); "fixed" is the
+# textbook doubly-robust correction.
 doubly_robust <- function(dataset, treatment_var = "W", outcome_var = "Y",
-                          num_trees = 100, bootstrap_se = FALSE) {
+                          num_trees = 100, bootstrap_se = FALSE,
+                          seed = 12325, compat = "r") {
   .as_row(.bridge()$doubly_robust(.cols(dataset), treatment_var, outcome_var,
-                                  as.integer(num_trees), bootstrap_se))
+                                  as.integer(num_trees), bootstrap_se,
+                                  as.integer(seed), compat))
 }
 
 doubly_robust_glm <- function(dataset, treatment_var = "W", outcome_var = "Y",
-                              bootstrap_se = FALSE) {
+                              bootstrap_se = FALSE, seed = 0, compat = "r") {
   .as_row(.bridge()$doubly_robust_glm(.cols(dataset), treatment_var, outcome_var,
-                                      bootstrap_se))
+                                      bootstrap_se, as.integer(seed), compat))
 }
 
 belloni <- function(dataset, treatment_var = "W", outcome_var = "Y",
@@ -99,10 +104,16 @@ belloni <- function(dataset, treatment_var = "W", outcome_var = "Y",
                             covariates, compat))
 }
 
+# se_mode = "r" reproduces the reference's averaged-SE quirk
+# (ate_functions.R:383; "pooled" treats folds as independent);
+# crossfit = "r" its partial cross-fitting ("full" = textbook
+# out-of-fold DML).
 double_ml <- function(dataset, treatment_var = "W", outcome_var = "Y",
-                      num_trees = 100) {
+                      num_trees = 100, seed = 123, se_mode = "r",
+                      crossfit = "r") {
   .as_row(.bridge()$double_ml(.cols(dataset), treatment_var, outcome_var,
-                              as.integer(num_trees)))
+                              as.integer(num_trees), as.integer(seed),
+                              se_mode, crossfit))
 }
 
 residual_balance_ATE <- function(dataset, treatment_var = "W", outcome_var = "Y",
@@ -111,10 +122,14 @@ residual_balance_ATE <- function(dataset, treatment_var = "W", outcome_var = "Y"
                                          optimizer))
 }
 
+# variance_compat = "grf" reproduces grf's num_groups between-group df
+# in the little-bags variance (default "unbiased" uses gn - 1).
 causal_forest_tpu <- function(dataset, treatment_var = "W", outcome_var = "Y",
-                              num_trees = 2000, seed = 12345) {
+                              num_trees = 2000, seed = 12345,
+                              variance_compat = "unbiased") {
   res <- .bridge()$causal_forest(.cols(dataset), treatment_var, outcome_var,
-                                 as.integer(num_trees), as.integer(seed))
+                                 as.integer(num_trees), as.integer(seed),
+                                 variance_compat)
   row <- .as_row(res)
   attr(row, "incorrect_ate") <- res$incorrect_ate
   attr(row, "incorrect_se") <- res$incorrect_se

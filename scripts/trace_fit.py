@@ -1,0 +1,135 @@
+"""Capture + parse a device trace of the 1M-row forest fits.
+
+Round-4 perf work: RESULTS.md's round-3 table says the grow is now
+~80% XLA-side (route+score+leaf 24.4 ms/tree vs ~6 ms of histogram
+kernel at chunk 8), so the next lever must be picked from a real
+op-level trace, not another per-stage A/B. This captures a
+jax.profiler trace of a small warm fit at --rows and prints the top
+device ops by total self-time, grouped by fusion name.
+
+Usage:
+  python scripts/trace_fit.py --rows 1000000 --trees 32 [--mode causal|classifier]
+"""
+
+import argparse
+import glob
+import gzip
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from ate_replication_causalml_tpu.utils.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+
+def build_fit(mode, n, trees):
+    key = jax.random.key(0)
+    kx, kw, ky = jax.random.split(key, 3)
+    x = jax.random.normal(kx, (n, 21), dtype=jnp.float32)
+    tau = 1.0 + (x[:, 0] > 0)
+    w = (jax.random.uniform(kw, (n,)) < jax.nn.sigmoid(0.8 * x[:, 1])).astype(
+        jnp.float32
+    )
+    y = 0.5 * x[:, 1] + tau * w + 0.5 * jax.random.normal(ky, (n,))
+    if mode == "classifier":
+        from ate_replication_causalml_tpu.models.forest import fit_forest_classifier
+
+        wb = (w > 0.5).astype(jnp.float32)
+
+        def run(seed):
+            f = fit_forest_classifier(
+                x, wb, jax.random.key(seed), n_trees=trees, depth=9
+            )
+            return float(f.leaf_value.sum())
+
+        return run
+    from ate_replication_causalml_tpu.data.frame import CausalFrame
+    from ate_replication_causalml_tpu.models.causal_forest import fit_causal_forest
+
+    frame = CausalFrame(x=x, w=w, y=y)
+
+    def run(seed):
+        f = fit_causal_forest(
+            frame, key=jax.random.key(seed), n_trees=trees, depth=8,
+            nuisance_trees=50,
+        )
+        return float(f.forest.leaf_stats.sum())
+
+    return run
+
+
+def parse_trace(trace_dir):
+    """Sum device-op self-times out of the xplane proto (TF profiler
+    wire format, parsed with tensorflow's bundled protos)."""
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2  # type: ignore
+
+    paths = glob.glob(
+        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True
+    )
+    if not paths:
+        print("no xplane.pb found under", trace_dir, file=sys.stderr)
+        return
+    xspace = xplane_pb2.XSpace()
+    with open(max(paths, key=os.path.getmtime), "rb") as f:
+        xspace.ParseFromString(f.read())
+    for plane in xspace.planes:
+        if "TPU" not in plane.name and "Device" not in plane.name:
+            continue
+        totals = {}
+        for line in plane.lines:
+            # XLA Ops / XLA Modules lines carry the per-op events.
+            if line.name not in ("XLA Ops", "XLA TraceMe", "Steps"):
+                pass
+            for ev in line.events:
+                name = plane.event_metadata[ev.metadata_id].name
+                totals.setdefault((line.name, name), [0.0, 0])
+                totals[(line.name, name)][0] += ev.duration_ps / 1e12
+                totals[(line.name, name)][1] += 1
+        if not totals:
+            continue
+        print(f"== plane: {plane.name}")
+        by_line = {}
+        for (ln, name), (secs, cnt) in totals.items():
+            by_line.setdefault(ln, []).append((secs, cnt, name))
+        for ln, rows in by_line.items():
+            rows.sort(reverse=True)
+            tot = sum(r[0] for r in rows)
+            print(f"-- line {ln!r}: total {tot:.3f}s")
+            for secs, cnt, name in rows[:30]:
+                print(f"   {secs:8.3f}s  x{cnt:<6d} {name[:110]}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--trees", type=int, default=32)
+    ap.add_argument("--mode", default="causal")
+    ap.add_argument("--trace-dir", default="/tmp/trace_fit")
+    ap.add_argument("--parse-only", action="store_true")
+    args = ap.parse_args()
+
+    if not args.parse_only:
+        run = build_fit(args.mode, args.rows, args.trees)
+        t0 = time.perf_counter()
+        run(1)  # compile
+        print(f"# compile+first {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+        t0 = time.perf_counter()
+        run(2)  # warm
+        warm = time.perf_counter() - t0
+        print(f"# warm {warm:.1f}s ({warm * 1000 / args.trees:.1f} ms/tree)",
+              file=sys.stderr)
+        os.makedirs(args.trace_dir, exist_ok=True)
+        with jax.profiler.trace(args.trace_dir):
+            t0 = time.perf_counter()
+            run(3)
+            traced = time.perf_counter() - t0
+        print(f"# traced run {traced:.1f}s", file=sys.stderr)
+    parse_trace(args.trace_dir)
+
+
+if __name__ == "__main__":
+    main()

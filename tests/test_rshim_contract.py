@@ -107,6 +107,77 @@ def _check_as_row_contract(res):
         assert math.isfinite(v) or math.isnan(v)
 
 
+# Every quirk-pair knob the Python estimator layer exposes must be
+# reachable from R through the shim (VERDICT r3 #4): the R wrapper
+# must DECLARE the knob as a formal argument and PASS it to the bridge
+# call. Keys are shim function names (the causal-forest wrapper is
+# exported as causal_forest_tpu).
+_COMPAT_KNOBS = {
+    "doubly_robust": {"compat"},
+    "doubly_robust_glm": {"compat"},
+    "double_ml": {"se_mode", "crossfit"},
+    "belloni": {"compat"},
+    "causal_forest_tpu": {"variance_compat"},
+}
+
+
+def _r_function_blocks(src):
+    """name -> (formals_text, body_text) for each top-level R function."""
+    out = {}
+    for m in re.finditer(
+        r"^(\w+) <- function\(([^{]*)\)\s*\{(.*?)^\}", src, flags=re.M | re.S
+    ):
+        out[m.group(1)] = (m.group(2), m.group(3))
+    return out
+
+
+def test_shim_exposes_compat_knobs():
+    src = _shim_source()
+    blocks = _r_function_blocks(src)
+    for fn, knobs in _COMPAT_KNOBS.items():
+        assert fn in blocks, f"shim missing {fn}"
+        formals, body = blocks[fn]
+        for knob in knobs:
+            assert re.search(rf"\b{knob}\s*=", formals), (
+                f"{fn} does not declare {knob!r} as an argument"
+            )
+            assert re.search(rf"\b{knob}\b", body), (
+                f"{fn} does not pass {knob!r} through to the bridge"
+            )
+
+
+def test_bridge_accepts_every_shim_knob():
+    """The Python side of each knob: the rbridge function must accept
+    the knob by keyword (guards signature drift on either side)."""
+    import inspect
+
+    bridge_name = {"causal_forest_tpu": "causal_forest"}
+    for fn, knobs in _COMPAT_KNOBS.items():
+        target = getattr(rbridge, bridge_name.get(fn, fn))
+        params = inspect.signature(target).parameters
+        for knob in knobs:
+            assert knob in params, f"rbridge.{target.__name__} lacks {knob!r}"
+
+
+def test_compat_knob_values_change_results():
+    """End to end through the bridge payload contract: the corrected
+    modes must be selectable and (on a confounded panel) move the
+    estimate — i.e. the knob actually reaches the estimator."""
+    cols = _reticulate_payload(n=600, seed=3)
+    r_row = rbridge.doubly_robust_glm(cols)
+    fixed_row = rbridge.doubly_robust_glm(cols, compat="fixed")
+    _check_as_row_contract(r_row)
+    _check_as_row_contract(fixed_row)
+    assert r_row["ATE"] != fixed_row["ATE"]
+    dml_r = rbridge.double_ml(cols, num_trees=8)
+    dml_full = rbridge.double_ml(cols, num_trees=8, crossfit="full")
+    _check_as_row_contract(dml_r)
+    _check_as_row_contract(dml_full)
+    assert dml_r["ATE"] != dml_full["ATE"]
+    dml_pooled = rbridge.double_ml(cols, num_trees=8, se_mode="pooled")
+    assert dml_pooled["lower_ci"] != dml_r["lower_ci"]
+
+
 def test_plain_list_payloads_round_trip():
     cols = _reticulate_payload()
     _check_as_row_contract(rbridge.naive_ate(cols))

@@ -1,0 +1,206 @@
+"""Exactness contracts for the row-side Pallas kernels
+(ops/tree_pallas.py): leaf-table lookup and tree-batched routing.
+
+Both kernels replace XLA formulations in the streaming growers, so
+they must be BIT-identical to them — lookups select a single table
+entry via a one-nonzero-product contraction, routing is an integer
+compare — no rounding path exists in either.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ate_replication_causalml_tpu.models.forest import (
+    route_rows,
+    route_rows_blocked,
+)
+from ate_replication_causalml_tpu.ops.tree_pallas import (
+    codes_transposed,
+    route_bits,
+    table_lookup,
+)
+
+
+def test_table_lookup_matches_gather():
+    rng = np.random.default_rng(0)
+    n, L = 5000, 512
+    table = jnp.asarray(rng.normal(size=L), jnp.float32)
+    ids = jnp.asarray(rng.integers(0, L, n), jnp.int32)
+    got = table_lookup(table, ids, backend="pallas_interpret")
+    assert jnp.array_equal(got, table[ids])
+    # The gather fallback obeys the same contract.
+    assert jnp.array_equal(table_lookup(table, ids, backend="gather"), table[ids])
+
+
+def test_table_lookup_out_of_range_is_zero():
+    table = jnp.asarray([1.0, 2.0, 3.0], jnp.float32)
+    ids = jnp.asarray([0, -1, 2, 3], jnp.int32)
+    want = jnp.asarray([1.0, 0.0, 3.0, 0.0], jnp.float32)
+    got = table_lookup(table, ids, backend="pallas_interpret")
+    assert jnp.array_equal(got, want)
+    assert jnp.array_equal(table_lookup(table, ids, backend="gather"), want)
+
+
+def test_table_lookup_vmap_collapses():
+    """Vmapped (and nested-vmapped) calls must equal per-tree calls —
+    the rule flattens batch axes into the kernel's tree axis."""
+    rng = np.random.default_rng(1)
+    t, n, L = 5, 700, 64
+    tables = jnp.asarray(rng.normal(size=(t, L)), jnp.float32)
+    ids = jnp.asarray(rng.integers(0, L, (t, n)), jnp.int32)
+    got = jax.vmap(
+        lambda tb, i: table_lookup(tb, i, backend="pallas_interpret")
+    )(tables, ids)
+    want = jnp.stack([tables[i][ids[i]] for i in range(t)])
+    assert jnp.array_equal(got, want)
+    # Nested vmap (groups × trees), mirroring the causal grower.
+    tables2 = tables[:4].reshape(2, 2, L)
+    ids2 = ids[:4].reshape(2, 2, n)
+    got2 = jax.vmap(
+        jax.vmap(lambda tb, i: table_lookup(tb, i, backend="pallas_interpret"))
+    )(tables2, ids2)
+    assert jnp.array_equal(got2, want[:4].reshape(2, 2, n))
+
+
+def test_table_lookup_multichannel():
+    """A (K, L) table looks all K channels up through one shared
+    one-hot — bit-identical to K separate gathers."""
+    rng = np.random.default_rng(9)
+    K, L, n = 5, 256, 3000
+    table = jnp.asarray(rng.normal(size=(K, L)), jnp.float32)
+    ids = jnp.asarray(rng.integers(0, L, n), jnp.int32)
+    got = table_lookup(table, ids, backend="pallas_interpret")
+    want = table[:, ids]
+    assert got.shape == (K, n)
+    assert jnp.array_equal(got, want)
+    assert jnp.array_equal(table_lookup(table, ids, backend="gather"), want)
+
+
+def test_predict_cate_kernel_path_matches_matmul():
+    """predict_cate's Pallas row path (TPU default) must reproduce the
+    matmul formulation exactly — routing and leaf broadcast are both
+    exact selections."""
+    from ate_replication_causalml_tpu.models.causal_forest import (
+        grow_causal_forest,
+        predict_cate,
+    )
+
+    rng = np.random.default_rng(11)
+    n, p = 3000, 5
+    x = jnp.asarray(rng.normal(size=(n, p)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=n), jnp.float32)
+    y = jnp.asarray(0.8 * w * (x[:, 0] > 0) + rng.normal(size=n), jnp.float32)
+    forest = grow_causal_forest(
+        x, w, y, jax.random.key(3), n_trees=8, depth=4,
+        hist_backend="pallas_interpret",
+    )
+    base = predict_cate(forest, x, oob=True, row_backend="matmul")
+    kern = predict_cate(forest, x, oob=True, row_backend="pallas_interpret")
+    np.testing.assert_allclose(
+        np.asarray(kern.cate), np.asarray(base.cate), rtol=0, atol=0
+    )
+    np.testing.assert_allclose(
+        np.asarray(kern.variance), np.asarray(base.variance), rtol=0, atol=0
+    )
+
+
+def test_route_bits_matches_blocked_route():
+    """The Pallas route must agree bit-for-bit with the one-hot-matmul
+    route at every level width, including the vmapped tree case."""
+    rng = np.random.default_rng(2)
+    n, p, n_bins = 3000, 7, 16
+    codes = jnp.asarray(rng.integers(0, n_bins, (n, p)), jnp.int32)
+    codes_t = codes_transposed(codes)
+    for m in (1, 2, 8, 64):
+        ids = jnp.asarray(rng.integers(0, m, n), jnp.int32)
+        bf = jnp.asarray(rng.integers(0, p, m), jnp.int32)
+        bb = jnp.asarray(rng.integers(0, n_bins, m), jnp.int32)
+        routed = route_rows_blocked(ids, bf, bb, codes)
+        want_bit = routed - 2 * ids
+        got_bit = route_bits(codes_t, ids, bf, bb, backend="pallas_interpret")
+        assert jnp.array_equal(got_bit, want_bit), f"m={m}"
+
+
+def test_route_bits_vmap_collapses():
+    rng = np.random.default_rng(3)
+    t, n, p, n_bins, m = 3, 900, 5, 8, 4
+    codes = jnp.asarray(rng.integers(0, n_bins, (n, p)), jnp.int32)
+    codes_t = codes_transposed(codes)
+    ids = jnp.asarray(rng.integers(0, m, (t, n)), jnp.int32)
+    bf = jnp.asarray(rng.integers(0, p, (t, m)), jnp.int32)
+    bb = jnp.asarray(rng.integers(0, n_bins, (t, m)), jnp.int32)
+    got = jax.vmap(
+        lambda i, f, b: route_bits(codes_t, i, f, b, backend="pallas_interpret")
+    )(ids, bf, bb)
+    want = jnp.stack([
+        route_rows(
+            jax.nn.one_hot(ids[i], m, dtype=jnp.float32), bf[i], bb[i],
+            codes.astype(jnp.float32), ids[i],
+        )
+        - 2 * ids[i]
+        for i in range(t)
+    ])
+    assert jnp.array_equal(got, want)
+
+
+def test_streaming_grower_unchanged_by_route_kernel():
+    """The classifier streaming path (which now routes and records
+    leaves through the new kernels) must produce the same forest as the
+    XLA backend — same splits, same leaf values, same train_leaf."""
+    from ate_replication_causalml_tpu.models.forest import fit_forest_classifier
+
+    rng = np.random.default_rng(4)
+    n, p = 4000, 6
+    x = jnp.asarray(rng.normal(size=(n, p)), jnp.float32)
+    y = (rng.random(n) < (0.3 + 0.4 * (x[:, 0] > 0))).astype(np.float32)
+    y = jnp.asarray(y)
+    key = jax.random.key(7)
+    f_pal = fit_forest_classifier(
+        x, y, key, n_trees=4, depth=5, hist_backend="pallas_interpret"
+    )
+    f_xla = fit_forest_classifier(
+        x, y, key, n_trees=4, depth=5, hist_backend="xla"
+    )
+    assert jnp.array_equal(f_pal.split_feat, f_xla.split_feat)
+    assert jnp.array_equal(f_pal.split_bin, f_xla.split_bin)
+    np.testing.assert_allclose(
+        np.asarray(f_pal.leaf_value), np.asarray(f_xla.leaf_value),
+        rtol=0, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(f_pal.train_leaf), np.asarray(f_xla.train_leaf),
+        rtol=0, atol=1e-6,
+    )
+
+
+def test_variance_compat_grf_df_ratio():
+    """variance_compat="grf" divides the between-group variance by
+    num_groups instead of gn−1. With ci_group_size=1 the within-group
+    correction vanishes (every group is one tree), so the final
+    variances differ by exactly (gn−1)/gn wherever they are positive."""
+    from ate_replication_causalml_tpu.models.causal_forest import (
+        grow_causal_forest,
+        predict_cate,
+    )
+
+    rng = np.random.default_rng(21)
+    n, p = 2500, 5
+    x = jnp.asarray(rng.normal(size=(n, p)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=n), jnp.float32)
+    y = jnp.asarray(1.2 * w * (x[:, 1] > 0) + rng.normal(size=n), jnp.float32)
+    forest = grow_causal_forest(
+        x, w, y, jax.random.key(5), n_trees=6, depth=4, ci_group_size=1,
+        hist_backend="pallas_interpret",
+    )
+    unb = predict_cate(forest, x, oob=False)
+    grf = predict_cate(forest, x, oob=False, variance_compat="grf")
+    np.testing.assert_allclose(
+        np.asarray(grf.cate), np.asarray(unb.cate), rtol=0, atol=0
+    )
+    vu = np.asarray(unb.variance)
+    vg = np.asarray(grf.variance)
+    pos = vu > 0
+    assert pos.any()
+    gn = 6  # every tree produced a prediction (oob=False, nonempty leaves)
+    np.testing.assert_allclose(vg[pos] / vu[pos], (gn - 1) / gn, rtol=1e-5)
