@@ -74,6 +74,17 @@ def _row(res):
     }
 
 
+def _write_biased_csv(biased, path):
+    """The ONE serialization both R harnesses read — the exact and band
+    contracts must feed R the identical biased frame, so the format
+    lives in one place."""
+    cols = {f"x{i}": np.asarray(biased.x[:, i]) for i in range(biased.x.shape[1])}
+    cols["W"] = np.asarray(biased.w)
+    cols["Y"] = np.asarray(biased.y)
+    np.savetxt(path, np.column_stack(list(cols.values())), delimiter=",",
+               header=",".join(cols), comments="", fmt="%.17g")
+
+
 def _tiny_rows():
     frame, biased, drop = _setup(4000, 3000, seed=20260730)
     p_log = logistic_propensity(biased.x, biased.w)
@@ -220,13 +231,7 @@ def test_r_parity_1e4_contract(tmp_path):
     frame, biased, _ = _setup(4000, 3000, seed=20260730)
     n = biased.n
     csv = tmp_path / "biased.csv"
-    cols = {f"x{i}": np.asarray(biased.x[:, i]) for i in range(biased.x.shape[1])}
-    cols["W"] = np.asarray(biased.w)
-    cols["Y"] = np.asarray(biased.y)
-    header = ",".join(cols)
-    mat = np.column_stack(list(cols.values()))
-    np.savetxt(csv, mat, delimiter=",", header=header, comments="",
-               fmt="%.17g")
+    _write_biased_csv(biased, csv)
     rscript = tmp_path / "harness.R"
     rscript.write_text(
         f"""
@@ -317,3 +322,196 @@ def test_r_parity_1e4_contract(tmp_path):
         float(np.asarray(ps_lasso).mean()), float(r_rows["ps_lasso_mean"][2]),
         atol=1e-4, err_msg="ps_lasso_mean")
     assert len(covered) >= 10, covered
+
+
+# ---------------------------------------------------------------------------
+# R-parity coverage manifest: all 16 SURVEY §2.1 components, each mapped
+# to the executable R-side leg that checks it. "exact" legs live in
+# test_r_parity_1e4_contract (1e-4 on identical RNG streams); "band"
+# legs live in test_r_parity_forest_band_contract (R's forests are
+# unseeded — randomForest swallows its seed= argument, grf seeds only
+# the subsampling — so the contract is replicate-band overlap, not bit
+# parity). This manifest is asserted WITHOUT R, so the enumeration
+# itself can never rot while the executable legs stay environment-
+# gated.
+# ---------------------------------------------------------------------------
+_PARITY_MANIFEST = {
+    "naive_ate": "exact",
+    "ate_condmean_ols": "exact",
+    "prop_score_weight": "exact",
+    "prop_score_ols": "exact",
+    "ate_condmean_lasso": "exact",
+    "ate_lasso": "exact",
+    "prop_score_lasso": "exact",
+    "doubly_robust_rf": "band",       # ate_functions.R:149-207 (RF PS)
+    "doubly_robust_glm": "exact",
+    "tau_hat_dr_est_bootstrap": "exact",
+    "belloni": "exact",
+    "chernozhukov": "band",           # ate_functions.R:332-369
+    "double_ml": "band",              # ate_functions.R:372-390
+    "residual_balance_ATE": "exact",  # when balanceHD is installed
+    "causal_forest": "band",          # ate_replication.Rmd:249-272 (+ incorrect-ATE demo)
+    "logistic_propensity": "exact",
+}
+
+
+# The component set the band harness must exercise — cross-asserted
+# against both the manifest and the harness's own accumulator keys so
+# deleting a leg (or renaming a component) trips the manifest test
+# even without R.
+_BAND_COMPONENTS = ("doubly_robust_rf", "chernozhukov", "double_ml",
+                    "causal_forest")
+
+
+def test_parity_manifest_enumerates_16_components():
+    assert len(_PARITY_MANIFEST) == 16
+    assert sorted(set(_PARITY_MANIFEST.values())) == ["band", "exact"]
+    band = {k for k, v in _PARITY_MANIFEST.items() if v == "band"}
+    assert band == set(_BAND_COMPONENTS)
+    # The band harness's R script and accumulators must cover exactly
+    # these components (plus the incorrect-ATE demo rider).
+    import inspect
+
+    src = inspect.getsource(test_r_parity_forest_band_contract)
+    for comp in _BAND_COMPONENTS + ("incorrect_cf_ate",):
+        assert f'"{comp}"' in src, f"band harness lost its {comp} leg"
+
+
+@pytest.mark.skipif(
+    shutil.which("Rscript") is None or not os.path.exists(_REFERENCE_R),
+    reason="Rscript or the reference checkout is unavailable in this image "
+           "(no R binary, no network, installs forbidden — see PARITY.md)",
+)
+def test_r_parity_forest_band_contract(tmp_path):
+    """Statistical-band R parity for the forest-dependent components
+    (VERDICT r3 #3): DR-RF, chernozhukov, double_ml, and the causal
+    forest pair (AIPW row + the incorrect mean-CATE demo).
+
+    R's forests are UNSEEDED — ``randomForest(seed=)`` is silently
+    swallowed (SURVEY §2.1 #8/#12) and grf's seed only pins
+    subsampling — so bit parity is impossible by construction. The
+    contract instead: run each R component ``REPS`` times, run ours
+    with ``REPS`` independent keys, and assert the two replicate means
+    agree within 4 combined standard errors (+ a small absolute floor
+    for the near-deterministic pieces). SE columns are checked as a
+    ratio band [0.5, 2] — fold/replicate noise moves them more than the
+    point estimates.
+
+    Replicate seeds are documented in the harness itself: the
+    randomForest legs are intentionally unseeded (that IS the
+    reference's behavior — its seed= is swallowed); the grf leg uses
+    seed = 12345 + rep, deviating from the reference's fixed 12345 on
+    purpose, because grf's seed pins subsampling and identical seeds
+    would collapse the replicate variance the band needs. Our reps use
+    jax.random.key(1000+i).
+    """
+    REPS = 5
+    frame, biased, _ = _setup(4000, 3000, seed=20260730)
+    csv = tmp_path / "biased.csv"
+    _write_biased_csv(biased, csv)
+    rscript = tmp_path / "forest_band.R"
+    rscript.write_text(
+        f"""
+        source("{_REFERENCE_R}")
+        suppressWarnings(library(dplyr))
+        suppressWarnings(library(randomForest))
+        df_mod <- read.csv("{csv}")
+        covariates <- setdiff(names(df_mod), c("W", "Y"))
+        N <- nrow(df_mod)
+        idx1 <- 1:floor(N/2); idx2 <- (floor(N/2)+1):N
+        out <- data.frame()
+        for (rep in 1:{REPS}) {{
+          dr <- doubly_robust(df_mod, "W", "Y", num_trees = 100)
+          out <- rbind(out, data.frame(component = "doubly_robust_rf",
+                                       rep = rep, ate = dr$ATE,
+                                       se = (dr$upper_ci - dr$ATE) / 1.96))
+          ch <- chernozhukov(df_mod, "W", "Y", idx1, idx2, 100)
+          out <- rbind(out, data.frame(component = "chernozhukov", rep = rep,
+                                       ate = ch$tau_hat, se = ch$se_hat))
+          dm <- double_ml(df_mod, "W", "Y", num_trees = 100)
+          out <- rbind(out, data.frame(component = "double_ml", rep = rep,
+                                       ate = dm$ATE,
+                                       se = (dm$upper_ci - dm$ATE) / 1.96))
+          cf_ok <- tryCatch({{
+            forest <- grf::causal_forest(X = as.matrix(df_mod[, covariates]),
+                                         Y = as.matrix(df_mod[, "Y"]),
+                                         W = as.matrix(df_mod[, "W"]),
+                                         num.trees = 500, honesty = TRUE,
+                                         seed = 12345 + rep)
+            pred <- predict(forest, estimate.variance = TRUE)
+            out <<- rbind(out, data.frame(component = "incorrect_cf_ate",
+                                          rep = rep,
+                                          ate = mean(pred$predictions),
+                                          se = sqrt(mean(pred$variance.estimates))))
+            eff <- tryCatch(grf::estimate_average_effect(forest),
+                            error = function(e)
+                              grf::average_treatment_effect(forest,
+                                                            method = "AIPW"))
+            out <<- rbind(out, data.frame(component = "causal_forest",
+                                          rep = rep,
+                                          ate = eff[["estimate"]],
+                                          se = eff[["std.err"]]))
+            TRUE
+          }}, error = function(e) FALSE)
+        }}
+        write.csv(out, "{tmp_path}/r_band.csv", row.names = FALSE)
+        """
+    )
+    subprocess.run(["Rscript", str(rscript)], check=True, timeout=7200)
+    import csv as csvmod
+
+    r_samples = {}
+    with open(tmp_path / "r_band.csv") as f:
+        rd = csvmod.DictReader(f)
+        for row in rd:
+            r_samples.setdefault(row["component"], []).append(
+                (float(row["ate"]), float(row["se"]))
+            )
+
+    from ate_replication_causalml_tpu.estimators.causal_forest_est import (
+        causal_forest_report,
+    )
+    from ate_replication_causalml_tpu.estimators.dml import chernozhukov
+
+    ours = {k: [] for k in (
+        "doubly_robust_rf", "chernozhukov", "double_ml", "causal_forest",
+        "incorrect_cf_ate",
+    )}
+    n = biased.n
+    half = n // 2
+    idx1, idx2 = np.arange(half), np.arange(half, n)
+    for i in range(REPS):
+        key = jax.random.key(1000 + i)
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        dr = doubly_robust(
+            biased, lambda f: rf_oob_propensity(f, key=k1, n_trees=100))
+        ours["doubly_robust_rf"].append(
+            (float(dr.ate), (float(dr.upper_ci) - float(dr.ate)) / 1.96))
+        tau, se = chernozhukov(biased, idx1, idx2, 100, 9, k2)
+        ours["chernozhukov"].append((float(tau), float(se)))
+        dm = double_ml(biased, n_trees=100, key=k3)
+        ours["double_ml"].append(
+            (float(dm.ate), (float(dm.upper_ci) - float(dm.ate)) / 1.96))
+        rep = causal_forest_report(biased, key=k4, n_trees=500,
+                                   nuisance_trees=200)
+        ours["causal_forest"].append(
+            (float(rep.result.ate),
+             (float(rep.result.upper_ci) - float(rep.result.ate)) / 1.96))
+        ours["incorrect_cf_ate"].append(
+            (float(rep.incorrect_ate), float(rep.incorrect_se)))
+
+    for comp, our_samp in ours.items():
+        if comp not in r_samples:
+            assert comp in ("causal_forest", "incorrect_cf_ate"), (
+                f"R harness produced no rows for {comp}")
+            continue  # grf not installed in this R
+        r_ates = np.array([a for a, _ in r_samples[comp]])
+        o_ates = np.array([a for a, _ in our_samp])
+        band = 4.0 * np.sqrt(r_ates.var(ddof=1) / len(r_ates)
+                             + o_ates.var(ddof=1) / len(o_ates)) + 2e-3
+        assert abs(r_ates.mean() - o_ates.mean()) <= band, (
+            comp, r_ates.mean(), o_ates.mean(), band)
+        r_ses = np.array([s for _, s in r_samples[comp]])
+        o_ses = np.array([s for _, s in our_samp])
+        ratio = o_ses.mean() / max(r_ses.mean(), 1e-12)
+        assert 0.5 <= ratio <= 2.0, (comp, ratio)
