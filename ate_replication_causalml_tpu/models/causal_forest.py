@@ -64,7 +64,7 @@ from ate_replication_causalml_tpu.models.forest import (
     fit_forest_regressor,
     forest_oob_mean,
     pick_chunk,
-    pick_divisor,
+    plan_host_dispatch,
     plan_tree_dispatch,
     quantile_bins,
     resolve_hist_backend,
@@ -215,17 +215,17 @@ def grow_causal_forest(
         kernel_weights=5,
     )
     group_chunk = auto_chunk if group_chunk is None else min(group_chunk, auto_chunk)
-    group_chunk = pick_chunk(n_groups, group_chunk)
-    n_chunks = -(-n_groups // group_chunk)
-    group_keys = jax.random.split(key, n_chunks * group_chunk)
     # Superchunking (see forest.py::_DISPATCH_CHUNK_TARGET): several
     # vmapped group chunks per dispatch via an inner lax.map — the
     # remote tunnel charges ~80 ms per dispatched executable, which
     # dominates a chunk-per-dispatch loop at million-row auto chunks.
-    super_ = pick_divisor(
-        n_chunks, max(1, dispatch_tree_target(chunk_rows) // (group_chunk * k))
+    # Ceil-padded plan: executable shape independent of n_trees (see
+    # forest.py::plan_host_dispatch).
+    group_chunk, super_, n_disp = plan_host_dispatch(
+        n_groups, group_chunk,
+        max(1, dispatch_tree_target(chunk_rows) // k),
     )
-    n_disp = n_chunks // super_  # exact: super_ divides n_chunks
+    group_keys = jax.random.split(key, n_disp * super_ * group_chunk)
 
     # Elastic host loop over one compiled chunk executable (shared
     # across chunks and fits): bounded device-program size, and a

@@ -391,6 +391,40 @@ def pick_chunk(total: int, chunk: int) -> int:
     return d if d * 2 >= chunk else chunk
 
 
+def plan_host_dispatch(total_units: int, unit_chunk: int,
+                       target_units: int) -> tuple[int, int, int]:
+    """(chunk, super_, n_disp) for a host dispatch loop, with ceil
+    padding instead of divisor-fitting (round 4).
+
+    The old policy shrank the vmap chunk to a divisor of the total —
+    e.g. the flagship's 500 nuisance trees at the streaming cap 11 →
+    chunk 10 — under-filling the histogram kernel's tree batch, and
+    fit the superchunk factor to the chunk count, inflating dispatch
+    counts when the counts didn't divide (500 trees at 1M rows ran 50
+    dispatches of (1, 10); now 23 of (2, 11) — the tunnel charges
+    ~80 ms per dispatch). Now the chunk is always the full budget
+    width and the last dispatch is padded. Cross-FIT executable
+    sharing is NOT a goal here: fits that differ in tree counts
+    usually also differ in a jit static (classifier vs regressor
+    ``mtry``, depth, rows), so their executables are distinct
+    regardless of the key-block shape.
+
+    Padding is bounded by one superchunk: at most ``super_·chunk − 1``
+    extra trees are grown and sliced away (≤1.2% at the flagship
+    shapes; worst at small fits where a tree costs milliseconds).
+
+    Callers split ``n_disp·super_·chunk`` keys (prefix-stable in
+    jax.random.split, so every real unit's key — and therefore every
+    grown tree — is bit-identical to the divisor policy's) and slice
+    the concatenated output back to ``total_units``.
+    """
+    chunk = max(1, min(unit_chunk, total_units))
+    n_chunks = -(-total_units // chunk)
+    super_ = max(1, min(target_units // chunk, n_chunks))
+    n_disp = -(-n_chunks // super_)
+    return chunk, super_, n_disp
+
+
 # HBM budget for the largest per-level matmul operand of one vmapped
 # tree chunk (the (rows, max_nodes) f32 node one-hots). Several live
 # operands of comparable size coexist per level (node one-hot, weighted
@@ -562,16 +596,16 @@ def fit_forest_classifier(
     xb_onehot = bin_onehot(codes, n_bins) if hist_backend == "onehot" else None
     yf = y.astype(jnp.float32)
 
-    tree_chunk = pick_chunk(n_trees, tree_chunk)
-    n_chunks = -(-n_trees // tree_chunk)  # ceil: padded, sliced after
-    tree_keys = jax.random.split(key, n_chunks * tree_chunk)
     # Superchunking: several vmapped chunks per DISPATCH via an inner
     # lax.map (sequential → same memory as one chunk). The remote-device
     # tunnel charges ~80 ms per dispatched executable with large args,
     # so at small auto chunks (million-row fits) a chunk-per-dispatch
-    # loop pays minutes of pure overhead.
-    super_ = pick_divisor(n_chunks, max(1, dispatch_tree_target(n) // tree_chunk))
-    n_disp = n_chunks // super_  # exact: super_ divides n_chunks
+    # loop pays minutes of pure overhead. Ceil-padded plan: executable
+    # shape independent of n_trees (see plan_host_dispatch).
+    tree_chunk, super_, n_disp = plan_host_dispatch(
+        n_trees, tree_chunk, dispatch_tree_target(n)
+    )
+    tree_keys = jax.random.split(key, n_disp * super_ * tree_chunk)
 
     def chunk_shard(i: int):
         kk = tree_keys[

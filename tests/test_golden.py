@@ -480,6 +480,7 @@ def test_r_parity_forest_band_contract(tmp_path):
     n = biased.n
     half = n // 2
     idx1, idx2 = np.arange(half), np.arange(half, n)
+    grf_present = "causal_forest" in r_samples  # grf may be uninstalled
     for i in range(REPS):
         key = jax.random.key(1000 + i)
         k1, k2, k3, k4 = jax.random.split(key, 4)
@@ -492,6 +493,8 @@ def test_r_parity_forest_band_contract(tmp_path):
         dm = double_ml(biased, n_trees=100, key=k3)
         ours["double_ml"].append(
             (float(dm.ate), (float(dm.upper_ci) - float(dm.ate)) / 1.96))
+        if not grf_present:
+            continue  # don't pay 5 causal fits with no R side to compare
         rep = causal_forest_report(biased, key=k4, n_trees=500,
                                    nuisance_trees=200)
         ours["causal_forest"].append(
