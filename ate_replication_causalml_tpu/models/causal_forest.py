@@ -61,9 +61,9 @@ from ate_replication_causalml_tpu.models.forest import (
     bin_onehot,
     binarize,
     dispatch_tree_target,
+    exact_subsample_mask,
     fit_forest_regressor,
     forest_oob_mean,
-    pick_chunk,
     plan_host_dispatch,
     plan_tree_dispatch,
     quantile_bins,
@@ -320,12 +320,10 @@ def grow_causal_forest_sharded(
     if group_chunk is not None and group_chunk < auto_chunk:
         # An explicit (smaller) chunk re-plans the dispatch split so the
         # watchdog budget still holds per dispatched executable.
-        group_chunk = pick_chunk(per_dev_groups, group_chunk)
-        n_chunks = -(-per_dev_groups // group_chunk)
-        chunks_per_disp = min(
-            max(1, dispatch_tree_target(plan_rows) // (group_chunk * k)), n_chunks
+        group_chunk, chunks_per_disp, n_disp = plan_host_dispatch(
+            per_dev_groups, group_chunk,
+            max(1, dispatch_tree_target(plan_rows) // k),
         )
-        n_disp = -(-n_chunks // chunks_per_disp)
     else:
         group_chunk = auto_chunk
     per_disp_dev = chunks_per_disp * group_chunk
@@ -586,9 +584,13 @@ def _grow_cf_chunk(group_keys, codes, wt, yt, mom_stack, xb_onehot, *,
 
     def grow_group(group_key):
         sk, tk = jax.random.split(group_key)
-        perm = jax.random.permutation(sk, n)
-        idx = perm[:s]
-        in_mask = jnp.zeros((n,), bool).at[idx].set(True)
+        # Exact s-of-n half-sample via the order-statistic mask (round
+        # 4): kills the permutation's payload sort + 500k-row scatter
+        # (~3.5 ms/tree of the 1M grow). The gather path derives its
+        # index vector from the SAME mask (ascending row order — order
+        # is statistically irrelevant and every backend sees the same
+        # subsample from the same key).
+        in_mask = exact_subsample_mask(sk, n, s)
         tree_keys = jax.random.split(tk, k)
         vone = jax.vmap(
             grow_one, in_axes=(None, None, None, None, None, None, None, 0)
@@ -605,6 +607,7 @@ def _grow_cf_chunk(group_keys, codes, wt, yt, mom_stack, xb_onehot, *,
                 in_mask.astype(jnp.float32), None, tree_keys,
             )
         else:
+            idx = jnp.nonzero(in_mask, size=s)[0]
             feats, bins, stats = vone(
                 codes[idx], wt[idx], yt[idx], mom_stack[idx], None,
                 jnp.ones((s,), jnp.float32), idx, tree_keys,

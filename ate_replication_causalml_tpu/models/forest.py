@@ -206,6 +206,37 @@ def route_rows_blocked(
     return out.reshape(n_pad)[:n]
 
 
+def exact_subsample_mask(key: jax.Array, n: int, s: int) -> jax.Array:
+    """Uniform s-of-n subsample as a boolean mask, without a
+    permutation.
+
+    ``jax.random.permutation(n)[:s]`` pays a keys+payload sort AND a
+    500k-row scatter to build the mask — a round-4 device trace put the
+    pair at ~3.5 ms/tree of the causal grow (the little-bag groups draw
+    one half-sample each, grf's subsample-without-replacement). This
+    draws one u32 per row and takes the rows below the s-th order
+    statistic (ONE single-array sort), with ties at the threshold
+    broken in index order so the mask has EXACTLY s rows always.
+
+    Distribution: uniform over s-subsets up to the tie-break — a tie
+    requires a u32 collision at the threshold (~n/2^32 per row, ~10^-4
+    expected tied rows at n=10^6), at which point lower indices win;
+    the bias is orders of magnitude below Monte-Carlo noise. Matches
+    sampling WITHOUT replacement semantics (grf's subsample), not R's
+    ``sample()`` stream — the causal forest is statistically-, not
+    bit-, matched to grf (its C++ RNG is different anyway).
+    """
+    if not 1 <= s <= n:  # s is static; s-1 would wrap the sort index
+        raise ValueError(f"need 1 <= s <= n, got s={s}, n={n}")
+    bits = jax.random.bits(key, (n,), jnp.uint32)
+    kth = jnp.sort(bits)[s - 1]
+    below = bits < kth
+    short = s - jnp.sum(below.astype(jnp.int32))
+    ties = bits == kth
+    take_tie = ties & (jnp.cumsum(ties.astype(jnp.int32)) <= short)
+    return below | take_tie
+
+
 @functools.lru_cache(maxsize=None)
 def bitrev_perm(level: int) -> tuple[int, ...]:
     """Bit-reversal permutation of ``2^level`` node ids (an involution).
@@ -467,19 +498,21 @@ def plan_tree_dispatch(
     :func:`dispatch_tree_target` (the remote-worker watchdog budget —
     devices run in parallel, so a dispatch's wall-clock is its
     per-DEVICE work); ``n_disp`` dispatches cover ``per_dev_total``
-    units. Shared by the host-loop and shard_map fitters; unit-tested at
-    the million-row scale in tests/test_parallel.py."""
-    chunk = pick_chunk(
-        per_dev_total,
-        auto_tree_chunk(n_rows, depth, cap=cap, trees_per_unit=trees_per_unit,
-                        leaf_onehot=leaf_onehot, streaming=streaming,
-                        p=p, n_bins=n_bins, kernel_weights=kernel_weights),
+    units. Shared by the shard_map fitters; unit-tested at the
+    million-row scale in tests/test_parallel.py. The tail is
+    :func:`plan_host_dispatch` — full-width chunks with ceil padding,
+    the same round-4 policy as the host loops (the divisor policy
+    under-filled the kernel's tree batch and inflated dispatch
+    counts)."""
+    budget = auto_tree_chunk(
+        n_rows, depth, cap=cap, trees_per_unit=trees_per_unit,
+        leaf_onehot=leaf_onehot, streaming=streaming,
+        p=p, n_bins=n_bins, kernel_weights=kernel_weights,
     )
-    n_chunks = -(-per_dev_total // chunk)
-    chunks_per_disp = min(
-        max(1, dispatch_tree_target(n_rows) // (chunk * trees_per_unit)), n_chunks
+    return plan_host_dispatch(
+        per_dev_total, budget,
+        max(1, dispatch_tree_target(n_rows) // trees_per_unit),
     )
-    return chunk, chunks_per_disp, -(-n_chunks // chunks_per_disp)
 
 
 def auto_tree_chunk(

@@ -125,8 +125,13 @@ def bench_forest(n=FOREST_ROWS):
     ate, se = float(eff.estimate), float(eff.std_err)  # device sync HERE
     sec_per_1m = steady_s * 1e6 / n
     flops = _forest_fit_flops(n, FOREST_TREES, 8)
-    # v5e (lite) peak ≈ 197 TFLOP/s bf16 / ≈49 TFLOP/s f32 MXU; report
-    # against the f32 peak since the engine runs f32 histograms.
+    # Utilization diagnostic: analytic dense-formulation matmul flops
+    # over wall-clock, as a fraction of an assumed 49.2 TF/s f32 MXU
+    # reference rate. The classifier kernels feed the MXU bf16 operands
+    # (up to 4× the f32 rate), so values ABOVE 100% are possible and
+    # simply mean part of the issued work ran at bf16 rate — read the
+    # absolute analytic TF/s alongside it (both are in the JSON
+    # record). It is a work-rate diagnostic, not a true peak fraction.
     mfu = flops / steady_s / 49.2e12
     # Stderr diagnostics only — the JSON record is RETURNED, and the
     # caller (main) owns when it prints: in default mode both metric
@@ -148,6 +153,7 @@ def bench_forest(n=FOREST_ROWS):
         "vs_baseline": round(FOREST_BASELINE_S_PER_1M / sec_per_1m, 2),
         "samples_s": [round(steady_a, 1), round(steady_b, 1)],
         "rows": n,
+        "analytic_tflops": round(flops / steady_s / 1e12, 1),
         "mfu_f32_pct": round(mfu * 100, 1),
     }
 
