@@ -76,19 +76,24 @@ def test_dml_and_balance(dataset):
 def test_run_notebook_sweep_quick(tmp_path):
     """The R notebook's one-call driver: full sweep rows in rbind-ready
     form, quick config with the caller's n_obs actually honored."""
-    # Shapes/configs come FROM test_pipeline_driver's TINY sweep so the
-    # two tests share compiled executables within a suite run (and the
-    # invariant can't silently drift). Floats mimic R-numeric arrival.
-    from tests.test_pipeline_driver import TINY
+    # Shapes/configs come FROM test_pipeline_driver's MICRO sweep so the
+    # config invariant can't silently drift. (Round-4 note: this was
+    # TINY "to share compiled executables within a suite run" — but
+    # --dist loadfile puts the two files on different WORKERS and the
+    # suite disables the persistent cache, so no sharing ever happened;
+    # this test paid a full TINY-scale compile under 3-way core
+    # contention, 484 s of the suite. MICRO exercises the identical
+    # driver surface.) Floats mimic R-numeric arrival.
+    from tests.test_pipeline_driver import MICRO
 
     rows = rbridge.run_notebook_sweep(
-        n_obs=TINY.prep.n_obs, seed=1991, quick=True,
+        n_obs=MICRO.prep.n_obs, seed=1991, quick=True,
         outdir=str(tmp_path / "out"),
         overrides=dict(
-            synthetic_pool=float(TINY.synthetic_pool),
-            dr_trees=float(TINY.dr_trees), dml_trees=TINY.dml_trees,
-            cf_trees=TINY.cf_trees, cf_nuisance_trees=TINY.cf_nuisance_trees,
-            forest_depth=TINY.forest_depth,
+            synthetic_pool=float(MICRO.synthetic_pool),
+            dr_trees=float(MICRO.dr_trees), dml_trees=MICRO.dml_trees,
+            cf_trees=MICRO.cf_trees, cf_nuisance_trees=MICRO.cf_nuisance_trees,
+            forest_depth=MICRO.forest_depth, balance_iters=MICRO.balance_iters,
         ),
     )
     methods = [r["Method"] for r in rows]
