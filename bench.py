@@ -42,6 +42,7 @@ BASELINE_S = 60.0
 # extrapolation.
 FOREST_ROWS = 100_000
 FOREST_TREES = 2_000
+FOREST_NUISANCE_TREES = 500
 FOREST_BASELINE_S_PER_1M = 6_700.0
 # Default-mode forest scale (smoke override; parsed at import so a
 # malformed value fails before the AIPW stage burns minutes).
@@ -111,7 +112,7 @@ def bench_forest(n=FOREST_ROWS):
         t0 = time.perf_counter()
         fitted = fit_causal_forest(
             frame, key=jax.random.key(seed), n_trees=FOREST_TREES, depth=8,
-            nuisance_trees=500,
+            nuisance_trees=FOREST_NUISANCE_TREES,
         )
         _ = float(fitted.forest.leaf_stats.sum())  # sync
         return time.perf_counter() - t0, fitted

@@ -1,0 +1,91 @@
+"""Contracts for the driver-facing surfaces that no other test pins:
+bench.py's JSON record schema (the driver parses these into
+BENCH_r*.json every round) and the host dispatch plan's coverage
+invariants. Pure-Python/tiny-shape — no chip, no heavy compiles.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_forest_record_schema_via_flops_model():
+    """The bench module's record-building pieces: the flop model is
+    positive and monotone in rows/trees (a broken refactor that zeroes
+    a term would silently flatline the MFU diagnostic)."""
+    sys.path.insert(0, _REPO)
+    import bench
+
+    f1 = bench._forest_fit_flops(100_000, 2000, 8)
+    f2 = bench._forest_fit_flops(1_000_000, 2000, 8)
+    f3 = bench._forest_fit_flops(1_000_000, 4000, 8)
+    assert 0 < f1 < f2 < f3
+    # The 1M/2000-tree fit issues ~4.8 PFLOP under the current engine
+    # (RESULTS.md round-4); drifting an order of magnitude means the
+    # model no longer describes the algorithm.
+    assert 1e15 < f2 < 2e16
+
+
+def test_plan_host_dispatch_invariants():
+    """Every (total, budget, target) plan covers the total, never
+    over-pads by more than one superchunk, and stays within the
+    dispatch target per executable."""
+    from ate_replication_causalml_tpu.models.forest import plan_host_dispatch
+
+    for total in (1, 2, 16, 50, 100, 250, 500, 2000, 2500):
+        for budget in (1, 5, 8, 11, 16, 32):
+            for target in (1, 16, 25, 256, 3000):
+                chunk, super_, n_disp = plan_host_dispatch(total, budget, target)
+                grown = n_disp * super_ * chunk
+                assert grown >= total, (total, budget, target)
+                assert grown - total < super_ * chunk, (total, budget, target)
+                # The round-4 policy point: the chunk is the FULL
+                # budget width (the divisor policy's shrunken chunks —
+                # e.g. 500 trees at budget 11 -> chunk 10 — under-fill
+                # the kernel's tree batch and would pass weaker bounds).
+                assert chunk == max(1, min(budget, total))
+                # Watchdog bound: one dispatch's units stay within the
+                # target (unless a single chunk already exceeds it).
+                assert super_ * chunk <= max(target, chunk), (
+                    total, budget, target)
+
+
+def test_default_bench_emits_two_records_cpu_smoke():
+    """`python bench.py` must print one JSON record per metric, forest
+    LAST (the driver's single-line parse lands on the flagship).
+    Run on the CPU backend at smoke scale — slow in absolute terms
+    (~2-3 min of XLA compiles) but the only executable guard on the
+    driver's BENCH_r* contract."""
+    env = {
+        "PATH": "/usr/bin:/bin:/usr/local/bin",
+        "JAX_PLATFORMS": "cpu",
+        "ATE_BENCH_FOREST_ROWS": "1500",
+        "ATE_NO_COMPILE_CACHE": "1",
+        "HOME": "/tmp",
+    }
+    out = subprocess.run(
+        [sys.executable, "-c",
+         # Shrink every scale knob before main() runs: the contract
+         # under test is the record schema/ordering, not throughput.
+         "import jax; jax.config.update('jax_platforms', 'cpu');\n"
+         "import bench\n"
+         "bench.N_ROWS = 4_000; bench.N_BOOT = 32; bench.CHUNK = 8\n"
+         "bench.FOREST_TREES = 4; bench.FOREST_NUISANCE_TREES = 8\n"
+         "bench.main()\n"],
+        capture_output=True, text=True, timeout=1200, cwd=_REPO, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    records = [json.loads(l) for l in lines]
+    assert len(records) == 2, lines
+    metrics = [r["metric"] for r in records]
+    assert metrics[0] == "aipw_bootstrap_se_10k_replicates_1m_rows"
+    assert metrics[1] == "causal_forest_2000_trees_sec_per_1m_rows"
+    for r in records:
+        for field in ("metric", "value", "unit", "vs_baseline", "samples_s"):
+            assert field in r, (field, r)
+    for field in ("rows", "analytic_tflops", "mfu_bf16_pct"):
+        assert field in records[1], field
