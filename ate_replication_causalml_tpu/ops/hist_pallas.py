@@ -190,7 +190,8 @@ def _hist_kernel(codes_ref, node_ref, w_ref, out_ref, *, n_weights, max_nodes,
 
 
 def _hist_kernel_batched(codes_ref, node_ref, w_ref, out_ref, *, n_weights,
-                         n_trees, max_nodes, bw, f_pb, n_bins, in_dtype):
+                         n_trees, max_nodes, bw, f_pb, n_bins, in_dtype,
+                         shared_weights=False):
     """One grid step of the TREE-BATCHED kernel: fold one row tile into
     one feature group's histograms for ``n_trees`` trees at once.
 
@@ -212,7 +213,16 @@ def _hist_kernel_batched(codes_ref, node_ref, w_ref, out_ref, *, n_weights,
 
     codes_ref: (1, TILE, bw·f_pb) int32 — this group's features only
     node_ref:  (T, TILE)  int32         — node id per (tree, row); pad -1
-    w_ref:     (T·K, TILE) f32          — weights, tree-major; pad 0
+    w_ref:     (T·K, TILE) f32          — weights, tree-major; pad 0 —
+               or (K, TILE) with ``shared_weights=True``: ONE weight
+               stack shared by every tree (round 5 — the causal
+               grower's honest/subsample membership rides in the id
+               stream, so its five ρ-decomposition channels are
+               tree-invariant; sharing kills the (T·K, n) HBM operand
+               and its per-level DMA). The per-(tree, channel) products
+               are identical either way — w_row is the same (1, TILE)
+               sublane slice — so the output is bit-identical to the
+               per-tree layout fed with equal rows.
     out_ref:   (1, T·K·max_nodes, bw·LANES) f32
     """
     @pl.when(pl.program_id(1) == 0)
@@ -236,7 +246,8 @@ def _hist_kernel_batched(codes_ref, node_ref, w_ref, out_ref, *, n_weights,
         node_row = node_ref[t : t + 1, :]                       # (1, TILE)
         node_oh_t = (node_row == node_iota_t).astype(in_dtype)  # (M, TILE)
         for k in range(n_weights):
-            w_row = w_ref[t * n_weights + k : t * n_weights + k + 1, :]
+            w_base = k if shared_weights else t * n_weights + k
+            w_row = w_ref[w_base : w_base + 1, :]
             lhs_parts.append(node_oh_t * w_row.astype(in_dtype))
     lhs_t = (
         lhs_parts[0] if len(lhs_parts) == 1 else jnp.concatenate(lhs_parts, axis=0)
@@ -355,6 +366,43 @@ def bin_histogram_pallas(
     return out[:, :, :p, :]
 
 
+def _batched_layout(codes, n, p, n_bins, tile, bw):
+    """The feature-blocked, row-padded codes layout shared by both
+    tree-batched wrappers (per-tree and shared-weights — review r5:
+    one site for tiling/padding fixes). Returns
+    (codes_b, f_pb, bw, p_groups, p_pad, tile, n_pad)."""
+    f_pb = _LANES // n_bins
+    p_blocks = -(-p // f_pb)
+    bw = p_blocks if bw is None else min(bw, p_blocks)
+    p_groups = -(-p_blocks // bw)
+    p_pad = p_groups * bw * f_pb
+    if tile is None:
+        # Fixed 2048 rows per grid step. Larger tiles (4096-16384) were
+        # tried to amortize per-step costs further, but Mosaic's compile
+        # of the unrolled compare/concat body stalls for minutes at
+        # those widths on the remote compile service (measured twice,
+        # round 3) — the tree batching is where the amortization comes
+        # from, not the tile.
+        tile = 2048
+    n_pad = _round_up(max(n, tile), tile)
+    codes = _offset_codes(codes, n, p, n_pad, p_pad, f_pb, n_bins)
+    codes_b = codes.reshape(n_pad, p_groups, bw * f_pb).transpose(1, 0, 2)
+    return codes_b, f_pb, bw, p_groups, p_pad, tile, n_pad
+
+
+def _batched_unlayout(out, n_trees, k_w, max_nodes, p_groups, bw, f_pb,
+                      n_bins, p_pad, p):
+    """Inverse of the kernel's blocked output layout: keep each 128-lane
+    block's live lanes, restore feature order, split tree/channel axes."""
+    out = out.reshape(p_groups, n_trees * k_w * max_nodes, bw, _LANES)[
+        ..., : f_pb * n_bins
+    ]
+    out = out.transpose(1, 0, 2, 3).reshape(
+        n_trees, k_w, max_nodes, p_pad, n_bins
+    )
+    return out[:, :, :, :p, :]
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("max_nodes", "n_bins", "tile", "bw", "interpret", "bf16"),
@@ -395,25 +443,9 @@ def bin_histogram_pallas_batched(
     n_trees, k_w = weights.shape[0], weights.shape[1]
     if n_bins > _LANES:
         raise ValueError(f"n_bins={n_bins} > {_LANES} unsupported")
-    f_pb = _LANES // n_bins
-    p_blocks = -(-p // f_pb)
-    if bw is None:
-        bw = p_blocks
-    bw = min(bw, p_blocks)
-    p_groups = -(-p_blocks // bw)
-    p_pad = p_groups * bw * f_pb
-    if tile is None:
-        # Fixed 2048 rows per grid step. Larger tiles (4096-16384) were
-        # tried to amortize per-step costs further, but Mosaic's compile
-        # of the unrolled compare/concat body stalls for minutes at
-        # those widths on the remote compile service (measured twice,
-        # round 3) — the tree batching is where the amortization comes
-        # from, not the tile.
-        tile = 2048
-    n_pad = _round_up(max(n, tile), tile)
-
-    codes = _offset_codes(codes, n, p, n_pad, p_pad, f_pb, n_bins)
-    codes_b = codes.reshape(n_pad, p_groups, bw * f_pb).transpose(1, 0, 2)
+    codes_b, f_pb, bw, p_groups, p_pad, tile, n_pad = _batched_layout(
+        codes, n, p, n_bins, tile, bw
+    )
     # Lane-major row layouts: node (T, n), weights (T·K, n) — rows on
     # lanes, so the kernel's per-tree strips are sublane slices.
     node_tn = jnp.pad(
@@ -447,13 +479,79 @@ def bin_histogram_pallas_batched(
         interpret=interpret,
         compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_BUDGET),
     )(codes_b, node_tn, w_tkn)
-    out = out.reshape(p_groups, n_trees * k_w * max_nodes, bw, _LANES)[
-        ..., : f_pb * n_bins
-    ]
-    out = out.transpose(1, 0, 2, 3).reshape(
-        n_trees, k_w, max_nodes, p_pad, n_bins
+    return _batched_unlayout(
+        out, n_trees, k_w, max_nodes, p_groups, bw, f_pb, n_bins, p_pad, p
     )
-    return out[:, :, :, :p, :]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_nodes", "n_bins", "tile", "bw", "interpret", "bf16"),
+)
+def bin_histogram_pallas_batched_shared(
+    codes: jax.Array,
+    node_of_row: jax.Array,
+    weights: jax.Array,
+    *,
+    max_nodes: int,
+    n_bins: int,
+    tile: int | None = None,
+    bw: int | None = None,
+    interpret: bool = False,
+    bf16: bool = False,
+) -> jax.Array:
+    """:func:`bin_histogram_pallas_batched` with ONE weight stack
+    shared by every tree: ``weights`` is (K, n), not (T, K, n).
+
+    Same (T, K, max_nodes, p, n_bins) output, bit-identical to the
+    per-tree layout fed ``broadcast_to(weights[None], (T, K, n))`` —
+    but the kernel DMAs a (K, tile) block per step instead of
+    (T·K, tile), and no (T·K, n) HBM operand ever exists. This is the
+    round-5 causal-grower contract: honest/subsample membership lives
+    in the id stream (-1 drops a row), so the five ρ channels are the
+    raw per-row moment stack, invariant across trees
+    (models/causal_forest.py::grow_one_streaming).
+    """
+    n, p = codes.shape
+    n_trees = node_of_row.shape[0]
+    k_w = weights.shape[0]
+    if n_bins > _LANES:
+        raise ValueError(f"n_bins={n_bins} > {_LANES} unsupported")
+    codes_b, f_pb, bw, p_groups, p_pad, tile, n_pad = _batched_layout(
+        codes, n, p, n_bins, tile, bw
+    )
+    node_tn = jnp.pad(
+        node_of_row.astype(jnp.int32), ((0, 0), (0, n_pad - n)),
+        constant_values=-1,
+    )
+    w_kn = jnp.pad(weights.astype(jnp.float32), ((0, 0), (0, n_pad - n)))
+
+    grid = (p_groups, n_pad // tile)
+    out = pl.pallas_call(
+        functools.partial(
+            _hist_kernel_batched, n_weights=k_w, n_trees=n_trees,
+            max_nodes=max_nodes, bw=bw, f_pb=f_pb, n_bins=n_bins,
+            in_dtype=jnp.bfloat16 if bf16 else jnp.float32,
+            shared_weights=True,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, tile, bw * f_pb), lambda j, i: (j, i, 0)),
+            pl.BlockSpec((n_trees, tile), lambda j, i: (0, i)),
+            pl.BlockSpec((k_w, tile), lambda j, i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, n_trees * k_w * max_nodes, bw * _LANES), lambda j, i: (j, 0, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (p_groups, n_trees * k_w * max_nodes, bw * _LANES), jnp.float32
+        ),
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_BUDGET),
+    )(codes_b, node_tn, w_kn)
+    return _batched_unlayout(
+        out, n_trees, k_w, max_nodes, p_groups, bw, f_pb, n_bins, p_pad, p
+    )
 
 
 def kernel_lanes(p: int, n_bins: int) -> int:
@@ -547,6 +645,102 @@ def _pallas_batched_vmappable(max_nodes: int, n_bins: int, bf16: bool,
     return g
 
 
+@functools.lru_cache(maxsize=None)
+def _pallas_batched_shared_vmappable(max_nodes: int, n_bins: int, bf16: bool,
+                                     interpret: bool):
+    """The shared-weights tree-batched kernel as a `custom_vmap`
+    callable: g(codes (n, p), node (T, n), weights (K, n)).
+
+    Mirrors :func:`_pallas_batched_vmappable`'s collapse rule for the
+    causal grower's nested vmaps (groups × little-bag trees), but the
+    weight stack NEVER batches — it is the chunk-shared per-row moment
+    stack. A vmap level that batches node ids flattens into the tree
+    axis; batched codes fall back to a per-slice loop; batched weights
+    (no caller today) broadcast into the per-tree kernel, preserving
+    correctness at the old cost."""
+    from jax import custom_batching
+
+    def impl(codes, node, weights):
+        t = node.shape[0]
+        cap = batched_tree_cap(
+            max_nodes, weights.shape[0], p=codes.shape[1], n_bins=n_bins
+        )
+        outs = [
+            bin_histogram_pallas_batched_shared(
+                codes, node[s : s + cap], weights,
+                max_nodes=max_nodes, n_bins=n_bins, bf16=bf16,
+                interpret=interpret,
+            )
+            for s in range(0, t, cap)
+        ]
+        return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
+
+    @custom_batching.custom_vmap
+    def g(codes, node, weights):
+        return impl(codes, node, weights)
+
+    @g.def_vmap
+    def _rule(axis_size, in_batched, codes, node, weights):  # noqa: ANN001
+        codes_b, node_b, w_b = in_batched
+        if w_b:
+            # A batched weight stack contradicts the shared-weights
+            # contract (weights are THE chunk-shared operand); no
+            # caller does this — fail loudly rather than silently
+            # broadcasting at the dense kernel's cost (review r5).
+            raise NotImplementedError(
+                "bin_histogram_shared: weights must not be vmapped — "
+                "use bin_histogram for per-tree weight stacks"
+            )
+        if codes_b:
+            out = jnp.stack([
+                g(codes[i], node[i] if node_b else node, weights)
+                for i in range(axis_size)
+            ])
+            return out, True
+        if not node_b:
+            node = jnp.broadcast_to(node[None], (axis_size,) + node.shape)
+        b, t = node.shape[0], node.shape[1]
+        out = g(codes, node.reshape(b * t, node.shape[2]), weights)
+        return out.reshape((b, t) + out.shape[1:]), True
+
+    return g
+
+
+def bin_histogram_shared(
+    codes: jax.Array,
+    node_of_row: jax.Array,
+    weights: jax.Array,
+    *,
+    max_nodes: int,
+    n_bins: int,
+    backend: str = "auto",
+) -> jax.Array:
+    """:func:`bin_histogram` whose weight stack is SHARED across any
+    vmapped tree axes: node_of_row (n,) per tree, weights (K, n) common.
+
+    Under the growers' nested vmaps the node ids flatten into the
+    kernel's tree axis exactly as :func:`bin_histogram` does, but the
+    weights stay one (K, n) operand — no per-tree broadcast is ever
+    materialized. Output per call: (K, max_nodes, p, n_bins),
+    bit-identical to ``bin_histogram(codes, ids, weights·mask)`` when
+    the caller folds the row mask into the ids (0/1 weights only — the
+    causal membership contract)."""
+    backend = resolve_hist_backend(
+        backend, allow_onehot=False, n_rows=codes.shape[0], n_bins=n_bins
+    )
+    if backend in ("pallas", "pallas_bf16", "pallas_interpret"):
+        g = _pallas_batched_shared_vmappable(
+            max_nodes, n_bins, backend == "pallas_bf16",
+            backend == "pallas_interpret",
+        )
+        return g(codes, node_of_row[None], weights)[0]
+    if backend == "xla":
+        return bin_histogram_xla(
+            codes, node_of_row, weights, max_nodes=max_nodes, n_bins=n_bins
+        )
+    raise ValueError(f"unknown histogram backend {backend!r}")
+
+
 def bin_histogram_batched(
     codes: jax.Array,
     node_of_row: jax.Array,
@@ -596,6 +790,29 @@ def node_sums(
     if backend.startswith("pallas"):
         codes0 = jnp.zeros((n, 1), jnp.int32)
         h = bin_histogram(
+            codes0, ids, weights, max_nodes=num_nodes, n_bins=128,
+            backend=backend,
+        )  # (K, M, 1, 128); only bin 0 is populated
+        return h[:, :, 0, 0].T
+    oh = jax.nn.one_hot(ids, num_nodes, dtype=jnp.float32)
+    return jnp.matmul(oh.T, weights.T)  # (M, K)
+
+
+def node_sums_shared(
+    ids: jax.Array,
+    weights: jax.Array,
+    num_nodes: int,
+    backend: str = "auto",
+) -> jax.Array:
+    """:func:`node_sums` with the weight stack shared across vmapped
+    tree axes (ids (n,) per tree, weights (K, n) common) — the honest
+    leaf payload with estimate-half membership folded into the ids."""
+    n = ids.shape[0]
+    backend = resolve_hist_backend(backend, allow_onehot=False, n_rows=n,
+                                   n_bins=128)
+    if backend.startswith("pallas"):
+        codes0 = jnp.zeros((n, 1), jnp.int32)
+        h = bin_histogram_shared(
             codes0, ids, weights, max_nodes=num_nodes, n_bins=128,
             backend=backend,
         )  # (K, M, 1, 128); only bin 0 is populated

@@ -209,12 +209,20 @@ def balance_qp(
 
 
 @functools.lru_cache(maxsize=32)
-def _balance_qp_jitted_x64(zeta, ub, rho, max_iters, tol):
-    return jax.jit(
-        functools.partial(
-            balance_qp, zeta=zeta, ub=ub, rho=rho, max_iters=max_iters, tol=tol
+def _balance_qp_jitted_x64(max_iters):
+    # Keyed on the ONE graph-shaping scalar (``max_iters`` bounds the
+    # while_loop's adapt freeze point, a Python computation); the pure
+    # numeric scalars (zeta, ub, rho, tol) enter as traced operands, so
+    # a sweep over many configurations reuses one executable per
+    # (max_iters, input shape) instead of thrashing the cache
+    # (ADVICE r4: >32 distinct scalar combos recompiled on every
+    # eviction cycle).
+    def run(x, target, zeta, ub, rho, tol):
+        return balance_qp(
+            x, target, zeta=zeta, ub=ub, rho=rho, max_iters=max_iters, tol=tol
         )
-    )
+
+    return jax.jit(run)
 
 
 def balance_qp_x64(
@@ -241,11 +249,13 @@ def balance_qp_x64(
     and far cheaper than the 12k-iteration f32 crawl it replaces.
     """
     with jax.enable_x64():
-        sol = _balance_qp_jitted_x64(
-            float(zeta), float(ub), float(rho), int(max_iters), float(tol)
-        )(
+        sol = _balance_qp_jitted_x64(int(max_iters))(
             jnp.asarray(x, jnp.float64),
             jnp.asarray(target, jnp.float64),
+            jnp.float64(zeta),
+            jnp.float64(ub),
+            jnp.float64(rho),
+            jnp.float64(tol),
         )
         jax.block_until_ready(sol)
     return sol

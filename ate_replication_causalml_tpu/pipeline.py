@@ -63,6 +63,29 @@ from ate_replication_causalml_tpu.models.forest import rf_oob_propensity
 from ate_replication_causalml_tpu.utils.profiling import StageTimer, xla_trace
 
 
+# The sweep's result-row manifest, in notebook order (Rmd:128-272) —
+# ``run_sweep``'s ``report.results`` contains exactly these methods (the
+# oracle rides separately in ``report.oracle``). External contracts
+# (the driver's multichip dryrun, tests) assert against THIS tuple, not
+# a hard-coded row count, so adding or removing a sweep stage updates
+# every consumer in one place.
+SWEEP_METHODS = (
+    "naive",
+    "Direct Method",
+    "Propensity_Weighting",
+    "Propensity_Regression",
+    "Propensity_Weighting_LASSOPS",
+    "Single-equation LASSO",
+    "Usual LASSO",
+    "Doubly Robust with Random Forest PS",
+    "Doubly Robust with logistic regression PS",
+    "Belloni et.al",
+    "Double Machine Learning",
+    "residual_balancing",
+    "Causal Forest(GRF)",
+)
+
+
 @dataclasses.dataclass(frozen=True)
 class SweepConfig:
     """Every constant the notebook hardcodes, in one place.
@@ -352,6 +375,14 @@ def run_sweep(
     cf_rec = ckpt.get("Causal Forest(GRF)") or {}
     report.incorrect_cf_ate = cf_rec.get("incorrect_ate")
     report.incorrect_cf_se = cf_rec.get("incorrect_se")
+
+    # Producer-side manifest check: the stage literals above ARE the
+    # sweep; this catches a stage added/reordered without updating
+    # SWEEP_METHODS at the definition site, in every test path (review
+    # r5: the tuple is otherwise a parallel transcription).
+    assert [r.method for r in report.results] == list(SWEEP_METHODS), (
+        [r.method for r in report.results]
+    )
 
     if outdir:
         with open(os.path.join(outdir, "report.json"), "w") as f:

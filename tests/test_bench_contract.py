@@ -59,13 +59,17 @@ def test_default_bench_emits_two_records_cpu_smoke():
     Run on the CPU backend at smoke scale — slow in absolute terms
     (~2-3 min of XLA compiles) but the only executable guard on the
     driver's BENCH_r* contract."""
-    env = {
-        "PATH": "/usr/bin:/bin:/usr/local/bin",
-        "JAX_PLATFORMS": "cpu",
-        "ATE_BENCH_FOREST_ROWS": "1500",
-        "ATE_NO_COMPILE_CACHE": "1",
-        "HOME": "/tmp",
-    }
+    # Inherit the parent's environment (ADVICE r4: a replaced env broke
+    # the child's jax import on hosts whose deps resolve via
+    # virtualenv/PYTHONPATH or a nonstandard prefix) and override only
+    # the knobs under test.
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        ATE_BENCH_FOREST_ROWS="1500",
+        ATE_NO_COMPILE_CACHE="1",
+    )
+    env.pop("XLA_FLAGS", None)  # no virtual-device mesh in the child
     out = subprocess.run(
         [sys.executable, "-c",
          # Shrink every scale knob before main() runs: the contract

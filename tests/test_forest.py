@@ -348,3 +348,39 @@ def test_exact_subsample_mask():
     for s2 in (1, n - 1, n):
         m = exact_subsample_mask(jax.random.key(9), n, s2)
         assert int(m.sum()) == s2, s2
+
+
+def test_exact_subsample_mask_matches_sort_kth():
+    """The round-5 binary-search selection returns the SAME mask as the
+    sort-based order statistic it replaced (same draws, same kth, same
+    index tie-break) — including degenerate s and a forced-tie regime."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as _np
+
+    from ate_replication_causalml_tpu.models.forest import exact_subsample_mask
+
+    n = 5_003
+    for key_i, s in ((0, 1), (1, 2_501), (2, n - 1), (3, n), (4, 777)):
+        key = jax.random.key(key_i)
+        bits = jax.random.bits(key, (n,), jnp.uint32)
+        kth = jnp.sort(bits)[s - 1]
+        below = bits < kth
+        short = s - jnp.sum(below.astype(jnp.int32))
+        ties = bits == kth
+        ref = below | (ties & (jnp.cumsum(ties.astype(jnp.int32)) <= short))
+        got = exact_subsample_mask(key, n, s)
+        assert bool(jnp.array_equal(got, ref)), (key_i, s)
+
+    # Direct check of the kth==0 boundary the search special-cases:
+    # all-zero bits means kth == 0 and the first s indices win.
+    import ate_replication_causalml_tpu.models.forest as _f
+
+    orig = jax.random.bits
+    try:
+        jax.random.bits = lambda *a, **k: jnp.zeros(a[1], jnp.uint32)
+        m = _f.exact_subsample_mask(jax.random.key(0), 100, 7)
+        assert int(m.sum()) == 7
+        assert bool(m[:7].all()) and not bool(m[7:].any())
+    finally:
+        jax.random.bits = orig

@@ -395,3 +395,87 @@ def test_resolve_backend_bf16_upgrade(monkeypatch):
     monkeypatch.setattr(hp.jax, "default_backend", lambda: "cpu")
     assert hp.resolve_hist_backend(
         "auto", n_rows=big, n_bins=64, integer_weights=True) == "onehot"
+
+
+def test_shared_weights_kernel_bit_identical(case):
+    """Round-5 contract: the shared-weights kernel with membership in
+    the id stream is BIT-identical to the per-tree kernel fed the
+    equivalent 0/1-masked weights (the causal grower's honest/subsample
+    fold — models/causal_forest.py::grow_one_streaming)."""
+    from ate_replication_causalml_tpu.ops.hist_pallas import (
+        bin_histogram_pallas_batched,
+        bin_histogram_pallas_batched_shared,
+    )
+
+    codes, node, weights, max_nodes, n_bins = case
+    rng = np.random.default_rng(7)
+    t = 3
+    n = codes.shape[0]
+    # Per-tree 0/1 membership masks and per-tree node streams.
+    member = rng.integers(0, 2, (t, n)).astype(np.float32)
+    nodes_t = rng.integers(0, max_nodes, (t, n)).astype(np.int32)
+    shared_w = rng.uniform(-2, 2, (5, n)).astype(np.float32)
+
+    # Old formulation: per-tree weights = mask · shared channels.
+    w_per_tree = member[:, None, :] * shared_w[None, :, :]  # (T, 5, n)
+    ref = bin_histogram_pallas_batched(
+        jnp.asarray(codes), jnp.asarray(nodes_t), jnp.asarray(w_per_tree),
+        max_nodes=max_nodes, n_bins=n_bins, tile=256, interpret=True,
+    )
+    # New formulation: membership folded into ids, weights shared.
+    ids_masked = np.where(member > 0, nodes_t, -1).astype(np.int32)
+    got = bin_histogram_pallas_batched_shared(
+        jnp.asarray(codes), jnp.asarray(ids_masked), jnp.asarray(shared_w),
+        max_nodes=max_nodes, n_bins=n_bins, tile=256, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_shared_custom_vmap_collapses(case):
+    """bin_histogram_shared under nested vmaps (groups × trees) returns
+    the same histograms as per-slice calls, with the weight stack never
+    batched."""
+    from ate_replication_causalml_tpu.ops.hist_pallas import bin_histogram_shared
+
+    codes, node, weights, max_nodes, n_bins = case
+    rng = np.random.default_rng(11)
+    g, t = 2, 3
+    n = codes.shape[0]
+    nodes_gt = rng.integers(-1, max_nodes, (g, t, n)).astype(np.int32)
+    shared_w = rng.uniform(-2, 2, (4, n)).astype(np.float32)
+
+    def one(ids):
+        return bin_histogram_shared(
+            jnp.asarray(codes), ids, jnp.asarray(shared_w),
+            max_nodes=max_nodes, n_bins=n_bins, backend="pallas_interpret",
+        )
+
+    got = jax.vmap(jax.vmap(one))(jnp.asarray(nodes_gt))
+    for i in range(g):
+        for j in range(t):
+            ref = one(jnp.asarray(nodes_gt[i, j]))
+            np.testing.assert_array_equal(
+                np.asarray(got[i, j]), np.asarray(ref)
+            )
+
+
+def test_node_sums_shared_matches_masked_node_sums(case):
+    from ate_replication_causalml_tpu.ops.hist_pallas import (
+        node_sums,
+        node_sums_shared,
+    )
+
+    codes, node, weights, max_nodes, n_bins = case
+    rng = np.random.default_rng(13)
+    n = codes.shape[0]
+    member = rng.integers(0, 2, n).astype(np.float32)
+    shared_w = rng.uniform(-2, 2, (5, n)).astype(np.float32)
+    ref = node_sums(
+        jnp.asarray(node), jnp.asarray(member[None, :] * shared_w), max_nodes,
+        backend="pallas_interpret",
+    )
+    got = node_sums_shared(
+        jnp.asarray(np.where(member > 0, node, -1).astype(np.int32)),
+        jnp.asarray(shared_w), max_nodes, backend="pallas_interpret",
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
