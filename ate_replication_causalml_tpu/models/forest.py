@@ -583,7 +583,13 @@ def auto_tree_chunk(
     width = 1 << (depth if leaf_onehot else depth - 1)
     rows_eff = min(n_rows, _ROUTE_BLOCK) if streaming else n_rows
     per_tree = 4 * rows_eff * width * trees_per_unit
-    chunk = max(1, min(cap, _CHUNK_BYTES_BUDGET // max(per_tree, 1)))
+    # Streaming chunks are kernel-cap-bound, not ``cap``-bound: the
+    # round-5 on-chip A/B (ops/hist_pallas.py::batched_tree_cap) showed
+    # per-call fixed work amortizing linearly in the batch with flat
+    # marginal cost, so the legacy cap only serves as a 2× safety bound
+    # against runaway per-chunk HBM (the (T, n) id/weight streams).
+    hard_cap = 2 * cap if streaming else cap
+    chunk = max(1, min(hard_cap, _CHUNK_BYTES_BUDGET // max(per_tree, 1)))
     if streaming:
         from ate_replication_causalml_tpu.ops.hist_pallas import batched_tree_cap
 

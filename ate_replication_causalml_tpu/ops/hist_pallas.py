@@ -566,14 +566,22 @@ def batched_tree_cap(max_nodes: int, n_weights: int, tile: int = 2048,
                      p: int = 21, n_bins: int = 64) -> int:
     """Largest tree batch T whose kernel working set fits the scoped-VMEM
     budget: out block (T·K·M, lanes) f32 + lhs (tile, T·K·M) f32 + bin
-    one-hot (tile, lanes), with ~2× headroom for Mosaic temps. ``p`` and
-    ``n_bins`` size the lane axis — the default is the GGL shape; pass
-    the real values for wider feature sets or the estimate undercounts
-    VMEM."""
+    one-hot and codes temps. ``p`` and ``n_bins`` size the lane axis —
+    the default is the GGL shape; pass the real values for wider
+    feature sets or the estimate undercounts VMEM.
+
+    Headroom (round 5, scripts/ab_lhs_variant.py on-chip): T=22 at the
+    causal deep shape (K=5, M=64 — 97 MB of out+lhs) compiles and runs
+    under the 100 MB budget, so the old 2× halving double-counted
+    Mosaic temps; 0.9× with an explicit fixed term matches observed
+    fits. The same A/B measured the deep-level MARGINAL cost flat in T
+    (~4.7 ms/tree) while the ~4.7 ms per-call fixed work (bin one-hot
+    build + codes DMA + grid overhead, level-invariant) divides by T —
+    a bigger batch is pure fixed-cost amortization."""
     lanes = kernel_lanes(p, n_bins)
     per_tree = 4 * n_weights * max_nodes * (lanes + tile)
-    fixed = 4 * tile * lanes
-    return max(1, (_VMEM_BUDGET // 2 - fixed) // max(per_tree, 1))
+    fixed = 2 * 4 * tile * lanes
+    return max(1, (int(_VMEM_BUDGET * 0.9) - fixed) // max(per_tree, 1))
 
 
 @functools.lru_cache(maxsize=None)
@@ -655,9 +663,10 @@ def _pallas_batched_shared_vmappable(max_nodes: int, n_bins: int, bf16: bool,
     causal grower's nested vmaps (groups × little-bag trees), but the
     weight stack NEVER batches — it is the chunk-shared per-row moment
     stack. A vmap level that batches node ids flattens into the tree
-    axis; batched codes fall back to a per-slice loop; batched weights
-    (no caller today) broadcast into the per-tree kernel, preserving
-    correctness at the old cost."""
+    axis; batched codes fall back to a per-slice loop; vmapping the
+    WEIGHTS raises (use :func:`bin_histogram` for per-tree stacks —
+    the rule fails loudly rather than silently paying the dense
+    broadcast)."""
     from jax import custom_batching
 
     def impl(codes, node, weights):

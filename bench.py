@@ -9,10 +9,10 @@ then 10,000 bootstrap replicates of the combination step, chunked +
 sharded over the mesh.
 
 The default (no-args) mode prints one JSON record per north-star metric
-(VERDICT r3 #2) — the AIPW bootstrap line first, then the 1M-row causal
-forest sec/1M line (min of two warm fits, both samples + MFU in the
-record). The forest line prints LAST so a single-line parse lands on
-the flagship metric:
+(VERDICT r3 #2, r4 #6) — the AIPW bootstrap line, the cached
+predict+variance line, then the 1M-row causal forest sec/1M line (min
+of two warm fits, both samples + MFU in the record). The forest FIT
+line prints LAST so a single-line parse lands on the flagship metric:
   {"metric": ..., "value": N, "unit": "s", "vs_baseline": N, ...}
 vs_baseline = baseline / measured — >1 means faster than target.
 """
@@ -90,10 +90,65 @@ def _forest_fit_flops(n, trees, depth, nuisance_trees=500,
     )
 
 
-def bench_forest(n=FOREST_ROWS):
+def bench_forest_predict(fitted, n):
+    """Predict-side throughput (VERDICT r4 #6): grf's in-sample
+    ``predict(forest, estimate.variance=TRUE)`` equivalent —
+    1M-row OOB CATE + little-bags variance over all 2000 trees, via the
+    (T, n) leaf-index cache (compute_leaf_index) so repeated scoring is
+    routing-free. Reported as sec/1M rows of the cached predict (the
+    cache build rides in the record as ``leaf_index_s``).
+
+    ``vs_baseline`` uses the same 6,700 s/1M grf FIT extrapolation as
+    the fit metric — the reference publishes no predict timing; grf's
+    variance predict re-walks every tree per query row, a workload of
+    the same order as a fit level sweep (documented, not measured)."""
+    from ate_replication_causalml_tpu.models.causal_forest import (
+        compute_leaf_index,
+        predict_cate,
+    )
+
+    t0 = time.perf_counter()
+    li = compute_leaf_index(fitted.forest, fitted.x)
+    li.block_until_ready()
+    _ = int(li[0, 0])  # host sync (block_until_ready lies on axon)
+    leaf_index_s = time.perf_counter() - t0
+
+    def one(seed):
+        t0 = time.perf_counter()
+        pred = predict_cate(fitted.forest, fitted.x, oob=True, leaf_index=li)
+        c, v = float(pred.cate.sum()), float(pred.variance.sum())  # sync
+        return time.perf_counter() - t0, c, v
+
+    compile_s, _, _ = one(0)
+    a, _, _ = one(1)
+    b, c_sum, v_sum = one(2)
+    steady = min(a, b)
+    sec_per_1m = steady * 1e6 / n
+    print(
+        f"# predict rows={n} trees={fitted.forest.n_trees} "
+        f"leaf_index={leaf_index_s:.1f}s first={compile_s:.1f}s "
+        f"steady={steady:.2f}s (runs {a:.2f}/{b:.2f}) "
+        f"mean_cate={c_sum / n:.4f} mean_var={v_sum / n:.6f}",
+        file=sys.stderr,
+    )
+    return {
+        "metric": "causal_forest_predict_var_sec_per_1m_rows",
+        "value": round(sec_per_1m, 2),
+        "unit": "s",
+        "vs_baseline": round(FOREST_BASELINE_S_PER_1M / sec_per_1m, 2),
+        "samples_s": [round(a, 2), round(b, 2)],
+        "rows": n,
+        "leaf_index_s": round(leaf_index_s, 2),
+        "baseline_note": "vs the grf FIT extrapolation (no published predict baseline)",
+    }
+
+
+def bench_forest(n=FOREST_ROWS, with_predict=False):
     """Causal-forest throughput: full grf-equivalent fit (2x500-tree
     nuisance forests + 2000 honest gradient-split trees) at ``n`` rows,
-    reported as sec/1M rows (pass --rows to measure at 1M directly)."""
+    reported as sec/1M rows (pass --rows to measure at 1M directly).
+    ``with_predict=True`` also measures the cached-predict stage and
+    returns (fit_record, predict_record)."""
     from ate_replication_causalml_tpu.data.frame import CausalFrame
     from ate_replication_causalml_tpu.models.causal_forest import (
         average_treatment_effect,
@@ -151,7 +206,7 @@ def bench_forest(n=FOREST_ROWS):
     # Both warm samples ride in the record (advisor r3: min-of-two alone
     # reports the optimistic sample; downstream readers get the raw pair
     # and can take the median/max themselves), plus the MFU diagnostic.
-    return {
+    record = {
         "metric": "causal_forest_2000_trees_sec_per_1m_rows",
         "value": round(sec_per_1m, 1),
         "unit": "s",
@@ -161,6 +216,9 @@ def bench_forest(n=FOREST_ROWS):
         "analytic_tflops": round(flops / steady_s / 1e12, 1),
         "mfu_bf16_pct": round(mfu * 100, 1),
     }
+    if with_predict:
+        return record, bench_forest_predict(fitted, n)
+    return record
 
 
 def bench_hist_ab(n=N_ROWS, trees=32, depth=9):
@@ -290,6 +348,14 @@ def main():
         if "--rows" in sys.argv:
             rows = int(sys.argv[sys.argv.index("--rows") + 1])
         return bench_hist_ab(rows)
+    if "--forest-predict" in sys.argv:
+        rows = FOREST_ROWS
+        if "--rows" in sys.argv:
+            rows = int(sys.argv[sys.argv.index("--rows") + 1])
+        fit_rec, pred_rec = bench_forest(rows, with_predict=True)
+        print(json.dumps(pred_rec))
+        print(json.dumps(fit_rec))
+        return None
     if "--forest" in sys.argv:
         rows = FOREST_ROWS
         if "--rows" in sys.argv:
@@ -348,15 +414,19 @@ def main():
         "vs_baseline": round(BASELINE_S / best, 2),
         "samples_s": [round(s, 3) for s in samples],
     }
-    # VERDICT r3 #2: the default (driver-run) bench must carry BOTH
-    # north-star metrics. Both stages run to completion BEFORE either
-    # JSON record prints — a mid-run failure (and the __main__ re-exec
-    # retry it triggers) can never leave partial or duplicated records.
-    # The flagship forest record prints LAST so a single-line parse
-    # lands on the sec/1M metric. (Env override exists so a smoke run
-    # doesn't need the full 1M fit.)
-    forest_record = bench_forest(DEFAULT_FOREST_ROWS)
+    # VERDICT r3 #2 + r4 #6: the default (driver-run) bench carries the
+    # north-star metrics — AIPW bootstrap, the cached predict+variance
+    # stage, and the flagship forest fit. Every stage runs to
+    # completion BEFORE any JSON record prints — a mid-run failure (and
+    # the __main__ re-exec retry it triggers) can never leave partial
+    # or duplicated records. The flagship forest FIT record prints LAST
+    # so a single-line parse lands on the sec/1M metric. (Env override
+    # exists so a smoke run doesn't need the full 1M fit.)
+    forest_record, predict_record = bench_forest(
+        DEFAULT_FOREST_ROWS, with_predict=True
+    )
     print(json.dumps(aipw_record))
+    print(json.dumps(predict_record))
     print(json.dumps(forest_record))
 
 

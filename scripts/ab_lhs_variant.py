@@ -1,0 +1,193 @@
+"""A/B: concat-lhs (one big dot) vs per-tree dots with slice
+accumulation in the tree-batched histogram kernel, at the causal
+deep-level shape (round-5 perf work).
+
+Motivation: the concat builds a (T*K*M, TILE) VMEM buffer whose size
+caps the tree batch at ~8 for the causal shape (K=5, M=64); per-tree
+dots of (K*M, TILE) accumulate straight into the output block, so the
+cap is set by the OUTPUT block alone and the bin one-hot build
+amortizes over more trees. Output must be bit-identical (asserted
+here on a small case, interpret mode is too slow at 1M).
+
+Per NEXT.md hardware lessons: whole jitted computations only, timed by
+float() sync, repeats inside one dispatch via lax.fori_loop.
+"""
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, "/root/repo")
+from ate_replication_causalml_tpu.ops.hist_pallas import (  # noqa: E402
+    _LANES,
+    _VMEM_BUDGET,
+    _batched_layout,
+    _hist_kernel_batched,
+)
+
+from ate_replication_causalml_tpu.utils.compile_cache import (  # noqa: E402
+    enable_persistent_cache,
+)
+
+enable_persistent_cache()
+
+
+def _kernel_pertree(codes_ref, node_ref, w_ref, out_ref, *, n_weights,
+                    n_trees, max_nodes, bw, f_pb, n_bins, in_dtype):
+    """Per-tree-dot variant: no concatenated lhs; each tree's (K·M, TILE)
+    weighted node one-hot block dots into its own output slice."""
+    from ate_replication_causalml_tpu.ops.hist_pallas import _build_bin_oh
+
+    @pl.when(pl.program_id(1) == 0)
+    def _zero():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    tile = codes_ref.shape[1]
+    bin_oh = _build_bin_oh(codes_ref, bw, f_pb, n_bins, in_dtype)
+    node_iota_t = lax.broadcasted_iota(jnp.int32, (max_nodes, tile), 0)
+    for t in range(n_trees):
+        node_row = node_ref[t : t + 1, :]
+        node_oh_t = (node_row == node_iota_t).astype(in_dtype)
+        parts = []
+        for k in range(n_weights):
+            w_row = w_ref[k : k + 1, :]  # shared weights
+            parts.append(node_oh_t * w_row.astype(in_dtype))
+        lhs_t = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+        base = t * n_weights * max_nodes
+        out_ref[0, base : base + n_weights * max_nodes, :] += lax.dot_general(
+            lhs_t, bin_oh,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+
+def run_variant(kernel_fn, codes, node, weights, max_nodes, n_bins, shared):
+    n, p = codes.shape
+    n_trees = node.shape[0]
+    k_w = weights.shape[0] if shared else weights.shape[1]
+    codes_b, f_pb, bw, p_groups, p_pad, tile, n_pad = _batched_layout(
+        codes, n, p, n_bins, None, None
+    )
+    node_tn = jnp.pad(node, ((0, 0), (0, n_pad - n)), constant_values=-1)
+    if shared:
+        w_op = jnp.pad(weights, ((0, 0), (0, n_pad - n)))
+        w_spec = pl.BlockSpec((k_w, tile), lambda j, i: (0, i))
+    else:
+        w_op = jnp.pad(
+            weights.reshape(n_trees * k_w, n), ((0, 0), (0, n_pad - n))
+        )
+        w_spec = pl.BlockSpec((n_trees * k_w, tile), lambda j, i: (0, i))
+    grid = (p_groups, n_pad // tile)
+    return pl.pallas_call(
+        kernel_fn,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, tile, bw * f_pb), lambda j, i: (j, i, 0)),
+            pl.BlockSpec((n_trees, tile), lambda j, i: (0, i)),
+            w_spec,
+        ],
+        out_specs=pl.BlockSpec(
+            (1, n_trees * k_w * max_nodes, bw * _LANES), lambda j, i: (j, 0, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (p_groups, n_trees * k_w * max_nodes, bw * _LANES), jnp.float32
+        ),
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_BUDGET),
+    )(codes_b, node_tn, w_op)
+
+
+def main():
+    n, p, n_bins = 1_000_000, 21, 64
+    max_nodes, k_w = 64, 5  # causal level-7 shape
+    key = jax.random.key(0)
+    kc, kn, kw = jax.random.split(key, 3)
+    codes = jax.random.randint(kc, (n, p), 0, n_bins, jnp.int32)
+    weights = jax.random.normal(kw, (k_w, n), jnp.float32)
+
+    for t_batch in (4, 8, 12, 16, 22):
+        node = jax.random.randint(kn, (t_batch, n), -1, max_nodes, jnp.int32)
+
+        for name, fn, shared in (
+            (
+                "concat",
+                functools.partial(
+                    _hist_kernel_batched, n_weights=k_w, n_trees=t_batch,
+                    max_nodes=max_nodes, bw=11, f_pb=2, n_bins=n_bins,
+                    in_dtype=jnp.float32, shared_weights=True,
+                ),
+                True,
+            ),
+            (
+                "pertree",
+                functools.partial(
+                    _kernel_pertree, n_weights=k_w, n_trees=t_batch,
+                    max_nodes=max_nodes, bw=11, f_pb=2, n_bins=n_bins,
+                    in_dtype=jnp.float32,
+                ),
+                True,
+            ),
+        ):
+            run = jax.jit(
+                lambda c, nd, w, fn=fn, shared=shared: run_variant(
+                    fn, c, nd, w, max_nodes, n_bins, shared
+                ).sum()
+            )
+            try:
+                t0 = time.perf_counter()
+                v = float(run(codes, node, weights))
+                compile_s = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                v = float(run(codes, node, weights))
+                warm1 = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                v = float(run(codes, node, weights))
+                warm = min(warm1, time.perf_counter() - t0)
+                print(
+                    f"T={t_batch:2d} {name:8s} warm={warm * 1e3:7.1f} ms "
+                    f"({warm * 1e3 / t_batch:6.2f} ms/tree) "
+                    f"compile={compile_s:.1f}s sum={v:.3e}",
+                    flush=True,
+                )
+            except Exception as e:  # noqa: BLE001
+                print(f"T={t_batch:2d} {name:8s} FAILED: {str(e)[:200]}",
+                      flush=True)
+
+    # Bit-identity on a small case (compiled, same chip).
+    n2 = 100_000
+    codes2 = codes[:n2]
+    node2 = jax.random.randint(kn, (4, n2), -1, max_nodes, jnp.int32)
+    w2 = weights[:, :n2]
+    a = jax.jit(
+        lambda: run_variant(
+            functools.partial(
+                _hist_kernel_batched, n_weights=k_w, n_trees=4,
+                max_nodes=max_nodes, bw=11, f_pb=2, n_bins=n_bins,
+                in_dtype=jnp.float32, shared_weights=True,
+            ),
+            codes2, node2, w2, max_nodes, n_bins, True,
+        )
+    )()
+    b = jax.jit(
+        lambda: run_variant(
+            functools.partial(
+                _kernel_pertree, n_weights=k_w, n_trees=4,
+                max_nodes=max_nodes, bw=11, f_pb=2, n_bins=n_bins,
+                in_dtype=jnp.float32,
+            ),
+            codes2, node2, w2, max_nodes, n_bins, True,
+        )
+    )()
+    import numpy as np
+
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    print("bit-identical: OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

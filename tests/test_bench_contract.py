@@ -53,9 +53,10 @@ def test_plan_host_dispatch_invariants():
                     total, budget, target)
 
 
-def test_default_bench_emits_two_records_cpu_smoke():
-    """`python bench.py` must print one JSON record per metric, forest
-    LAST (the driver's single-line parse lands on the flagship).
+def test_default_bench_emits_three_records_cpu_smoke():
+    """`python bench.py` must print one JSON record per metric (AIPW,
+    cached predict+variance, forest fit), forest fit LAST (the
+    driver's single-line parse lands on the flagship).
     Run on the CPU backend at smoke scale — slow in absolute terms
     (~2-3 min of XLA compiles) but the only executable guard on the
     driver's BENCH_r* contract."""
@@ -84,12 +85,16 @@ def test_default_bench_emits_two_records_cpu_smoke():
     assert out.returncode == 0, out.stderr[-2000:]
     lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
     records = [json.loads(l) for l in lines]
-    assert len(records) == 2, lines
+    assert len(records) == 3, lines
     metrics = [r["metric"] for r in records]
     assert metrics[0] == "aipw_bootstrap_se_10k_replicates_1m_rows"
-    assert metrics[1] == "causal_forest_2000_trees_sec_per_1m_rows"
+    assert metrics[1] == "causal_forest_predict_var_sec_per_1m_rows"
+    # Flagship fit metric LAST — the driver's single-line parse.
+    assert metrics[2] == "causal_forest_2000_trees_sec_per_1m_rows"
     for r in records:
         for field in ("metric", "value", "unit", "vs_baseline", "samples_s"):
             assert field in r, (field, r)
     for field in ("rows", "analytic_tflops", "mfu_bf16_pct"):
+        assert field in records[2], field
+    for field in ("rows", "leaf_index_s"):
         assert field in records[1], field
