@@ -643,7 +643,10 @@ def fit_forest_classifier(
 
     mtry defaults to floor(sqrt(p)) (randomForest's classification
     default). Trees are grown in chunks of ``tree_chunk`` (default:
-    auto-sized to the HBM budget, ≤32): one jitted chunk executable
+    auto-sized to the HBM budget and the kernel's VMEM tree cap — ≤32
+    on the XLA/onehot backends, up to 2× that on the streaming
+    backends where the kernel cap rules; auto_tree_chunk): one jitted
+    chunk executable
     (compiled once), driven by a host loop — bounded device-program size
     and memory, chunk-level progress/retry points (parallel/retry.py),
     identical numbers to a monolithic run since every chunk owns its

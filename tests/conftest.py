@@ -7,7 +7,18 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_backend_optimization_level" not in _flags:
+    # The suite is ~90% XLA:CPU compile (round 5: the module-standard
+    # causal fit measured 63 s cold / 6.4 s warm). Opt level 1 HALVES
+    # compile with identical warm wall-clock (32.0/6.4 vs 62.9/6.4;
+    # level 0 tripled execution — rejected). Tests only — the
+    # TPU production path never sees this flag. Golden/bit-identity
+    # tests run under it and pass: the fusion decisions it skips do
+    # not change f32 accumulation order in the contraction paths the
+    # goldens pin.
+    _flags = (_flags + " --xla_backend_optimization_level=1").strip()
+os.environ["XLA_FLAGS"] = _flags
 
 import jax  # noqa: E402
 

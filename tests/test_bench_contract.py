@@ -69,8 +69,11 @@ def test_default_bench_emits_three_records_cpu_smoke():
         JAX_PLATFORMS="cpu",
         ATE_BENCH_FOREST_ROWS="1500",
         ATE_NO_COMPILE_CACHE="1",
+        # No virtual-device mesh in the child, but keep the suite's
+        # compile-time opt level (the child is ~90% XLA compile too —
+        # see conftest.py).
+        XLA_FLAGS="--xla_backend_optimization_level=1",
     )
-    env.pop("XLA_FLAGS", None)  # no virtual-device mesh in the child
     out = subprocess.run(
         [sys.executable, "-c",
          # Shrink every scale knob before main() runs: the contract

