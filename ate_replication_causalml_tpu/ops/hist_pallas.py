@@ -97,10 +97,18 @@ def resolve_hist_backend(
 
     ``integer_weights=True`` declares every weight vector integer-valued
     in [-256, 256] (the classifier forests: Poisson counts and counts·y
-    with y ∈ {0,1}) — there the bf16 kernel is bit-exact and the fastest
-    backend everywhere past the crossover (see table), so 'auto'
-    upgrades the kernel pick to ``pallas_bf16``. The caller owns the
-    declaration; it is asserted nowhere on the device path.
+    with y ∈ {0,1}) — there the bf16 kernel is bit-exact (asserted in
+    tests/test_hist_pallas.py). Through round 4, 'auto' upgraded such
+    fits to ``pallas_bf16``; round 5 dropped the upgrade: the measured
+    kernel delta is noise on this chip generation (see table — the MXU
+    runs bf16 passes for both operand dtypes, and after the
+    transposed-lhs rewrite the kernel is fixed-cost-bound, not
+    MXU-bound), while the split static made the flagship's binary-W and
+    continuous-Y nuisance fits compile two ~35 s executables where one
+    serves both (integer sums are exact in the f32 kernel too). The
+    flag is retained so call sites still document the invariant and a
+    future MXU-bound regime can re-enable the upgrade;
+    ``pallas_bf16`` stays explicitly selectable.
 
     ``allow_lossy_bf16=True`` upgrades to the bf16 kernel even for
     FLOAT weights: inputs are rounded to bf16 (≤0.4% relative) before
@@ -117,7 +125,7 @@ def resolve_hist_backend(
                 and n_bins is not None
                 and n_bins <= _LANES
             ):
-                if integer_weights or allow_lossy_bf16:
+                if allow_lossy_bf16:
                     return "pallas_bf16"
                 return "pallas"
             return "xla"

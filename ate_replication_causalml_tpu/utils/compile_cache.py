@@ -95,7 +95,11 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
         )
         return None
     try:
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        # 0.1 s (round 5; was 1.0): on the remote-compile toolchain even
+        # primitive-sized executables cost 0.5-2 s of wall-clock to
+        # compile, so sub-second entries are exactly the ones a fresh
+        # process wants back. Entry files are a few KB each.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
     except (AttributeError, ValueError) as e:
         # Cache dir IS active at this point — report the partial state
         # accurately rather than claiming the cache is off.

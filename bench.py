@@ -263,10 +263,10 @@ def bench_hist_ab(n=N_ROWS, trees=32, depth=9):
 def _cpu_child_reexec(flag):
     """Re-exec this script onto the 8-virtual-CPU backend for a sharded
     bench mode (the TPU is one chip; the config must land before
-    backend init). Returns True in the PARENT (after the child exits —
-    caller should have exited via sys.exit) and False in the child,
-    which is left configured for 8 CPU devices. Shared by --sharded
-    and --mesh-scaling."""
+    backend init). In the PARENT this never returns — it exits with the
+    child's return code via sys.exit. Returns False in the child, which
+    is left configured for 8 CPU devices. Shared by --sharded and
+    --mesh-scaling."""
     import subprocess
 
     if os.environ.get("_ATE_SHARDED_CHILD") != "1":

@@ -38,6 +38,43 @@ def test_binarize_roundtrip():
     assert np.all(np.diff(codes[order, 0]) >= 0)
 
 
+def test_quantile_bins_bit_identical_to_jnp_quantile():
+    """The f32 order-statistic path (round 5 — lax.sort costs ~17 s to
+    compile on the remote TPU toolchain) must be BIT-identical to
+    jnp.quantile: same bracketing order statistics (ties, ±0.0, value
+    duplication included), same interpolation arithmetic, same
+    NaN-poisons-the-slice semantics. Goldens ride on this equality."""
+    from ate_replication_causalml_tpu.models.forest import exact_order_stats
+
+    rng = np.random.default_rng(11)
+    base = rng.normal(size=(997, 4)).astype(np.float32)
+    base[:, 1] = np.round(base[:, 1])          # heavy ties
+    base[:200, 2] = -0.0                        # signed-zero runs
+    base[200:400, 2] = 0.0
+    for n_bins in (16, 64):
+        for arr in (base, base[:5]):            # tiny n: low == high ranks
+            x = jnp.asarray(arr)
+            qs = jnp.linspace(0.0, 1.0, n_bins + 1)[1:-1]
+            ref = jnp.quantile(x, qs, axis=0).T
+            np.testing.assert_array_equal(
+                np.asarray(quantile_bins(x, n_bins)), np.asarray(ref)
+            )
+    # NaN slice poisoning matches.
+    xn = base.copy()
+    xn[3, 0] = np.nan
+    got = np.asarray(quantile_bins(jnp.asarray(xn), 16))
+    ref = np.asarray(jnp.quantile(jnp.asarray(xn), jnp.linspace(0, 1, 17)[1:-1], axis=0).T)
+    np.testing.assert_array_equal(got, ref)
+    assert np.isnan(got[0]).all() and not np.isnan(got[1:]).any()
+    # The selection itself is bit-identical to sort-then-gather.
+    x = jnp.asarray(base)
+    ranks = jnp.asarray([0, 1, 496, 995, 996], jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(exact_order_stats(x, ranks)),
+        np.asarray(jnp.sort(x, axis=0))[np.asarray(ranks)].T,
+    )
+
+
 def test_route_rows_blocked_exact():
     """Row-blocked routing must be BIT-identical to the one-shot one-hot
     route — routing is integer compares, so blocking can't change it."""
@@ -261,8 +298,8 @@ def test_center_invariance_binary():
     codes = binarize(x, edges)
     keys = jax.random.split(jax.random.key(0), 8)
     kw = dict(depth=5, mtry=2, n_bins=32, hist_backend="xla")
-    off = _grow_chunk(keys, codes, y, None, center=False, **kw)
-    on = _grow_chunk(keys, codes, y, None, center=True, **kw)
+    off = _grow_chunk(keys, codes, y, None, jnp.float32(0.0), **kw)
+    on = _grow_chunk(keys, codes, y, None, jnp.float32(1.0), **kw)
     # Invariance is exact in exact arithmetic (the shift adds a per-node
     # constant to every candidate's score); in f32 rare near-ties flip —
     # measured 97% identical splits with the flips confined to

@@ -24,7 +24,7 @@ def _curve(n_rows, depth, total, trees_per_unit=1, streaming=False,
     return out
 
 
-def _assert_scaling(curve, total, trees_per_unit=1):
+def _assert_scaling(curve, total):
     for d, per_dev, chunk, cpd, n_disp in curve:
         # Coverage: the plan grows at least the per-device total, and
         # over-pads by less than one dispatch-superchunk (the
