@@ -437,25 +437,44 @@ def exact_order_stats(x: jax.Array, ranks: jax.Array) -> jax.Array:
     ``lax.sort``'s, which is the point — on the remote-compile TPU
     toolchain the (n, p) sort costs ~17 s to COMPILE for ~1 s of
     execution, a first-call tax every fresh-cache fit paid three times
-    (same trick as :func:`exact_subsample_mask`, round 5)."""
+    (same trick as :func:`exact_subsample_mask`, round 5).
+
+    Ranks are processed in chunks of ≤16 under a sequential ``lax.map``
+    so the per-round (n, p, chunk) count intermediate stays ~1 GB-
+    bounded at the 1M-row flagship even if XLA materializes it — the
+    unchunked form OOMed the 16 GB chip when a second fit's binning ran
+    while the first fit's (T, n) forest arrays were still resident
+    (bench.py's min-of-two protocol)."""
     keys = _f32_sort_key(x)  # (n, p)
     p = x.shape[1]
     r = ranks.shape[0]
-    target = (ranks + 1).astype(jnp.int32)[None, :]  # (1, R) count threshold
-    lo = jnp.zeros((p, r), jnp.uint32)
-    hi = jnp.full((p, r), jnp.uint32(0xFFFFFFFF))
+    g = min(16, r)
+    n_chunks = -(-r // g)
+    # Pad with repeats of the last rank; sliced away below.
+    ranks_p = jnp.concatenate(
+        [ranks, jnp.broadcast_to(ranks[-1:], (n_chunks * g - r,))]
+    ).reshape(n_chunks, g)
 
-    def step(_, bounds):
-        lo, hi = bounds
-        mid = lo + (hi - lo) // 2
-        cnt = jnp.sum(
-            keys[:, :, None] <= mid[None, :, :], axis=0, dtype=jnp.int32
-        )
-        ok = cnt >= target
-        return jnp.where(ok, lo, mid + 1), jnp.where(ok, mid, hi)
+    def search(ranks_chunk):
+        target = (ranks_chunk + 1).astype(jnp.int32)[None, :]  # (1, g)
+        lo = jnp.zeros((p, g), jnp.uint32)
+        hi = jnp.full((p, g), jnp.uint32(0xFFFFFFFF))
 
-    lo, hi = lax.fori_loop(0, 32, step, (lo, hi))
-    return _key_to_f32(lo)
+        def step(_, bounds):
+            lo, hi = bounds
+            mid = lo + (hi - lo) // 2
+            cnt = jnp.sum(
+                keys[:, :, None] <= mid[None, :, :], axis=0, dtype=jnp.int32
+            )
+            ok = cnt >= target
+            return jnp.where(ok, lo, mid + 1), jnp.where(ok, mid, hi)
+
+        lo, _ = lax.fori_loop(0, 32, step, (lo, hi))
+        return lo  # (p, g)
+
+    out = lax.map(search, ranks_p)  # (n_chunks, p, g)
+    out = jnp.moveaxis(out, 1, 0).reshape(p, n_chunks * g)[:, :r]
+    return _key_to_f32(out)
 
 
 @functools.partial(jax.jit, static_argnames=("n_bins",))

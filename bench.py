@@ -179,7 +179,15 @@ def bench_forest(n=FOREST_ROWS, with_predict=False):
     # unaffected in between) — a single sample can record a 3-4× outlier
     # as THE throughput number. Two samples minutes apart make that
     # vanishingly unlikely; both are printed.
+    #
+    # Each fit REPLACES the previous fitted forest, and the old one must
+    # be released BEFORE the next fit starts: ``in_sample`` alone is
+    # (2000, 1M) = 2 GB at the flagship shape, and retaining it through
+    # the next fit's nuisance-OOB peak OOMed the 16 GB chip (the ATE and
+    # the predict stage only ever use the LAST fit).
+    fitted = None
     steady_a, fitted = one_fit(2)
+    fitted = None
     steady_b, fitted = one_fit(3)
     steady_s = min(steady_a, steady_b)
     eff = average_treatment_effect(fitted)
