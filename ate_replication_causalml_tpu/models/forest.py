@@ -483,20 +483,28 @@ def quantile_bins(x: jax.Array, n_bins: int = 64) -> jax.Array:
     shared by every tree (the binned representation is what CART's
     exhaustive threshold scan degrades to at histogram resolution).
 
-    Values are BIT-identical to ``jnp.quantile(x, qs, axis=0).T``
-    (asserted in tests/test_forest.py) but the f32 path selects the two
-    bracketing order statistics per quantile with
-    :func:`exact_order_stats` instead of a full ``lax.sort`` — same
-    interpolation arithmetic (weights in qs.dtype, value·weight operand
-    order, final cast to x.dtype), ~17 s less compile per fresh cache.
-    Jitted as ONE executable (and shared by all three flagship fits —
-    same shapes): on the remote-compile toolchain even trivial eager
-    primitives pay a 1-5 s per-executable compile tax, so the eager
-    form of this function cost more to compile than the sort it
-    replaced."""
+    On TPU (f32) this selects the two bracketing order statistics per
+    quantile with :func:`_order_stat_quantiles` instead of sorting:
+    BIT-identical values (asserted in tests/test_forest.py), ~17 s less
+    compile per fresh cache — on the remote-compile toolchain the
+    (1M, 21) ``lax.sort`` costs 17.3 s to COMPILE for ~1 s of
+    execution, and even trivial eager primitives pay a 1-5 s
+    per-executable tax (hence the jit: ONE executable, shared by all
+    three flagship fits). Everywhere else ``jnp.quantile`` wins: the
+    search issues ~50× a sort's comparisons, which priced a 1-core CPU
+    test-suite run at +10 minutes before this gate, while CPU compile
+    is cheap — so CPU (and non-f32) keep the sort."""
     qs = jnp.linspace(0.0, 1.0, n_bins + 1)[1:-1]
-    if x.dtype != jnp.float32:
+    if x.dtype != jnp.float32 or jax.default_backend() != "tpu":
         return jnp.quantile(x, qs, axis=0).T  # (p, n_bins-1)
+    return _order_stat_quantiles(x, qs)
+
+
+def _order_stat_quantiles(x: jax.Array, qs: jax.Array) -> jax.Array:
+    """The sort-free quantile path: ``jnp.quantile(x, qs, axis=0).T``
+    computed from :func:`exact_order_stats` with jnp.quantile's exact
+    interpolation arithmetic (weights in qs.dtype, value·weight operand
+    order, final cast to x.dtype, NaN poisons the slice)."""
     n = x.shape[0]
     qn = qs * (jnp.asarray(n, qs.dtype) - 1)
     low = jnp.floor(qn)
@@ -799,6 +807,10 @@ def fit_forest_classifier(
     auto_chunk = auto_tree_chunk(
         n, depth, cap=32, streaming=hist_backend.startswith("pallas"),
         p=p, n_bins=n_bins,
+        # Mirrors the grower's floor choice (interpret mode pads
+        # nothing) so the planned chunk matches what the kernels
+        # actually allocate.
+        hist_floor=1 if hist_backend == "pallas_interpret" else _HIST_M_FLOOR,
     )
     tree_chunk = auto_chunk if tree_chunk is None else min(tree_chunk, auto_chunk)
     edges = quantile_bins(x, n_bins)
@@ -949,8 +961,16 @@ def _grow_chunk(tree_keys, codes, yf, xb_onehot, center, *, depth, mtry, n_bins,
                 route_fn=lambda ids, bf, bb: route_bits(
                     codes_t, ids, bf, bb, backend=row_backend
                 ),
-                hist_floor=_HIST_M_FLOOR,
-                route_floor=_ROUTE_M_FLOOR,
+                # The uniform floors exist to cut Mosaic kernel
+                # instantiations (a remote-compile cost); interpret mode
+                # has no compile and would pay the padded widths in
+                # eager execution — the CPU suite measured minutes.
+                # Bit-identity across floor settings is asserted in
+                # tests/test_forest.py::test_grow_floors_bit_identical.
+                hist_floor=1 if row_backend == "pallas_interpret"
+                else _HIST_M_FLOOR,
+                route_floor=1 if row_backend == "pallas_interpret"
+                else _ROUTE_M_FLOOR,
             )
         else:
             feats_l, bins_l = [], []
@@ -1286,6 +1306,7 @@ def fit_forest_sharded(
     tree_chunk, chunks_per_disp, n_disp = plan_tree_dispatch(
         n, depth, per_dev_total, streaming=hist_backend.startswith("pallas"),
         p=p, n_bins=n_bins,
+        hist_floor=1 if hist_backend == "pallas_interpret" else _HIST_M_FLOOR,
     )
     per_disp_dev = chunks_per_disp * tree_chunk
 

@@ -39,12 +39,17 @@ def test_binarize_roundtrip():
 
 
 def test_quantile_bins_bit_identical_to_jnp_quantile():
-    """The f32 order-statistic path (round 5 — lax.sort costs ~17 s to
-    compile on the remote TPU toolchain) must be BIT-identical to
-    jnp.quantile: same bracketing order statistics (ties, ±0.0, value
-    duplication included), same interpolation arithmetic, same
-    NaN-poisons-the-slice semantics. Goldens ride on this equality."""
-    from ate_replication_causalml_tpu.models.forest import exact_order_stats
+    """The TPU f32 order-statistic path (round 5 — lax.sort costs ~17 s
+    to compile on the remote TPU toolchain; CPU keeps the sort) must be
+    BIT-identical to jnp.quantile: same bracketing order statistics
+    (ties, ±0.0, value duplication included), same interpolation
+    arithmetic, same NaN-poisons-the-slice semantics. Goldens generated
+    through either path ride on this equality; the helper is called
+    directly because quantile_bins itself dispatches by backend."""
+    from ate_replication_causalml_tpu.models.forest import (
+        _order_stat_quantiles,
+        exact_order_stats,
+    )
 
     rng = np.random.default_rng(11)
     base = rng.normal(size=(997, 4)).astype(np.float32)
@@ -57,13 +62,18 @@ def test_quantile_bins_bit_identical_to_jnp_quantile():
             qs = jnp.linspace(0.0, 1.0, n_bins + 1)[1:-1]
             ref = jnp.quantile(x, qs, axis=0).T
             np.testing.assert_array_equal(
+                np.asarray(_order_stat_quantiles(x, qs)), np.asarray(ref)
+            )
+            # The public entry agrees regardless of which path it picks.
+            np.testing.assert_array_equal(
                 np.asarray(quantile_bins(x, n_bins)), np.asarray(ref)
             )
     # NaN slice poisoning matches.
     xn = base.copy()
+    qs16 = jnp.linspace(0, 1, 17)[1:-1]
     xn[3, 0] = np.nan
-    got = np.asarray(quantile_bins(jnp.asarray(xn), 16))
-    ref = np.asarray(jnp.quantile(jnp.asarray(xn), jnp.linspace(0, 1, 17)[1:-1], axis=0).T)
+    got = np.asarray(_order_stat_quantiles(jnp.asarray(xn), qs16))
+    ref = np.asarray(jnp.quantile(jnp.asarray(xn), qs16, axis=0).T)
     np.testing.assert_array_equal(got, ref)
     assert np.isnan(got[0]).all() and not np.isnan(got[1:]).any()
     # The selection itself is bit-identical to sort-then-gather.
@@ -73,6 +83,75 @@ def test_quantile_bins_bit_identical_to_jnp_quantile():
         np.asarray(exact_order_stats(x, ranks)),
         np.asarray(jnp.sort(x, axis=0))[np.asarray(ranks)].T,
     )
+
+
+def test_grow_floors_bit_identical():
+    """The uniform-width kernel floors (round 5 — fewer Mosaic
+    instantiations on TPU) must not change ANY bit of the level loop's
+    outputs: padded histogram columns are never selected (ids < live m)
+    and are sliced away; zero-padded route-table rows are never indexed.
+    Asserted on the shared streaming_level_loop directly, since the
+    production growers pick floors by backend.
+
+    The histogram backend here must be the (interpret-mode) Pallas
+    kernel — the engine the floors actually pad in production. Its
+    per-column accumulation order is fixed by the kernel's row-tile
+    loop, independent of M, so padding is bit-exact; the XLA matmul
+    backend makes NO such guarantee (its reduction blocking follows the
+    output shape — observed one-ulp histogram shifts under the suite's
+    opt-level-1 flags), which is one more reason the floors are applied
+    only on the kernel path."""
+    from ate_replication_causalml_tpu.models.forest import streaming_level_loop
+    from ate_replication_causalml_tpu.ops.hist_pallas import bin_histogram
+    from ate_replication_causalml_tpu.ops.tree_pallas import (
+        codes_transposed,
+        route_bits,
+    )
+
+    rng = np.random.default_rng(5)
+    n, p, n_bins, depth = 700, 5, 16, 5
+    x = jnp.asarray(rng.normal(size=(n, p)), jnp.float32)
+    edges = quantile_bins(x, n_bins)
+    codes = binarize(x, edges)
+    codes_t = codes_transposed(codes)
+    counts = jnp.asarray(rng.poisson(1.0, n), jnp.float32)
+    y = jnp.asarray(rng.normal(size=n), jnp.float32)
+    weights = jnp.stack([counts, counts * y])
+    lk = jax.random.split(jax.random.key(2), depth)
+
+    def run(hist_floor, route_floor):
+        from ate_replication_causalml_tpu.models.forest import select_split
+
+        def tables_fn(hist, level, perm):
+            hist_c, hist_y = hist[0], hist[1]
+            cl = jnp.cumsum(hist_c, axis=2)
+            yl = jnp.cumsum(hist_y, axis=2)
+            ct, ytot = cl[:, :, -1:], yl[:, :, -1:]
+            cr, yr = ct - cl, ytot - yl
+            score = -(yl * yl / jnp.maximum(cl, 1e-12)
+                      + yr * yr / jnp.maximum(cr, 1e-12))
+            score = jnp.where((cl > 0) & (cr > 0), score, jnp.inf)
+            return select_split(score, lk[level], 1 << level, p, n_bins, 3,
+                                perm=perm)
+
+        return streaming_level_loop(
+            codes, depth, n_bins,
+            hist_fn=lambda ids, m: bin_histogram(
+                codes, ids, weights, max_nodes=m, n_bins=n_bins,
+                backend="pallas_interpret",
+            ),
+            tables_fn=tables_fn,
+            route_fn=lambda ids, bf, bb: route_bits(
+                codes_t, ids, bf, bb, backend="pallas_interpret"
+            ),
+            hist_floor=hist_floor,
+            route_floor=route_floor,
+        )
+
+    base = run(1, 1)
+    padded = run(16, 32)
+    for a, b in zip(base, padded):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 def test_route_rows_blocked_exact():
