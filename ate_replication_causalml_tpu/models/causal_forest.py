@@ -340,20 +340,11 @@ def grow_causal_forest_sharded(
         key, n_disp * axis_size * per_disp_dev
     ).reshape(n_disp, axis_size * per_disp_dev)
 
-    def device_body(keys, codes, wt, yt, mom_stack):
-        return _grow_cf_chunk(
-            keys.reshape(chunks_per_disp, group_chunk),
-            codes, wt, yt, mom_stack, None,
-            depth=depth, mtry=mtry, n_bins=n_bins, min_node=min_node,
-            s=s, k=k, honesty=honesty, hist_backend=hist_backend,
-        )
-
-    grow = jax.jit(jax.shard_map(
-        device_body,
-        mesh=mesh,
-        in_specs=(P(axis_name), P(), P(), P(), P()),
-        out_specs=P(axis_name),
-    ))
+    grow = _sharded_cf_grow_fn(
+        mesh, axis_name, chunks_per_disp, group_chunk,
+        depth=depth, mtry=mtry, n_bins=n_bins, min_node=min_node,
+        s=s, k=k, honesty=honesty, hist_backend=hist_backend,
+    )
     key_sharding = NamedSharding(mesh, P(axis_name))
 
     def dispatch(i: int):
@@ -375,6 +366,32 @@ def grow_causal_forest_sharded(
         bin_edges=edges,
         ci_group_size=k,
     )
+
+
+@functools.lru_cache(maxsize=64)
+def _sharded_cf_grow_fn(mesh, axis_name, chunks_per_disp, group_chunk, *,
+                        depth, mtry, n_bins, min_node, s, k, honesty,
+                        hist_backend):
+    """The jitted shard_map causal-grow executable, cached on (mesh,
+    plan, statics) — same reason as forest.py::_sharded_grow_fn: a
+    per-call `jax.jit(shard_map(local_lambda))` re-traced and
+    re-compiled every fit."""
+    from jax.sharding import PartitionSpec as P
+
+    def device_body(keys, codes, wt, yt, mom_stack):
+        return _grow_cf_chunk(
+            keys.reshape(chunks_per_disp, group_chunk),
+            codes, wt, yt, mom_stack, None,
+            depth=depth, mtry=mtry, n_bins=n_bins, min_node=min_node,
+            s=s, k=k, honesty=honesty, hist_backend=hist_backend,
+        )
+
+    return jax.jit(jax.shard_map(
+        device_body,
+        mesh=mesh,
+        in_specs=(P(axis_name), P(), P(), P(), P()),
+        out_specs=P(axis_name),
+    ))
 
 
 @functools.partial(

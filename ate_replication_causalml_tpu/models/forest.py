@@ -1317,18 +1317,10 @@ def fit_forest_sharded(
         n_disp, axis_size * per_disp_dev
     )
 
-    def device_body(keys, codes, yf, center):
-        return _grow_chunk(
-            keys.reshape(chunks_per_disp, tree_chunk), codes, yf, None, center,
-            depth=depth, mtry=mtry, n_bins=n_bins, hist_backend=hist_backend,
-        )
-
-    grow = jax.jit(jax.shard_map(
-        device_body,
-        mesh=mesh,
-        in_specs=(P(axis_name), P(), P(), P()),
-        out_specs=P(axis_name),
-    ))
+    grow = _sharded_grow_fn(
+        mesh, axis_name, chunks_per_disp, tree_chunk,
+        depth=depth, mtry=mtry, n_bins=n_bins, hist_backend=hist_backend,
+    )
     key_sharding = NamedSharding(mesh, P(axis_name))
     center = jnp.float32(not y01)
 
@@ -1347,6 +1339,56 @@ def fit_forest_sharded(
         bin_edges=edges,
         train_leaf=cat(4),
         train_fp=codes_fingerprint(codes),
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _sharded_grow_fn(mesh, axis_name, chunks_per_disp, tree_chunk, *,
+                     depth, mtry, n_bins, hist_backend):
+    """The jitted shard_map grow executable, cached on (mesh, plan,
+    statics). Building `jax.jit(shard_map(local_lambda))` inside
+    :func:`fit_forest_sharded` gave every CALL a fresh function
+    identity — jit re-traced and re-compiled the same computation per
+    fit (masked when the persistent cache served the recompile from
+    disk; a cache-less CPU child measured it as a 10× inflation of the
+    MESH_SCALING forest curve). `jax.sharding.Mesh` is hashable, so the
+    executable is shared by every fit with the same plan."""
+    from jax.sharding import PartitionSpec as P
+
+    def device_body(keys, codes, yf, center):
+        return _grow_chunk(
+            keys.reshape(chunks_per_disp, tree_chunk), codes, yf, None, center,
+            depth=depth, mtry=mtry, n_bins=n_bins, hist_backend=hist_backend,
+        )
+
+    return jax.jit(jax.shard_map(
+        device_body,
+        mesh=mesh,
+        in_specs=(P(axis_name), P(), P(), P()),
+        out_specs=P(axis_name),
+    ))
+
+
+def sharded_fit_plan(
+    n_rows: int,
+    depth: int,
+    per_dev_total: int,
+    hist_backend: str = "auto",
+    n_bins: int = 64,
+    p: int = 21,
+) -> tuple[int, int, int]:
+    """The (chunk, chunks_per_disp, n_disp) plan :func:`fit_forest_sharded`
+    will actually use, after backend resolution — for callers recording
+    dispatch-plan evidence (bench.py --mesh-scaling): quoting
+    :func:`plan_tree_dispatch` with default statics can describe a
+    different executable layout than the fit being timed."""
+    resolved = resolve_hist_backend(
+        hist_backend, allow_onehot=False, n_rows=n_rows, n_bins=n_bins,
+    )
+    return plan_tree_dispatch(
+        n_rows, depth, per_dev_total,
+        streaming=resolved.startswith("pallas"), p=p, n_bins=n_bins,
+        hist_floor=1 if resolved == "pallas_interpret" else _HIST_M_FLOOR,
     )
 
 

@@ -281,6 +281,17 @@ def _cpu_child_reexec(flag):
         env = dict(os.environ)
         env["_ATE_SHARDED_CHILD"] = "1"
         env["JAX_PLATFORMS"] = "cpu"
+        # The child must NOT share the TPU session's persistent cache
+        # or its remote compile service: with the remote-compile env
+        # inherited, the child's XLA:CPU executables are AOT-compiled
+        # on the toolchain host, whose feature set (+amx,
+        # +prefer-no-scatter, ...) the local CPU lacks — loading those
+        # entries warns "could lead to SIGILL" (observed), exactly the
+        # foreign-machine hazard compile_cache.py documents. Local CPU
+        # compiles at these MICRO shapes are cheap; run the child
+        # cache-less and fully local.
+        env["ATE_NO_COMPILE_CACHE"] = "1"
+        env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
         rc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), flag], env=env
         ).returncode
@@ -372,12 +383,11 @@ def bench_mesh_scaling(out_path="MESH_SCALING.json"):
     The 8 devices are VIRTUAL CPU devices on one physical core, so
     wall-clock cannot show real speedup — the honest claims this
     artifact records are (1) the sharded paths execute and stay
-    correct at every axis size, (2) the sharding overhead on the same
-    silicon is bounded but real — measured up to ~60% at 8 virtual
-    devices on the MICRO forest (8 shard_map programs time-slicing one
-    core), ~35% on the AIPW bootstrap — and (3) the deterministic
-    dispatch plan
-    divides per-device work as 1/d — the quantity that IS the
+    correct at every axis size, (2) the time-slicing overhead of d
+    shard_map programs on one core is bounded (the d=8 over d=1 ratio
+    is computed from the measured ``_s`` arrays and written into the
+    record, not asserted in prose), and (3) the deterministic dispatch
+    plan divides per-device work as 1/d — the quantity that IS the
     multi-chip speedup when devices are physical. Writes
     ``MESH_SCALING.json``; the plan curve is pinned by
     tests/test_mesh_scaling.py without running this.
@@ -389,16 +399,15 @@ def bench_mesh_scaling(out_path="MESH_SCALING.json"):
 
     from ate_replication_causalml_tpu.models.forest import (
         fit_forest_sharded,
-        plan_tree_dispatch,
+        sharded_fit_plan,
     )
 
     record = {
         "devices": [1, 2, 4, 8],
         "host": "1-core CPU, 8 virtual devices (wall-clock cannot "
                 "speed up; the claims are correctness at every axis "
-                "size, bounded time-slicing overhead — up to ~60% at "
-                "d=8 on this 1-core host, see the _s arrays — and the "
-                "1/d dispatch plan)",
+                "size, the measured d=8/d=1 overhead ratios below, "
+                "and the 1/d dispatch plan)",
     }
 
     # (a) Boot-axis AIPW bootstrap (shared sweep with --sharded).
@@ -418,7 +427,10 @@ def bench_mesh_scaling(out_path="MESH_SCALING.json"):
     for d in record["devices"]:
         mesh = Mesh(np.asarray(jax.devices()[:d]), ("tree",))
         per_dev = -(-ft // d)
-        chunk, cpd, n_disp = plan_tree_dispatch(fn, fd, per_dev)
+        # The plan the fit ACTUALLY uses (post backend-resolution) —
+        # quoting plan_tree_dispatch with default statics can describe
+        # a different executable layout than the one timed below.
+        chunk, cpd, n_disp = sharded_fit_plan(fn, fd, per_dev)
         forest_disp.append(n_disp)
         forest_per_dev.append(per_dev)
 
@@ -441,6 +453,12 @@ def bench_mesh_scaling(out_path="MESH_SCALING.json"):
     record["forest_dispatches"] = forest_disp
     record["forest_per_dev_trees"] = forest_per_dev
     record["forest_config"] = {"rows": fn, "trees": ft, "depth": fd}
+    # Measured time-slicing overhead of 8 programs on 1 core — THE
+    # bounded-overhead claim, computed rather than asserted.
+    record["overhead_ratio_8dev_over_1dev"] = {
+        "aipw_boot": round(record["aipw_boot_s"][-1] / record["aipw_boot_s"][0], 3),
+        "forest_fit": round(forest_s[-1] / forest_s[0], 3),
+    }
 
     with open(out_path, "w") as f:
         json.dump(record, f, indent=1)
