@@ -68,3 +68,28 @@ def test_flagship_streaming_plan_curve():
     # for both flagship fits (the curves are not degenerate).
     assert nuis[-1][4] < nuis[0][4], nuis
     assert causal[-1][4] < causal[0][4], causal
+
+
+def test_sharded_fit_plan_matches_resolved_backend(monkeypatch):
+    """bench.py records the dispatch plan via sharded_fit_plan, which
+    must reproduce the plan fit_forest_sharded actually computes after
+    backend resolution — on CPU (resolve → 'xla', non-streaming) and on
+    TPU at kernel scale (resolve → 'pallas', streaming + classifier
+    hist floor). A mismatch would pair a timing with a plan from a
+    different executable layout in MESH_SCALING.json."""
+    import ate_replication_causalml_tpu.ops.hist_pallas as hp
+    from ate_replication_causalml_tpu.models.forest import (
+        _HIST_M_FLOOR,
+        sharded_fit_plan,
+    )
+
+    # CPU: 'auto' (allow_onehot=False) resolves to the non-streaming
+    # XLA path at any size.
+    assert sharded_fit_plan(4_000, 6, 64) == plan_tree_dispatch(
+        4_000, 6, 64, streaming=False
+    )
+    # TPU at kernel scale: streaming pallas with the classifier floor.
+    monkeypatch.setattr(hp.jax, "default_backend", lambda: "tpu")
+    assert sharded_fit_plan(1_000_000, 9, 500) == plan_tree_dispatch(
+        1_000_000, 9, 500, streaming=True, hist_floor=_HIST_M_FLOOR
+    )
