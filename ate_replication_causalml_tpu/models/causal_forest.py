@@ -85,6 +85,8 @@ from ate_replication_causalml_tpu.ops.tree_pallas import (
     route_bits,
     table_lookup,
 )
+from ate_replication_causalml_tpu import observability as obs
+from ate_replication_causalml_tpu.parallel.mesh import shard_map as _shard_map
 from ate_replication_causalml_tpu.parallel.retry import require_all, run_shards
 
 _EPS = 1e-12
@@ -248,7 +250,11 @@ def grow_causal_forest(
         )
 
     chunks = require_all(
-        run_shards(chunk_shard, n_disp, retriable=(jax.errors.JaxRuntimeError,))
+        run_shards(
+            obs.instrument_dispatch("causal_forest", chunk_shard),
+            n_disp, retriable=(jax.errors.JaxRuntimeError,),
+            pool="causal_forest",
+        )
     )
     flat = lambda j: jnp.concatenate(
         [c[j].reshape((-1,) + c[j].shape[2:]) for c in chunks], axis=0
@@ -353,7 +359,11 @@ def grow_causal_forest_sharded(
         )
 
     parts = require_all(
-        run_shards(dispatch, n_disp, retriable=(jax.errors.JaxRuntimeError,))
+        run_shards(
+            obs.instrument_dispatch("causal_forest_sharded", dispatch),
+            n_disp, retriable=(jax.errors.JaxRuntimeError,),
+            pool="causal_forest_sharded",
+        )
     )
     flat = lambda j: jnp.concatenate(
         [c[j].reshape((-1,) + c[j].shape[2:]) for c in parts], axis=0
@@ -386,7 +396,7 @@ def _sharded_cf_grow_fn(mesh, axis_name, chunks_per_disp, group_chunk, *,
             s=s, k=k, honesty=honesty, hist_backend=hist_backend,
         )
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(_shard_map(
         device_body,
         mesh=mesh,
         in_specs=(P(axis_name), P(), P(), P(), P()),

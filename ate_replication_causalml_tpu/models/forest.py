@@ -54,6 +54,8 @@ from ate_replication_causalml_tpu.ops.tree_pallas import (
     route_bits,
     table_lookup,
 )
+from ate_replication_causalml_tpu import observability as obs
+from ate_replication_causalml_tpu.parallel.mesh import shard_map as _shard_map
 from ate_replication_causalml_tpu.parallel.retry import require_all, run_shards
 
 
@@ -840,9 +842,15 @@ def fit_forest_classifier(
 
     # Elastic host loop (parallel/retry.py): a transient device failure
     # (dropped tunnel, preemption) re-runs only that dispatch; keys are
-    # explicit so the retried dispatch is bit-identical.
+    # explicit so the retried dispatch is bit-identical. Telemetry:
+    # dispatch counts + per-dispatch host durations, labeled by fitter
+    # (recorded at the dispatch boundary — no sync added).
     chunks = require_all(
-        run_shards(chunk_shard, n_disp, retriable=(jax.errors.JaxRuntimeError,))
+        run_shards(
+            obs.instrument_dispatch("forest_classifier", chunk_shard),
+            n_disp, retriable=(jax.errors.JaxRuntimeError,),
+            pool="forest_classifier",
+        )
     )
     cat = lambda j: jnp.concatenate([c[j] for c in chunks], axis=0)[:n_trees]
     return Forest(
@@ -1328,7 +1336,11 @@ def fit_forest_sharded(
         return grow(jax.device_put(tree_keys[i], key_sharding), codes, yf, center)
 
     parts = require_all(
-        run_shards(dispatch, n_disp, retriable=(jax.errors.JaxRuntimeError,))
+        run_shards(
+            obs.instrument_dispatch("forest_sharded", dispatch),
+            n_disp, retriable=(jax.errors.JaxRuntimeError,),
+            pool="forest_sharded",
+        )
     )
     cat = lambda j: jnp.concatenate([c[j] for c in parts], axis=0)[:n_trees]
     return Forest(
@@ -1361,7 +1373,7 @@ def _sharded_grow_fn(mesh, axis_name, chunks_per_disp, tree_chunk, *,
             depth=depth, mtry=mtry, n_bins=n_bins, hist_backend=hist_backend,
         )
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(_shard_map(
         device_body,
         mesh=mesh,
         in_specs=(P(axis_name), P(), P(), P()),

@@ -1,0 +1,111 @@
+"""Unified telemetry subsystem (SURVEY.md §5.1 grown up).
+
+One import surface for the three parts:
+
+* metrics registry (``counter``/``gauge``/``histogram``, process-global
+  ``REGISTRY``) — observability.registry
+* structured event log (``span``/``emit``, process-global ``EVENTS``)
+  — observability.events
+* exporters (``metrics.json`` + ``events.jsonl`` + Prometheus
+  textfile, all atomic) — observability.export / observability.promtext
+* device & compile capture (cost analysis, memory stats, compile-cache
+  listeners) — observability.device
+
+Master switch: ``ATE_TPU_TELEMETRY=0`` disables everything at a cached
+bool check per hook. All instrumentation is host-side, outside jitted
+code — estimator numerics are bit-identical either way.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+from ate_replication_causalml_tpu.observability.device import (
+    install_jax_monitoring,
+    record_compiled_cost,
+    record_device_memory,
+    watch_cache_dir,
+)
+from ate_replication_causalml_tpu.observability.events import (
+    EVENTS,
+    EventLog,
+    emit,
+    span,
+)
+from ate_replication_causalml_tpu.observability.export import (
+    atomic_write_json,
+    atomic_write_text,
+    write_events_jsonl,
+    write_metrics_json,
+    write_run_artifacts,
+)
+from ate_replication_causalml_tpu.observability.registry import (
+    REGISTRY,
+    SCHEMA_VERSION,
+    MetricsRegistry,
+    counter,
+    enabled,
+    gauge,
+    histogram,
+    sanitize_label,
+    set_enabled,
+)
+
+__all__ = [
+    "EVENTS", "EventLog", "MetricsRegistry", "REGISTRY", "SCHEMA_VERSION",
+    "atomic_write_json", "atomic_write_text", "bench_record", "counter",
+    "emit", "enabled", "gauge", "histogram", "install_jax_monitoring",
+    "instrument_dispatch", "record_compiled_cost", "record_device_memory",
+    "sanitize_label", "set_enabled", "span", "watch_cache_dir",
+    "write_events_jsonl", "write_metrics_json", "write_run_artifacts",
+]
+
+
+def instrument_dispatch(kind: str, fn: Callable[[int], object]):
+    """Wrap a shard/dispatch thunk (``fn(i) -> result``) with dispatch
+    counters and a duration histogram, labeled ``fit=kind``.
+
+    The duration is the HOST-side dispatch boundary — time to enqueue
+    (and, where the runtime blocks, execute) one dispatched executable.
+    No sync is added: results are returned exactly as produced, so
+    async dispatch semantics and numbers are untouched.
+    """
+    if not enabled():
+        return fn
+    c = counter("tree_dispatch_total", "forest tree-chunk dispatches")
+    h = histogram("tree_dispatch_seconds", "per-dispatch host wall-clock")
+    c.inc(0, fit=kind)
+
+    def wrapped(i: int):
+        t0 = time.perf_counter()
+        out = fn(i)
+        h.observe(time.perf_counter() - t0, fit=kind)
+        c.inc(1, fit=kind)
+        return out
+
+    return wrapped
+
+
+def bench_record(**fields) -> dict:
+    """Build a bench JSON record THROUGH the registry: the ``value`` /
+    ``vs_baseline`` numbers land as gauges labeled by ``metric`` before
+    the dict is returned, so BENCH_*.json lines and metrics.json can
+    never disagree — they are the same store read twice."""
+    record = dict(fields)
+    metric = record.get("metric", "unknown")
+    g = gauge("bench_value", "north-star bench record values")
+    for key in ("value", "vs_baseline"):
+        v = record.get(key)
+        if isinstance(v, (int, float)):
+            g.set(float(v), metric=metric, field=key)
+    unit = record.get("unit")
+    if unit is not None:
+        gauge("bench_unit_info", "bench record unit (info gauge)").set(
+            1.0, metric=metric, unit=str(unit)
+        )
+    emit("bench_record", status="ok", **{
+        k: v for k, v in record.items()
+        if isinstance(v, (int, float, str, bool))
+    })
+    return record

@@ -1,0 +1,213 @@
+"""Device / compile instrumentation (the tentpole's part 2, JAX side).
+
+Three capture surfaces, all host-side and all failure-tolerant (a
+telemetry probe must never take down the run it observes):
+
+* :func:`record_compiled_cost` — ``jax.stages.Compiled.cost_analysis()``
+  (flops / bytes accessed) and ``memory_analysis()`` (where the backend
+  implements it) per jitted entry point, as gauges labeled by entry
+  name. This is what lets bench.py report MFU from the compiler's own
+  FLOP count next to its analytic estimate.
+* :func:`record_device_memory` — ``Device.memory_stats()`` gauges per
+  local device (TPU reports bytes_in_use / peak_bytes_in_use etc.; CPU
+  returns nothing and is skipped).
+* :func:`install_jax_monitoring` — bridges ``jax.monitoring``'s
+  compilation-cache events (hits / misses / retrieval time / time
+  saved) into the registry, and pre-creates every compile-cache counter
+  at zero so "cache never used" is visible as an explicit 0 in
+  metrics.json rather than a missing key.
+
+``watch_cache_dir`` adds a snapshot-time collector that scans the
+persistent-cache directory for entry-count / total-bytes gauges (and
+entries written since the watch began — the write counter the cache
+API itself does not expose).
+
+JAX is imported lazily inside functions: the observability package
+stays importable (and testable) without initializing a backend.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ate_replication_causalml_tpu.observability.registry import (
+    REGISTRY,
+    counter,
+    enabled,
+    gauge,
+    histogram,
+)
+
+_CACHE_EVENT_COUNTERS = {
+    "/jax/compilation_cache/cache_hits": "compile_cache_hits_total",
+    "/jax/compilation_cache/cache_misses": "compile_cache_misses_total",
+    "/jax/compilation_cache/tasks_using_cache": "compile_cache_tasks_total",
+    "/jax/compilation_cache/task_disabled_cache": "compile_cache_disabled_tasks_total",
+    "/jax/compilation_cache/compile_requests_use_cache": "compile_cache_requests_total",
+}
+_CACHE_DURATION_METRICS = {
+    "/jax/compilation_cache/compile_time_saved_sec": "compile_cache_time_saved_seconds",
+    "/jax/compilation_cache/cache_retrieval_time_sec": "compile_cache_retrieval_seconds",
+}
+
+_installed = False
+_WATCHED_CACHE_DIRS: set[str] = set()
+
+
+def install_jax_monitoring() -> bool:
+    """Register jax.monitoring listeners for the compilation-cache
+    events (idempotent; returns whether listeners are active). Always
+    pre-creates the counters at zero — the metrics.json contract is
+    that cache keys are PRESENT on every run, zero or not."""
+    global _installed
+    if not enabled():
+        return False
+    for name in _CACHE_EVENT_COUNTERS.values():
+        counter(name, "jax compilation-cache events").inc(0)
+    for name in _CACHE_DURATION_METRICS.values():
+        histogram(name, "jax compilation-cache durations")
+    # The shard retry families are part of the same "present on every
+    # instrumented run" contract (scripts/check_metrics_schema.py), but
+    # run_shards only creates them when a dispatch loop actually runs —
+    # a bench mode that never fans out would otherwise export a pair
+    # that fails its own validator.
+    for name in ("shard_attempts_total", "shard_retries_total",
+                 "shard_failures_total", "shard_backoff_seconds_total"):
+        counter(name, "run_shards retry telemetry").inc(0)
+    if _installed:
+        return True
+    try:
+        from jax import monitoring
+    except Exception:  # noqa: BLE001 — no monitoring API on this jax
+        return False
+
+    def on_event(event: str, **kwargs) -> None:
+        name = _CACHE_EVENT_COUNTERS.get(event)
+        if name is not None:
+            counter(name).inc(1)
+
+    def on_duration(event: str, duration_secs: float, **kwargs) -> None:
+        name = _CACHE_DURATION_METRICS.get(event)
+        if name is not None:
+            histogram(name).observe(duration_secs)
+
+    try:
+        monitoring.register_event_listener(on_event)
+        monitoring.register_event_duration_secs_listener(on_duration)
+    except Exception:  # noqa: BLE001 — listener API drift
+        return False
+    _installed = True
+    return True
+
+
+def _scan_cache_dir(cache_dir: str) -> tuple[int, int]:
+    entries = 0
+    total = 0
+    try:
+        with os.scandir(cache_dir) as it:
+            for e in it:
+                if e.is_file():
+                    entries += 1
+                    total += e.stat().st_size
+    except OSError:
+        pass
+    return entries, total
+
+
+def watch_cache_dir(cache_dir: str) -> None:
+    """Gauge the persistent-cache directory at every snapshot:
+    ``compile_cache_entries`` / ``compile_cache_bytes`` (current state)
+    and ``compile_cache_entries_written`` (growth since the watch began
+    — this process's writes, assuming no concurrent writer).
+
+    Idempotent per directory: ``enable_persistent_cache`` runs at
+    import time in several entry points (rbridge, pipeline.main), and
+    stacking one collector per call would both rescan the directory
+    repeatedly and reset the entries-written baseline to the latest
+    call, erasing writes already counted."""
+    if not enabled():
+        return
+    if cache_dir in _WATCHED_CACHE_DIRS:
+        return
+    _WATCHED_CACHE_DIRS.add(cache_dir)
+    base_entries, _ = _scan_cache_dir(cache_dir)
+
+    def collect() -> None:
+        entries, total = _scan_cache_dir(cache_dir)
+        g = gauge("compile_cache_entries", "persistent-cache entry files")
+        g.set(entries)
+        gauge("compile_cache_bytes", "persistent-cache total bytes").set(total)
+        gauge(
+            "compile_cache_entries_written",
+            "entries added since this process enabled the cache",
+        ).set(max(0, entries - base_entries))
+
+    REGISTRY.add_collector(collect)
+    collect()
+
+
+def record_compiled_cost(name: str, compiled) -> dict:
+    """Record a ``jax.stages.Compiled``'s cost/memory analysis as gauges
+    labeled ``entry=name``; returns the captured numbers (possibly
+    empty — both analyses are backend-best-effort)."""
+    out: dict = {}
+    if not enabled():
+        return out
+    try:
+        cost = compiled.cost_analysis()
+        # Older jax returns a one-dict list, newer a dict.
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        for key in ("flops", "bytes accessed", "optimal_seconds"):
+            v = cost.get(key) if isinstance(cost, dict) else None
+            if v is not None and v == v:  # skip NaN placeholders
+                out[key.replace(" ", "_")] = float(v)
+    except Exception:  # noqa: BLE001 — cost analysis is best-effort
+        pass
+    try:
+        mem = compiled.memory_analysis()
+        for attr in (
+            "temp_size_in_bytes", "argument_size_in_bytes",
+            "output_size_in_bytes", "generated_code_size_in_bytes",
+        ):
+            v = getattr(mem, attr, None)
+            if v is not None:
+                out[attr] = float(v)
+    except Exception:  # noqa: BLE001 — not implemented on every backend
+        pass
+    g = gauge("compiled_cost", "cost/memory analysis per jitted entry")
+    for key, v in out.items():
+        g.set(v, entry=name, stat=key)
+    return out
+
+
+def record_device_memory(context: str = "") -> dict:
+    """Per-device ``memory_stats()`` gauges (bytes_in_use,
+    peak_bytes_in_use, ...), labeled by device and optional context.
+    Returns {device_label: stats}. Skips devices without stats (CPU)."""
+    out: dict = {}
+    if not enabled():
+        return out
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 — backend init failure
+        return out
+    g = gauge("device_memory_bytes", "Device.memory_stats() per device")
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 — unsupported on this platform
+            stats = None
+        if not stats:
+            continue
+        label = f"{d.platform}:{d.id}"
+        out[label] = stats
+        for key, v in stats.items():
+            if isinstance(v, (int, float)):
+                if context:
+                    g.set(float(v), device=label, stat=key, context=context)
+                else:
+                    g.set(float(v), device=label, stat=key)
+    return out

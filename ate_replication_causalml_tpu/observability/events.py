@@ -1,0 +1,198 @@
+"""Structured event log (the tentpole's part 1, second half).
+
+Span-shaped records — name, start/end in both monotonic and wall time,
+status, attributes, parent span — collected in memory and exported as
+``results/events.jsonl``. ``StageTimer``/``stage``/the sweep driver are
+thin emitters into this log; a reader can reconstruct the whole run's
+timeline (what computed, what resumed, what retried, in what nesting)
+without parsing prints.
+
+Parentage is tracked per thread: a span opened inside another span on
+the same thread records it as parent. The log is ring-buffered
+(``max_events``) so a week-long serving run cannot grow it unbounded;
+the oldest records are evicted first and evictions are counted in the export header.
+
+Zero-cost when disabled (``ATE_TPU_TELEMETRY=0``): :func:`span` hands
+back a shared no-op context manager.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import itertools
+import json
+import threading
+import time
+from typing import Iterator
+
+from ate_replication_causalml_tpu.observability.registry import (
+    SCHEMA_VERSION,
+    enabled,
+)
+
+
+class Span:
+    """One open span. Mutate ``attrs`` / call :meth:`set_status` while
+    inside the ``with`` block; the record is appended on exit. Status
+    defaults to ``ok`` (``error`` on an exception escaping the block)."""
+
+    __slots__ = (
+        "name", "span_id", "parent_id", "status", "attrs",
+        "start_unix", "start_mono", "thread",
+    )
+
+    def __init__(self, name: str, span_id: str, parent_id: str | None,
+                 attrs: dict):
+        self.name = name
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.status = "ok"
+        self.attrs = attrs
+        self.start_unix = time.time()
+        self.start_mono = time.monotonic()
+        self.thread = threading.get_ident()
+
+    def set_status(self, status: str) -> None:
+        self.status = status
+
+    def set_attr(self, key: str, value) -> None:
+        self.attrs[key] = value
+
+    def _record(self, end_mono: float, end_unix: float) -> dict:
+        return {
+            "name": self.name,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "status": self.status,
+            "start_unix": self.start_unix,
+            "end_unix": end_unix,
+            "start_mono_s": self.start_mono,
+            "end_mono_s": end_mono,
+            "dur_s": end_mono - self.start_mono,
+            "thread": self.thread,
+            "attrs": self.attrs,
+        }
+
+
+class _NullSpan:
+    """Shared do-nothing span for disabled mode (and a safe object for
+    callers that unconditionally ``sp.set_status(...)``)."""
+
+    __slots__ = ()
+
+    def set_status(self, status: str) -> None:
+        pass
+
+    def set_attr(self, key: str, value) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+@contextlib.contextmanager
+def _null_ctx() -> Iterator[_NullSpan]:
+    yield _NULL_SPAN
+
+
+class EventLog:
+    """Thread-safe in-memory span/event collector."""
+
+    def __init__(self, max_events: int = 100_000):
+        self.max_events = max_events
+        self._lock = threading.Lock()
+        # True ring: at capacity the OLDEST record is evicted — the tail
+        # of a dying run (the error spans) is the diagnostic part.
+        self._records: collections.deque[dict] = collections.deque(
+            maxlen=max_events
+        )
+        self._dropped = 0
+        self._ids = itertools.count(1)
+        self._tls = threading.local()
+
+    def _next_id(self) -> str:
+        return f"{next(self._ids):08x}"
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _append(self, rec: dict) -> None:
+        with self._lock:
+            if len(self._records) == self.max_events:
+                self._dropped += 1  # deque evicts the oldest record
+            self._records.append(rec)
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs) -> Iterator[Span | _NullSpan]:
+        """Open a span; the record lands in the log when the block
+        exits. Exceptions mark status ``error`` (with the exception type
+        in attrs) and propagate."""
+        if not enabled():
+            with _null_ctx() as sp:
+                yield sp
+            return
+        stack = self._stack()
+        parent = stack[-1].span_id if stack else None
+        sp = Span(name, self._next_id(), parent, dict(attrs))
+        stack.append(sp)
+        try:
+            yield sp
+        except BaseException as e:
+            sp.status = "error"
+            sp.attrs.setdefault("error_type", type(e).__name__)
+            raise
+        finally:
+            stack.pop()
+            self._append(sp._record(time.monotonic(), time.time()))
+
+    def emit(self, name: str, status: str = "event", **attrs) -> None:
+        """Zero-duration point event (parented like a span)."""
+        if not enabled():
+            return
+        stack = self._stack()
+        parent = stack[-1].span_id if stack else None
+        sp = Span(name, self._next_id(), parent, dict(attrs))
+        sp.status = status
+        self._append(sp._record(sp.start_mono, sp.start_unix))
+
+    def records(self) -> list[dict]:
+        with self._lock:
+            return list(self._records)
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records.clear()
+            self._dropped = 0
+
+    def to_jsonl(self) -> str:
+        """The events.jsonl payload: a versioned header line, then one
+        record per line in arrival order."""
+        header = {
+            "schema_version": SCHEMA_VERSION,
+            "kind": "events_header",
+            "dropped": self.dropped,
+        }
+        lines = [json.dumps(header)]
+        lines += [json.dumps(r) for r in self.records()]
+        return "\n".join(lines) + "\n"
+
+
+#: The process-global default event log (mirrors registry.REGISTRY).
+EVENTS = EventLog()
+
+
+def span(name: str, **attrs):
+    return EVENTS.span(name, **attrs)
+
+
+def emit(name: str, status: str = "event", **attrs) -> None:
+    EVENTS.emit(name, status=status, **attrs)

@@ -1,0 +1,98 @@
+"""Exporters: atomic file writes + the metrics.json / events.jsonl pair.
+
+Every JSON artifact the framework persists next to a checkpoint goes
+through :func:`atomic_write_text` (tmp file in the target directory +
+``os.replace``) — a kill mid-write can no longer leave a truncated
+``report.json`` / ``metrics.json`` beside a valid ``results.jsonl``
+(the satellite for ``pipeline.py`` and ``StageTimer.dump``).
+
+``write_run_artifacts`` is the driver-facing call: one line in
+``run_sweep``/``bench.py`` lands ``metrics.json``, ``events.jsonl`` and
+the Prometheus textfile in the output directory. All writers are no-ops
+when telemetry is disabled — no empty husk files.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+from ate_replication_causalml_tpu.observability import events as _events
+from ate_replication_causalml_tpu.observability import registry as _registry
+
+METRICS_BASENAME = "metrics.json"
+EVENTS_BASENAME = "events.jsonl"
+PROMTEXT_BASENAME = "metrics.prom"
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    """Write ``text`` to ``path`` atomically: tmp file in the same
+    directory (same filesystem — ``os.replace`` must not cross mounts),
+    fsync, rename."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(path) + ".", suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(text)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_json(path: str, obj, indent: int | None = 1,
+                      sort_keys: bool = False) -> None:
+    atomic_write_text(
+        path, json.dumps(obj, indent=indent, sort_keys=sort_keys) + "\n"
+    )
+
+
+def write_metrics_json(path: str,
+                       registry: _registry.MetricsRegistry | None = None,
+                       extra: dict | None = None) -> dict | None:
+    """Snapshot ``registry`` (default: the global one) to ``path``.
+    ``extra`` merges into the top level (e.g. run identity). Returns the
+    snapshot, or None when telemetry is disabled (nothing written)."""
+    if not _registry.enabled():
+        return None
+    snap = (registry or _registry.REGISTRY).snapshot()
+    if extra:
+        snap.update(extra)
+    atomic_write_json(path, snap)
+    return snap
+
+
+def write_events_jsonl(path: str, log: _events.EventLog | None = None) -> bool:
+    if not _registry.enabled():
+        return False
+    atomic_write_text(path, (log or _events.EVENTS).to_jsonl())
+    return True
+
+
+def write_run_artifacts(outdir: str, extra: dict | None = None) -> list[str]:
+    """Write metrics.json + events.jsonl + metrics.prom into ``outdir``.
+    Returns the paths written ([] when telemetry is disabled)."""
+    if not _registry.enabled():
+        return []
+    from ate_replication_causalml_tpu.observability.promtext import (
+        write_prom_textfile,
+    )
+
+    paths = []
+    mpath = os.path.join(outdir, METRICS_BASENAME)
+    write_metrics_json(mpath, extra=extra)
+    paths.append(mpath)
+    epath = os.path.join(outdir, EVENTS_BASENAME)
+    write_events_jsonl(epath)
+    paths.append(epath)
+    ppath = os.path.join(outdir, PROMTEXT_BASENAME)
+    write_prom_textfile(ppath)
+    paths.append(ppath)
+    return paths

@@ -1,0 +1,117 @@
+"""Prometheus textfile exporter (the tentpole's part 3).
+
+Renders the registry into the Prometheus text exposition format so a
+long sweep/serving run can be scraped via the node-exporter textfile
+collector: point ``--collector.textfile.directory`` at the run's output
+directory and the driver's periodic ``metrics.prom`` rewrites become
+scrape targets. Histograms export as summaries (``_count``/``_sum``)
+plus ``_min``/``_max`` gauges — no fixed bucket boundaries, matching
+the registry's summary-histogram semantics.
+
+Also runnable standalone on a saved ``metrics.json``::
+
+    python -m ate_replication_causalml_tpu.observability.promtext \
+        results/metrics.json [results/metrics.prom]
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+
+from ate_replication_causalml_tpu.observability import registry as _registry
+from ate_replication_causalml_tpu.observability.export import atomic_write_text
+
+_NAME_SAFE = re.compile(r"[^a-zA-Z0-9_:]")
+_PREFIX = "ate_tpu_"
+
+
+def _prom_name(name: str) -> str:
+    return _PREFIX + _NAME_SAFE.sub("_", name)
+
+
+def _prom_labels(label_key: str) -> str:
+    """Registry label-key string (``k=v,k2=v2``) → ``{k="v",k2="v2"}``."""
+    if not label_key:
+        return ""
+    parts = []
+    for pair in label_key.split(","):
+        k, _, v = pair.partition("=")
+        v = v.replace("\\", r"\\").replace('"', r"\"")
+        parts.append(f'{_NAME_SAFE.sub("_", k)}="{v}"')
+    return "{" + ",".join(parts) + "}"
+
+
+def _render_sections(counters: dict, gauges: dict, histograms: dict) -> str:
+    lines: list[str] = []
+
+    def family(name: str, ptype: str, samples: dict, render_sample):
+        lines.append(f"# TYPE {name} {ptype}")
+        for key, val in sorted(samples.items()):
+            render_sample(name, _prom_labels(key), val)
+
+    for name, samples in sorted(counters.items()):
+        family(
+            _prom_name(name), "counter", samples,
+            lambda n, lb, v: lines.append(f"{n}{lb} {v!r}"),
+        )
+    for name, samples in sorted(gauges.items()):
+        family(
+            _prom_name(name), "gauge", samples,
+            lambda n, lb, v: lines.append(f"{n}{lb} {v!r}"),
+        )
+    for name, samples in sorted(histograms.items()):
+        pname = _prom_name(name)
+        lines.append(f"# TYPE {pname} summary")
+        for key, s in sorted(samples.items()):
+            lb = _prom_labels(key)
+            lines.append(f"{pname}_count{lb} {s['count']!r}")
+            lines.append(f"{pname}_sum{lb} {s['sum']!r}")
+            lines.append(f"{pname}_min{lb} {s['min']!r}")
+            lines.append(f"{pname}_max{lb} {s['max']!r}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def render_prom_text(
+    registry: _registry.MetricsRegistry | None = None,
+) -> str:
+    snap = (registry or _registry.REGISTRY).snapshot()
+    return render_prom_from_snapshot(snap)
+
+
+def render_prom_from_snapshot(snap: dict) -> str:
+    return _render_sections(
+        snap.get("counters", {}), snap.get("gauges", {}),
+        snap.get("histograms", {}),
+    )
+
+
+def write_prom_textfile(
+    path: str, registry: _registry.MetricsRegistry | None = None
+) -> bool:
+    """Atomic textfile write (node-exporter reads whole files; a torn
+    write would drop the entire scrape). No-op when disabled."""
+    if not _registry.enabled():
+        return False
+    atomic_write_text(path, render_prom_text(registry))
+    return True
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv or len(argv) > 2:
+        print(__doc__, file=sys.stderr)
+        return 2
+    with open(argv[0]) as f:
+        snap = json.load(f)
+    text = render_prom_from_snapshot(snap)
+    if len(argv) == 2:
+        atomic_write_text(argv[1], text)
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

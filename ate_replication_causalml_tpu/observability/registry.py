@@ -1,0 +1,250 @@
+"""Process-global metrics registry (the tentpole's part 1).
+
+Counters, gauges and summary-histograms with labels, thread-safe,
+snapshot-able to a plain dict — the machine-readable replacement for
+the prints that round-3 (stale-checkpoint resume) and round-5
+(cold-start) regressions had to be diagnosed from. Every emitter in the
+framework (StageTimer, run_shards, the forest dispatch loops, the
+compile-cache listeners) writes into the default registry; the driver
+and bench export it as ``metrics.json`` / a Prometheus textfile.
+
+Zero-cost when disabled: ``ATE_TPU_TELEMETRY=0`` turns every mutator
+into a single cached-bool check and no allocation. Telemetry is
+host-side only — nothing here is ever traced into jitted code, so
+estimator outputs are bit-identical with telemetry on or off.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+from typing import Callable, Iterator
+
+_ENV = "ATE_TPU_TELEMETRY"
+_enabled_cache: bool | None = None
+
+# metrics.json / events.jsonl schema version — bump on breaking layout
+# changes; scripts/check_metrics_schema.py validates against it.
+SCHEMA_VERSION = 1
+
+_LABEL_SAFE = re.compile(r"[^A-Za-z0-9_-]")
+
+
+def enabled() -> bool:
+    """Telemetry master switch: on unless ``ATE_TPU_TELEMETRY=0``.
+    The env var is read once and cached (the hot paths call this per
+    record); tests flip it via :func:`set_enabled`."""
+    global _enabled_cache
+    if _enabled_cache is None:
+        _enabled_cache = os.environ.get(_ENV, "1") != "0"
+    return _enabled_cache
+
+
+def set_enabled(value: bool | None) -> None:
+    """Override the master switch (``None`` re-reads the env var)."""
+    global _enabled_cache
+    _enabled_cache = value if value is None else bool(value)
+
+
+def sanitize_label(label: str) -> str:
+    """Map any char outside ``[A-Za-z0-9_-]`` to ``_`` — sweep method
+    names like ``Causal Forest(GRF)`` and ``Belloni et.al`` become
+    trace *directory* names and Prometheus label material verbatim
+    otherwise."""
+    return _LABEL_SAFE.sub("_", label)
+
+
+def _label_key(labels: dict) -> str:
+    """Canonical string form of a label set (sorted ``k=v`` pairs);
+    the empty string for the unlabeled sample. ``,`` and ``=`` inside
+    values map to ``_`` — the key's own separators must stay
+    unambiguous for every downstream parser (promtext, the schema
+    checker); label values are identifiers, not payload."""
+    if not labels:
+        return ""
+    clean = lambda v: str(v).replace(",", "_").replace("=", "_")
+    return ",".join(f"{k}={clean(labels[k])}" for k in sorted(labels))
+
+
+class Counter:
+    """Monotonically increasing per-label-set float counter."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str, lock: threading.RLock):
+        self.name = name
+        self.help = help
+        self._lock = lock
+        self.samples: dict[str, float] = {}
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        """Add ``value`` (must be >= 0). ``inc(0, **labels)`` is the
+        idiom for pre-creating a labeled sample so "present but zero"
+        is distinguishable from "never instrumented" in metrics.json
+        (the retry counters on a healthy run)."""
+        if not enabled():
+            return
+        if value < 0:
+            raise ValueError(f"counter {self.name}: negative increment {value}")
+        key = _label_key(labels)
+        with self._lock:
+            self.samples[key] = self.samples.get(key, 0.0) + value
+
+
+class Gauge:
+    """Last-write-wins per-label-set value."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str, lock: threading.RLock):
+        self.name = name
+        self.help = help
+        self._lock = lock
+        self.samples: dict[str, float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        if not enabled():
+            return
+        with self._lock:
+            self.samples[_label_key(labels)] = float(value)
+
+    def add(self, value: float, **labels) -> None:
+        if not enabled():
+            return
+        key = _label_key(labels)
+        with self._lock:
+            self.samples[key] = self.samples.get(key, 0.0) + float(value)
+
+
+class Histogram:
+    """Summary histogram: count/sum/min/max/last per label set.
+
+    Deliberately bucket-free — the consumers here (regression triage,
+    the bench records) want totals and extremes, and a summary exports
+    to the Prometheus text format without fixing bucket boundaries
+    that million-row and 2k-row runs would never share.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str, lock: threading.RLock):
+        self.name = name
+        self.help = help
+        self._lock = lock
+        self.samples: dict[str, dict] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        if not enabled():
+            return
+        value = float(value)
+        key = _label_key(labels)
+        with self._lock:
+            s = self.samples.get(key)
+            if s is None:
+                self.samples[key] = {
+                    "count": 1, "sum": value, "min": value,
+                    "max": value, "last": value,
+                }
+            else:
+                s["count"] += 1
+                s["sum"] += value
+                s["min"] = min(s["min"], value)
+                s["max"] = max(s["max"], value)
+                s["last"] = value
+
+
+class MetricsRegistry:
+    """Thread-safe named-metric store with collector hooks.
+
+    Collectors are zero-arg callables run at :meth:`snapshot` time for
+    state that is cheaper to scan than to stream (e.g. the compile-cache
+    directory's entry count/bytes). A collector that raises is dropped
+    from that snapshot, never fatal — telemetry must not take down a
+    run it is observing.
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._metrics: dict[str, Counter | Gauge | Histogram] = {}
+        self._collectors: list[Callable[[], None]] = []
+
+    def _get(self, cls, name: str, help: str):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, self._lock)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}"
+                )
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "") -> Histogram:
+        return self._get(Histogram, name, help)
+
+    def add_collector(self, fn: Callable[[], None]) -> None:
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
+
+    def metrics(self) -> Iterator[Counter | Gauge | Histogram]:
+        with self._lock:
+            return iter(list(self._metrics.values()))
+
+    def snapshot(self) -> dict:
+        """Versioned plain-dict snapshot (the metrics.json payload)."""
+        for fn in list(self._collectors):
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — observers must not crash runs
+                pass
+        out = {
+            "schema_version": SCHEMA_VERSION,
+            "created_unix": time.time(),
+            "counters": {},
+            "gauges": {},
+            "histograms": {},
+        }
+        with self._lock:
+            for m in self._metrics.values():
+                if not m.samples:
+                    # Families created but never sampled (e.g. touched
+                    # while telemetry was disabled) are noise, not data.
+                    continue
+                section = out[m.kind + "s"]
+                section[m.name] = {
+                    k: (dict(v) if isinstance(v, dict) else v)
+                    for k, v in m.samples.items()
+                }
+        return out
+
+    def reset(self) -> None:
+        """Drop every metric and collector (tests only)."""
+        with self._lock:
+            self._metrics.clear()
+            self._collectors.clear()
+
+
+#: The process-global default registry every in-tree emitter writes to.
+REGISTRY = MetricsRegistry()
+
+
+def counter(name: str, help: str = "") -> Counter:
+    return REGISTRY.counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    return REGISTRY.gauge(name, help)
+
+
+def histogram(name: str, help: str = "") -> Histogram:
+    return REGISTRY.histogram(name, help)

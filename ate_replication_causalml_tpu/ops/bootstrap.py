@@ -36,6 +36,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ate_replication_causalml_tpu.parallel.mesh import get_mesh, shard_axis_size
+from ate_replication_causalml_tpu.parallel.mesh import shard_map as _shard_map
 
 
 def bootstrap_indices(key: jax.Array, n: int, n_boot: int) -> jax.Array:
@@ -281,7 +282,7 @@ def aipw_bootstrap_se_sharded(
         )
         return jax.lax.all_gather(taus, axis_name, tiled=True)
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(P(axis_name), P(), P(), P(), P(), P()),

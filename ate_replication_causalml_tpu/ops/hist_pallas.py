@@ -41,6 +41,14 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# Renamed TPUCompilerParams -> CompilerParams across jax releases; one
+# local alias (imported by tree_pallas / scripts) serves both without
+# mutating the jax module.
+_COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None)
+if _COMPILER_PARAMS is None:
+    _COMPILER_PARAMS = pltpu.TPUCompilerParams
+
+
 _LANES = 128
 
 
@@ -365,7 +373,7 @@ def bin_histogram_pallas(
             (p_groups, k_w * max_nodes, bw * _LANES), jnp.float32
         ),
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_BUDGET),
+        compiler_params=_COMPILER_PARAMS(vmem_limit_bytes=_VMEM_BUDGET),
     )(codes_b, node2d, weights)
     # (p_groups, K·M, bw·LANES) → per 128-lane block keep the live
     # f_pb·n_bins lanes, then restore feature order.
@@ -485,7 +493,7 @@ def bin_histogram_pallas_batched(
             (p_groups, n_trees * k_w * max_nodes, bw * _LANES), jnp.float32
         ),
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_BUDGET),
+        compiler_params=_COMPILER_PARAMS(vmem_limit_bytes=_VMEM_BUDGET),
     )(codes_b, node_tn, w_tkn)
     return _batched_unlayout(
         out, n_trees, k_w, max_nodes, p_groups, bw, f_pb, n_bins, p_pad, p
@@ -555,7 +563,7 @@ def bin_histogram_pallas_batched_shared(
             (p_groups, n_trees * k_w * max_nodes, bw * _LANES), jnp.float32
         ),
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_BUDGET),
+        compiler_params=_COMPILER_PARAMS(vmem_limit_bytes=_VMEM_BUDGET),
     )(codes_b, node_tn, w_kn)
     return _batched_unlayout(
         out, n_trees, k_w, max_nodes, p_groups, bw, f_pb, n_bins, p_pad, p

@@ -37,6 +37,7 @@ import numpy as np
 from jax import lax
 
 from ate_replication_causalml_tpu.ops.linalg import _PREC
+from ate_replication_causalml_tpu.parallel.mesh import shard_map as _shard_map
 
 DEFAULT_NLAMBDA = 100
 DEFAULT_THRESH = 1e-7
@@ -372,7 +373,7 @@ def _cv_glmnet_impl(
         ax = mesh.shape[fold_axis]
         k_pad = -(-nfolds // ax) * ax
         fold_ids = jnp.arange(1, k_pad + 1)
-        sharded = jax.shard_map(
+        sharded = _shard_map(
             lambda ids: jax.vmap(fold_fit)(ids),
             mesh=mesh,
             in_specs=_P(fold_axis),

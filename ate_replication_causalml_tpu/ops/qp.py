@@ -248,7 +248,10 @@ def balance_qp_x64(
     slow per FLOP, irrelevant for this tiny one-shot (n_arm × 21) solve,
     and far cheaper than the 12k-iteration f32 crawl it replaces.
     """
-    with jax.enable_x64():
+    x64 = getattr(jax, "enable_x64", None)
+    if x64 is None:  # pre-top-level-API jax
+        from jax.experimental import enable_x64 as x64
+    with x64():
         sol = _balance_qp_jitted_x64(int(max_iters))(
             jnp.asarray(x, jnp.float64),
             jnp.asarray(target, jnp.float64),

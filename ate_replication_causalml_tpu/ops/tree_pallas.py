@@ -39,9 +39,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+
 
 from ate_replication_causalml_tpu.ops.hist_pallas import (
+    _COMPILER_PARAMS,
     _round_up,
     _VMEM_BUDGET,
 )
@@ -113,7 +114,7 @@ def _table_lookup_batched(table, ids, *, interpret=False):
         out_specs=pl.BlockSpec((n_trees * n_chan, _TILE), lambda i: (0, i)),
         out_shape=jax.ShapeDtypeStruct((n_trees * n_chan, n_pad), jnp.float32),
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_BUDGET),
+        compiler_params=_COMPILER_PARAMS(vmem_limit_bytes=_VMEM_BUDGET),
     )(table, ids)
     return out.reshape(n_trees, n_chan, n_pad)[:, :, :n]
 
@@ -246,7 +247,7 @@ def _route_bits_batched(codes_t, ids, tab, *, interpret=False):
         out_specs=pl.BlockSpec((n_trees, _TILE), lambda i: (0, i)),
         out_shape=jax.ShapeDtypeStruct((n_trees, n_pad), jnp.int32),
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_BUDGET),
+        compiler_params=_COMPILER_PARAMS(vmem_limit_bytes=_VMEM_BUDGET),
     )(codes_t, ids, tab)
     return out[:, :n]
 

@@ -25,6 +25,36 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+# ``jax.shard_map`` landed as a top-level API after the experimental
+# namespace; this image's jax (0.4.37) only has the experimental one,
+# and the replication-check kwarg was renamed check_rep → check_vma
+# across the same span. Every in-tree caller imports the symbol from
+# here; the wrapper translates whichever spelling the installed jax
+# does not accept.
+def _resolve_shard_map():
+    import inspect
+
+    try:
+        sm = jax.shard_map
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map as sm
+    try:
+        params = set(inspect.signature(sm).parameters)
+    except (TypeError, ValueError):
+        return sm
+
+    def compat(*args, **kwargs):
+        if "check_vma" in kwargs and "check_vma" not in params:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        elif "check_rep" in kwargs and "check_rep" not in params:
+            kwargs["check_vma"] = kwargs.pop("check_rep")
+        return sm(*args, **kwargs)
+
+    return compat
+
+
+shard_map = _resolve_shard_map()
+
 # Canonical axis names used across the framework.
 BOOT_AXIS = "boot"
 TREE_AXIS = "tree"

@@ -23,6 +23,7 @@ from __future__ import annotations
 import jax
 from jax.sharding import Mesh
 
+from ate_replication_causalml_tpu import observability as obs
 from ate_replication_causalml_tpu.parallel.mesh import BOOT_AXIS, DATA_AXIS
 
 
@@ -53,19 +54,34 @@ def init_multihost(
         if explicit
         else {}
     )
+    def _done(ok: bool, how: str) -> bool:
+        # World-shape telemetry: the event log records how this process
+        # joined (or didn't), and the gauges make a mis-sized world
+        # visible in metrics.json without grepping launcher logs.
+        obs.emit("multihost_init", status="ok" if ok else "skipped", how=how)
+        if ok:
+            obs.gauge("process_count", "jax.process_count()").set(
+                jax.process_count()
+            )
+            obs.gauge("device_count", "jax.device_count()").set(
+                jax.device_count()
+            )
+        return ok
+
     try:
         jax.distributed.initialize(**kwargs)
-        return True
+        return _done(True, "initialized")
     except RuntimeError as e:
         if "already" in str(e).lower():
             # A launcher (or an early devices() call) initialized first;
             # report whether a multi-process world actually exists.
-            return jax.process_count() > 1
+            return _done(jax.process_count() > 1, "already_initialized")
         raise
     except ValueError:
         if explicit:
             raise  # misconfigured explicit args must not be swallowed
-        return False  # auto-detection found no multi-host environment
+        # Auto-detection found no multi-host environment.
+        return _done(False, "not_detected")
 
 
 def make_pod_mesh(

@@ -29,6 +29,8 @@ from typing import Callable, Iterable, Sequence
 import jax
 import jax.numpy as jnp
 
+from ate_replication_causalml_tpu import observability as obs
+
 
 def probe_devices(devices: Sequence | None = None) -> list:
     """Return the subset of ``devices`` (default: all) that complete a
@@ -63,6 +65,7 @@ def run_shards(
     backoff_s: float = 0.25,
     log: Callable[[str], None] | None = None,
     retriable: tuple[type[BaseException], ...] = (Exception,),
+    pool: str = "shards",
 ) -> list[ShardOutcome]:
     """Run ``shard_fn(i)`` for every shard ``i`` with per-shard retry.
 
@@ -72,12 +75,27 @@ def run_shards(
     :class:`ShardOutcome`; the others still complete — callers decide
     whether partial coverage is acceptable (e.g. 9/10 bootstrap batches
     still estimate an SE) or raise via :func:`require_all`.
+
+    ``pool`` labels this call's telemetry: attempts / retries /
+    failures / backoff-seconds counters (observability/), created at
+    zero up front so a healthy run still exports the keys — "no
+    retries" is a reported fact, not a missing metric. Retries and
+    exhaustions additionally land in the event log with the error
+    string, which is how a transient-tunnel-drop diagnosis stops
+    requiring print archaeology.
     """
+    attempts_c = obs.counter("shard_attempts_total", "run_shards attempts")
+    retries_c = obs.counter("shard_retries_total", "failed attempts that will retry")
+    failures_c = obs.counter("shard_failures_total", "shards that exhausted retries")
+    backoff_c = obs.counter("shard_backoff_seconds_total", "backoff sleep time")
+    for c in (attempts_c, retries_c, failures_c, backoff_c):
+        c.inc(0, pool=pool)
     outcomes = [ShardOutcome(index=i) for i in range(n_shards)]
     for out in outcomes:
         delay = backoff_s
         while out.attempts < max_attempts and not out.ok:
             out.attempts += 1
+            attempts_c.inc(1, pool=pool)
             try:
                 out.result = shard_fn(out.index)
                 out.ok = True
@@ -86,8 +104,20 @@ def run_shards(
                 if log:
                     log(f"shard {out.index} attempt {out.attempts} failed: {out.error}")
                 if out.attempts < max_attempts:
+                    retries_c.inc(1, pool=pool)
+                    obs.emit(
+                        "shard_retry", status="retrying", pool=pool,
+                        shard=out.index, attempt=out.attempts, error=out.error,
+                    )
+                    backoff_c.inc(delay, pool=pool)
                     time.sleep(delay)
                     delay *= 2.0
+                else:
+                    failures_c.inc(1, pool=pool)
+                    obs.emit(
+                        "shard_failed", status="error", pool=pool,
+                        shard=out.index, attempt=out.attempts, error=out.error,
+                    )
     return outcomes
 
 

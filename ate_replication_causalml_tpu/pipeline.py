@@ -59,6 +59,7 @@ from ate_replication_causalml_tpu.estimators import (
     prop_score_weight,
     residual_balance_ate,
 )
+from ate_replication_causalml_tpu import observability as obs
 from ate_replication_causalml_tpu.models.forest import rf_oob_propensity
 from ate_replication_causalml_tpu.utils.profiling import StageTimer, xla_trace
 
@@ -218,7 +219,50 @@ def run_sweep(
     plots: bool = True,
     log: Callable[[str], None] = print,
 ) -> SweepReport:
-    """The full notebook run, checkpointed and timed."""
+    """The full notebook run, checkpointed and timed.
+
+    Telemetry (observability/): the whole run is a ``run_sweep`` span;
+    every estimator stage is a child span whose status records whether
+    it COMPUTED or RESUMED from the checkpoint — the distinction the
+    round-3 stale-resume incident had to be reconstructed from prints.
+    With an ``outdir``, ``metrics.json`` + ``events.jsonl`` + a
+    Prometheus textfile land next to ``report.json`` (all written
+    atomically). ``ATE_TPU_TELEMETRY=0`` disables all of it; estimator
+    outputs are bit-identical either way.
+    """
+    # Cache counters must exist in metrics.json even when the embedding
+    # process never enabled the persistent cache (idempotent).
+    obs.install_jax_monitoring()
+    try:
+        with obs.span("run_sweep", out=outdir or "", csv=csv_path or "synthetic"):
+            report = _run_sweep_impl(config, csv_path, outdir, plots, log)
+        return report
+    finally:
+        # Export in a finally: a failing run is exactly the run whose
+        # telemetry (retry events, partial stage counters) matters
+        # most. Device-memory gauges first (TPU reports them; CPU has
+        # none), then the exporter trio — metrics.json / events.jsonl /
+        # metrics.prom — beside report.json, after the root span has
+        # closed so the event log contains the complete run.
+        if outdir:
+            try:
+                obs.record_device_memory(context="run_sweep")
+                written = obs.write_run_artifacts(outdir)
+                if written:
+                    log(f"telemetry: {', '.join(written)}")
+            except Exception as e:  # noqa: BLE001 — observer must not
+                # replace the run's real exception (full disk, outdir
+                # deleted mid-run) with an export error.
+                log(f"telemetry export failed: {e!r}")
+
+
+def _run_sweep_impl(
+    config: SweepConfig,
+    csv_path: str | None,
+    outdir: str | None,
+    plots: bool,
+    log: Callable[[str], None],
+) -> SweepReport:
     if outdir:
         os.makedirs(outdir, exist_ok=True)
     # Resume is only valid for the same config + data source + device
@@ -284,29 +328,43 @@ def run_sweep(
         with fold_ctx():
             return fn()
 
+    stage_c = obs.counter(
+        "sweep_stage_total", "sweep stages by resume-vs-computed status"
+    )
+
     def stage(method: str, fn: Callable[[], object]) -> EstimatorResult:
-        """Run one estimator with timing + checkpointing. ``fn`` returns
-        an EstimatorResult, or (EstimatorResult, extras-dict) — extras
-        ride the checkpoint record (read back via ``ckpt.get``)."""
+        """Run one estimator with timing + checkpointing + telemetry.
+        ``fn`` returns an EstimatorResult, or (EstimatorResult,
+        extras-dict) — extras ride the checkpoint record (read back via
+        ``ckpt.get``). The stage span's status records whether the row
+        was computed or resumed from the checkpoint."""
         cached = ckpt.get(method)
-        if cached is not None:
-            log(f"  [resume] {method}: ate={cached['ate']:.4f}")
-            nanf = lambda v: float("nan") if v is None else v
-            res = EstimatorResult(
-                method=cached["method"], ate=cached["ate"],
-                lower_ci=nanf(cached["lower_ci"]), upper_ci=nanf(cached["upper_ci"]),
-                se=nanf(cached["se"]),
-            )
-            timer.seconds[method] = cached.get("seconds", 0.0)
+        with obs.span("sweep_stage", method=method) as sp:
+            if cached is not None:
+                sp.set_status("resumed")
+                stage_c.inc(1, method=method, status="resumed")
+                log(f"  [resume] {method}: ate={cached['ate']:.4f}")
+                nanf = lambda v: float("nan") if v is None else v
+                res = EstimatorResult(
+                    method=cached["method"], ate=cached["ate"],
+                    lower_ci=nanf(cached["lower_ci"]), upper_ci=nanf(cached["upper_ci"]),
+                    se=nanf(cached["se"]),
+                )
+                timer.seconds[method] = cached.get("seconds", 0.0)
+                return res
+            sp.set_status("computed")
+            # xla_trace sanitizes the label itself (method names carry
+            # spaces/parens/dots — e.g. ``Causal Forest(GRF)``).
+            with timer.stage(method), xla_trace(method):
+                out = fn()
+            res, extras = out if isinstance(out, tuple) else (out, {})
+            dt = timer.seconds[method]
+            sp.set_attr("seconds", round(dt, 3))
+            stage_c.inc(1, method=method, status="computed")
+            ckpt.put(dict(res.to_dict(), seconds=round(dt, 3), **extras))
+            log(f"  {method}: ate={res.ate:.4f} ci=[{res.lower_ci:.4f},{res.upper_ci:.4f}] "
+                f"({dt:.1f}s)")
             return res
-        with timer.stage(method), xla_trace(method.replace(" ", "_")):
-            out = fn()
-        res, extras = out if isinstance(out, tuple) else (out, {})
-        dt = timer.seconds[method]
-        ckpt.put(dict(res.to_dict(), seconds=round(dt, 3), **extras))
-        log(f"  {method}: ate={res.ate:.4f} ci=[{res.lower_ci:.4f},{res.upper_ci:.4f}] "
-            f"({dt:.1f}s)")
-        return res
 
     # ── The sweep, in notebook order (Rmd:128-272) ────────────────────
     report.oracle = stage("oracle", lambda: naive_ate(df, method="oracle"))
@@ -385,18 +443,19 @@ def run_sweep(
     )
 
     if outdir:
-        with open(os.path.join(outdir, "report.json"), "w") as f:
-            json.dump(
-                _jsonsafe({
-                    "oracle": report.oracle.to_dict(),
-                    "results": [r.to_dict() for r in report.results],
-                    "n_dropped": report.n_dropped,
-                    "n_biased": report.n_biased,
-                    "incorrect_cf": [report.incorrect_cf_ate, report.incorrect_cf_se],
-                    "timings_s": {k: round(v, 3) for k, v in report.timings_s.items()},
-                }),
-                f, indent=1,
-            )
+        # Atomic (tmp + os.replace): a kill mid-write must not leave a
+        # truncated report.json next to a valid results.jsonl.
+        obs.atomic_write_json(
+            os.path.join(outdir, "report.json"),
+            _jsonsafe({
+                "oracle": report.oracle.to_dict(),
+                "results": [r.to_dict() for r in report.results],
+                "n_dropped": report.n_dropped,
+                "n_biased": report.n_biased,
+                "incorrect_cf": [report.incorrect_cf_ate, report.incorrect_cf_se],
+                "timings_s": {k: round(v, 3) for k, v in report.timings_s.items()},
+            }),
+        )
     if plots and outdir:
         from ate_replication_causalml_tpu.viz import notebook_figures
 

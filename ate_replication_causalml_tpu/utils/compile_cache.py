@@ -76,7 +76,15 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
     it (only the XLA:CPU serializer has misbehaved)."""
     import jax
 
+    from ate_replication_causalml_tpu import observability as obs
+
+    # Bridge jax.monitoring's cache events (hits / misses / retrieval
+    # time / time saved) into the metrics registry, and pre-create the
+    # counters at zero — metrics.json always carries the cache keys,
+    # even on the kill-switch path below.
+    obs.install_jax_monitoring()
     if os.environ.get("ATE_NO_COMPILE_CACHE") == "1":
+        obs.gauge("compile_cache_enabled", "persistent cache active").set(0.0)
         return None
     cache_dir = cache_dir or _default_cache_dir()
     try:
@@ -84,9 +92,12 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
         if existing:
             # Respect a cache already configured by the embedding process
             # (e.g. the test suite's conftest dir) — don't retarget.
+            obs.gauge("compile_cache_enabled", "persistent cache active").set(1.0)
+            obs.watch_cache_dir(existing)
             return existing
         jax.config.update("jax_compilation_cache_dir", cache_dir)
     except (AttributeError, ValueError) as e:  # flag renamed/removed
+        obs.gauge("compile_cache_enabled", "persistent cache active").set(0.0)
         warnings.warn(
             f"persistent compilation cache disabled ({e}); first calls will "
             "be compile-dominated",
@@ -94,6 +105,10 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
             stacklevel=2,
         )
         return None
+    obs.gauge("compile_cache_enabled", "persistent cache active").set(1.0)
+    # Entry-count / total-bytes gauges, refreshed at every metrics
+    # snapshot (the write counter the cache API itself doesn't expose).
+    obs.watch_cache_dir(cache_dir)
     try:
         # 0.1 s (round 5; was 1.0): on the remote-compile toolchain even
         # primitive-sized executables cost 0.5-2 s of wall-clock to

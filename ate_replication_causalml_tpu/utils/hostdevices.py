@@ -1,0 +1,56 @@
+"""Virtual host-device provisioning across jax versions.
+
+Newer jax exposes ``jax.config.update("jax_num_cpu_devices", n)``;
+older jax (this image's 0.4.37) only honors
+``XLA_FLAGS=--xla_force_host_platform_device_count=N`` read at backend
+init. Four call sites (tests/conftest.py, bench.py's CPU child,
+``__graft_entry__``'s dryrun, the multihost test child) need the same
+dance with subtly different semantics — one helper so they cannot
+drift. Pure ``os``/``re``: importable before jax, never initializes a
+backend.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_FLAG = "--xla_force_host_platform_device_count"
+
+
+def xla_flags_with_device_count(flags: str, n: int,
+                                keep_larger: bool = False) -> tuple[str, int]:
+    """Return ``(new_flags, count)``: ``flags`` with the device-count
+    flag REPLACED by ``n`` (an inherited smaller count silently shrinks
+    every mesh; append-if-absent is the bug, not the feature). With
+    ``keep_larger`` a larger inherited count survives — for callers that
+    need *at least* ``n`` rather than exactly ``n``."""
+    m = re.search(rf"{_FLAG}=(\d+)", flags)
+    count = n
+    if m and keep_larger:
+        count = max(n, int(m.group(1)))
+    stripped = re.sub(rf"{_FLAG}=\d+", "", flags)
+    return (stripped + f" {_FLAG}={count}").strip(), count
+
+
+def force_host_device_count(n: int, keep_larger: bool = False) -> int:
+    """Provision ``n`` virtual CPU devices on whatever jax is installed.
+
+    Tries the config option first (works even after import, newer jax);
+    falls back to rewriting ``XLA_FLAGS`` in ``os.environ`` — which only
+    takes effect if the backend has not initialized yet, exactly like
+    the config path's own requirement. Returns the count provisioned.
+    """
+    import jax
+
+    try:
+        current = getattr(jax.config, "jax_num_cpu_devices", 0) or 0
+        count = max(n, current) if keep_larger else n
+        jax.config.update("jax_num_cpu_devices", count)
+        return count
+    except AttributeError:
+        flags, count = xla_flags_with_device_count(
+            os.environ.get("XLA_FLAGS", ""), n, keep_larger=keep_larger
+        )
+        os.environ["XLA_FLAGS"] = flags
+        return count

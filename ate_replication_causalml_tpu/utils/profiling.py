@@ -14,17 +14,24 @@ wall-clock metric, so timing is a first-class subsystem:
 * :func:`xla_trace` — wraps ``jax.profiler.trace`` when a trace dir is
   set (``ATE_TPU_TRACE_DIR`` env var or argument) and is a no-op
   otherwise, so production code can leave the hook in place.
+
+All three are thin emitters into the unified telemetry layer
+(``observability/``): stage durations land in the
+``stage_seconds`` histogram and as spans in the event log; trace
+activations are counted. ``ATE_TPU_TELEMETRY=0`` reduces every emit to
+one cached-bool check.
 """
 
 from __future__ import annotations
 
 import contextlib
-import json
 import os
 import time
 from typing import Iterator
 
 import jax
+
+from ate_replication_causalml_tpu import observability as obs
 
 _TRACE_ENV = "ATE_TPU_TRACE_DIR"
 
@@ -39,10 +46,13 @@ class StageTimer:
     def stage(self, name: str) -> Iterator[None]:
         t0 = time.perf_counter()
         try:
-            yield
+            with obs.span("stage", stage=name):
+                yield
         finally:
-            self.seconds[name] = self.seconds.get(name, 0.0) + (
-                time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self.seconds[name] = self.seconds.get(name, 0.0) + dt
+            obs.histogram("stage_seconds", "StageTimer stage durations").observe(
+                dt, stage=name
             )
 
     def report(self) -> str:
@@ -55,8 +65,9 @@ class StageTimer:
         return "\n".join(lines)
 
     def dump(self, path: str) -> None:
-        with open(path, "w") as f:
-            json.dump(self.seconds, f, indent=2, sort_keys=True)
+        # Atomic (tmp + os.replace): a kill mid-dump must not leave a
+        # truncated JSON next to a valid checkpoint.
+        obs.atomic_write_json(path, self.seconds, indent=2, sort_keys=True)
 
 
 @contextlib.contextmanager
@@ -64,21 +75,35 @@ def stage(name: str, log=None) -> Iterator[None]:
     """Time one stage; ``log`` (e.g. ``print``) receives `name: N.NNNs`."""
     t0 = time.perf_counter()
     try:
-        yield
+        with obs.span("stage", stage=name):
+            yield
     finally:
+        dt = time.perf_counter() - t0
+        obs.histogram("stage_seconds", "StageTimer stage durations").observe(
+            dt, stage=name
+        )
         if log is not None:
-            log(f"{name}: {time.perf_counter() - t0:.3f}s")
+            log(f"{name}: {dt:.3f}s")
 
 
 @contextlib.contextmanager
 def xla_trace(label: str = "trace", trace_dir: str | None = None) -> Iterator[None]:
     """``jax.profiler.trace`` scoped to a block when a trace directory is
-    configured; no-op otherwise. View with TensorBoard / xprof."""
+    configured; no-op otherwise. View with TensorBoard / xprof.
+
+    The label becomes a trace DIRECTORY name, so it is sanitized here
+    (any char outside ``[A-Za-z0-9_-]`` → ``_``) regardless of what the
+    caller passes — sweep method names like ``Causal Forest(GRF)`` or
+    ``Belloni et.al`` would otherwise hit the filesystem verbatim."""
     trace_dir = trace_dir or os.environ.get(_TRACE_ENV)
     if not trace_dir:
         yield
         return
+    label = obs.sanitize_label(label)
     path = os.path.join(trace_dir, label)
     os.makedirs(path, exist_ok=True)
+    obs.counter("xla_trace_total", "jax.profiler.trace activations").inc(
+        1, label=label
+    )
     with jax.profiler.trace(path):
         yield

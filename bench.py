@@ -25,6 +25,7 @@ import time
 import jax
 import jax.numpy as jnp
 
+from ate_replication_causalml_tpu import observability as obs
 from ate_replication_causalml_tpu.utils.compile_cache import enable_persistent_cache
 
 enable_persistent_cache()
@@ -47,6 +48,10 @@ FOREST_BASELINE_S_PER_1M = 6_700.0
 # Default-mode forest scale (smoke override; parsed at import so a
 # malformed value fails before the AIPW stage burns minutes).
 DEFAULT_FOREST_ROWS = int(os.environ.get("ATE_BENCH_FOREST_ROWS", 1_000_000))
+
+# Set when this process re-execs a CPU child that runs the real bench —
+# the child then owns the $ATE_TPU_METRICS_DIR export (see main()).
+_delegated_to_child = False
 
 
 def make_panel(key, n):
@@ -131,16 +136,16 @@ def bench_forest_predict(fitted, n):
         f"mean_cate={c_sum / n:.4f} mean_var={v_sum / n:.6f}",
         file=sys.stderr,
     )
-    return {
-        "metric": "causal_forest_predict_var_sec_per_1m_rows",
-        "value": round(sec_per_1m, 2),
-        "unit": "s",
-        "vs_baseline": round(FOREST_BASELINE_S_PER_1M / sec_per_1m, 2),
-        "samples_s": [round(a, 2), round(b, 2)],
-        "rows": n,
-        "leaf_index_s": round(leaf_index_s, 2),
-        "baseline_note": "vs the grf FIT extrapolation (no published predict baseline)",
-    }
+    return obs.bench_record(
+        metric="causal_forest_predict_var_sec_per_1m_rows",
+        value=round(sec_per_1m, 2),
+        unit="s",
+        vs_baseline=round(FOREST_BASELINE_S_PER_1M / sec_per_1m, 2),
+        samples_s=[round(a, 2), round(b, 2)],
+        rows=n,
+        leaf_index_s=round(leaf_index_s, 2),
+        baseline_note="vs the grf FIT extrapolation (no published predict baseline)",
+    )
 
 
 def bench_forest(n=FOREST_ROWS, with_predict=False):
@@ -211,19 +216,23 @@ def bench_forest(n=FOREST_ROWS, with_predict=False):
         f"fit_matmul_flops={flops:.3e} mfu_bf16~{mfu * 100:.1f}%",
         file=sys.stderr,
     )
+    # Device-memory gauges while the flagship forest is still resident —
+    # the HBM picture the OOM comments above reconstruct by hand (TPU
+    # reports memory_stats(); CPU has none and is skipped).
+    obs.record_device_memory(context="bench_forest")
     # Both warm samples ride in the record (advisor r3: min-of-two alone
     # reports the optimistic sample; downstream readers get the raw pair
     # and can take the median/max themselves), plus the MFU diagnostic.
-    record = {
-        "metric": "causal_forest_2000_trees_sec_per_1m_rows",
-        "value": round(sec_per_1m, 1),
-        "unit": "s",
-        "vs_baseline": round(FOREST_BASELINE_S_PER_1M / sec_per_1m, 2),
-        "samples_s": [round(steady_a, 1), round(steady_b, 1)],
-        "rows": n,
-        "analytic_tflops": round(flops / steady_s / 1e12, 1),
-        "mfu_bf16_pct": round(mfu * 100, 1),
-    }
+    record = obs.bench_record(
+        metric="causal_forest_2000_trees_sec_per_1m_rows",
+        value=round(sec_per_1m, 1),
+        unit="s",
+        vs_baseline=round(FOREST_BASELINE_S_PER_1M / sec_per_1m, 2),
+        samples_s=[round(steady_a, 1), round(steady_b, 1)],
+        rows=n,
+        analytic_tflops=round(flops / steady_s / 1e12, 1),
+        mfu_bf16_pct=round(mfu * 100, 1),
+    )
     if with_predict:
         return record, bench_forest_predict(fitted, n)
     return record
@@ -260,12 +269,12 @@ def bench_hist_ab(n=N_ROWS, trees=32, depth=9):
         results[backend] = best * 1000.0 / trees
         print(f"# {backend}: {results[backend]:.1f} ms/tree "
               f"({trees} trees, {n} rows, depth {depth})", file=sys.stderr)
-    print(json.dumps({
-        "metric": f"hist_bf16_over_xla_ms_per_tree_{n}_rows",
-        "value": round(results["pallas_bf16"], 1),
-        "unit": "ms/tree",
-        "vs_baseline": round(results["xla"] / results["pallas_bf16"], 3),
-    }))
+    print(json.dumps(obs.bench_record(
+        metric=f"hist_bf16_over_xla_ms_per_tree_{n}_rows",
+        value=round(results["pallas_bf16"], 1),
+        unit="ms/tree",
+        vs_baseline=round(results["xla"] / results["pallas_bf16"], 3),
+    )))
 
 
 def _cpu_child_reexec(flag):
@@ -278,6 +287,11 @@ def _cpu_child_reexec(flag):
     import subprocess
 
     if os.environ.get("_ATE_SHARDED_CHILD") != "1":
+        # The CHILD owns this run's telemetry: without the flag, the
+        # parent's sys.exit would run main()'s export-finally with a
+        # near-empty registry and overwrite the child's metrics.json.
+        global _delegated_to_child
+        _delegated_to_child = True
         env = dict(os.environ)
         env["_ATE_SHARDED_CHILD"] = "1"
         env["JAX_PLATFORMS"] = "cpu"
@@ -297,7 +311,11 @@ def _cpu_child_reexec(flag):
         ).returncode
         sys.exit(rc)
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    from ate_replication_causalml_tpu.utils.hostdevices import (
+        force_host_device_count,
+    )
+
+    force_host_device_count(8)
     return False
 
 
@@ -364,12 +382,12 @@ def bench_sharded():
     )
     print(
         json.dumps(
-            {
-                "metric": "sharded_bootstrap_8dev_over_1dev_wallclock",
-                "value": round(times[8] / times[1], 3),
-                "unit": "ratio",
-                "vs_baseline": round(times[1] / times[8], 2),
-            }
+            obs.bench_record(
+                metric="sharded_bootstrap_8dev_over_1dev_wallclock",
+                value=round(times[8] / times[1], 3),
+                unit="ratio",
+                vs_baseline=round(times[1] / times[8], 2),
+            )
         )
     )
 
@@ -460,14 +478,13 @@ def bench_mesh_scaling(out_path="MESH_SCALING.json"):
         "forest_fit": round(forest_s[-1] / forest_s[0], 3),
     }
 
-    with open(out_path, "w") as f:
-        json.dump(record, f, indent=1)
-    print(json.dumps({
-        "metric": "mesh_scaling_forest_per_dev_trees_8dev_over_1dev",
-        "value": round(forest_per_dev[-1] / forest_per_dev[0], 3),
-        "unit": "ratio",
-        "vs_baseline": round(forest_per_dev[0] / forest_per_dev[-1], 2),
-    }))
+    obs.atomic_write_json(out_path, record)
+    print(json.dumps(obs.bench_record(
+        metric="mesh_scaling_forest_per_dev_trees_8dev_over_1dev",
+        value=round(forest_per_dev[-1] / forest_per_dev[0], 3),
+        unit="ratio",
+        vs_baseline=round(forest_per_dev[0] / forest_per_dev[-1], 2),
+    )))
     print(f"# wrote {out_path}", file=sys.stderr)
 
 
@@ -478,6 +495,26 @@ def _timed(fn):
 
 
 def main():
+    """Run the selected bench mode, then export the telemetry registry
+    (metrics.json / events.jsonl / metrics.prom) to
+    ``$ATE_TPU_METRICS_DIR`` when set — even on failure, so a crashed
+    run still leaves its partial counters behind for diagnosis. The
+    bench records themselves flow THROUGH the registry
+    (observability.bench_record), so the printed BENCH lines and the
+    exported metrics.json cannot disagree."""
+    try:
+        return _main()
+    finally:
+        outdir = os.environ.get("ATE_TPU_METRICS_DIR")
+        if outdir and not _delegated_to_child:
+            try:
+                obs.write_run_artifacts(outdir)
+            except Exception as e:  # noqa: BLE001 — an export error must
+                # not replace the bench's real exception/exit status
+                print(f"# telemetry export failed: {e!r}", file=sys.stderr)
+
+
+def _main():
     if "--mesh-scaling" in sys.argv:
         return bench_mesh_scaling()
     if "--sharded" in sys.argv:
@@ -523,19 +560,25 @@ def main():
         )
         return tau, sd(taus)
 
-    # Compile once (not counted — XLA caches the executable). Timing
-    # converts the scalar outputs to Python floats: a device->host sync
-    # that is reliable on every backend (block_until_ready is a no-op on
-    # some experimental platforms).
+    # Compile once via the AOT path (not counted in the steady metric —
+    # XLA caches the executable either way). Lower+compile explicitly so
+    # the compiler's own cost analysis (flops / bytes) can be captured
+    # for THIS executable — the measured-MFU companion to the analytic
+    # estimate the forest record carries. Timing converts the scalar
+    # outputs to Python floats: a device->host sync that is reliable on
+    # every backend (block_until_ready is a no-op on some experimental
+    # platforms).
     t0 = time.perf_counter()
-    tau, se = full_aipw_bootstrap(x, w, y, jax.random.key(1))
+    compiled = full_aipw_bootstrap.lower(x, w, y, jax.random.key(1)).compile()
+    cost = obs.record_compiled_cost("aipw_bootstrap", compiled)
+    tau, se = compiled(x, w, y, jax.random.key(1))
     tau, se = float(tau), float(se)
     compile_and_run = time.perf_counter() - t0
 
     samples = []
     for rep in range(3):
         t0 = time.perf_counter()
-        tau, se = full_aipw_bootstrap(x, w, y, jax.random.key(2 + rep))
+        tau, se = compiled(x, w, y, jax.random.key(2 + rep))
         tau, se = float(tau), float(se)
         samples.append(time.perf_counter() - t0)
     best = min(samples)
@@ -553,6 +596,17 @@ def main():
         "vs_baseline": round(BASELINE_S / best, 2),
         "samples_s": [round(s, 3) for s in samples],
     }
+    # Compiler-reported cost of the measured executable (when the
+    # backend implements cost_analysis): flops → achieved TF/s and, on
+    # TPU, MFU against the v5e 197 TF/s bf16 peak — the number the
+    # forest record previously had to estimate analytically.
+    flops = cost.get("flops")
+    if flops:
+        aipw_record["compiled_flops"] = flops
+        aipw_record["tflops_per_s"] = round(flops / best / 1e12, 3)
+        if jax.default_backend() == "tpu":
+            aipw_record["mfu_bf16_pct"] = round(flops / best / 197e12 * 100, 2)
+    aipw_record = obs.bench_record(**aipw_record)
     # VERDICT r3 #2 + r4 #6: the default (driver-run) bench carries the
     # north-star metrics — AIPW bootstrap, the cached predict+variance
     # stage, and the flagship forest fit. Every stage runs to

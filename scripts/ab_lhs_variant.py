@@ -21,10 +21,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+
 
 sys.path.insert(0, "/root/repo")
-from ate_replication_causalml_tpu.ops.hist_pallas import (  # noqa: E402
+from ate_replication_causalml_tpu.ops.hist_pallas import (
+    _COMPILER_PARAMS,  # noqa: E402
     _LANES,
     _VMEM_BUDGET,
     _batched_layout,
@@ -98,7 +99,7 @@ def run_variant(kernel_fn, codes, node, weights, max_nodes, n_bins, shared):
         out_shape=jax.ShapeDtypeStruct(
             (p_groups, n_trees * k_w * max_nodes, bw * _LANES), jnp.float32
         ),
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_BUDGET),
+        compiler_params=_COMPILER_PARAMS(vmem_limit_bytes=_VMEM_BUDGET),
     )(codes_b, node_tn, w_op)
 
 

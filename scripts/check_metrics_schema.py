@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Validate a telemetry artifact pair against the versioned schema.
+
+Usage::
+
+    python scripts/check_metrics_schema.py results/
+    python scripts/check_metrics_schema.py metrics.json events.jsonl \
+        [--require-stages "naive,oracle,..."]
+
+Checks ``metrics.json`` (schema version, section shapes, the counter
+families every instrumented run must carry — shard retry and compile
+cache) and ``events.jsonl`` (versioned header, span record fields,
+parent references resolving, non-negative durations). With
+``--require-stages``, every named stage must appear as a
+``sweep_stage_total`` label — the quick-sweep acceptance gate for all
+13 ``SWEEP_METHODS`` plus the oracle.
+
+Importable: the telemetry integration test drives :func:`validate_pair`
+directly. Pure stdlib — runnable on any saved ``results/`` directory
+without JAX.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+EXPECTED_SCHEMA_VERSION = 1
+
+# Counter families every instrumented run exports, zero or not: "no
+# retries happened" must be a recorded 0, not a missing key.
+REQUIRED_COUNTERS = (
+    "shard_attempts_total",
+    "shard_retries_total",
+    "shard_failures_total",
+    "compile_cache_hits_total",
+    "compile_cache_misses_total",
+)
+
+_EVENT_FIELDS = (
+    "name", "span_id", "status", "start_unix", "end_unix",
+    "start_mono_s", "end_mono_s", "dur_s", "attrs",
+)
+
+
+def validate_metrics(snap: dict, require_stages: list[str] | None = None) -> list[str]:
+    errors: list[str] = []
+    ver = snap.get("schema_version")
+    if ver != EXPECTED_SCHEMA_VERSION:
+        errors.append(f"metrics: schema_version {ver!r} != {EXPECTED_SCHEMA_VERSION}")
+    for section in ("counters", "gauges", "histograms"):
+        fam = snap.get(section)
+        if not isinstance(fam, dict):
+            errors.append(f"metrics: missing/invalid section {section!r}")
+            continue
+        for name, samples in fam.items():
+            if not isinstance(samples, dict):
+                errors.append(f"metrics: {section}.{name} is not a label->value map")
+                continue
+            for key, val in samples.items():
+                if section == "histograms":
+                    if not (isinstance(val, dict)
+                            and {"count", "sum", "min", "max"} <= set(val)):
+                        errors.append(
+                            f"metrics: histogram {name}[{key!r}] lacks "
+                            "count/sum/min/max"
+                        )
+                elif not isinstance(val, (int, float)):
+                    errors.append(f"metrics: {section}.{name}[{key!r}] non-numeric")
+    counters = snap.get("counters", {})
+    for name in REQUIRED_COUNTERS:
+        if name not in counters:
+            errors.append(f"metrics: required counter {name!r} absent")
+    if require_stages:
+        stage_samples = counters.get("sweep_stage_total", {})
+        seen = set()
+        for key in stage_samples:
+            for pair in key.split(","):
+                k, _, v = pair.partition("=")
+                if k == "method":
+                    seen.add(v)
+        for stage in require_stages:
+            if stage not in seen:
+                errors.append(
+                    f"metrics: sweep_stage_total has no sample for "
+                    f"method={stage!r}"
+                )
+    return errors
+
+
+def validate_events(lines: list[str]) -> list[str]:
+    errors: list[str] = []
+    if not lines:
+        return ["events: empty file (expected a header line)"]
+    try:
+        header = json.loads(lines[0])
+    except json.JSONDecodeError:
+        return ["events: header line is not valid JSON"]
+    if header.get("kind") != "events_header":
+        errors.append("events: first line is not an events_header")
+    if header.get("schema_version") != EXPECTED_SCHEMA_VERSION:
+        errors.append(
+            f"events: schema_version {header.get('schema_version')!r} != "
+            f"{EXPECTED_SCHEMA_VERSION}"
+        )
+    records = []
+    for i, line in enumerate(lines[1:], start=2):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            errors.append(f"events: line {i} is not valid JSON")
+            continue
+        missing = [f for f in _EVENT_FIELDS if f not in rec]
+        if missing:
+            errors.append(f"events: line {i} missing fields {missing}")
+            continue
+        if rec["dur_s"] < -1e-9 or rec["end_mono_s"] < rec["start_mono_s"]:
+            errors.append(f"events: line {i} has negative duration")
+        records.append(rec)
+    if header.get("dropped", 0):
+        # The event log is a ring: once records were evicted, a child
+        # span's parent may legitimately be gone — dangling references
+        # are expected on exactly the long runs the ring exists for.
+        return errors
+    ids = {r["span_id"] for r in records}
+    for r in records:
+        parent = r.get("parent_id")
+        if parent is not None and parent not in ids:
+            errors.append(
+                f"events: span {r['span_id']} ({r['name']}) references "
+                f"unknown parent {parent}"
+            )
+    return errors
+
+
+def validate_pair(metrics_path: str, events_path: str,
+                  require_stages: list[str] | None = None) -> list[str]:
+    errors: list[str] = []
+    try:
+        with open(metrics_path) as f:
+            snap = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"metrics: cannot read {metrics_path}: {e}"]
+    errors += validate_metrics(snap, require_stages=require_stages)
+    try:
+        with open(events_path) as f:
+            lines = f.read().splitlines()
+    except OSError as e:
+        return errors + [f"events: cannot read {events_path}: {e}"]
+    errors += validate_events(lines)
+    return errors
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("paths", nargs="+",
+                    help="a results/ directory, or metrics.json events.jsonl")
+    ap.add_argument("--require-stages", default=None,
+                    help="comma-separated stage names that must appear in "
+                         "sweep_stage_total")
+    args = ap.parse_args(argv)
+    if len(args.paths) == 1 and os.path.isdir(args.paths[0]):
+        metrics_path = os.path.join(args.paths[0], "metrics.json")
+        events_path = os.path.join(args.paths[0], "events.jsonl")
+    elif len(args.paths) == 2:
+        metrics_path, events_path = args.paths
+    else:
+        ap.error("pass a directory or exactly two file paths")
+    stages = (
+        [s for s in args.require_stages.split(",") if s]
+        if args.require_stages else None
+    )
+    errors = validate_pair(metrics_path, events_path, require_stages=stages)
+    for e in errors:
+        print(f"FAIL {e}", file=sys.stderr)
+    if errors:
+        return 1
+    print(f"OK {metrics_path} + {events_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

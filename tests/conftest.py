@@ -5,9 +5,16 @@ reference lacks). Must run before JAX initializes its backend."""
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+from ate_replication_causalml_tpu.utils.hostdevices import (
+    xla_flags_with_device_count,
+)
+
+# REPLACE any inherited device-count flag (appending only-if-absent
+# keeps a smaller inherited count and silently under-provisions every
+# mesh test — see utils/hostdevices.py). On old jax this flag is the
+# only provisioning path; XLA reads it at backend init, after imports.
+_flags, _ = xla_flags_with_device_count(os.environ.get("XLA_FLAGS", ""), 8)
 if "xla_backend_optimization_level" not in _flags:
     # The suite is ~90% XLA:CPU compile (round 5: the module-standard
     # causal fit measured 63 s cold / 6.4 s warm). Opt level 1 HALVES
@@ -23,7 +30,12 @@ os.environ["XLA_FLAGS"] = _flags
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Older jax: no such option — the XLA_FLAGS device-count override
+    # above is what actually provisions the 8 virtual devices there.
+    pass
 
 # Persistent XLA compilation cache — OPT-IN via ATE_TEST_CACHE=1.
 # Round 3 hit reproducible late-suite segfaults on this image's jaxlib.

@@ -13,6 +13,7 @@ import textwrap
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from ate_replication_causalml_tpu.parallel.mesh import BOOT_AXIS, DATA_AXIS
 from ate_replication_causalml_tpu.parallel.multihost import init_multihost, make_pod_mesh
@@ -23,9 +24,14 @@ _CHILD = textwrap.dedent(
     """
     import sys
     proc_id, port = int(sys.argv[1]), sys.argv[2]
+    from ate_replication_causalml_tpu.utils.hostdevices import (
+        force_host_device_count,
+    )
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    # Exactly 2 (not keep_larger): the assertions below pin the world
+    # shape, and the pytest parent's XLA_FLAGS carries an inherited 8.
+    force_host_device_count(2)
     import numpy as np
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -101,6 +107,13 @@ def test_two_process_distributed_bootstrap_and_psum():
         for p in procs:
             p.kill()
         raise AssertionError(f"2-process run hung; partial output: {outs}")
+    if any("Multiprocess computations aren't implemented" in o for o in outs):
+        # This jaxlib's CPU backend has no cross-process collective
+        # support at all (observed on jaxlib 0.4.36: the distributed
+        # runtime initializes, then the first global computation raises
+        # INVALID_ARGUMENT). Capability-gate rather than fail — on pod
+        # images the test runs in full.
+        pytest.skip("this jaxlib cannot run cross-process collectives on CPU")
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {pid} failed:\n{out}"
         assert f"CHILD_OK {pid}" in out, out

@@ -1,0 +1,336 @@
+"""Telemetry-layer tests (observability/): registry semantics, span
+nesting, disabled-mode no-ops, atomic writes, the retry/dispatch/cache
+instrumentation, and the quick-sweep integration contract — metrics.json
+and events.jsonl written beside report.json, valid under
+scripts/check_metrics_schema.py, with per-stage records for every
+``SWEEP_METHODS`` entry and bit-identical estimator output with
+telemetry on vs off."""
+
+import dataclasses
+import json
+import os
+import sys
+
+import pytest
+
+from ate_replication_causalml_tpu import observability as obs
+from ate_replication_causalml_tpu.observability.events import EventLog
+from ate_replication_causalml_tpu.observability.registry import MetricsRegistry
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+import check_metrics_schema as cms  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    """Each test starts from an empty global registry/event log with
+    telemetry ON (the env default), and leaves no override behind."""
+    obs.set_enabled(True)
+    obs.REGISTRY.reset()
+    obs.EVENTS.clear()
+    yield
+    obs.set_enabled(None)
+
+
+# ── registry semantics ──────────────────────────────────────────────────
+
+
+def test_counter_gauge_histogram_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("hits", "help text")
+    c.inc()
+    c.inc(2.5, pool="a")
+    c.inc(0, pool="b")  # pre-created, exported as explicit zero
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = reg.gauge("level")
+    g.set(3, k="v")
+    g.set(7, k="v")  # last write wins
+    g.add(1.5)
+    h = reg.histogram("lat")
+    for v in (2.0, 0.5, 4.0):
+        h.observe(v, op="x")
+    snap = reg.snapshot()
+    assert snap["schema_version"] == obs.SCHEMA_VERSION
+    assert snap["counters"]["hits"] == {"": 1.0, "pool=a": 2.5, "pool=b": 0.0}
+    assert snap["gauges"]["level"] == {"k=v": 7.0, "": 1.5}
+    s = snap["histograms"]["lat"]["op=x"]
+    assert (s["count"], s["sum"], s["min"], s["max"], s["last"]) == (3, 6.5, 0.5, 4.0, 4.0)
+    # A name cannot change kind.
+    with pytest.raises(TypeError):
+        reg.gauge("hits")
+    # Same name + kind returns the same metric object.
+    assert reg.counter("hits") is c
+
+
+def test_collector_runs_at_snapshot_and_is_crash_proof():
+    reg = MetricsRegistry()
+    reg.add_collector(lambda: reg.gauge("scanned").set(42))
+    reg.add_collector(lambda: 1 / 0)  # must not take down the snapshot
+    assert reg.snapshot()["gauges"]["scanned"] == {"": 42.0}
+
+
+def test_sanitize_label():
+    assert obs.sanitize_label("Causal Forest(GRF)") == "Causal_Forest_GRF_"
+    assert obs.sanitize_label("Belloni et.al") == "Belloni_et_al"
+    assert obs.sanitize_label("ok_name-9") == "ok_name-9"
+
+
+# ── event log / spans ───────────────────────────────────────────────────
+
+
+def test_span_nesting_and_jsonl_roundtrip():
+    log = EventLog()
+    with log.span("outer", run="r1"):
+        with log.span("inner") as sp:
+            sp.set_status("computed")
+            sp.set_attr("method", "naive")
+        log.emit("ping", status="event", n=1)
+    recs = log.records()
+    # Children close (and record) before their parent.
+    assert [r["name"] for r in recs] == ["inner", "ping", "outer"]
+    outer = recs[2]
+    assert recs[0]["parent_id"] == outer["span_id"]
+    assert recs[1]["parent_id"] == outer["span_id"]
+    assert outer["parent_id"] is None
+    assert recs[0]["status"] == "computed"
+    assert recs[0]["attrs"]["method"] == "naive"
+    assert all(r["end_mono_s"] >= r["start_mono_s"] for r in recs)
+    # JSONL: versioned header + one record per line, schema-clean.
+    lines = log.to_jsonl().splitlines()
+    assert json.loads(lines[0])["kind"] == "events_header"
+    assert cms.validate_events(lines) == []
+
+
+def test_span_error_status_propagates():
+    log = EventLog()
+    with pytest.raises(RuntimeError):
+        with log.span("boom"):
+            raise RuntimeError("x")
+    (rec,) = log.records()
+    assert rec["status"] == "error"
+    assert rec["attrs"]["error_type"] == "RuntimeError"
+
+
+def test_event_log_ring_buffer_evicts_oldest():
+    log = EventLog(max_events=2)
+    for i in range(5):
+        log.emit("e", i=i)
+    # True ring: the NEWEST records survive (the tail of a dying run is
+    # the diagnostic part); evictions are counted.
+    assert [r["attrs"]["i"] for r in log.records()] == [3, 4]
+    assert log.dropped == 3
+    assert json.loads(log.to_jsonl().splitlines()[0])["dropped"] == 3
+
+
+# ── disabled mode ───────────────────────────────────────────────────────
+
+
+def test_disabled_mode_is_a_noop(tmp_path):
+    obs.set_enabled(False)
+    obs.counter("c").inc(5)
+    obs.gauge("g").set(1)
+    obs.histogram("h").observe(2)
+    with obs.span("s") as sp:
+        sp.set_status("anything")  # must not raise on the null span
+        sp.set_attr("k", "v")
+    obs.emit("e")
+    snap = obs.REGISTRY.snapshot()
+    assert snap["counters"] == {} and snap["gauges"] == {}
+    assert obs.EVENTS.records() == []
+    # Exporters write nothing — no empty husk files.
+    assert obs.write_run_artifacts(str(tmp_path)) == []
+    assert os.listdir(tmp_path) == []
+    # instrument_dispatch returns the function unwrapped.
+    fn = lambda i: i
+    assert obs.instrument_dispatch("kind", fn) is fn
+
+
+def test_env_var_controls_enabled(monkeypatch):
+    obs.set_enabled(None)
+    monkeypatch.setenv("ATE_TPU_TELEMETRY", "0")
+    assert obs.enabled() is False
+    obs.set_enabled(None)
+    monkeypatch.setenv("ATE_TPU_TELEMETRY", "1")
+    assert obs.enabled() is True
+
+
+# ── atomic writes ───────────────────────────────────────────────────────
+
+
+def test_atomic_write_json_no_tmp_residue(tmp_path):
+    path = str(tmp_path / "sub" / "x.json")
+    obs.atomic_write_json(path, {"a": [1, 2]})
+    assert json.load(open(path)) == {"a": [1, 2]}
+    obs.atomic_write_json(path, {"a": 3})  # overwrite in place
+    assert json.load(open(path)) == {"a": 3}
+    assert os.listdir(os.path.dirname(path)) == ["x.json"]
+
+
+def test_stage_timer_dump_is_valid_json(tmp_path):
+    from ate_replication_causalml_tpu.utils.profiling import StageTimer
+
+    t = StageTimer()
+    with t.stage("a"):
+        pass
+    path = str(tmp_path / "timings.json")
+    t.dump(path)
+    assert set(json.load(open(path))) == {"a"}
+    # The stage also landed in the registry histogram and event log.
+    snap = obs.REGISTRY.snapshot()
+    assert "stage=a" in snap["histograms"]["stage_seconds"]
+    assert any(r["name"] == "stage" for r in obs.EVENTS.records())
+
+
+# ── retry / dispatch instrumentation ────────────────────────────────────
+
+
+def test_run_shards_healthy_exports_zero_retry_counters():
+    from ate_replication_causalml_tpu.parallel.retry import run_shards
+
+    outs = run_shards(lambda i: i, 3, pool="p0")
+    assert [o.result for o in outs] == [0, 1, 2]
+    c = obs.REGISTRY.snapshot()["counters"]
+    assert c["shard_attempts_total"]["pool=p0"] == 3.0
+    # Present-but-zero: a healthy run still exports the retry keys.
+    assert c["shard_retries_total"]["pool=p0"] == 0.0
+    assert c["shard_failures_total"]["pool=p0"] == 0.0
+    assert c["shard_backoff_seconds_total"]["pool=p0"] == 0.0
+
+
+def test_run_shards_counts_retries_failures_and_events():
+    from ate_replication_causalml_tpu.parallel.retry import (
+        inject_failures,
+        run_shards,
+    )
+
+    fn = inject_failures(lambda i: i, {0: 1, 2: 5})
+    outs = run_shards(fn, 3, max_attempts=3, backoff_s=0.001, pool="p1")
+    assert outs[0].ok and outs[1].ok and not outs[2].ok
+    c = obs.REGISTRY.snapshot()["counters"]
+    # shard0: 2 attempts; shard1: 1; shard2: 3.
+    assert c["shard_attempts_total"]["pool=p1"] == 6.0
+    # shard0 retried once, shard2 twice.
+    assert c["shard_retries_total"]["pool=p1"] == 3.0
+    assert c["shard_failures_total"]["pool=p1"] == 1.0
+    assert c["shard_backoff_seconds_total"]["pool=p1"] > 0.0
+    names = [r["name"] for r in obs.EVENTS.records()]
+    assert names.count("shard_retry") == 3
+    assert names.count("shard_failed") == 1
+
+
+def test_instrument_dispatch_records_counts_and_durations():
+    wrapped = obs.instrument_dispatch("fitX", lambda i: i * 2)
+    assert [wrapped(i) for i in range(4)] == [0, 2, 4, 6]
+    snap = obs.REGISTRY.snapshot()
+    assert snap["counters"]["tree_dispatch_total"]["fit=fitX"] == 4.0
+    assert snap["histograms"]["tree_dispatch_seconds"]["fit=fitX"]["count"] == 4
+
+
+# ── promtext / schema checker ───────────────────────────────────────────
+
+
+def test_promtext_renders_and_escapes():
+    obs.counter("req_total").inc(3, method='Causal Forest("GRF")')
+    obs.gauge("mem").set(1.0)
+    obs.histogram("lat").observe(0.5, op="fit")
+    from ate_replication_causalml_tpu.observability.promtext import (
+        render_prom_text,
+    )
+
+    text = render_prom_text()
+    assert "# TYPE ate_tpu_req_total counter" in text
+    assert 'method="Causal Forest(\\"GRF\\")"' in text
+    assert "ate_tpu_lat_count" in text and "ate_tpu_lat_sum" in text
+
+
+def test_check_metrics_schema_cli_roundtrip(tmp_path):
+    # Build a registry that satisfies the required families, export it,
+    # and run the standalone checker exactly as CI/ops would.
+    from ate_replication_causalml_tpu.parallel.retry import run_shards
+
+    obs.install_jax_monitoring()
+    run_shards(lambda i: i, 1)
+    with obs.span("root"):
+        obs.emit("child")
+    paths = obs.write_run_artifacts(str(tmp_path))
+    assert [os.path.basename(p) for p in paths] == [
+        "metrics.json", "events.jsonl", "metrics.prom",
+    ]
+    assert cms.main([str(tmp_path)]) == 0
+    # A truncated metrics.json must fail loudly.
+    with open(os.path.join(tmp_path, "metrics.json"), "w") as f:
+        f.write('{"schema_version": 1')
+    assert cms.main([str(tmp_path)]) == 1
+
+
+# ── quick-sweep integration ─────────────────────────────────────────────
+
+
+def test_quick_sweep_telemetry_integration(tmp_path):
+    """One MICRO sweep (same shapes as test_pipeline_driver's, so the
+    in-process executables are shared): the telemetry artifacts land
+    beside report.json, pass the schema checker with every SWEEP_METHODS
+    stage plus the oracle, and carry dispatch/retry/cache counters. A
+    resume run re-exports with status=resumed stages, and a
+    telemetry-off run produces bit-identical estimator output with no
+    artifacts."""
+    from test_pipeline_driver import MICRO
+
+    from ate_replication_causalml_tpu.pipeline import SWEEP_METHODS, run_sweep
+
+    out = str(tmp_path / "sweep")
+    report = run_sweep(MICRO, outdir=out, plots=False, log=lambda s: None)
+
+    mpath = os.path.join(out, "metrics.json")
+    epath = os.path.join(out, "events.jsonl")
+    required = list(SWEEP_METHODS) + ["oracle"]
+    errors = cms.validate_pair(mpath, epath, require_stages=required)
+    assert errors == [], errors
+    assert os.path.exists(os.path.join(out, "metrics.prom"))
+
+    snap = json.load(open(mpath))
+    stage_samples = snap["counters"]["sweep_stage_total"]
+    for m in required:
+        assert stage_samples.get(f"method={m},status=computed") == 1.0, m
+    # Forest fits dispatched through the instrumented elastic loop.
+    assert sum(snap["counters"]["tree_dispatch_total"].values()) > 0
+    assert sum(snap["counters"]["shard_attempts_total"].values()) > 0
+    # Healthy run: retry counters present AND zero.
+    assert sum(snap["counters"]["shard_retries_total"].values()) == 0.0
+    # Compile-cache counters present (zero here: the test harness runs
+    # cache-less by design — presence is the contract).
+    assert "compile_cache_hits_total" in snap["counters"]
+    assert "compile_cache_misses_total" in snap["counters"]
+
+    # events.jsonl: a sweep_stage span per stage, nested under run_sweep.
+    recs = [json.loads(l) for l in open(epath).read().splitlines()[1:]]
+    by_id = {r["span_id"]: r for r in recs}
+    stages = [r for r in recs if r["name"] == "sweep_stage"]
+    assert sorted(r["attrs"]["method"] for r in stages) == sorted(required)
+    for r in stages:
+        assert r["status"] == "computed"
+        assert by_id[r["parent_id"]]["name"] == "run_sweep"
+
+    # Resume: stages re-export as status=resumed.
+    obs.REGISTRY.reset()
+    obs.EVENTS.clear()
+    report2 = run_sweep(MICRO, outdir=out, plots=False, log=lambda s: None)
+    snap2 = json.load(open(mpath))
+    for m in required:
+        key = f"method={m},status=resumed"
+        assert snap2["counters"]["sweep_stage_total"].get(key) == 1.0, m
+
+    # Telemetry off: the driver writes no artifacts and returns the
+    # same numbers (run via the resume path — the disabled-mode
+    # mutators are unit-tested above; estimator numerics never see
+    # telemetry at all, it is host-side only).
+    obs.set_enabled(False)
+    for name in ("metrics.json", "events.jsonl", "metrics.prom"):
+        os.remove(os.path.join(out, name))
+    report3 = run_sweep(MICRO, outdir=out, plots=False, log=lambda s: None)
+    assert not os.path.exists(os.path.join(out, "metrics.json"))
+    assert not os.path.exists(os.path.join(out, "events.jsonl"))
+    for m in SWEEP_METHODS:
+        assert report3.results[m].ate == report2.results[m].ate == report.results[m].ate
+    assert report3.oracle.ate == report.oracle.ate

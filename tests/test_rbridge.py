@@ -56,6 +56,7 @@ def test_lasso_family(dataset):
     assert p.shape == (1200,)
 
 
+@pytest.mark.slow
 def test_aipw_and_forest(dataset):
     _check_row(rbridge.doubly_robust_glm(dataset),
                "Doubly Robust with logistic regression PS")
@@ -66,6 +67,7 @@ def test_aipw_and_forest(dataset):
     assert np.isfinite(row["incorrect_ate"]) and row["incorrect_se"] >= 0
 
 
+@pytest.mark.slow
 def test_dml_and_balance(dataset):
     _check_row(rbridge.double_ml(dataset, num_trees=16),
                "Double Machine Learning")
@@ -73,6 +75,7 @@ def test_dml_and_balance(dataset):
     _check_row(rbridge.belloni(dataset), "Belloni et.al")
 
 
+@pytest.mark.slow
 def test_run_notebook_sweep_quick(tmp_path):
     """The R notebook's one-call driver: full sweep rows in rbind-ready
     form, quick config with the caller's n_obs actually honored."""

@@ -17,6 +17,7 @@ import os
 import re
 
 import numpy as np
+import pytest
 
 from ate_replication_causalml_tpu import rbridge
 
@@ -159,6 +160,7 @@ def test_bridge_accepts_every_shim_knob():
             assert knob in params, f"rbridge.{target.__name__} lacks {knob!r}"
 
 
+@pytest.mark.slow
 def test_compat_knob_values_change_results():
     """End to end through the bridge payload contract: the corrected
     modes must be selectable and (on a confounded panel) move the
