@@ -1,0 +1,287 @@
+"""graftlint core: findings, suppressions, module model, rule registry.
+
+An AST-based lint framework for the failure classes that cost TPU runs
+silently instead of loudly: jit caches keyed on ambient backend state,
+PRNG keys spent twice, dtype drift against the x64 policy, torn file
+writes, unlocked shared state. Rules live in
+:mod:`ate_replication_causalml_tpu.analysis.rules`; the CLI is
+``scripts/graftlint.py``.
+
+Deliberately stdlib-only (``ast`` + ``tokenize``): the linter must run
+in CI images and pre-commit hooks without importing jax — importing the
+package under analysis could itself initialize a backend.
+
+Suppression syntax (checked by tests/test_graftlint.py):
+
+* ``code  # graftlint: disable=JGL001`` — suppress on this line
+  (comma-separated rule ids, or ``all``);
+* a comment-only line ``# graftlint: disable=JGL001`` suppresses the
+  next line;
+* ``# graftlint: disable-file=JGL004`` anywhere — suppress the rule for
+  the whole file.
+
+Suppressed findings are retained (``LintResult.suppressed``) so the
+reporters can show what the comments are holding back.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Iterable, Iterator
+
+#: Rule id for files the parser itself rejects — always active, never
+#: suppressible (a file that does not parse cannot carry comments we
+#: trust).
+PARSE_ERROR_ID = "JGL000"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*(disable|disable-file)\s*=\s*"
+    r"([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+class Suppressions:
+    """Per-file suppression state parsed from ``# graftlint:`` comments.
+
+    Comments are found with :mod:`tokenize` (not a substring scan) so a
+    ``#`` inside a string literal can never disable a rule.
+    """
+
+    def __init__(self, source: str):
+        self.file_rules: set[str] = set()
+        self.line_rules: dict[int, set[str]] = {}
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if not m:
+                    continue
+                kind = m.group(1)
+                rules = {r.strip() for r in m.group(2).split(",") if r.strip()}
+                if kind == "disable-file":
+                    self.file_rules |= rules
+                    continue
+                line = tok.start[0]
+                self.line_rules.setdefault(line, set()).update(rules)
+                # A comment-only line shields the line below it.
+                if tok.line[: tok.start[1]].strip() == "":
+                    self.line_rules.setdefault(line + 1, set()).update(rules)
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            pass  # unparsable files are reported as JGL000 elsewhere
+
+    def covers(self, rule: str, line: int) -> bool:
+        if rule == PARSE_ERROR_ID:
+            return False
+        for rules in (self.file_rules, self.line_rules.get(line, ())):
+            if rule in rules or "all" in rules:
+                return True
+        return False
+
+
+class ModuleInfo:
+    """Parsed module plus the name-resolution context rules share."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.suppressions = Suppressions(source)
+        # alias -> canonical dotted prefix, e.g. jnp -> jax.numpy,
+        # lax -> jax.lax, environ -> os.environ, partial ->
+        # functools.partial. Collected from every import in the module
+        # (function-local imports included: resolution is name-based,
+        # not scope-exact, which is the right precision for linting).
+        self.aliases: dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    def resolve(self, node: ast.AST) -> str | None:
+        """Canonical dotted name of a Name/Attribute chain, with the
+        leading alias expanded (``jnp.float64`` -> ``jax.numpy.float64``),
+        or None for non-name expressions."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(node.id)
+        parts.reverse()
+        head = self.aliases.get(parts[0], parts[0])
+        return ".".join([head] + parts[1:])
+
+
+class Rule:
+    """Base class: subclasses set ``id``/``name``/``description`` and
+    implement :meth:`check`."""
+
+    id: str = ""
+    name: str = ""
+    description: str = ""
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, module: ModuleInfo, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            rule=self.id,
+            path=module.relpath,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0) + 1,
+            message=message,
+        )
+
+
+#: Registered rule classes keyed by id (populated by @register).
+RULES: dict[str, type[Rule]] = {}
+
+
+def register(cls: type[Rule]) -> type[Rule]:
+    if not cls.id or cls.id in RULES:
+        raise ValueError(f"rule id {cls.id!r} missing or already registered")
+    RULES[cls.id] = cls
+    return cls
+
+
+@dataclasses.dataclass
+class LintResult:
+    """Aggregate outcome of a lint run."""
+
+    findings: list[Finding] = dataclasses.field(default_factory=list)
+    suppressed: list[Finding] = dataclasses.field(default_factory=list)
+    files: int = 0
+
+    def extend(self, other: "LintResult") -> None:
+        self.findings.extend(other.findings)
+        self.suppressed.extend(other.suppressed)
+        self.files += other.files
+
+    def sort(self) -> None:
+        key = lambda f: (f.path, f.line, f.col, f.rule)
+        self.findings.sort(key=key)
+        self.suppressed.sort(key=key)
+
+
+def _active_rules(select: Iterable[str] | None) -> list[Rule]:
+    ids = list(RULES) if select is None else list(select)
+    unknown = [i for i in ids if i not in RULES]
+    if unknown:
+        raise ValueError(f"unknown rule id(s): {', '.join(unknown)}")
+    return [RULES[i]() for i in ids]
+
+
+def lint_source(
+    source: str,
+    path: str = "<string>",
+    relpath: str | None = None,
+    select: Iterable[str] | None = None,
+) -> LintResult:
+    """Lint one source string. ``relpath`` is what path-scoped rules
+    (JGL004/005/006) match against; defaults to ``path``."""
+    result = LintResult(files=1)
+    try:
+        module = ModuleInfo(path, relpath if relpath is not None else path, source)
+    except (SyntaxError, ValueError) as e:
+        result.findings.append(
+            Finding(
+                rule=PARSE_ERROR_ID,
+                path=relpath if relpath is not None else path,
+                line=getattr(e, "lineno", None) or 1,
+                col=(getattr(e, "offset", None) or 1),
+                message=f"file does not parse: {e.msg if isinstance(e, SyntaxError) else e}",
+            )
+        )
+        return result
+    for rule in _active_rules(select):
+        for f in rule.check(module):
+            if module.suppressions.covers(f.rule, f.line):
+                result.suppressed.append(f)
+            else:
+                result.findings.append(f)
+    result.sort()
+    return result
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
+    """Expand files/directories into .py files, sorted, skipping
+    __pycache__ and hidden directories."""
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(
+                d for d in dirs if d != "__pycache__" and not d.startswith(".")
+            )
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    yield os.path.join(root, name)
+
+
+def lint_paths(
+    paths: Iterable[str],
+    select: Iterable[str] | None = None,
+    root: str | None = None,
+) -> LintResult:
+    """Lint files/directories. ``root`` anchors the relative paths used
+    both for reporting and for the path-scoped rules (default: CWD)."""
+    root = os.path.abspath(root or os.getcwd())
+    result = LintResult()
+    paths = list(paths)
+    for p in paths:
+        if not os.path.exists(p):
+            # A vanished path must FAIL the gate, not pass it vacuously
+            # (a package rename would otherwise lint zero files and
+            # report a clean tree forever).
+            result.findings.append(
+                Finding(PARSE_ERROR_ID, p, 1, 1, "path does not exist")
+            )
+    for path in iter_py_files(paths):
+        ap = os.path.abspath(path)
+        rel = os.path.relpath(ap, root) if ap.startswith(root + os.sep) else path
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except OSError as e:
+            result.findings.append(
+                Finding(PARSE_ERROR_ID, rel, 1, 1, f"unreadable file: {e}")
+            )
+            result.files += 1
+            continue
+        result.extend(lint_source(source, path=path, relpath=rel, select=select))
+    result.sort()
+    return result

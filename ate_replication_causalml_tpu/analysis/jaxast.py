@@ -1,0 +1,260 @@
+"""Shared AST machinery for the JAX-aware rules: jit detection, the
+per-module trace reachability graph, and mutable-global discovery.
+
+Terminology: a function is a *trace root* when it is decorated with
+``jax.jit``/``pjit`` (directly or through ``functools.partial``) or
+wrapped by a call-form ``jax.jit(fn)``. A function is *traced* when it
+is a root or is referenced (called, vmapped, passed to ``lax.map``,
+captured…) — transitively — from a traced function's own statements.
+Reference-based edges over-approximate calls on purpose: a function
+handed to ``jax.vmap``/``lax.scan`` is traced without a direct call
+node, and a false edge costs at most one suppressible finding, while a
+missed edge silently waives the rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterator
+
+from ate_replication_causalml_tpu.analysis.core import ModuleInfo
+
+JIT_NAMES = {
+    "jax.jit",
+    "jax.pjit",
+    "jax.experimental.pjit.pjit",
+    "pjit.pjit",
+}
+
+_PARTIAL_NAMES = {"functools.partial"}
+
+#: In-place container mutators — shared by the mutable-global discovery
+#: here and JGL006's unlocked-mutation detection (one list, no drift).
+MUTATOR_METHODS = {
+    "append", "extend", "insert", "pop", "popleft", "remove", "clear",
+    "update", "setdefault", "add", "discard", "appendleft", "popitem",
+}
+
+FunctionNode = ast.FunctionDef | ast.AsyncFunctionDef
+
+
+@dataclasses.dataclass
+class FunctionRecord:
+    node: FunctionNode
+    qualname: str
+    parent: str | None  # enclosing function qualname, if nested
+    jitted: bool = False
+    static_names: set[str] = dataclasses.field(default_factory=set)
+    #: bare names referenced (Load context) in this function's own
+    #: statements, nested defs excluded — the call-graph edge source.
+    refs: set[str] = dataclasses.field(default_factory=set)
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    def param_names(self) -> list[str]:
+        a = self.node.args
+        return [x.arg for x in a.posonlyargs + a.args + a.kwonlyargs]
+
+    def traced_params(self) -> set[str]:
+        """Parameter names that are tracers inside the jitted body."""
+        out = set(self.param_names()) - self.static_names
+        out.discard("self")
+        out.discard("cls")
+        return out
+
+
+def _static_arg_values(call: ast.Call) -> tuple[set[str], set[int]]:
+    names: set[str] = set()
+    nums: set[int] = set()
+    for kw in call.keywords:
+        vals: list[ast.expr]
+        if isinstance(kw.value, (ast.Tuple, ast.List)):
+            vals = list(kw.value.elts)
+        else:
+            vals = [kw.value]
+        if kw.arg == "static_argnames":
+            names |= {
+                v.value
+                for v in vals
+                if isinstance(v, ast.Constant) and isinstance(v.value, str)
+            }
+        elif kw.arg == "static_argnums":
+            nums |= {
+                v.value
+                for v in vals
+                if isinstance(v, ast.Constant) and isinstance(v.value, int)
+            }
+    return names, nums
+
+
+def jit_decorator_statics(
+    module: ModuleInfo, deco: ast.expr
+) -> tuple[set[str], set[int]] | None:
+    """``(static_argnames, static_argnums)`` when ``deco`` is a jit/pjit
+    decorator (bare, called, or via functools.partial); None otherwise."""
+    if module.resolve(deco) in JIT_NAMES:
+        return set(), set()
+    if isinstance(deco, ast.Call):
+        fr = module.resolve(deco.func)
+        if fr in JIT_NAMES:
+            return _static_arg_values(deco)
+        if fr in _PARTIAL_NAMES and deco.args:
+            if module.resolve(deco.args[0]) in JIT_NAMES:
+                return _static_arg_values(deco)
+    return None
+
+
+def own_statements(fn: FunctionNode) -> Iterator[ast.AST]:
+    """Every node lexically in ``fn`` excluding nested function/class
+    bodies (those are analyzed as their own scopes) — but including
+    nested lambdas, which stay part of the enclosing scope."""
+    stack: list[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            stack.append(child)
+
+
+def collect_functions(module: ModuleInfo) -> dict[str, FunctionRecord]:
+    """All function defs (any nesting), keyed by dotted qualname.
+
+    Memoized per ModuleInfo: several rules need the table, and the
+    records are never mutated after collection (``traced_functions``
+    returns its reachability verdicts separately), so sharing is safe.
+    """
+    cached = getattr(module, "_graftlint_functions", None)
+    if cached is not None:
+        return cached
+    records: dict[str, FunctionRecord] = {}
+
+    def visit(node: ast.AST, prefix: str, parent: str | None) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                rec = FunctionRecord(node=child, qualname=qual, parent=parent)
+                for deco in child.decorator_list:
+                    statics = jit_decorator_statics(module, deco)
+                    if statics is not None:
+                        rec.jitted = True
+                        names, nums = statics
+                        params = rec.param_names()
+                        rec.static_names |= names
+                        rec.static_names |= {
+                            params[i] for i in nums if i < len(params)
+                        }
+                for sub in own_statements(child):
+                    if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                        rec.refs.add(sub.id)
+                records[qual] = rec
+                visit(child, qual + ".", qual)
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.", parent)
+            else:
+                visit(child, prefix, parent)
+
+    visit(module.tree, "", None)
+    module._graftlint_functions = records
+    return records
+
+
+def call_form_jit_roots(
+    module: ModuleInfo, records: dict[str, FunctionRecord]
+) -> dict[str, tuple[set[str], set[int]]]:
+    """Functions wrapped by call-form ``jax.jit(fn)`` anywhere in the
+    module (e.g. ``return jax.jit(run)`` in a cached factory), mapped
+    to the ``(static_argnames, static_argnums)`` of the wrapping call."""
+    by_name: dict[str, list[str]] = {}
+    for qual, rec in records.items():
+        by_name.setdefault(rec.name, []).append(qual)
+    roots: dict[str, tuple[set[str], set[int]]] = {}
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Call) and module.resolve(node.func) in JIT_NAMES):
+            continue
+        statics = _static_arg_values(node)
+        for arg in node.args[:1]:
+            if isinstance(arg, ast.Name):
+                for qual in by_name.get(arg.id, ()):
+                    roots[qual] = statics
+    return roots
+
+
+def traced_functions(
+    module: ModuleInfo, records: dict[str, FunctionRecord]
+) -> dict[str, str | None]:
+    """Reachability verdicts: ``qualname -> None`` for trace roots,
+    ``qualname -> root_qualname`` for functions reached transitively.
+    Pure — ``records`` (shared via the collect_functions memo) is
+    never mutated."""
+    by_name: dict[str, list[str]] = {}
+    for qual, rec in records.items():
+        by_name.setdefault(rec.name, []).append(qual)
+
+    roots = {q for q, r in records.items() if r.jitted}
+    roots |= set(call_form_jit_roots(module, records))
+
+    traced: dict[str, str | None] = {}
+    frontier: list[tuple[str, str]] = [(q, q) for q in sorted(roots)]
+    while frontier:
+        qual, root = frontier.pop()
+        if qual in traced:
+            continue
+        traced[qual] = None if qual in roots else root
+        for name in records[qual].refs:
+            for callee in by_name.get(name, ()):
+                if callee not in traced and callee != qual:
+                    frontier.append((callee, root))
+    return traced
+
+
+def mutable_globals(module: ModuleInfo) -> set[str]:
+    """Module-level names that behave like ambient mutable state:
+    rebound more than once at module scope, rebound through ``global``,
+    or module-level containers that some code mutates in place."""
+    assign_counts: dict[str, int] = {}
+    container_names: set[str] = set()
+    for node in module.tree.body:
+        targets: list[ast.expr] = []
+        value: ast.expr | None = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            targets, value = [node.target], getattr(node, "value", None)
+        for t in targets:
+            if isinstance(t, ast.Name):
+                assign_counts[t.id] = assign_counts.get(t.id, 0) + 1
+                if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                                      ast.DictComp, ast.SetComp)):
+                    container_names.add(t.id)
+                elif isinstance(value, ast.Call) and (
+                    module.resolve(value.func)
+                    in {
+                        "dict", "list", "set", "collections.deque",
+                        "collections.defaultdict", "collections.OrderedDict",
+                    }
+                ):
+                    container_names.add(t.id)
+
+    mutable = {n for n, c in assign_counts.items() if c > 1}
+    mutated_containers: set[str] = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Global):
+            mutable.update(node.names)
+        elif isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
+            targets = node.targets if isinstance(node, ast.Assign) else (
+                [node.target] if isinstance(node, ast.AugAssign) else node.targets
+            )
+            for t in targets:
+                if isinstance(t, ast.Subscript) and isinstance(t.value, ast.Name):
+                    mutated_containers.add(t.value.id)
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            base = node.func.value
+            if isinstance(base, ast.Name) and node.func.attr in MUTATOR_METHODS:
+                mutated_containers.add(base.id)
+    mutable |= container_names & mutated_containers
+    return mutable

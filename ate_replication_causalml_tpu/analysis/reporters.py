@@ -1,0 +1,51 @@
+"""Finding reporters: human-readable text and machine-readable JSON."""
+
+from __future__ import annotations
+
+import json
+
+from ate_replication_causalml_tpu.analysis.core import RULES, LintResult
+
+#: Schema version of the JSON report (mirrors the observability
+#: artifact convention: breaking layout changes bump it).
+REPORT_SCHEMA_VERSION = 1
+
+
+def render_human(result: LintResult, show_suppressed: bool = False) -> str:
+    lines = [f.render() for f in result.findings]
+    if show_suppressed:
+        lines += [f"{f.render()} [suppressed]" for f in result.suppressed]
+    by_rule: dict[str, int] = {}
+    for f in result.findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    breakdown = (
+        " (" + ", ".join(f"{r}×{n}" for r, n in sorted(by_rule.items())) + ")"
+        if by_rule
+        else ""
+    )
+    lines.append(
+        f"graftlint: {len(result.findings)} finding(s){breakdown}, "
+        f"{len(result.suppressed)} suppressed, {result.files} file(s) checked"
+    )
+    return "\n".join(lines)
+
+
+def render_json(result: LintResult) -> str:
+    payload = {
+        "schema_version": REPORT_SCHEMA_VERSION,
+        "files": result.files,
+        "findings": [f.as_dict() for f in result.findings],
+        "suppressed": [f.as_dict() for f in result.suppressed],
+        "rules": {
+            rule_id: {"name": cls.name, "description": cls.description}
+            for rule_id, cls in sorted(RULES.items())
+        },
+    }
+    return json.dumps(payload, indent=1) + "\n"
+
+
+def render_rule_table() -> str:
+    lines = []
+    for rule_id, cls in sorted(RULES.items()):
+        lines.append(f"{rule_id}  {cls.name:<24} {cls.description}")
+    return "\n".join(lines)

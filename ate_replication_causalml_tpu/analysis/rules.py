@@ -1,0 +1,846 @@
+"""The graftlint rule set (JGL001–JGL006).
+
+Each rule targets a failure class that has actually bitten (or nearly
+bitten) this codebase on TPU — see ADVICE.md and the rule docstrings.
+Rules are registered on import; ``core.lint_source`` runs them all
+unless a ``select`` list narrows the set.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Iterator
+
+from ate_replication_causalml_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    register,
+)
+from ate_replication_causalml_tpu.analysis.jaxast import (
+    MUTATOR_METHODS,
+    FunctionRecord,
+    call_form_jit_roots,
+    collect_functions,
+    mutable_globals,
+    own_statements,
+    traced_functions,
+)
+
+# ---------------------------------------------------------------- JGL001
+
+#: Calls whose result depends on ambient process/backend state. Inside
+#: a traced body they execute once, at trace time, and the jit cache is
+#: NOT keyed on them — a later change of the ambient state silently
+#: reuses the stale executable.
+_AMBIENT_CALLS = {
+    "jax.default_backend",
+    "jax.devices",
+    "jax.local_devices",
+    "jax.device_count",
+    "jax.local_device_count",
+    "jax.process_index",
+    "jax.process_count",
+    "os.getenv",
+}
+
+_AMBIENT_READ_PREFIXES = ("os.environ", "jax.config.")
+
+
+@register
+class JitAmbientState(Rule):
+    """ADVICE.md r5's ``quantile_bins`` bug, generalized: a jitted (or
+    transitively traced) function branching on ``jax.default_backend()``
+    / ``os.environ`` / a mutable module global bakes that value into the
+    cached executable without it appearing in the cache key."""
+
+    id = "JGL001"
+    name = "jit-ambient-state"
+    description = (
+        "jit-traced function reads ambient state (backend, environ, "
+        "mutable module global) that is not part of the jit cache key"
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        records = collect_functions(module)
+        traced = traced_functions(module, records)
+        if not traced:
+            return
+        globals_ = mutable_globals(module)
+
+        for qual, via in traced.items():
+            rec = records[qual]
+            where = (
+                f"jitted function '{rec.name}'"
+                if via is None
+                else f"'{rec.name}' (traced via jit of '{via}')"
+            )
+            # Python scoping: a name assigned anywhere in the function
+            # (or a parameter) is LOCAL throughout it — a Load of it
+            # cannot read the like-named module global. `global` decls
+            # re-expose the module binding.
+            local_binds = set(rec.param_names())
+            global_decls: set[str] = set()
+            for n in own_statements(rec.node):
+                if isinstance(n, ast.Global):
+                    global_decls.update(n.names)
+                elif isinstance(n, ast.Name) and isinstance(
+                    n.ctx, (ast.Store, ast.Del)
+                ):
+                    local_binds.add(n.id)
+            local_binds -= global_decls
+            skip: set[int] = set()
+            for node in own_statements(rec.node):
+                if id(node) in skip:
+                    continue
+                if isinstance(node, ast.Call):
+                    fr = module.resolve(node.func)
+                    if fr in _AMBIENT_CALLS or (
+                        fr and fr.startswith("os.environ.")
+                    ):
+                        skip.update(id(d) for d in ast.walk(node.func))
+                        yield self.finding(
+                            module,
+                            node,
+                            f"{where} calls {fr}() at trace time; the jit "
+                            "cache is not keyed on it — hoist the branch "
+                            "into an unjitted dispatcher or pass the value "
+                            "as a static argument",
+                        )
+                elif isinstance(node, (ast.Attribute, ast.Name)):
+                    if not isinstance(getattr(node, "ctx", None), ast.Load):
+                        continue
+                    fr = module.resolve(node)
+                    if fr and (
+                        fr == "os.environ"
+                        or any(fr.startswith(p) for p in _AMBIENT_READ_PREFIXES)
+                    ):
+                        # Attribute chains resolve at every level; flag the
+                        # outermost match once, not its sub-chains too.
+                        skip.update(id(d) for d in ast.walk(node))
+                        yield self.finding(
+                            module,
+                            node,
+                            f"{where} reads ambient state '{fr}' at trace "
+                            "time; the jit cache is not keyed on it",
+                        )
+                    elif (
+                        isinstance(node, ast.Name)
+                        and node.id in globals_
+                        and node.id not in local_binds
+                    ):
+                        yield self.finding(
+                            module,
+                            node,
+                            f"{where} reads mutable module global "
+                            f"'{node.id}' at trace time; the jit cache is "
+                            "not keyed on it",
+                        )
+
+
+# ---------------------------------------------------------------- JGL002
+
+_KEY_PARAM_RE = re.compile(r"^(key|rng|prng\w*|\w*_key|\w*_rng)$")
+
+_KEY_ORIGINS = {
+    "jax.random.key",
+    "jax.random.PRNGKey",
+    "jax.random.fold_in",
+    "jax.random.split",
+    "jax.random.wrap_key_data",
+    "jax.random.clone",
+}
+
+
+def _is_split_call(module: ModuleInfo, node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and module.resolve(node.func) == "jax.random.split"
+    )
+
+
+@register
+class PrngKeyReuse(Rule):
+    """A PRNG key consumed by two ``jax.random`` calls yields correlated
+    draws (identical, for the same distribution/shape) — the classic
+    silent statistics bug. Also flags split results that are partially
+    discarded (``_`` targets, never-read names, ``split(k)[1:]``): key
+    material that vanishes usually means a consumer was dropped or a
+    parent key is being double-spent elsewhere.
+
+    Sanctioned idioms stay quiet: ``key, sub = split(key)`` (rebind in
+    the consuming statement) and ``fold_in(key, i)`` (derivation — its
+    contract is minting many keys from one live parent; only ``split``
+    retires its input)."""
+
+    id = "JGL002"
+    name = "prng-key-reuse"
+    description = (
+        "PRNG key consumed by >=2 jax.random calls, consumed in a loop, "
+        "or split output partially discarded"
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for rec in collect_functions(module).values():
+            yield from self._check_function(module, rec)
+
+    def _check_function(
+        self, module: ModuleInfo, rec: FunctionRecord
+    ) -> Iterator[Finding]:
+        fn = rec.node
+        # All names read anywhere in the function (nested defs included:
+        # closures legitimately consume enclosing keys).
+        loads = {
+            n.id
+            for n in ast.walk(fn)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+        }
+        # name -> (bound_loop_depth, consumed_count, first_use_line)
+        state: dict[str, list] = {
+            p: [0, 0, 0] for p in rec.param_names() if _KEY_PARAM_RE.match(p)
+        }
+        findings: list[Finding] = []
+
+        def bind(name: str, depth: int) -> None:
+            state[name] = [depth, 0, 0]
+
+        def unbind(name: str) -> None:
+            state.pop(name, None)
+
+        def consume(name: str, node: ast.AST, depth: int) -> None:
+            st = state.get(name)
+            if st is None:
+                return
+            st[1] += 1
+            if st[1] == 1:
+                st[2] = node.lineno
+                if depth > st[0]:
+                    findings.append(
+                        self.finding(
+                            module,
+                            node,
+                            f"PRNG key '{name}' is consumed inside a loop "
+                            "but bound outside it — every iteration reuses "
+                            "the same key; split or fold_in per iteration",
+                        )
+                    )
+            elif st[1] == 2:
+                findings.append(
+                    self.finding(
+                        module,
+                        node,
+                        f"PRNG key '{name}' is consumed by a second "
+                        f"jax.random call (first use at line {st[2]}) — "
+                        "split it and give each consumer its own key",
+                    )
+                )
+
+        def handle_assign(node: ast.Assign | ast.AnnAssign, depth: int) -> None:
+            value = node.value
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            origin = (
+                isinstance(value, ast.Call)
+                and module.resolve(value.func) in _KEY_ORIGINS
+            )
+            sub_of_split = isinstance(value, ast.Subscript) and _is_split_call(
+                module, value.value
+            )
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    if origin or sub_of_split:
+                        bind(t.id, depth)
+                    else:
+                        unbind(t.id)
+                elif isinstance(t, (ast.Tuple, ast.List)) and origin:
+                    split = module.resolve(value.func) == "jax.random.split"
+                    for el in t.elts:
+                        if not isinstance(el, ast.Name):
+                            continue
+                        if split and el.id == "_":
+                            findings.append(
+                                self.finding(
+                                    module,
+                                    el,
+                                    "split output bound to '_' discards key "
+                                    "material — size the split to the "
+                                    "consumers",
+                                )
+                            )
+                        elif split and el.id not in loads:
+                            findings.append(
+                                self.finding(
+                                    module,
+                                    el,
+                                    f"split output '{el.id}' is never used — "
+                                    "dead key material usually means a "
+                                    "dropped consumer",
+                                )
+                            )
+                        else:
+                            bind(el.id, depth)
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    for el in t.elts:
+                        if isinstance(el, ast.Name):
+                            unbind(el.id)
+
+        def scan_expr(
+            node: ast.AST, depth: int, rebound: set[str] = frozenset()
+        ) -> None:
+            if isinstance(
+                node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+            ):
+                # Comprehensions ARE loops: a key consumed in the body
+                # while bound outside is the same n-identical-draws bug
+                # as the `for` form.
+                for gen in node.generators:
+                    scan_expr(gen.iter, depth, rebound)
+                    for cond in gen.ifs:
+                        scan_expr(cond, depth + 1, rebound)
+                parts = (
+                    (node.key, node.value)
+                    if isinstance(node, ast.DictComp)
+                    else (node.elt,)
+                )
+                for part in parts:
+                    scan_expr(part, depth + 1, rebound)
+                return
+            if (
+                isinstance(node, ast.Subscript)
+                and _is_split_call(module, node.value)
+                and isinstance(node.slice, ast.Slice)
+            ):
+                # Anywhere a split output is sliced — assignment,
+                # return, call argument — sibling keys vanish.
+                findings.append(
+                    self.finding(
+                        module,
+                        node,
+                        "slice of jax.random.split output discards sibling "
+                        "keys — size the split to the consumers",
+                    )
+                )
+            if isinstance(node, ast.Call):
+                fr = module.resolve(node.func)
+                # fold_in is derivation, not consumption: it exists to
+                # mint many independent keys from one live parent
+                # (per-iteration fold_in is what this rule's own
+                # message recommends). split, by contrast, retires
+                # its input.
+                if (
+                    fr
+                    and fr.startswith("jax.random.")
+                    and fr != "jax.random.fold_in"
+                ):
+                    for arg in list(node.args) + [
+                        kw.value for kw in node.keywords
+                    ]:
+                        if isinstance(arg, ast.Name) and arg.id not in rebound:
+                            consume(arg.id, node, depth)
+            for child in ast.iter_child_nodes(node):
+                scan_expr(child, depth, rebound)
+
+        def rebound_targets(node: ast.Assign | ast.AnnAssign) -> set[str]:
+            """Target names of a key-origin assignment whose value also
+            consumes them: ``key, sub = split(key)`` / ``key =
+            fold_in(key, i)`` is the canonical per-iteration rethreading
+            this rule RECOMMENDS — the self-consume is a rebind, not a
+            spend."""
+            value = node.value
+            is_origin = (
+                isinstance(value, ast.Call)
+                and module.resolve(value.func) in _KEY_ORIGINS
+            ) or (
+                isinstance(value, ast.Subscript)
+                and _is_split_call(module, value.value)
+            )
+            if not is_origin:
+                return set()
+            out: set[str] = set()
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in targets:
+                elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+                out |= {el.id for el in elts if isinstance(el, ast.Name)}
+            return out
+
+        def walk(body: Iterable[ast.stmt], depth: int) -> None:
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue  # separate scope, checked on its own
+                if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    if stmt.value is not None:
+                        scan_expr(stmt.value, depth, rebound_targets(stmt))
+                    handle_assign(stmt, depth)
+                    continue
+                if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    scan_expr(stmt.iter, depth)
+                    # Any tracked name in the (possibly tuple) loop
+                    # target is rebound per iteration — `for i, key in
+                    # enumerate(split(key, n))` is hygienic.
+                    for el in ast.walk(stmt.target):
+                        if isinstance(el, ast.Name) and el.id in state:
+                            bind(el.id, depth + 1)
+                    walk(stmt.body, depth + 1)
+                    walk(stmt.orelse, depth)
+                    continue
+                if isinstance(stmt, ast.While):
+                    scan_expr(stmt.test, depth)
+                    walk(stmt.body, depth + 1)
+                    walk(stmt.orelse, depth)
+                    continue
+                if isinstance(stmt, (ast.If,)):
+                    scan_expr(stmt.test, depth)
+                    walk(stmt.body, depth)
+                    walk(stmt.orelse, depth)
+                    continue
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    for item in stmt.items:
+                        scan_expr(item.context_expr, depth)
+                    walk(stmt.body, depth)
+                    continue
+                if isinstance(stmt, ast.Try):
+                    walk(stmt.body, depth)
+                    for h in stmt.handlers:
+                        walk(h.body, depth)
+                    walk(stmt.orelse, depth)
+                    walk(stmt.finalbody, depth)
+                    continue
+                scan_expr(stmt, depth)
+
+        walk(fn.body, 0)
+        yield from findings
+
+
+# ---------------------------------------------------------------- JGL003
+
+_STATIC_ATTRS = {
+    "shape", "ndim", "dtype", "size", "itemsize", "weak_type", "sharding",
+    "aval", "nbytes",
+}
+
+_TRACE_SAFE_CALLS = {"isinstance", "len", "getattr", "hasattr", "type", "callable"}
+
+
+@register
+class TracedPythonBranch(Rule):
+    """``if``/``while`` on a traced value inside a jitted body raises
+    ``TracerBoolConversionError`` at best — and at worst (when the value
+    happens to be concrete on one path, e.g. under ``disable_jit`` or a
+    constant-folded input) silently freezes one branch into the cached
+    executable. Use ``lax.cond``/``lax.while_loop``/``jnp.where``."""
+
+    id = "JGL003"
+    name = "traced-python-branch"
+    description = (
+        "Python if/while tests a traced value inside a jitted function "
+        "(use lax.cond / jnp.where)"
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        records = collect_functions(module)
+        call_roots = call_form_jit_roots(module, records)
+        for qual, rec in records.items():
+            if rec.jitted:
+                traced = rec.traced_params()
+            elif qual in call_roots:
+                # Call-form jit (`run = jax.jit(body, ...)`): the
+                # wrapping call carries the statics.
+                names, nums = call_roots[qual]
+                params = rec.param_names()
+                statics = names | {params[i] for i in nums if i < len(params)}
+                traced = set(params) - statics - {"self", "cls"}
+            else:
+                continue
+            if not traced:
+                continue
+            yield from self._scan(module, rec, rec.node.body, traced)
+
+    def _offending_name(
+        self, test: ast.expr, traced: set[str]
+    ) -> ast.Name | None:
+        skip: set[int] = set()
+        for node in ast.walk(test):
+            if id(node) in skip:
+                skip.update(id(c) for c in ast.iter_child_nodes(node))
+                continue
+            if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+                # x.shape / x.ndim / x.dtype … are trace-time static.
+                skip.update(id(c) for c in ast.iter_child_nodes(node))
+            elif isinstance(node, ast.Call):
+                fr = isinstance(node.func, ast.Name) and node.func.id
+                if fr in _TRACE_SAFE_CALLS:
+                    skip.update(id(c) for c in ast.iter_child_nodes(node))
+            elif isinstance(node, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+            ) and all(
+                isinstance(c, ast.Constant) and c.value is None
+                for c in node.comparators
+            ):
+                # `x is None` is decided at trace time (tracer vs None).
+                skip.update(id(c) for c in ast.iter_child_nodes(node))
+            elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                if node.id in traced:
+                    return node
+        return None
+
+    def _scan(
+        self,
+        module: ModuleInfo,
+        rec: FunctionRecord,
+        body: Iterable[ast.stmt],
+        traced: set[str],
+    ) -> Iterator[Finding]:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # Nested def: its params shadow the jitted fn's tracers.
+                inner = traced - {
+                    a.arg
+                    for a in (
+                        stmt.args.posonlyargs + stmt.args.args + stmt.args.kwonlyargs
+                    )
+                }
+                if inner:
+                    yield from self._scan(module, rec, stmt.body, inner)
+                continue
+            if isinstance(stmt, (ast.If, ast.While)):
+                bad = self._offending_name(stmt.test, traced)
+                if bad is not None:
+                    kind = "if" if isinstance(stmt, ast.If) else "while"
+                    yield self.finding(
+                        module,
+                        stmt,
+                        f"Python `{kind}` on traced value '{bad.id}' inside "
+                        f"jitted '{rec.name}' — use lax.cond/lax.while_loop/"
+                        "jnp.where, or mark the argument static",
+                    )
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if sub:
+                    yield from self._scan(module, rec, sub, traced)
+            for h in getattr(stmt, "handlers", ()):
+                yield from self._scan(module, rec, h.body, traced)
+
+
+# ---------------------------------------------------------------- JGL004
+
+_F64_NAMES = {"numpy.float64", "numpy.double", "jax.numpy.float64"}
+_F64_STRINGS = {"float64", "double", "f8", ">f8", "<f8"}
+_JNP_PREFIXES = ("jax.numpy.", "jax.lax.")
+
+
+def _in_dtype_scope(relpath: str) -> bool:
+    parts = relpath.split("/")
+    return "ops" in parts or "estimators" in parts
+
+
+@register
+class DtypeDrift(Rule):
+    """The numerics contract (BASELINE.json parity to 1e-4) is defined
+    under the session dtype policy; a literal ``np.float64`` (or an
+    un-dtyped Python ``float()`` fed straight into a jnp op) inside
+    ``ops/``/``estimators/`` silently promotes — or silently truncates
+    on TPU where f64 is emulated. Intentional f64 islands (the QP
+    solver) carry explicit suppressions."""
+
+    id = "JGL004"
+    name = "dtype-drift"
+    description = (
+        "literal float64 dtype or bare float() feeding a jnp op in "
+        "ops/ or estimators/ drifts against the x64 policy"
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if not _in_dtype_scope(module.relpath):
+            return
+        flagged: set[int] = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.Attribute, ast.Name)):
+                fr = module.resolve(node)
+                if fr in _F64_NAMES and id(node) not in flagged:
+                    yield self.finding(
+                        module,
+                        node,
+                        f"literal {fr.rsplit('.', 1)[-1]} dtype pins f64 "
+                        "regardless of the x64 policy — derive the dtype "
+                        "from the operand or the policy instead",
+                    )
+                    if isinstance(node, ast.Attribute):
+                        flagged.update(id(c) for c in ast.walk(node))
+            elif isinstance(node, ast.keyword) and node.arg == "dtype":
+                v = node.value
+                if isinstance(v, ast.Constant) and v.value in _F64_STRINGS:
+                    yield self.finding(
+                        module,
+                        v,
+                        f"dtype={v.value!r} pins f64 regardless of the x64 "
+                        "policy — derive the dtype from the operand or the "
+                        "policy instead",
+                    )
+            elif isinstance(node, ast.Call):
+                fr = module.resolve(node.func)
+                if not (fr and fr.startswith(_JNP_PREFIXES)):
+                    continue
+                if any(kw.arg == "dtype" for kw in node.keywords):
+                    continue
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    if (
+                        isinstance(arg, ast.Call)
+                        and isinstance(arg.func, ast.Name)
+                        and arg.func.id == "float"
+                        and arg.args
+                    ):
+                        yield self.finding(
+                            module,
+                            arg,
+                            f"bare float(...) fed to {fr} without an "
+                            "explicit dtype — the weak f64 scalar promotes "
+                            "under x64 and truncates elsewhere; pass dtype= "
+                            "or cast with the policy dtype",
+                        )
+
+
+# ---------------------------------------------------------------- JGL005
+
+_WRITE_ALLOWED_SUFFIX = "observability/export.py"
+
+
+@register
+class NonAtomicWrite(Rule):
+    """A kill mid-write leaves a truncated artifact beside valid ones —
+    the failure mode PR 1 closed by routing every persisted artifact
+    through ``observability.export.atomic_write_text`` (tmp file +
+    fsync + ``os.replace``). Everything outside that module must use the
+    blessed helpers, not ``open(..., 'w')``/``json.dump``."""
+
+    id = "JGL005"
+    name = "non-atomic-write"
+    description = (
+        "open(..., 'w')/json.dump outside observability/export.py — use "
+        "atomic_write_text/atomic_write_json"
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if module.relpath.endswith(_WRITE_ALLOWED_SUFFIX):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fr = module.resolve(node.func)
+            if fr == "json.dump":
+                yield self.finding(
+                    module,
+                    node,
+                    "json.dump writes through a live handle — use "
+                    "observability.export.atomic_write_json",
+                )
+                continue
+            if fr not in ("open", "os.fdopen", "io.open"):
+                continue
+            mode = None
+            if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant):
+                mode = node.args[1].value
+            for kw in node.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                    mode = kw.value.value
+            if isinstance(mode, str) and ("w" in mode or "x" in mode):
+                yield self.finding(
+                    module,
+                    node,
+                    f"non-atomic {fr}(..., {mode!r}) — a kill mid-write "
+                    "leaves a truncated file; use observability.export."
+                    "atomic_write_text/atomic_write_json (append-mode "
+                    "journals are exempt by design)",
+                )
+
+
+# ---------------------------------------------------------------- JGL006
+
+_LOCK_ATTR_NAMES = {"_lock", "lock"}
+_LOCK_FACTORIES = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+}
+_EXEMPT_FACTORIES = {"threading.local", "itertools.count"}
+_CONTAINER_FACTORIES = {
+    "dict", "list", "set", "collections.deque", "collections.defaultdict",
+    "collections.OrderedDict",
+}
+
+
+def _self_attr(node: ast.expr, self_name: str) -> str | None:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == self_name
+    ):
+        return node.attr
+    return None
+
+
+@register
+class UnlockedSharedState(Rule):
+    """The observability registry/event log are mutated from the sweep
+    driver, the shard-retry loop, and compile-cache listener threads at
+    once; every mutation of a lock-guarded container must hold the
+    instance lock or snapshots can tear (and dict/deque invariants can
+    corrupt under free-threading)."""
+
+    id = "JGL006"
+    name = "unlocked-shared-state"
+    description = (
+        "observability/ class mutates lock-guarded shared state outside "
+        "`with self._lock`"
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if "observability/" not in module.relpath:
+            return
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(module, node)
+
+    def _check_class(
+        self, module: ModuleInfo, cls: ast.ClassDef
+    ) -> Iterator[Finding]:
+        init = next(
+            (
+                n
+                for n in cls.body
+                if isinstance(n, ast.FunctionDef) and n.name == "__init__"
+            ),
+            None,
+        )
+        if init is None or not init.args.args:
+            return
+        self_name = init.args.args[0].arg
+        locks: set[str] = set()
+        shared: set[str] = set()
+        for stmt in ast.walk(init):
+            if not isinstance(stmt, ast.Assign):
+                continue
+            value = stmt.value
+            for t in stmt.targets:
+                attr = _self_attr(t, self_name)
+                if attr is None:
+                    continue
+                resolved = (
+                    module.resolve(value.func)
+                    if isinstance(value, ast.Call)
+                    else None
+                )
+                if attr in _LOCK_ATTR_NAMES or resolved in _LOCK_FACTORIES:
+                    locks.add(attr)
+                elif resolved in _EXEMPT_FACTORIES:
+                    continue
+                elif isinstance(
+                    value, (ast.Dict, ast.List, ast.Set)
+                ) or resolved in _CONTAINER_FACTORIES:
+                    shared.add(attr)
+                elif isinstance(value, ast.Constant) and isinstance(
+                    value.value, (int, float)
+                ):
+                    # Mutable scalars (counters): plain rebinding is
+                    # atomic-enough, but += is a read-modify-write race.
+                    shared.add(attr)
+        if not locks or not shared:
+            return
+
+        for method in cls.body:
+            if not isinstance(method, ast.FunctionDef) or method.name == "__init__":
+                continue
+            if not method.args.args:
+                continue
+            m_self = method.args.args[0].arg
+            yield from self._scan(
+                module, cls, method.body, m_self, locks, shared, locked=False
+            )
+
+    def _scan(
+        self,
+        module: ModuleInfo,
+        cls: ast.ClassDef,
+        body: Iterable[ast.stmt],
+        self_name: str,
+        locks: set[str],
+        shared: set[str],
+        locked: bool,
+    ) -> Iterator[Finding]:
+        def flag(node: ast.AST, attr: str) -> Finding:
+            return self.finding(
+                module,
+                node,
+                f"{cls.name}.{attr} is mutated outside `with self."
+                f"{sorted(locks)[0]}` — registry/event-log shared state "
+                "must be mutated under the instance lock",
+            )
+
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            now_locked = locked
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    attr = _self_attr(item.context_expr, self_name)
+                    if attr in locks:
+                        now_locked = True
+            if not now_locked:
+                mutations = self._mutations_in(stmt, self_name, shared)
+                for node, attr in mutations:
+                    yield flag(node, attr)
+            for attr_name in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr_name, None)
+                if sub:
+                    yield from self._scan(
+                        module, cls, sub, self_name, locks, shared, now_locked
+                    )
+            for h in getattr(stmt, "handlers", ()):
+                yield from self._scan(
+                    module, cls, h.body, self_name, locks, shared, now_locked
+                )
+
+    def _mutations_in(
+        self, stmt: ast.stmt, self_name: str, shared: set[str]
+    ) -> list[tuple[ast.AST, str]]:
+        out: list[tuple[ast.AST, str]] = []
+        # Only this statement's own expression layer — child statements
+        # are visited by _scan with their own locked context. Compound
+        # statements contribute their header expressions.
+        if not hasattr(stmt, "body"):
+            nodes: list[ast.AST | None] = [stmt]
+        elif isinstance(stmt, (ast.If, ast.While)):
+            nodes = [stmt.test]
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            nodes = [stmt.iter, stmt.target]
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            nodes = [i.context_expr for i in stmt.items]
+        else:
+            nodes = []
+        for root in nodes:
+            if root is None:
+                continue
+            for node in ast.walk(root):
+                if isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
+                    targets = (
+                        node.targets
+                        if isinstance(node, (ast.Assign, ast.Delete))
+                        else [node.target]
+                    )
+                    for t in targets:
+                        attr = _self_attr(t, self_name)
+                        if attr in shared:
+                            out.append((node, attr))
+                        elif isinstance(t, ast.Subscript):
+                            attr = _self_attr(t.value, self_name)
+                            if attr in shared:
+                                out.append((node, attr))
+                elif isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute
+                ):
+                    if node.func.attr in MUTATOR_METHODS:
+                        attr = _self_attr(node.func.value, self_name)
+                        if attr in shared:
+                            out.append((node, attr))
+        return out

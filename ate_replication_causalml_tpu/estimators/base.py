@@ -93,8 +93,13 @@ class ResultTable:
     def to_json(self, path: str | None = None) -> str:
         s = json.dumps(self.to_records(), indent=2)
         if path is not None:
-            with open(path, "w") as f:
-                f.write(s)
+            # Local import: this module must stay importable without
+            # pulling the observability package's jax-touching parts.
+            from ate_replication_causalml_tpu.observability.export import (
+                atomic_write_text,
+            )
+
+            atomic_write_text(path, s)
         return s
 
     @classmethod

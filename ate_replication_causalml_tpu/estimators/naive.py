@@ -16,7 +16,6 @@ it is the known-bad baseline (SURVEY.md §4).
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp

@@ -69,14 +69,12 @@ from ate_replication_causalml_tpu.models.forest import (
     quantile_bins,
     resolve_hist_backend,
     route_rows,
-    route_rows_blocked,
     select_split,
     streaming_level_loop,
 )
 from ate_replication_causalml_tpu.ops.hist_pallas import (
     bin_histogram,
     bin_histogram_shared,
-    node_sums,
     node_sums_shared,
 )
 from ate_replication_causalml_tpu.ops.linalg import _PREC
@@ -561,7 +559,12 @@ def _grow_cf_chunk(group_keys, codes, wt, yt, mom_stack, xb_onehot, *,
             grow_mask = est_mask = base > 0.0
         else:
             gw = ew = base
-        split_key = jax.random.split(tree_key, depth + 1)[1:]
+        # FROZEN RNG stream (graftlint JGL002 would be right for new
+        # code): the honesty bernoulli spends tree_key directly and the
+        # level keys drop split slot 0 — replays of the original
+        # key-threading whose draws the goldens and the grf parity
+        # suite pin bit-for-bit. Rethreading would orphan every golden.
+        split_key = jax.random.split(tree_key, depth + 1)[1:]  # graftlint: disable=JGL002
         if streaming:
             return grow_one_streaming(
                 codes_g, mom5, grow_mask, est_mask, split_key
@@ -834,12 +837,6 @@ def _tau_from_sums(S, M):
     return tau, var
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "oob", "tree_chunk", "row_chunk", "row_backend", "variance_compat"
-    ),
-)
 def predict_cate(
     forest: CausalForest,
     x: jax.Array,
@@ -865,20 +862,16 @@ def predict_cate(
     Rows are processed in blocks of ``row_chunk`` (rows are independent
     in every aggregation), bounding the (rows, nodes) one-hot operands
     at the million-row scale.
-    """
-    if oob and x.shape[0] != forest.in_sample.shape[1]:
-        raise ValueError(
-            "oob=True is only valid for the training matrix: forest was "
-            f"fit on {forest.in_sample.shape[1]} rows, got {x.shape[0]}; "
-            "pass oob=False for new data"
-        )
-    codes = binarize(x, forest.bin_edges)
-    n = codes.shape[0]
-    T, depth = forest.n_trees, forest.depth
-    n_leaves = 1 << depth
-    k = forest.ci_group_size
-    n_groups = T // k
 
+    This entry point is an unjitted dispatcher (graftlint JGL001, the
+    same latent bug ADVICE.md r5 flagged on ``quantile_bins``): with
+    the jitted body resolving ``row_backend=None`` from
+    ``jax.default_backend()`` at trace time, the cache entry was keyed
+    on ``None`` — a backend change after the first call would silently
+    reuse the stale kernel path. The backend is now resolved on the
+    host on every call and enters the jitted implementation as a
+    concrete static argument.
+    """
     # On TPU the per-row stages run the Pallas row kernels
     # (ops/tree_pallas.py): routing without the per-level (rows, M)
     # one-hot, leaf-payload broadcast without the (rows, L) one-hot.
@@ -894,6 +887,42 @@ def predict_cate(
             "row_backend must be 'pallas', 'pallas_interpret' or 'matmul', "
             f"got {row_backend!r}"
         )
+    return _predict_cate_traced(
+        forest, x, oob, tree_chunk, row_chunk, leaf_index, row_backend,
+        variance_compat,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "oob", "tree_chunk", "row_chunk", "row_backend", "variance_compat"
+    ),
+)
+def _predict_cate_traced(
+    forest: CausalForest,
+    x: jax.Array,
+    oob: bool,
+    tree_chunk: int,
+    row_chunk: int,
+    leaf_index: jax.Array | None,
+    row_backend: str,
+    variance_compat: str,
+) -> CatePredictions:
+    """:func:`predict_cate`'s jitted body (``row_backend`` concrete)."""
+    if oob and x.shape[0] != forest.in_sample.shape[1]:
+        raise ValueError(
+            "oob=True is only valid for the training matrix: forest was "
+            f"fit on {forest.in_sample.shape[1]} rows, got {x.shape[0]}; "
+            "pass oob=False for new data"
+        )
+    codes = binarize(x, forest.bin_edges)
+    n = codes.shape[0]
+    T, depth = forest.n_trees, forest.depth
+    n_leaves = 1 << depth
+    k = forest.ci_group_size
+    n_groups = T // k
+
     streaming = row_backend != "matmul"
 
     def per_tree(feats, bins, leaf_stats, in_row, li, codes_b, codes_t_b):
@@ -1080,6 +1109,11 @@ def predict_cate(
         H > _EPS, var_psi / jnp.maximum(H, _EPS) ** 2, 0.0
     )
     return CatePredictions(cate=tau, variance=variance)
+
+
+# The dispatcher keeps the jitted body's cache controls (tests rebuild
+# traces with monkeypatched internals via predict_cate.clear_cache()).
+predict_cate.clear_cache = _predict_cate_traced.clear_cache
 
 
 @functools.partial(jax.jit, static_argnames=("clip",))

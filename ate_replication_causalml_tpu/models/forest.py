@@ -143,7 +143,10 @@ def route_rows(node_oh, best_feat, best_bin, codes_f, node_of_row):
     Returns: (rows,) int32 node ids one level down.
     """
     p = codes_f.shape[1]
-    dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    # Unlike quantile_bins' path gate, a stale backend baked into a
+    # cached trace here costs only bandwidth, never bits: the bf16 and
+    # f32 routing matmuls are exact for these operands (see docstring).
+    dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32  # graftlint: disable=JGL001
     route_tab = jnp.concatenate(
         [
             best_bin.astype(dt)[:, None],
@@ -189,7 +192,10 @@ def route_rows_blocked(
     # Build the block one-hot directly in the routing matmul's dtype
     # (bf16 on TPU — exact for 0/1; see route_rows) instead of f32 +
     # cast: halves the largest transient.
-    dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    # Unlike quantile_bins' path gate, a stale backend baked into a
+    # cached trace here costs only bandwidth, never bits: the bf16 and
+    # f32 routing matmuls are exact for these operands (see docstring).
+    dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32  # graftlint: disable=JGL001
 
     def blk(args):
         ids, cd = args
@@ -479,7 +485,6 @@ def exact_order_stats(x: jax.Array, ranks: jax.Array) -> jax.Array:
     return _key_to_f32(out)
 
 
-@functools.partial(jax.jit, static_argnames=("n_bins",))
 def quantile_bins(x: jax.Array, n_bins: int = 64) -> jax.Array:
     """Per-feature quantile bin edges, (p, n_bins-1). Computed once and
     shared by every tree (the binned representation is what CART's
@@ -491,14 +496,37 @@ def quantile_bins(x: jax.Array, n_bins: int = 64) -> jax.Array:
     compile per fresh cache — on the remote-compile toolchain the
     (1M, 21) ``lax.sort`` costs 17.3 s to COMPILE for ~1 s of
     execution, and even trivial eager primitives pay a 1-5 s
-    per-executable tax (hence the jit: ONE executable, shared by all
-    three flagship fits). Everywhere else ``jnp.quantile`` wins: the
-    search issues ~50× a sort's comparisons, which priced a 1-core CPU
-    test-suite run at +10 minutes before this gate, while CPU compile
-    is cheap — so CPU (and non-f32) keep the sort."""
-    qs = jnp.linspace(0.0, 1.0, n_bins + 1)[1:-1]
+    per-executable tax (hence the jitted implementations: ONE
+    executable, shared by all three flagship fits). Everywhere else
+    ``jnp.quantile`` wins: the search issues ~50× a sort's comparisons,
+    which priced a 1-core CPU test-suite run at +10 minutes before this
+    gate, while CPU compile is cheap — so CPU (and non-f32) keep the
+    sort.
+
+    This wrapper is deliberately NOT jitted (ADVICE.md r5 / graftlint
+    JGL001): the backend/dtype gate runs on the host on every call and
+    dispatches to one of two separately jitted implementations, so the
+    jit caches can never serve a path chosen under a different default
+    backend. Inside an enclosing trace the dispatch still happens once
+    at trace time — but then the choice is baked into the CALLER's
+    cache entry, which owns its own keying."""
+    x = jnp.asarray(x)
     if x.dtype != jnp.float32 or jax.default_backend() != "tpu":
-        return jnp.quantile(x, qs, axis=0).T  # (p, n_bins-1)
+        return _quantile_bins_sort(x, n_bins)
+    return _quantile_bins_order_stat(x, n_bins)
+
+
+@functools.partial(jax.jit, static_argnames=("n_bins",))
+def _quantile_bins_sort(x: jax.Array, n_bins: int) -> jax.Array:
+    """The ``jnp.quantile`` (sort) path — CPU and non-f32 dtypes."""
+    qs = jnp.linspace(0.0, 1.0, n_bins + 1)[1:-1]
+    return jnp.quantile(x, qs, axis=0).T  # (p, n_bins-1)
+
+
+@functools.partial(jax.jit, static_argnames=("n_bins",))
+def _quantile_bins_order_stat(x: jax.Array, n_bins: int) -> jax.Array:
+    """The sort-free TPU f32 path (bit-identical to the sort path)."""
+    qs = jnp.linspace(0.0, 1.0, n_bins + 1)[1:-1]
     return _order_stat_quantiles(x, qs)
 
 

@@ -16,7 +16,9 @@ from __future__ import annotations
 
 import json
 import os
+import stat
 import tempfile
+import threading
 
 from ate_replication_causalml_tpu.observability import events as _events
 from ate_replication_causalml_tpu.observability import registry as _registry
@@ -24,6 +26,53 @@ from ate_replication_causalml_tpu.observability import registry as _registry
 METRICS_BASENAME = "metrics.json"
 EVENTS_BASENAME = "events.jsonl"
 PROMTEXT_BASENAME = "metrics.prom"
+
+_artifact_mode_cache: int | None = None
+_artifact_mode_lock = threading.Lock()
+
+
+def _artifact_mode() -> int:
+    """The mode a plain ``open(path, "w")`` would give a new file —
+    0o666 masked by the process umask. Probed race-free by creating a
+    throwaway file with requested mode 0o666 and stat-ing it: the
+    ``os.umask(0)``-then-restore dance would leave a window in which
+    files created by OTHER threads (this module serves multi-threaded
+    telemetry) come out world-writable."""
+    global _artifact_mode_cache
+    if _artifact_mode_cache is None:
+        with _artifact_mode_lock:
+            if _artifact_mode_cache is None:
+                d = tempfile.gettempdir()
+                for i in range(100):
+                    probe = os.path.join(
+                        d, f".ate_umask_probe_{os.getpid()}_{i}"
+                    )
+                    try:
+                        fd = os.open(
+                            probe,
+                            os.O_CREAT | os.O_EXCL | os.O_WRONLY,
+                            0o666,
+                        )
+                    except FileExistsError:
+                        continue
+                    except OSError:
+                        break  # tempdir uncooperative: fallback below
+                    try:
+                        _artifact_mode_cache = stat.S_IMODE(
+                            os.fstat(fd).st_mode
+                        )
+                    finally:
+                        os.close(fd)
+                        try:
+                            os.unlink(probe)
+                        except OSError:
+                            pass
+                    break
+                if _artifact_mode_cache is None:
+                    # Probing must never make a WRITE fail that plain
+                    # open(path, "w") would have survived.
+                    _artifact_mode_cache = 0o644
+    return _artifact_mode_cache
 
 
 def atomic_write_text(path: str, text: str) -> None:
@@ -38,6 +87,15 @@ def atomic_write_text(path: str, text: str) -> None:
             f.write(text)
             f.flush()
             os.fsync(f.fileno())
+        # mkstemp creates 0600; match plain open(path, "w") semantics:
+        # an EXISTING artifact keeps its mode (a user-tightened 0600
+        # stays 0600), a new one gets the umask-derived default
+        # (shared results dirs are read by other uids/groups).
+        try:
+            mode = stat.S_IMODE(os.stat(path).st_mode)
+        except OSError:
+            mode = _artifact_mode()
+        os.chmod(tmp, mode)
         os.replace(tmp, path)
     except BaseException:
         try:

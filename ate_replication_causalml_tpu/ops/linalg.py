@@ -119,7 +119,9 @@ def alias_filter(cols, *, with_intercept: bool = True, tol: float = 1e-7):
     column (R models have an implicit leading intercept), so constant
     columns alias away as they do in ``lm``.
     """
-    a = np.asarray(cols, dtype=np.float64)
+    # Host-side numpy selection logic replicating LINPACK's f64 — not
+    # device compute, so the x64 policy doesn't apply here.
+    a = np.asarray(cols, dtype=np.float64)  # graftlint: disable=JGL004
     n = a.shape[0]
     basis: list[np.ndarray] = []
     if with_intercept:

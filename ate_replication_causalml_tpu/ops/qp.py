@@ -30,6 +30,13 @@ by the balancing estimator for both treatment arms.
 
 from __future__ import annotations
 
+# This module is the repo's ONE sanctioned f64 island: balance_qp_x64
+# forces float64 under a local enable_x64() scope regardless of the
+# session policy (ADMM dual updates floor at ~1e-3 residuals in f32 —
+# see its docstring for the measurements). The literal jnp.float64
+# casts are that contract, not drift.
+# graftlint: disable-file=JGL004
+
 import functools
 from typing import NamedTuple
 

@@ -182,9 +182,14 @@ class _Checkpoint:
             else:
                 self.done = {r["method"]: r for r in recs if r["method"] != "__config__"}
         if path and not self.done and not os.path.exists(path):
-            with open(path, "w") as f:
-                f.write(json.dumps({"method": "__config__",
-                                    "fingerprint": fingerprint}) + "\n")
+            # Atomic header write: a kill here must leave either no
+            # checkpoint or a valid one-line one, never a torn header
+            # that would stale-cycle the next resume. Appends below
+            # stay plain "a" — the reader already tolerates a
+            # truncated LAST line, and atomicity per row would mean
+            # rewriting the whole journal.
+            obs.atomic_write_text(path, json.dumps({"method": "__config__",
+                                                    "fingerprint": fingerprint}) + "\n")
 
     def get(self, method: str) -> dict | None:
         return self.done.get(method)
@@ -564,8 +569,7 @@ def write_report_md(report: SweepReport, outdir: str,
     if len(figs) >= 3:
         lines += [f"![causal ML methods]({figs[2]})", ""]
     path = os.path.join(outdir, "REPORT.md")
-    with open(path, "w") as f:
-        f.write("\n".join(lines))
+    obs.atomic_write_text(path, "\n".join(lines))
     return path
 
 

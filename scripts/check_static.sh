@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Static-analysis gate (ISSUE 2): graftlint + ruff + compileall as one
+# pass/fail. Run from anywhere; tier-1 invokes it via
+# tests/test_static_gate.py so a dirty tree fails CI, not a TPU run.
+#
+#   scripts/check_static.sh            # gate the package + scripts
+#
+# ruff is optional (the pinned CPU image does not ship it); when the
+# interpreter environment has it, the committed ruff.toml applies.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# Honor $PYTHON (tests pass sys.executable); fall back for
+# python3-only PATHs.
+PY="${PYTHON:-$(command -v python || command -v python3)}"
+
+fail=0
+
+echo "== graftlint (JAX-aware rules JGL001-006) =="
+"$PY" scripts/graftlint.py ate_replication_causalml_tpu scripts || fail=1
+
+echo "== compileall (syntax gate) =="
+"$PY" -m compileall -q ate_replication_causalml_tpu scripts tests bench.py __graft_entry__.py || fail=1
+
+if command -v ruff >/dev/null 2>&1; then
+    echo "== ruff (F, E9, B per ruff.toml) =="
+    ruff check ate_replication_causalml_tpu scripts tests bench.py __graft_entry__.py || fail=1
+else
+    echo "== ruff not installed; skipping (config: ruff.toml) =="
+fi
+
+if [ "$fail" -ne 0 ]; then
+    echo "check_static: FAILED"
+    exit 1
+fi
+echo "check_static: OK"

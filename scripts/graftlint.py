@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""graftlint CLI — JAX-aware static analysis for this repository.
+
+Usage:
+    python scripts/graftlint.py [paths...] [--json] [--select JGL001,...]
+                                [--show-suppressed] [--list-rules]
+
+Default path: ``ate_replication_causalml_tpu/``. Exits 0 on a clean
+tree, 1 when findings remain (including files that do not parse), 2 on
+usage errors. Suppress individual findings with
+``# graftlint: disable=JGL00x`` (see README "Static analysis").
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import types
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+# Import ONLY the analysis subpackage: executing the parent package's
+# __init__ would pull the estimator stack and with it jax — slow, and
+# wrong for a linter that must run in images with no accelerator stack
+# at all. A namespace stub satisfies the package machinery; the
+# analysis modules themselves are stdlib-only.
+if "ate_replication_causalml_tpu" not in sys.modules:
+    _pkg = types.ModuleType("ate_replication_causalml_tpu")
+    _pkg.__path__ = [os.path.join(_REPO_ROOT, "ate_replication_causalml_tpu")]
+    sys.modules["ate_replication_causalml_tpu"] = _pkg
+
+from ate_replication_causalml_tpu import analysis  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint", description=__doc__.split("\n")[1]
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        default=None,
+        help="files/directories to lint (default: the package)",
+    )
+    ap.add_argument("--json", action="store_true", help="JSON report on stdout")
+    ap.add_argument(
+        "--select",
+        default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    ap.add_argument(
+        "--show-suppressed",
+        action="store_true",
+        help="also print findings silenced by graftlint comments",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="print the rule table and exit"
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        print(analysis.render_rule_table())
+        return 0
+
+    select = None
+    if args.select:
+        select = [s.strip() for s in args.select.split(",") if s.strip()]
+    paths = args.paths or [
+        os.path.join(_REPO_ROOT, "ate_replication_causalml_tpu")
+    ]
+    try:
+        result = analysis.lint_paths(paths, select=select, root=_REPO_ROOT)
+    except ValueError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        sys.stdout.write(analysis.render_json(result))
+    else:
+        print(analysis.render_human(result, show_suppressed=args.show_suppressed))
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -13,7 +13,6 @@ Usage:
 
 import argparse
 import glob
-import gzip
 import os
 import sys
 import time

@@ -1,0 +1,600 @@
+"""graftlint analyzer tests: every JGL rule must fire on a seeded
+known-bad fixture at the expected line, stay quiet on the matching
+known-good twin, honor suppression comments, and report the shipped
+tree as clean.
+
+Pure-AST tests — no jax import, no device, so the whole module runs in
+milliseconds inside tier-1.
+"""
+
+import os
+import subprocess
+import sys
+
+from ate_replication_causalml_tpu.analysis import (
+    PARSE_ERROR_ID,
+    RULES,
+    lint_paths,
+    lint_source,
+    render_human,
+    render_json,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "ate_replication_causalml_tpu")
+
+
+def _lines(source, rule, relpath="pkg/mod.py"):
+    res = lint_source(source, relpath=relpath, select=[rule])
+    return [f.line for f in res.findings]
+
+
+def _messages(source, rule, relpath="pkg/mod.py"):
+    res = lint_source(source, relpath=relpath, select=[rule])
+    return [f.message for f in res.findings]
+
+
+# --------------------------------------------------------------- JGL001
+
+
+JGL001_BAD_DIRECT = """\
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def quantilish(x):
+    if jax.default_backend() != "tpu":      # line 6
+        return jnp.sort(x)
+    return x
+"""
+
+JGL001_BAD_TRANSITIVE = """\
+import functools
+import jax
+import jax.numpy as jnp
+
+def helper(x):
+    dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32  # line 6
+    return x.astype(dt)
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def entry(x, n):
+    return helper(x) * n
+"""
+
+JGL001_BAD_ENV_AND_GLOBAL = """\
+import os
+import jax
+
+_MODE = "fast"
+_CACHE = {}
+
+def set_mode(m):
+    global _MODE
+    _MODE = m
+
+@jax.jit
+def f(x):
+    flag = os.environ.get("ATE_TPU_X")      # line 13
+    if _MODE == "fast":                     # line 14
+        _ = _CACHE
+    return x
+"""
+
+JGL001_GOOD = """\
+import jax
+import jax.numpy as jnp
+
+_CONST = 3.0
+
+def dispatcher(x):
+    # unjitted host-side gate: allowed
+    if jax.default_backend() == "tpu":
+        return _impl_a(x)
+    return _impl_b(x)
+
+@jax.jit
+def _impl_a(x):
+    return x * _CONST
+
+@jax.jit
+def _impl_b(x):
+    return jnp.sort(x)
+"""
+
+
+def test_jgl001_fires_on_direct_jit_ambient_read():
+    assert _lines(JGL001_BAD_DIRECT, "JGL001") == [6]
+
+
+def test_jgl001_fires_transitively_with_via_chain():
+    res = lint_source(JGL001_BAD_TRANSITIVE, relpath="m.py", select=["JGL001"])
+    assert [f.line for f in res.findings] == [6]
+    assert "traced via jit of 'entry'" in res.findings[0].message
+
+
+def test_jgl001_fires_on_environ_and_mutable_global():
+    lines = _lines(JGL001_BAD_ENV_AND_GLOBAL, "JGL001")
+    assert lines == [13, 14], lines
+
+
+def test_jgl001_quiet_on_unjitted_dispatcher_and_constants():
+    assert _lines(JGL001_GOOD, "JGL001") == []
+
+
+def test_jgl001_local_shadow_of_mutable_global_is_not_a_read():
+    src = (
+        "import jax\n"
+        "_SCRATCH = {}\n"
+        "_SCRATCH[0] = 1\n"          # mutated: _SCRATCH is a mutable global
+        "@jax.jit\n"
+        "def g(x):\n"
+        "    _SCRATCH = x * 2\n"     # local shadows it — Python scoping
+        "    return _SCRATCH + 1\n"
+    )
+    assert _lines(src, "JGL001") == []
+
+
+def test_jgl001_call_form_jit_roots_are_traced():
+    src = (
+        "import jax\n"
+        "def factory():\n"
+        "    def run(x):\n"
+        "        return x if jax.default_backend() == 'cpu' else -x\n"
+        "    return jax.jit(run)\n"
+    )
+    assert _lines(src, "JGL001") == [4]
+
+
+# --------------------------------------------------------------- JGL002
+
+
+JGL002_BAD_DOUBLE_SPEND = """\
+import jax
+
+def sample(key):
+    a = jax.random.normal(key, (3,))
+    b = jax.random.uniform(key, (3,))       # line 5: second spend
+    return a + b
+"""
+
+JGL002_BAD_LOOP = """\
+import jax
+
+def sample(key, n):
+    out = []
+    for _i in range(n):
+        out.append(jax.random.normal(key, (3,)))   # line 6: loop reuse
+    return out
+"""
+
+JGL002_BAD_DISCARD = """\
+import jax
+
+def sample(key):
+    k1, _ = jax.random.split(key)           # line 4: '_' discard
+    lk = jax.random.split(k1, 8)[1:]        # line 5: slice discard
+    return lk
+"""
+
+JGL002_GOOD = """\
+import jax
+
+def sample(key):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (3,))
+    b = jax.random.uniform(k2, (3,))
+    return a + b
+
+def rebind_is_fresh(key):
+    key, sub = jax.random.split(key)
+    x = jax.random.normal(sub, (3,))
+    key, sub = jax.random.split(key)
+    return x + jax.random.normal(sub, (3,))
+
+def per_iter_keys(key, n):
+    ks = jax.random.split(key, n)
+    return [jax.random.normal(ks[i], (2,)) for i in range(n)]
+"""
+
+
+def test_jgl002_fires_on_double_spend():
+    res = lint_source(JGL002_BAD_DOUBLE_SPEND, relpath="m.py", select=["JGL002"])
+    assert [f.line for f in res.findings] == [5]
+    assert "first use at line 4" in res.findings[0].message
+
+
+def test_jgl002_fires_on_loop_reuse():
+    assert _lines(JGL002_BAD_LOOP, "JGL002") == [6]
+
+
+def test_jgl002_fires_on_comprehension_reuse():
+    src = (
+        "import jax\n"
+        "def sample(key, n):\n"
+        "    return [jax.random.normal(key, (4,)) for _i in range(n)]\n"
+    )
+    assert _lines(src, "JGL002") == [3]
+    hygienic = (
+        "import jax\n"
+        "def sample(key, n):\n"
+        "    ks = jax.random.split(key, n)\n"
+        "    return [jax.random.normal(ks[i], (4,)) for i in range(n)]\n"
+    )
+    assert _lines(hygienic, "JGL002") == []
+
+
+def test_jgl002_fires_on_partial_discard():
+    assert _lines(JGL002_BAD_DISCARD, "JGL002") == [4, 5]
+
+
+JGL002_GOOD_LOOPS = """\
+import jax
+
+def rethread_per_iteration(key, n):
+    outs = []
+    for i in range(n):
+        key, sub = jax.random.split(key)    # self-rebind: the idiom
+        outs.append(jax.random.normal(sub, (2,)))
+    return outs
+
+def fold_in_per_iteration(key, n):
+    outs = []
+    for i in range(n):
+        k = jax.random.fold_in(key, i)      # derivation, not a spend
+        outs.append(jax.random.normal(k, (2,)))
+    return outs
+"""
+
+
+def test_jgl002_quiet_on_hygienic_threading():
+    assert _lines(JGL002_GOOD, "JGL002") == []
+
+
+def test_jgl002_quiet_on_canonical_loop_rethreading():
+    """The rule's own advice ('split or fold_in per iteration') must not
+    be flagged when followed."""
+    assert _lines(JGL002_GOOD_LOOPS, "JGL002") == []
+
+
+def test_jgl002_tuple_for_target_rebinds_key():
+    src = (
+        "import jax\n"
+        "def sample(key, n):\n"
+        "    out = []\n"
+        "    for i, key in enumerate(jax.random.split(key, n)):\n"
+        "        out.append(jax.random.normal(key, (2,)))\n"
+        "    return out\n"
+    )
+    assert _lines(src, "JGL002") == []
+
+
+def test_jgl002_slice_discard_outside_assignment():
+    src = (
+        "import jax\n"
+        "def f(key):\n"
+        "    return jax.random.split(key, 4)[1:]\n"
+    )
+    assert _lines(src, "JGL002") == [3]
+
+
+# --------------------------------------------------------------- JGL003
+
+
+JGL003_BAD = """\
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def relu_ish(x, y):
+    if x > 0:                               # line 6
+        return x
+    while y > x:                            # line 8
+        y = y - 1.0
+    return y
+"""
+
+JGL003_GOOD = """\
+import functools
+import jax
+import jax.numpy as jnp
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def f(x, mode, flag=None):
+    if mode == "fast":          # static arg: fine
+        return x
+    if x.shape[0] > 2:          # shape is trace-time static: fine
+        return -x
+    if x.dtype == jnp.float32:  # dtype: fine
+        return x * 2
+    if flag is None:            # tracer-vs-None decided at trace time
+        return x
+    def inner(x):
+        if x:                   # shadowed param of nested def: fine
+            return 1
+        return 0
+    return x
+"""
+
+
+def test_jgl003_fires_on_traced_if_and_while():
+    assert _lines(JGL003_BAD, "JGL003") == [6, 8]
+
+
+def test_jgl003_quiet_on_static_shape_dtype_none_checks():
+    assert _lines(JGL003_GOOD, "JGL003") == []
+
+
+def test_jgl003_covers_call_form_jit():
+    src = (
+        "import jax\n"
+        "def body(x, flag):\n"
+        "    if flag:\n"
+        "        return -x\n"
+        "    return x\n"
+        "run = jax.jit(body)\n"
+    )
+    assert _lines(src, "JGL003") == [3]
+    # The same wrap with flag static is clean.
+    static = src.replace(
+        "run = jax.jit(body)", "run = jax.jit(body, static_argnums=(1,))"
+    )
+    assert _lines(static, "JGL003") == []
+
+
+# --------------------------------------------------------------- JGL004
+
+
+JGL004_BAD = """\
+import numpy as np
+import jax.numpy as jnp
+
+def f(x, v):
+    a = np.asarray(x, dtype=np.float64)     # line 5
+    b = jnp.zeros(3, dtype="float64")       # line 6
+    c = jnp.full((3,), float(v))            # line 7
+    return a, b, c
+"""
+
+JGL004_GOOD = """\
+import numpy as np
+import jax.numpy as jnp
+
+def f(x, v, out):
+    a = np.asarray(x, dtype=np.float32)
+    b = jnp.full((3,), float(v), dtype=out.dtype)  # explicit dtype: fine
+    c = float(v) * 2.0                             # host scalar math: fine
+    return a, b, c
+"""
+
+
+def test_jgl004_fires_inside_ops_scope():
+    lines = _lines(JGL004_BAD, "JGL004", relpath="pkg/ops/mod.py")
+    assert lines == [5, 6, 7], lines
+    assert _lines(JGL004_BAD, "JGL004", relpath="pkg/estimators/mod.py") == [5, 6, 7]
+
+
+def test_jgl004_quiet_outside_scope_and_on_explicit_dtypes():
+    # Same bad source outside ops//estimators/: no findings.
+    assert _lines(JGL004_BAD, "JGL004", relpath="pkg/data/mod.py") == []
+    assert _lines(JGL004_GOOD, "JGL004", relpath="pkg/ops/mod.py") == []
+
+
+# --------------------------------------------------------------- JGL005
+
+
+JGL005_BAD = """\
+import json
+
+def dump(path, obj):
+    with open(path, "w") as f:              # line 4
+        json.dump(obj, f)                   # line 5
+"""
+
+JGL005_GOOD = """\
+import json
+
+def read(path):
+    with open(path) as f:
+        return json.load(f)
+
+def journal(path, rec):
+    with open(path, "a") as f:              # append journals are exempt
+        f.write(json.dumps(rec) + "\\n")
+"""
+
+
+def test_jgl005_fires_on_write_mode_and_json_dump():
+    assert _lines(JGL005_BAD, "JGL005") == [4, 5]
+
+
+def test_jgl005_quiet_on_reads_appends_and_blessed_module():
+    assert _lines(JGL005_GOOD, "JGL005") == []
+    # The atomic-writer module itself is the allowlist.
+    assert (
+        _lines(JGL005_BAD, "JGL005", relpath="pkg/observability/export.py") == []
+    )
+
+
+# --------------------------------------------------------------- JGL006
+
+
+JGL006_BAD = """\
+import threading
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.samples = {}
+        self._dropped = 0
+
+    def put(self, k, v):
+        self.samples[k] = v                 # line 10: unlocked store
+        self._dropped += 1                  # line 11: unlocked rmw
+
+    def clear(self):
+        with self._lock:
+            self.samples.clear()            # locked: fine
+"""
+
+JGL006_GOOD = """\
+import threading
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.samples = {}
+        self._tls = threading.local()
+
+    def put(self, k, v):
+        with self._lock:
+            self.samples[k] = v
+
+    def local_scratch(self):
+        self._tls.stack = []                # thread-local: exempt
+
+class PlainRecord:
+    def __init__(self):
+        self.attrs = {}
+
+    def set(self, k, v):
+        self.attrs[k] = v                   # no lock in class: exempt
+"""
+
+
+def test_jgl006_fires_only_in_observability_scope():
+    rel = "pkg/observability/mod.py"
+    assert _lines(JGL006_BAD, "JGL006", relpath=rel) == [10, 11]
+    assert _lines(JGL006_BAD, "JGL006", relpath="pkg/ops/mod.py") == []
+
+
+def test_jgl006_quiet_on_locked_threadlocal_and_lockless_classes():
+    assert _lines(JGL006_GOOD, "JGL006", relpath="pkg/observability/mod.py") == []
+
+
+def test_jgl006_catches_mutation_in_compound_headers():
+    src = (
+        "import threading\n"
+        "class Log:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._events = []\n"
+        "    def drain(self):\n"
+        "        for e in [self._events.pop()]:\n"     # line 7
+        "            print(e)\n"
+    )
+    assert _lines(src, "JGL006", relpath="pkg/observability/mod.py") == [7]
+
+
+# ----------------------------------------------------- suppressions etc.
+
+
+def test_line_suppression_trailing_and_preceding():
+    trailing = JGL001_BAD_DIRECT.replace(
+        'if jax.default_backend() != "tpu":      # line 6',
+        'if jax.default_backend() != "tpu":  # graftlint: disable=JGL001',
+    )
+    assert _lines(trailing, "JGL001") == []
+    res = lint_source(trailing, relpath="m.py", select=["JGL001"])
+    assert [f.line for f in res.suppressed] == [6]
+
+    preceding = JGL001_BAD_DIRECT.replace(
+        '    if jax.default_backend() != "tpu":      # line 6',
+        "    # graftlint: disable=JGL001\n"
+        '    if jax.default_backend() != "tpu":',
+    )
+    assert _lines(preceding, "JGL001") == []
+
+
+def test_suppression_is_per_rule():
+    # A JGL002 comment must not silence a JGL001 finding on the line.
+    wrong_rule = JGL001_BAD_DIRECT.replace(
+        'if jax.default_backend() != "tpu":      # line 6',
+        'if jax.default_backend() != "tpu":  # graftlint: disable=JGL002',
+    )
+    assert _lines(wrong_rule, "JGL001") == [6]
+
+
+def test_file_suppression_and_all():
+    filewide = "# graftlint: disable-file=JGL005\n" + JGL005_BAD
+    assert _lines(filewide, "JGL005") == []
+    allrules = JGL005_BAD.replace(
+        'with open(path, "w") as f:              # line 4',
+        'with open(path, "w") as f:  # graftlint: disable=all',
+    )
+    assert _lines(allrules, "JGL005") == [5]
+
+
+def test_suppression_comment_inside_string_is_inert():
+    src = JGL005_BAD.replace(
+        "import json",
+        'import json\nNOTE = "# graftlint: disable-file=JGL005"',
+    )
+    assert _lines(src, "JGL005") == [5, 6]
+
+
+def test_parse_error_reported_and_unsuppressible():
+    res = lint_source("def broken(:\n  # graftlint: disable-file=JGL000\n")
+    assert [f.rule for f in res.findings] == [PARSE_ERROR_ID]
+    assert res.suppressed == []
+
+
+def test_rule_registry_has_at_least_six_active_rules():
+    jgl = [r for r in RULES if r.startswith("JGL") and r != PARSE_ERROR_ID]
+    assert len(jgl) >= 6
+    assert {"JGL001", "JGL002", "JGL003", "JGL004", "JGL005", "JGL006"} <= set(jgl)
+
+
+def test_reporters_render():
+    res = lint_source(JGL005_BAD, relpath="m.py")
+    human = render_human(res, show_suppressed=True)
+    assert "JGL005" in human and "finding(s)" in human
+    import json as _json
+
+    payload = _json.loads(render_json(res))
+    assert payload["schema_version"] == 1
+    assert payload["rules"]["JGL005"]["name"] == "non-atomic-write"
+    assert any(f["rule"] == "JGL005" for f in payload["findings"])
+
+
+# ------------------------------------------------------- the real tree
+
+
+def test_shipped_package_tree_is_clean():
+    """The acceptance gate: the package lints clean (suppressions are
+    allowed and expected — they must be explicit, not absent)."""
+    result = lint_paths([PKG], root=REPO)
+    assert result.files > 40
+    rendered = "\n".join(f.render() for f in result.findings)
+    assert not result.findings, f"graftlint findings on shipped tree:\n{rendered}"
+    # The known deliberate suppressions are present and load-bearing:
+    by_rule = {f.rule for f in result.suppressed}
+    assert {"JGL001", "JGL002", "JGL004"} <= by_rule
+
+
+def test_cli_exit_codes(tmp_path):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    cli = os.path.join(REPO, "scripts", "graftlint.py")
+    bad = tmp_path / "ops"
+    bad.mkdir()
+    (bad / "bad.py").write_text(JGL004_BAD)
+    proc = subprocess.run(
+        [sys.executable, cli, str(bad)], capture_output=True, text=True, env=env
+    )
+    assert proc.returncode == 1
+    assert "JGL004" in proc.stdout
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    proc = subprocess.run(
+        [sys.executable, cli, str(good)], capture_output=True, text=True, env=env
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    proc = subprocess.run(
+        [sys.executable, cli, "--list-rules"],
+        capture_output=True,
+        text=True,
+        env=env,
+    )
+    assert proc.returncode == 0
+    for rid in ("JGL001", "JGL006"):
+        assert rid in proc.stdout

@@ -2,10 +2,9 @@
 
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from ate_replication_causalml_tpu.ops.glm import logistic_glm, predict_proba
-from ate_replication_causalml_tpu.ops.linalg import add_intercept, ols, ols_no_intercept_1d, wls
+from ate_replication_causalml_tpu.ops.linalg import ols, ols_no_intercept_1d, wls
 
 RNG = np.random.default_rng(0)
 

@@ -6,7 +6,6 @@ scripts/check_metrics_schema.py, with per-stage records for every
 ``SWEEP_METHODS`` entry and bit-identical estimator output with
 telemetry on vs off."""
 
-import dataclasses
 import json
 import os
 import sys

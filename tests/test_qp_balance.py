@@ -3,7 +3,6 @@ approximate-residual-balancing estimator (estimators/balance.py) — the
 TPU-native replacement for quadprog/pogs behind balanceHD
 (``ate_functions.R:393-405``)."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

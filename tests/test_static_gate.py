@@ -1,0 +1,30 @@
+"""Tier-1 smoke test for the static-analysis gate: the shipped tree
+must pass scripts/check_static.sh (graftlint + compileall + optional
+ruff) so regressions fail CI instead of a TPU run.
+
+Kept cheap: the gate is pure AST/bytecode work, no jax import, no
+device — a few seconds of the tier-1 budget.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GATE = os.path.join(REPO, "scripts", "check_static.sh")
+
+
+def test_check_static_gate_passes_on_shipped_tree():
+    proc = subprocess.run(
+        ["bash", GATE],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=300,
+        env=dict(os.environ, PYTHON=sys.executable),
+    )
+    assert proc.returncode == 0, (
+        f"static gate failed\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    assert "check_static: OK" in proc.stdout
+    assert "graftlint" in proc.stdout
