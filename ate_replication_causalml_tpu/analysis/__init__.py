@@ -26,7 +26,7 @@ from ate_replication_causalml_tpu.analysis.core import (
     lint_source,
     register,
 )
-from ate_replication_causalml_tpu.analysis import rules as _rules  # noqa: F401 — registers JGL001-006
+from ate_replication_causalml_tpu.analysis import rules as _rules  # noqa: F401 — registers JGL001-007
 from ate_replication_causalml_tpu.analysis.reporters import (
     render_human,
     render_json,

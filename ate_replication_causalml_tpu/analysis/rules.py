@@ -1,4 +1,4 @@
-"""The graftlint rule set (JGL001–JGL006).
+"""The graftlint rule set (JGL001–JGL007).
 
 Each rule targets a failure class that has actually bitten (or nearly
 bitten) this codebase on TPU — see ADVICE.md and the rule docstrings.
@@ -844,3 +844,90 @@ class UnlockedSharedState(Rule):
                         if attr in shared:
                             out.append((node, attr))
         return out
+
+
+# ---------------------------------------------------------------- JGL007
+
+#: Paths allowed to make blanket exception decisions: the resilience
+#: layer's whole job is classified handling, and the shard runner's
+#: probe/retry loops are the sanctioned swallow sites.
+_RESILIENCE_EXEMPT_SUFFIX = "parallel/retry.py"
+_RESILIENCE_EXEMPT_DIR = "resilience/"
+
+_BROAD_EXC = {"Exception", "BaseException"}
+
+
+def _in_resilience_scope(relpath: str) -> bool:
+    return (
+        relpath.endswith(_RESILIENCE_EXEMPT_SUFFIX)
+        or _RESILIENCE_EXEMPT_DIR in relpath
+    )
+
+
+@register
+class SilentExceptionSwallow(Rule):
+    """ISSUE 3's failure class: a bare ``except Exception: pass`` (or a
+    ``retriable=(Exception,)`` shard-retry tuple) swallows programming
+    errors — the ``TypeError`` that should have killed the run on
+    attempt 1 instead burns the retry budget and surfaces, if at all,
+    as a mysterious "shard failure". Error-class decisions belong to
+    ``resilience.errors.classify``; everywhere else must either narrow
+    the type, record the failure, or carry an explicit suppression."""
+
+    id = "JGL007"
+    name = "silent-exception-swallow"
+    description = (
+        "bare `except Exception: pass` or overly-broad retriable= tuple "
+        "outside resilience/ and parallel/retry.py"
+    )
+
+    def _is_broad(self, module: ModuleInfo, type_node: ast.expr | None) -> bool:
+        if type_node is None:  # bare `except:` — broader than broad
+            return True
+        nodes = (
+            type_node.elts
+            if isinstance(type_node, ast.Tuple)
+            else [type_node]
+        )
+        return any(module.resolve(n) in _BROAD_EXC for n in nodes)
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if _in_resilience_scope(module.relpath):
+            return
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ExceptHandler):
+                silent = all(
+                    isinstance(s, (ast.Pass, ast.Continue)) for s in node.body
+                )
+                if silent and self._is_broad(module, node.type):
+                    label = (
+                        "bare `except:`" if node.type is None
+                        else "`except Exception`"
+                    )
+                    yield self.finding(
+                        module,
+                        node,
+                        f"{label} with a pass/continue body swallows "
+                        "programming errors silently — narrow the type, "
+                        "record the failure, or classify via "
+                        "resilience.errors",
+                    )
+            elif isinstance(node, ast.keyword) and node.arg == "retriable":
+                broad = next(
+                    (
+                        n
+                        for n in ast.walk(node.value)
+                        if isinstance(n, (ast.Name, ast.Attribute))
+                        and module.resolve(n) in _BROAD_EXC
+                    ),
+                    None,
+                )
+                if broad is not None:
+                    yield self.finding(
+                        module,
+                        broad,
+                        "retriable tuple includes Exception/BaseException — "
+                        "this retries programming errors; use the "
+                        "classified default (retriable=None) or list the "
+                        "transient types",
+                    )

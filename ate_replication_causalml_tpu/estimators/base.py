@@ -28,6 +28,11 @@ class EstimatorResult:
     implicitly via the CI half-width); for estimators with no SE the
     reference sets ``lower_ci == upper_ci == ate``
     (``ate_functions.R:107, 129``) and ``se`` is NaN.
+
+    ``status`` is the resilience layer's degradation marker: ``"ok"``
+    for a computed estimate, ``"failed"`` for a stage the sweep isolated
+    instead of aborting on (pipeline.py) — such rows carry NaN values
+    and render annotated, never as silent garbage.
     """
 
     method: str
@@ -35,6 +40,7 @@ class EstimatorResult:
     lower_ci: float
     upper_ci: float
     se: float = float("nan")
+    status: str = "ok"
 
     @classmethod
     def from_point_se(cls, method: str, ate: float, se: float) -> "EstimatorResult":

@@ -250,7 +250,7 @@ def grow_causal_forest(
     chunks = require_all(
         run_shards(
             obs.instrument_dispatch("causal_forest", chunk_shard),
-            n_disp, retriable=(jax.errors.JaxRuntimeError,),
+            n_disp,
             pool="causal_forest",
         )
     )
@@ -359,7 +359,7 @@ def grow_causal_forest_sharded(
     parts = require_all(
         run_shards(
             obs.instrument_dispatch("causal_forest_sharded", dispatch),
-            n_disp, retriable=(jax.errors.JaxRuntimeError,),
+            n_disp,
             pool="causal_forest_sharded",
         )
     )

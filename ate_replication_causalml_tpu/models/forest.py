@@ -868,15 +868,16 @@ def fit_forest_classifier(
             depth=depth, mtry=mtry, n_bins=n_bins, hist_backend=hist_backend,
         )
 
-    # Elastic host loop (parallel/retry.py): a transient device failure
-    # (dropped tunnel, preemption) re-runs only that dispatch; keys are
-    # explicit so the retried dispatch is bit-identical. Telemetry:
+    # Elastic host loop (parallel/retry.py, classified retry): a
+    # transient device failure (dropped tunnel, preemption) re-runs only
+    # that dispatch, while a programming error raises on attempt 1; keys
+    # are explicit so the retried dispatch is bit-identical. Telemetry:
     # dispatch counts + per-dispatch host durations, labeled by fitter
     # (recorded at the dispatch boundary — no sync added).
     chunks = require_all(
         run_shards(
             obs.instrument_dispatch("forest_classifier", chunk_shard),
-            n_disp, retriable=(jax.errors.JaxRuntimeError,),
+            n_disp,
             pool="forest_classifier",
         )
     )
@@ -1366,7 +1367,7 @@ def fit_forest_sharded(
     parts = require_all(
         run_shards(
             obs.instrument_dispatch("forest_sharded", dispatch),
-            n_disp, retriable=(jax.errors.JaxRuntimeError,),
+            n_disp,
             pool="forest_sharded",
         )
     )

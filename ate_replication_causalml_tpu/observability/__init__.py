@@ -34,6 +34,7 @@ from ate_replication_causalml_tpu.observability.events import (
     span,
 )
 from ate_replication_causalml_tpu.observability.export import (
+    atomic_file,
     atomic_write_json,
     atomic_write_text,
     write_events_jsonl,
@@ -54,7 +55,8 @@ from ate_replication_causalml_tpu.observability.registry import (
 
 __all__ = [
     "EVENTS", "EventLog", "MetricsRegistry", "REGISTRY", "SCHEMA_VERSION",
-    "atomic_write_json", "atomic_write_text", "bench_record", "counter",
+    "atomic_file", "atomic_write_json", "atomic_write_text",
+    "bench_record", "counter",
     "emit", "enabled", "gauge", "histogram", "install_jax_monitoring",
     "instrument_dispatch", "record_compiled_cost", "record_device_memory",
     "sanitize_label", "set_enabled", "span", "watch_cache_dir",

@@ -14,11 +14,13 @@ when telemetry is disabled — no empty husk files.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import stat
 import tempfile
 import threading
+from typing import Iterator
 
 from ate_replication_causalml_tpu.observability import events as _events
 from ate_replication_causalml_tpu.observability import registry as _registry
@@ -78,15 +80,30 @@ def _artifact_mode() -> int:
 def atomic_write_text(path: str, text: str) -> None:
     """Write ``text`` to ``path`` atomically: tmp file in the same
     directory (same filesystem — ``os.replace`` must not cross mounts),
-    fsync, rename."""
+    fsync, rename. Binary/streaming writers (the verified ``.npz``
+    checkpoint writer) use :func:`atomic_file` directly."""
+    _atomic_write(path, text)
+
+
+@contextlib.contextmanager
+def atomic_file(path: str) -> Iterator[str]:
+    """Yield a tmp path in ``path``'s directory for the caller to write
+    (streaming writers — ``np.savez_compressed`` — never need the whole
+    artifact in memory); on a clean exit the tmp is fsynced, given
+    ``open(path, "w")``-equivalent permissions and ``os.replace``d over
+    ``path``; on an exception it is unlinked. Same-filesystem by
+    construction, so the rename is atomic."""
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(path) + ".", suffix=".tmp")
+    os.close(fd)
     try:
-        with os.fdopen(fd, "w") as f:
-            f.write(text)
-            f.flush()
-            os.fsync(f.fileno())
+        yield tmp
+        fd = os.open(tmp, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
         # mkstemp creates 0600; match plain open(path, "w") semantics:
         # an EXISTING artifact keeps its mode (a user-tightened 0600
         # stays 0600), a new one gets the umask-derived default
@@ -103,6 +120,13 @@ def atomic_write_text(path: str, text: str) -> None:
         except OSError:
             pass
         raise
+
+
+def _atomic_write(path: str, data: str) -> None:
+    with atomic_file(path) as tmp:
+        # No fsync here: atomic_file fsyncs the tmp before the rename.
+        with open(tmp, "w") as f:
+            f.write(data)
 
 
 def atomic_write_json(path: str, obj, indent: int | None = 1,

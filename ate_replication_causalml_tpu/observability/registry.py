@@ -205,7 +205,10 @@ class MetricsRegistry:
         for fn in list(self._collectors):
             try:
                 fn()
-            except Exception:  # noqa: BLE001 — observers must not crash runs
+            # A collector callback is third-party observer code; it must
+            # never crash a snapshot:
+            # graftlint: disable=JGL007
+            except Exception:  # noqa: BLE001
                 pass
         out = {
             "schema_version": SCHEMA_VERSION,

@@ -1,4 +1,5 @@
-"""Failure detection & elastic recovery (SURVEY.md §5.3).
+"""Failure detection & elastic recovery (SURVEY.md §5.3, hardened in
+ISSUE 3).
 
 The reference has no systems-level fault tolerance (a single R process;
 its only robustness is numerical — propensity clipping, ``na.rm``). The
@@ -9,33 +10,56 @@ stateless and idempotent, so recovery is re-execution:
 * :func:`probe_devices` — failure detection: run a tiny addition on
   every visible device, report the healthy subset. A dropped axon
   tunnel / preempted slice shows up here instead of as a hang deep in
-  an estimator.
-* :func:`run_shards` — elastic shard runner: executes independent
-  shard thunks sequentially, retrying failures (transient
-  ``JaxRuntimeError``, tunnel drops) with exponential backoff.
-  Deterministic: each shard owns its RNG key, so a retried shard
-  reproduces exactly what the failed attempt would have produced.
-  Both forest fitters drive their tree-chunk loops through this.
-* :func:`inject_failures` — fault injection for tests: wraps a shard
-  function so chosen attempts raise, proving the recovery path.
+  an estimator. Chaos scope ``device:drop=k`` injects here.
+* :func:`run_shards` — hardened shard runner: executes independent
+  shard thunks sequentially with **classified** retry — transient
+  failures (``JaxRuntimeError``, ``OSError``) retried with capped
+  exponential backoff and deterministic per-``(pool, shard, attempt)``
+  jitter; programming errors (``TypeError``, ``ValueError``,
+  ``AssertionError``) raise immediately instead of burning retry
+  budget on a bug. A per-pool wall-clock ``deadline_s`` bounds the
+  whole pool; repeated device-origin failures trigger a
+  :func:`probe_devices` re-probe and, via ``redispatch``, move the
+  remaining shards onto the healthy subset. Deterministic: each shard
+  owns its RNG key, so a retried shard reproduces exactly what the
+  failed attempt would have produced. Both forest fitters drive their
+  tree-chunk loops through this.
+* :func:`inject_failures` — plan-based fault injection, now a thin
+  front for :func:`resilience.chaos.plan_faults`; probabilistic
+  injection comes from the ``ATE_TPU_CHAOS`` shard scope, which
+  :func:`run_shards` arms automatically.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import time
+import zlib
 from typing import Callable, Iterable, Sequence
 
 import jax
 import jax.numpy as jnp
 
 from ate_replication_causalml_tpu import observability as obs
+from ate_replication_causalml_tpu.resilience import chaos
+from ate_replication_causalml_tpu.resilience.errors import (
+    ChaosFault,
+    DeadlineExceeded,
+    classify,
+)
+
+#: Backoff growth is capped at this multiple of the base delay — after
+#: a few doublings a longer sleep stops buying recovery probability and
+#: only burns the pool deadline.
+BACKOFF_CAP_MULT = 8.0
 
 
 def probe_devices(devices: Sequence | None = None) -> list:
     """Return the subset of ``devices`` (default: all) that complete a
     trivial computation. Failures are caught, not raised — detection,
-    not crash."""
+    not crash. Under ``ATE_TPU_CHAOS`` ``device:drop=k`` the last ``k``
+    healthy devices are reported dead (deterministically, so they stay
+    dead on re-probe)."""
     healthy = []
     for d in devices if devices is not None else jax.devices():
         try:
@@ -44,18 +68,38 @@ def probe_devices(devices: Sequence | None = None) -> list:
                 healthy.append(d)
         except Exception:
             continue
+    inj = chaos.active()
+    if inj is not None:
+        healthy = inj.drop_devices(healthy)
     return healthy
 
 
 @dataclasses.dataclass
 class ShardOutcome:
-    """Bookkeeping for one shard's execution."""
+    """Bookkeeping for one shard's execution. ``deadline`` marks a
+    shard the pool deadline cut (vs one that exhausted its retries)."""
 
     index: int
     result: object = None
     attempts: int = 0
     ok: bool = False
     error: str | None = None
+    deadline: bool = False
+
+
+def backoff_delay(pool: str, shard: int, attempt: int,
+                  base_s: float) -> float:
+    """Backoff before retrying ``shard``'s ``attempt``-th failure:
+    exponential in the attempt, jittered, capped at
+    ``BACKOFF_CAP_MULT × base_s``. The jitter is a pure function of
+    ``(pool, shard, attempt)`` (crc32 → [0, 0.25)) — retries de-herd
+    across shards without any nondeterminism, so tests can assert the
+    exact sleep schedule."""
+    if base_s <= 0.0:
+        return 0.0
+    raw = base_s * (2.0 ** (attempt - 1))
+    jitter = zlib.crc32(f"{pool}|{shard}|{attempt}".encode()) / 2.0**32
+    return min(raw * (1.0 + 0.25 * jitter), BACKOFF_CAP_MULT * base_s)
 
 
 def run_shards(
@@ -64,10 +108,14 @@ def run_shards(
     max_attempts: int = 3,
     backoff_s: float = 0.25,
     log: Callable[[str], None] | None = None,
-    retriable: tuple[type[BaseException], ...] = (Exception,),
+    retriable: tuple[type[BaseException], ...] | None = None,
     pool: str = "shards",
+    deadline_s: float | None = None,
+    probe: Callable[[], list] | None = None,
+    redispatch: Callable[[list], Callable[[int], object]] | None = None,
+    reprobe_after: int = 2,
 ) -> list[ShardOutcome]:
-    """Run ``shard_fn(i)`` for every shard ``i`` with per-shard retry.
+    """Run ``shard_fn(i)`` for every shard ``i`` with classified retry.
 
     Shards must be independent and idempotent (they are: bootstrap
     batches, folds and tree chunks carry their own fold-in keys). A
@@ -76,13 +124,37 @@ def run_shards(
     whether partial coverage is acceptable (e.g. 9/10 bootstrap batches
     still estimate an SE) or raise via :func:`require_all`.
 
+    Error handling (``retriable=None``, the default) classifies via
+    :func:`resilience.errors.classify`: transient failures
+    (``JaxRuntimeError``, ``OSError``, plain ``RuntimeError``) retry;
+    programming errors (``TypeError``, ``ValueError``,
+    ``AssertionError``, …) raise immediately — a bug replayed three
+    times with backoff is still the same bug, reported late.
+    ``KeyboardInterrupt`` is never caught. Passing an explicit
+    ``retriable`` tuple restores opt-in semantics: listed types retry,
+    everything else propagates.
+
+    ``deadline_s`` bounds the POOL's wall clock: once it passes, no new
+    attempt starts and no backoff sleep begins; unfinished shards are
+    marked failed with a ``DeadlineExceeded`` error string (events:
+    ``shard_deadline``). Completed shards keep their results — deadline
+    pressure degrades coverage, it does not void finished work.
+
+    After ``reprobe_after`` device-origin failures
+    (``JaxRuntimeError``) across the pool, the runner re-probes
+    (``probe``, default :func:`probe_devices`) and emits
+    ``device_reprobe`` with the healthy count; with ``redispatch`` it
+    swaps in ``redispatch(healthy)`` as the shard function, moving the
+    REMAINING shards onto the surviving devices.
+
     ``pool`` labels this call's telemetry: attempts / retries /
     failures / backoff-seconds counters (observability/), created at
     zero up front so a healthy run still exports the keys — "no
     retries" is a reported fact, not a missing metric. Retries and
     exhaustions additionally land in the event log with the error
-    string, which is how a transient-tunnel-drop diagnosis stops
-    requiring print archaeology.
+    string. Under ``ATE_TPU_CHAOS`` the shard scope is armed here:
+    injected faults raise ``ChaosShardFault`` (transient) before the
+    thunk runs, each one a ``chaos_inject`` event.
     """
     attempts_c = obs.counter("shard_attempts_total", "run_shards attempts")
     retries_c = obs.counter("shard_retries_total", "failed attempts that will retry")
@@ -90,20 +162,68 @@ def run_shards(
     backoff_c = obs.counter("shard_backoff_seconds_total", "backoff sleep time")
     for c in (attempts_c, retries_c, failures_c, backoff_c):
         c.inc(0, pool=pool)
+
+    inj = chaos.active()
+    if inj is not None:
+        shard_fn = inj.wrap_shard(shard_fn, pool=pool)
+    catch = retriable if retriable is not None else (Exception,)
+    if inj is not None and retriable is not None:
+        # Injections must stay transient under the explicit-tuple mode
+        # too: a ChaosShardFault stands in for a preemption (which would
+        # raise one of the caller's listed types), so it walks the same
+        # retry path instead of escaping the pool on attempt 1.
+        catch = tuple(catch) + (ChaosFault,)
+    deadline = None if deadline_s is None else time.monotonic() + deadline_s
+    device_failures = 0
+    deadline_shards = 0
+
     outcomes = [ShardOutcome(index=i) for i in range(n_shards)]
     for out in outcomes:
-        delay = backoff_s
+        cut = False
         while out.attempts < max_attempts and not out.ok:
+            if deadline is not None and time.monotonic() >= deadline:
+                cut = True
+                break
             out.attempts += 1
             attempts_c.inc(1, pool=pool)
             try:
                 out.result = shard_fn(out.index)
                 out.ok = True
-            except retriable as e:  # noqa: PERF203 — retry loop
+                out.error = None
+            except catch as e:  # noqa: PERF203 — retry loop
+                if retriable is None and classify(e) == "fatal":
+                    # Programming error: re-execution replays the bug.
+                    obs.emit(
+                        "shard_fatal", status="error", pool=pool,
+                        shard=out.index, attempt=out.attempts,
+                        error=f"{type(e).__name__}: {e}",
+                    )
+                    raise
                 out.error = f"{type(e).__name__}: {e}"
                 if log:
                     log(f"shard {out.index} attempt {out.attempts} failed: {out.error}")
+                if _is_device_origin(e):
+                    device_failures += 1
+                    if reprobe_after and device_failures >= reprobe_after:
+                        device_failures = 0
+                        healthy = (probe or probe_devices)()
+                        obs.emit(
+                            "device_reprobe", status="ok", pool=pool,
+                            healthy=len(healthy), after_shard=out.index,
+                        )
+                        if redispatch is not None:
+                            shard_fn = redispatch(healthy)
+                            if inj is not None:
+                                shard_fn = inj.wrap_shard(shard_fn, pool=pool)
                 if out.attempts < max_attempts:
+                    delay = backoff_delay(pool, out.index, out.attempts, backoff_s)
+                    if deadline is not None and time.monotonic() + delay >= deadline:
+                        # The backoff recovery needs does not fit before
+                        # the deadline: cut the shard now instead of
+                        # spin-retrying with no backoff at all. No retry
+                        # is counted — none will run.
+                        cut = True
+                        break
                     retries_c.inc(1, pool=pool)
                     obs.emit(
                         "shard_retry", status="retrying", pool=pool,
@@ -111,23 +231,66 @@ def run_shards(
                     )
                     backoff_c.inc(delay, pool=pool)
                     time.sleep(delay)
-                    delay *= 2.0
-                else:
-                    failures_c.inc(1, pool=pool)
-                    obs.emit(
-                        "shard_failed", status="error", pool=pool,
-                        shard=out.index, attempt=out.attempts, error=out.error,
-                    )
+        if not out.ok:
+            failures_c.inc(1, pool=pool)
+            if cut:
+                out.deadline = True
+                tail = f"; last error: {out.error}" if out.error else ""
+                out.error = (
+                    f"DeadlineExceeded: pool {pool!r} deadline of "
+                    f"{deadline_s}s reached after {out.attempts} attempt(s)"
+                    f"{tail}"
+                )
+                deadline_shards += 1
+                obs.emit(
+                    "shard_deadline", status="error", pool=pool,
+                    shard=out.index, attempt=out.attempts, error=out.error,
+                )
+            else:
+                obs.emit(
+                    "shard_failed", status="error", pool=pool,
+                    shard=out.index, attempt=out.attempts, error=out.error,
+                )
+    if deadline_shards:
+        obs.emit(
+            "pool_deadline", status="error", pool=pool,
+            deadline_s=deadline_s, shards_cut=deadline_shards,
+        )
     return outcomes
 
 
+_DEVICE_ORIGIN_TYPES: tuple[type[BaseException], ...] | None = None
+
+
+def _is_device_origin(e: BaseException) -> bool:
+    """Failures that implicate the device/runtime rather than the shard:
+    worth a re-probe. Chaos shard faults count — they stand in for
+    preemptions, and the re-probe path is exactly what they test."""
+    global _DEVICE_ORIGIN_TYPES
+    if _DEVICE_ORIGIN_TYPES is None:
+        types: list[type[BaseException]] = [ChaosFault]
+        jax_rt = getattr(getattr(jax, "errors", None), "JaxRuntimeError", None)
+        if isinstance(jax_rt, type):
+            types.append(jax_rt)
+        _DEVICE_ORIGIN_TYPES = tuple(types)
+    return isinstance(e, _DEVICE_ORIGIN_TYPES)
+
+
 def require_all(outcomes: Iterable[ShardOutcome]) -> list:
-    """Results of fully successful runs; raises if any shard failed."""
+    """Results of fully successful runs; raises if any shard failed —
+    :class:`~..resilience.errors.DeadlineExceeded` (a RuntimeError
+    subclass, so broad handlers still work) when the pool deadline cut
+    any of them, plain RuntimeError otherwise, so callers can route
+    deadline pressure (a capacity decision) separately from exhausted
+    retries (a health problem)."""
     outcomes = list(outcomes)
     failed = [o for o in outcomes if not o.ok]
     if failed:
         detail = "; ".join(f"shard {o.index}: {o.error}" for o in failed[:5])
-        raise RuntimeError(f"{len(failed)}/{len(outcomes)} shards failed: {detail}")
+        msg = f"{len(failed)}/{len(outcomes)} shards failed: {detail}"
+        if any(o.deadline for o in failed):
+            raise DeadlineExceeded(msg)
+        raise RuntimeError(msg)
     return [o.result for o in outcomes]
 
 
@@ -135,14 +298,8 @@ def inject_failures(
     shard_fn: Callable[[int], object],
     fail_plan: dict[int, int],
 ) -> Callable[[int], object]:
-    """Fault injection: ``fail_plan[i] = k`` makes shard ``i``'s first
-    ``k`` attempts raise. For testing recovery paths."""
-    remaining = dict(fail_plan)
-
-    def wrapped(i: int):
-        if remaining.get(i, 0) > 0:
-            remaining[i] -= 1
-            raise RuntimeError(f"injected fault on shard {i}")
-        return shard_fn(i)
-
-    return wrapped
+    """Plan-based fault injection: ``fail_plan[i] = k`` makes shard
+    ``i``'s first ``k`` attempts raise. Kept as the historical name for
+    :func:`resilience.chaos.plan_faults` — one injection engine, one
+    ``chaos_inject`` event channel."""
+    return chaos.plan_faults(shard_fn, fail_plan)

@@ -15,6 +15,13 @@ What the notebook lacks, the driver adds (SURVEY.md §5):
   each row (the north star is a wall-clock metric, §5.1).
 * **Config as data** — every notebook global and call-site constant
   lives in :class:`SweepConfig` (§5.6).
+* **Graceful degradation** (ISSUE 3) — each stage runs under an
+  isolation policy: a failing estimator becomes a ``status="failed"``
+  row (error, attempts, seconds) instead of aborting the sweep; resume
+  retries failed and unresumable rows; reports and figures render
+  partial sweeps with failures annotated; a finite-value guard keeps
+  NaN/Inf point estimates out of the result set. The ``ATE_TPU_CHAOS``
+  fault injector (resilience/chaos.py) exercises all of it on demand.
 
 CLI::
 
@@ -26,6 +33,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import math
 import os
 from typing import Callable, Iterable
 
@@ -61,6 +69,11 @@ from ate_replication_causalml_tpu.estimators import (
 )
 from ate_replication_causalml_tpu import observability as obs
 from ate_replication_causalml_tpu.models.forest import rf_oob_propensity
+from ate_replication_causalml_tpu.resilience import chaos
+from ate_replication_causalml_tpu.resilience.errors import (
+    ChaosSpecError,
+    NonFiniteResult,
+)
 from ate_replication_causalml_tpu.utils.profiling import StageTimer, xla_trace
 
 
@@ -111,6 +124,10 @@ class SweepConfig:
     # trees / little-bag groups over a tree-axis mesh and CV folds over
     # a fold-axis mesh (SURVEY.md §2.4). False forces single-device.
     use_mesh: bool = True
+    # Stage isolation policy (ISSUE 3): "degrade" records a failing
+    # estimator as a status="failed" checkpoint/report row and keeps the
+    # sweep going (resume retries it); "raise" aborts on first failure.
+    fail_policy: str = "degrade"
 
     def quick(self) -> "SweepConfig":
         return dataclasses.replace(
@@ -134,6 +151,9 @@ class SweepReport:
     incorrect_cf_se: float | None = None
     timings_s: dict = dataclasses.field(default_factory=dict)
     figure_paths: list = dataclasses.field(default_factory=list)
+    #: method -> {"error", "attempts", "seconds"} for stages the
+    #: isolation policy degraded instead of aborting on.
+    failures: dict = dataclasses.field(default_factory=dict)
 
 
 def _jsonsafe(obj):
@@ -154,8 +174,16 @@ class _Checkpoint:
     """Append-only JSONL of finished result rows, keyed by method name.
 
     The first record is a config fingerprint; a checkpoint written under
-    a different config is set aside (renamed ``*.stale``) instead of
-    being silently reused as current results.
+    a different config is set aside (renamed ``*.stale`` / ``*.stale.N``
+    — never clobbering a prior set-aside) instead of being silently
+    reused as current results.
+
+    Torn lines (a kill mid-append, or chaos ``fs:torn_write``) are
+    skipped and counted into ``checkpoint_torn_lines_total``. The
+    journal stays append-only, so a torn line persists in the file and
+    is re-counted on every subsequent resume of the same outdir — the
+    metric reports the file's state, not newly lost data (the row
+    itself is recomputed on the first resume after the tear).
     """
 
     def __init__(self, path: str | None, fingerprint: str, log=print):
@@ -163,6 +191,7 @@ class _Checkpoint:
         self.done: dict[str, dict] = {}
         if path and os.path.exists(path):
             recs = []
+            torn = 0
             with open(path) as f:
                 for line in f:
                     if not line.strip():
@@ -171,11 +200,21 @@ class _Checkpoint:
                         recs.append(json.loads(line))
                     except json.JSONDecodeError:
                         # A kill mid-append leaves a truncated last line;
-                        # completed rows before it are still good.
+                        # completed rows before it are still good. Torn
+                        # lines are counted — silent data loss must show
+                        # up in metrics.json, not only in a log scroll.
+                        torn += 1
                         log(f"checkpoint {path}: skipping unparsable line")
+            if torn:
+                obs.counter(
+                    "checkpoint_torn_lines_total",
+                    "unparsable results.jsonl lines skipped on resume",
+                ).inc(torn)
+                obs.emit("checkpoint_torn_lines", status="warning",
+                         path=path, lines=torn)
             header = next((r for r in recs if r.get("method") == "__config__"), None)
             if header is None or header.get("fingerprint") != fingerprint:
-                stale = path + ".stale"
+                stale = _unused_stale_path(path)
                 os.replace(path, stale)
                 log(f"checkpoint {path} was written under a different config; "
                     f"moved to {stale} and starting fresh")
@@ -198,8 +237,50 @@ class _Checkpoint:
         rec = _jsonsafe(rec)
         self.done[rec["method"]] = rec
         if self.path:
+            line = json.dumps(rec) + "\n"
+            inj = chaos.active()
+            if inj is not None:
+                # fs:torn_write chaos: persist this row torn, the way a
+                # kill mid-append would. The in-memory copy above keeps
+                # the CURRENT run correct; the reader's torn-line skip +
+                # recompute-on-resume is the path under test.
+                line = inj.torn_line(line, site=self.path)
             with open(self.path, "a") as f:
-                f.write(json.dumps(rec) + "\n")
+                f.write(line)
+
+
+def _unused_stale_path(path: str) -> str:
+    """First free ``path + ".stale"[.N]`` — a second config change must
+    not clobber the results set aside by the first one."""
+    stale = path + ".stale"
+    n = 0
+    while os.path.exists(stale):
+        n += 1
+        stale = f"{path}.stale.{n}"
+    return stale
+
+
+#: Keys a checkpoint row must carry to resume. ``seconds``/extras are
+#: optional (legacy rows), but the statistical payload is not.
+_REQUIRED_ROW_KEYS = ("method", "ate", "lower_ci", "upper_ci", "se")
+
+
+def _row_resumable(rec: dict) -> tuple[bool, str]:
+    """Whether a checkpoint row can be resumed as-is, else why not
+    (hand-edited/legacy rows missing keys, rows whose ate is not a
+    finite number, and ``status="failed"`` rows all fall through to a
+    recompute instead of crashing the resume)."""
+    for k in _REQUIRED_ROW_KEYS:
+        if k not in rec:
+            return False, f"missing key {k!r}"
+    if rec.get("status", "ok") != "ok":
+        return False, f"status={rec.get('status')!r}"
+    ate = rec["ate"]
+    if isinstance(ate, bool) or not isinstance(ate, (int, float)):
+        return False, f"non-numeric ate {ate!r}"
+    if not math.isfinite(ate):
+        return False, f"non-finite ate {ate!r}"
+    return True, ""
 
 
 def build_frames(
@@ -270,6 +351,13 @@ def _run_sweep_impl(
 ) -> SweepReport:
     if outdir:
         os.makedirs(outdir, exist_ok=True)
+    # Arm chaos NOW, with fresh per-run fault budgets: a malformed
+    # ATE_TPU_CHAOS spec must fail the run at config time
+    # (ChaosSpecError), not surface as thirteen degraded stages — and a
+    # second sweep in the same process must get full budgets, not the
+    # remnants the previous run left.
+    chaos.reset()
+    chaos.active()
     # Resume is only valid for the same config + data source + device
     # topology (mesh and single-device runs are statistically equivalent
     # but not bit-identical) + framework version: estimator code changes
@@ -338,35 +426,95 @@ def _run_sweep_impl(
     )
 
     def stage(method: str, fn: Callable[[], object]) -> EstimatorResult:
-        """Run one estimator with timing + checkpointing + telemetry.
-        ``fn`` returns an EstimatorResult, or (EstimatorResult,
-        extras-dict) — extras ride the checkpoint record (read back via
-        ``ckpt.get``). The stage span's status records whether the row
-        was computed or resumed from the checkpoint."""
+        """Run one estimator with timing + checkpointing + telemetry,
+        under the config's isolation policy. ``fn`` returns an
+        EstimatorResult, or (EstimatorResult, extras-dict) — extras ride
+        the checkpoint record (read back via ``ckpt.get``). The stage
+        span's status records whether the row was computed, resumed
+        from the checkpoint, or failed-and-degraded.
+
+        Degradation (``fail_policy="degrade"``): an exception (or a
+        non-finite ATE — the finite-value guard) becomes a
+        ``status="failed"`` row carrying the error, attempt count and
+        seconds, in both the checkpoint and the report; the sweep
+        continues. Resume retries failed rows — and rows a hand edit or
+        format drift made unresumable (``_row_resumable``) — instead of
+        crashing on them. ``KeyboardInterrupt``/``SystemExit`` always
+        propagate: an operator's ^C is not an estimator failure."""
         cached = ckpt.get(method)
         with obs.span("sweep_stage", method=method) as sp:
             if cached is not None:
-                sp.set_status("resumed")
-                stage_c.inc(1, method=method, status="resumed")
-                log(f"  [resume] {method}: ate={cached['ate']:.4f}")
-                nanf = lambda v: float("nan") if v is None else v
-                res = EstimatorResult(
-                    method=cached["method"], ate=cached["ate"],
-                    lower_ci=nanf(cached["lower_ci"]), upper_ci=nanf(cached["upper_ci"]),
-                    se=nanf(cached["se"]),
-                )
-                timer.seconds[method] = cached.get("seconds", 0.0)
-                return res
+                resumable, why = _row_resumable(cached)
+                if resumable:
+                    sp.set_status("resumed")
+                    stage_c.inc(1, method=method, status="resumed")
+                    log(f"  [resume] {method}: ate={cached['ate']:.4f}")
+                    nanf = lambda v: float("nan") if v is None else v
+                    res = EstimatorResult(
+                        method=cached["method"], ate=cached["ate"],
+                        lower_ci=nanf(cached["lower_ci"]), upper_ci=nanf(cached["upper_ci"]),
+                        se=nanf(cached["se"]),
+                    )
+                    timer.seconds[method] = cached.get("seconds", 0.0)
+                    return res
+                obs.emit("checkpoint_row_rejected", status="retrying",
+                         method=method, reason=why)
+                log(f"  [retry] {method}: checkpoint row not resumable "
+                    f"({why}); recomputing")
             sp.set_status("computed")
-            # xla_trace sanitizes the label itself (method names carry
-            # spaces/parens/dots — e.g. ``Causal Forest(GRF)``).
-            with timer.stage(method), xla_trace(method):
-                out = fn()
-            res, extras = out if isinstance(out, tuple) else (out, {})
+            # The prior attempt count rides the same hand-editable row
+            # _row_resumable guards, so tolerate garbage here too.
+            prior = cached.get("attempts") if cached else 0
+            attempts = (
+                int(prior) + 1
+                if isinstance(prior, (int, float)) and not isinstance(prior, bool)
+                else 1
+            )
+            try:
+                # xla_trace sanitizes the label itself (method names carry
+                # spaces/parens/dots — e.g. ``Causal Forest(GRF)``).
+                with timer.stage(method), xla_trace(method):
+                    inj = chaos.active()
+                    if inj is not None:
+                        inj.maybe_fail_stage(method)
+                    out = fn()
+                res, extras = out if isinstance(out, tuple) else (out, {})
+                if not math.isfinite(res.ate):
+                    raise NonFiniteResult(
+                        f"estimator returned ATE {res.ate!r} from finite "
+                        f"inputs — refusing to record a garbage row"
+                    )
+            except (KeyboardInterrupt, SystemExit, ChaosSpecError):
+                # ^C is not an estimator failure, and a malformed chaos
+                # spec (env edited mid-run) is an operator error — both
+                # must abort, never degrade.
+                raise
+            except Exception as e:
+                if config.fail_policy != "degrade":
+                    raise
+                dt = timer.seconds.get(method, 0.0)
+                err = f"{type(e).__name__}: {e}"
+                sp.set_status("failed")
+                sp.set_attr("error", err)
+                stage_c.inc(1, method=method, status="failed")
+                obs.emit("sweep_stage_failed", status="error", method=method,
+                         error=err, attempts=attempts)
+                report.failures[method] = {
+                    "error": err, "attempts": attempts, "seconds": round(dt, 3),
+                }
+                nan = float("nan")
+                res = EstimatorResult(method=method, ate=nan, lower_ci=nan,
+                                      upper_ci=nan, se=nan, status="failed")
+                ckpt.put(dict(res.to_dict(), error=err, attempts=attempts,
+                              seconds=round(dt, 3)))
+                log(f"  [FAILED] {method}: {err} (attempt {attempts}, "
+                    f"{dt:.1f}s) — degrading, sweep continues")
+                return res
             dt = timer.seconds[method]
             sp.set_attr("seconds", round(dt, 3))
             stage_c.inc(1, method=method, status="computed")
-            ckpt.put(dict(res.to_dict(), seconds=round(dt, 3), **extras))
+            ckpt.put(dict(res.to_dict(), seconds=round(dt, 3),
+                          attempts=attempts, **extras))
             log(f"  {method}: ate={res.ate:.4f} ci=[{res.lower_ci:.4f},{res.upper_ci:.4f}] "
                 f"({dt:.1f}s)")
             return res
@@ -459,13 +607,21 @@ def _run_sweep_impl(
                 "n_biased": report.n_biased,
                 "incorrect_cf": [report.incorrect_cf_ate, report.incorrect_cf_se],
                 "timings_s": {k: round(v, 3) for k, v in report.timings_s.items()},
+                "failures": report.failures,
             }),
         )
     if plots and outdir:
         from ate_replication_causalml_tpu.viz import notebook_figures
 
+        # A degraded oracle cannot anchor the reference band; the
+        # figures render the partial sweep with failures annotated.
+        oracle_fig = (
+            report.oracle
+            if report.oracle is not None and math.isfinite(report.oracle.ate)
+            else None
+        )
         report.figure_paths = notebook_figures(
-            report.results, report.oracle, outdir)
+            report.results, oracle_fig, outdir)
         log(f"figures: {report.figure_paths}")
     if outdir:
         log(f"report: {write_report_md(report, outdir, csv_path=csv_path)}")
@@ -534,12 +690,33 @@ def write_report_md(report: SweepReport, outdir: str,
         "|---|---|---|---|",
     ]
     for r in report.results:
+        if getattr(r, "status", "ok") != "ok":
+            lines.append(f"| {r.method} | ✗ failed | — | — |")
+            continue
         secs = report.timings_s.get(r.method)
         lines.append(
             f"| {r.method} | {fmt(r.ate)} | [{fmt(r.lower_ci)}, "
             f"{fmt(r.upper_ci)}] | {secs:.1f} |" if secs is not None else
             f"| {r.method} | {fmt(r.ate)} | [{fmt(r.lower_ci)}, "
             f"{fmt(r.upper_ci)}] | — |")
+    if report.failures:
+        lines += [
+            "",
+            "### Degraded stages",
+            "",
+            "The sweep's isolation policy recorded these estimators as "
+            "failed and carried on (partial coverage, not an aborted "
+            "run); re-running with the same output directory retries "
+            "exactly these rows:",
+            "",
+            "| Method | error | attempts |",
+            "|---|---|---|",
+        ]
+        # Raw exception text can carry '|' (shape errors) or backticks —
+        # escape so one bad message cannot corrupt the table markup.
+        esc = lambda s: str(s).replace("|", "\\|").replace("`", "'")
+        for m, f in report.failures.items():
+            lines.append(f"| {m} | `{esc(f.get('error', '?'))}` | {f.get('attempts', '?')} |")
     if len(figs) >= 2:
         lines += ["", f"![regression methods]({figs[1]})"]
     lines += [

@@ -1,0 +1,49 @@
+"""Resilience layer: chaos injection, error taxonomy, typed failures.
+
+The sweep's estimators decompose into independent, idempotent, per-key
+shards (DML/AIPW cross-fitting, bootstrap-of-little-bags forests), so
+recovery is re-execution and partial coverage still yields a valid
+estimate. This package supplies the pieces every failure-prone layer
+shares:
+
+* :mod:`.chaos` — the ``ATE_TPU_CHAOS`` fault injector (shard faults,
+  torn writes, dropped devices, stage failures), seeded + deterministic,
+  every injection a structured observability event;
+* :mod:`.errors` — the fatal-vs-transient classification the hardened
+  shard runner retries by, and the typed failures
+  (:class:`CheckpointCorrupt`, :class:`DeadlineExceeded`,
+  :class:`NonFiniteResult`, the :class:`ChaosFault` family).
+
+Consumers: ``parallel/retry.py`` (classified retry, deadline, re-probe),
+``pipeline.py`` (stage isolation + graceful degradation),
+``utils/checkpoint.py`` (verified checkpoints). README "Resilience &
+fault injection" documents the operator surface.
+"""
+
+from ate_replication_causalml_tpu.resilience import chaos
+from ate_replication_causalml_tpu.resilience.errors import (
+    FATAL_ERRORS,
+    ChaosFault,
+    ChaosShardFault,
+    ChaosSpecError,
+    ChaosStageFault,
+    CheckpointCorrupt,
+    DeadlineExceeded,
+    NonFiniteResult,
+    classify,
+    transient_errors,
+)
+
+__all__ = [
+    "FATAL_ERRORS",
+    "ChaosFault",
+    "ChaosShardFault",
+    "ChaosSpecError",
+    "ChaosStageFault",
+    "CheckpointCorrupt",
+    "DeadlineExceeded",
+    "NonFiniteResult",
+    "chaos",
+    "classify",
+    "transient_errors",
+]

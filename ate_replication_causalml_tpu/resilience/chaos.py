@@ -1,0 +1,375 @@
+"""Chaos harness: seeded, env-configurable fault injection (ISSUE 3).
+
+The only way to trust a recovery path is to walk it on purpose. One env
+var arms deterministic fault injectors at every failure-prone boundary
+the framework owns — the shard runner, the checkpoint writers, the
+device prober, the sweep stages::
+
+    ATE_TPU_CHAOS="shard:p=0.2,seed=7;fs:torn_write;device:drop=1"
+
+Grammar: scopes separated by ``;``, each ``name:item,item,...`` where an
+item is ``key=value`` or a bare flag. Scopes and their keys:
+
+* ``shard`` — ``p`` (selection probability per ``(pool, shard)`` site),
+  ``seed``, ``times`` (failing attempts per selected site, default 1),
+  ``pool`` (substring filter). A selected shard's first ``times``
+  attempts raise :class:`~.errors.ChaosShardFault`.
+* ``fs`` — flags ``torn_write`` (the next checkpoint-journal append is
+  written truncated, the artifact a kill mid-append leaves) and
+  ``corrupt_npz`` (the next ``save_fitted`` writes a truncated archive,
+  which the load-side digest must reject); ``times`` budgets each flag.
+* ``device`` — ``drop=k``: ``probe_devices`` reports the last ``k``
+  devices unhealthy (``times`` probes affected; 0 = every probe).
+* ``stage`` — ``fail=<substring>``: the first ``times`` sweep stages
+  whose method name contains the substring raise
+  :class:`~.errors.ChaosStageFault` (exercising graceful degradation).
+
+Injection decisions are pure functions of ``(seed, scope, site)`` —
+never of call order or a global RNG — so a chaos run is reproducible
+and, because retried shards carry their own fold-in keys, its surviving
+results are bit-identical to a fault-free run's. Every injected fault
+is emitted as a structured ``chaos_inject`` observability event and
+counted in ``chaos_injections_total``, so chaos runs are auditable from
+``events.jsonl`` alone.
+
+This module imports no jax (decisions are host-side hashing), so it is
+usable from any layer without initializing a backend.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import hashlib
+import os
+import threading
+from typing import Callable, Iterator, Sequence
+
+from ate_replication_causalml_tpu.observability import events as _events
+from ate_replication_causalml_tpu.observability import registry as _registry
+from ate_replication_causalml_tpu.resilience.errors import (
+    ChaosShardFault,
+    ChaosSpecError,
+    ChaosStageFault,
+)
+
+ENV_VAR = "ATE_TPU_CHAOS"
+
+#: scope -> key -> expected type (bool keys are the bare flags).
+_SCOPE_SCHEMA: dict[str, dict[str, type]] = {
+    "shard": {"p": float, "seed": int, "times": int, "pool": str},
+    "fs": {"torn_write": bool, "corrupt_npz": bool, "times": int},
+    "device": {"drop": int, "times": int},
+    "stage": {"fail": str, "times": int},
+}
+
+_SCOPE_DEFAULTS: dict[str, dict[str, object]] = {
+    "shard": {"p": 0.0, "seed": 0, "times": 1, "pool": ""},
+    "fs": {"torn_write": False, "corrupt_npz": False, "times": 1},
+    "device": {"drop": 0, "times": 0},  # times=0: every probe
+    "stage": {"fail": "", "times": 1},
+}
+
+
+def _record_injection(scope: str, site: str, **detail) -> None:
+    """The single audit channel every injected fault reports through:
+    one counter family + one ``chaos_inject`` event shape, shared by
+    the injector and the plan-based wrapper so the two can never
+    diverge."""
+    _registry.counter(
+        "chaos_injections_total", "faults injected by the chaos harness"
+    ).inc(1, scope=scope)
+    _events.emit("chaos_inject", status="injected", scope=scope,
+                 site=site, **detail)
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosConfig:
+    """Parsed ``ATE_TPU_CHAOS`` spec: ``scopes[name][key]`` with
+    defaults filled in. Only scopes named in the spec are armed."""
+
+    spec: str
+    scopes: dict  # name -> {key: value}
+
+    def scope(self, name: str) -> dict | None:
+        return self.scopes.get(name)
+
+
+def parse_chaos(spec: str) -> ChaosConfig:
+    """Parse the grammar above; unknown scopes/keys and uncoercible
+    values raise :class:`ChaosSpecError` — a malformed chaos config must
+    fail the run at arm time, not silently inject nothing."""
+    scopes: dict[str, dict[str, object]] = {}
+    for raw_scope in spec.split(";"):
+        raw_scope = raw_scope.strip()
+        if not raw_scope:
+            continue
+        name, sep, body = raw_scope.partition(":")
+        name = name.strip()
+        schema = _SCOPE_SCHEMA.get(name)
+        if schema is None:
+            raise ChaosSpecError(
+                f"unknown chaos scope {name!r} in {spec!r} "
+                f"(known: {', '.join(sorted(_SCOPE_SCHEMA))})"
+            )
+        params = dict(_SCOPE_DEFAULTS[name])
+        if sep:
+            for item in body.split(","):
+                item = item.strip()
+                if not item:
+                    continue
+                key, eq, value = item.partition("=")
+                key = key.strip()
+                if key not in schema:
+                    raise ChaosSpecError(
+                        f"unknown key {key!r} for chaos scope {name!r} "
+                        f"(known: {', '.join(sorted(schema))})"
+                    )
+                typ = schema[key]
+                if not eq:
+                    if typ is not bool:
+                        raise ChaosSpecError(
+                            f"chaos key {name}:{key} needs a value "
+                            f"({key}=<{typ.__name__}>)"
+                        )
+                    params[key] = True
+                    continue
+                try:
+                    params[key] = (
+                        value.strip() if typ is str
+                        else typ(value.strip()) if typ is not bool
+                        else value.strip().lower() in ("1", "true", "yes", "on")
+                    )
+                except ValueError as e:
+                    raise ChaosSpecError(
+                        f"chaos key {name}:{key}={value!r} is not a "
+                        f"{typ.__name__}"
+                    ) from e
+        scopes[name] = params
+    return ChaosConfig(spec=spec, scopes=scopes)
+
+
+def _unit(seed: int, *parts: str) -> float:
+    """Deterministic uniform in [0, 1) from (seed, parts) — sha256, no
+    global RNG, independent of call order."""
+    h = hashlib.sha256(("%d|" % seed + "|".join(parts)).encode()).digest()
+    return int.from_bytes(h[:8], "big") / 2.0**64
+
+
+class ChaosInjector:
+    """Stateful fault budgets over a parsed :class:`ChaosConfig`.
+
+    *Selection* is stateless (hash of seed + site); *budgets* (``times``)
+    are process state guarded by a lock, so one injector arms a whole
+    run coherently across the sweep driver, shard loops and writers.
+    """
+
+    def __init__(self, config: ChaosConfig):
+        self.config = config
+        self._lock = threading.Lock()
+        self._shard_left: dict[tuple[str, int], int] = {}
+        fs = config.scope("fs") or _SCOPE_DEFAULTS["fs"]
+        self._fs_left = {
+            kind: (int(fs["times"]) if fs.get(kind) else 0)
+            for kind in ("torn_write", "corrupt_npz")
+        }
+        dev = config.scope("device")
+        self._device_left = int(dev["times"]) if dev else 0
+        self._device_unlimited = bool(dev) and int(dev["times"]) == 0
+        stage = config.scope("stage")
+        self._stage_left = int(stage["times"]) if stage else 0
+
+    # ── bookkeeping ───────────────────────────────────────────────────
+
+    _record = staticmethod(_record_injection)
+
+    # ── shard scope ───────────────────────────────────────────────────
+
+    def shard_should_fail(self, pool: str, shard: int, attempt: int) -> bool:
+        cfg = self.config.scope("shard")
+        if cfg is None or cfg["p"] <= 0.0:
+            return False
+        if cfg["pool"] and cfg["pool"] not in pool:
+            return False
+        key = (pool, shard)
+        with self._lock:
+            left = self._shard_left.get(key)
+            if left is None:
+                selected = _unit(
+                    int(cfg["seed"]), "shard", pool, str(shard)
+                ) < float(cfg["p"])
+                left = int(cfg["times"]) if selected else 0
+            if left <= 0:
+                self._shard_left[key] = 0
+                return False
+            self._shard_left[key] = left - 1
+        self._record("shard", f"{pool}/{shard}", pool=pool, shard=shard,
+                     attempt=attempt)
+        return True
+
+    def wrap_shard(
+        self, shard_fn: Callable[[int], object], pool: str
+    ) -> Callable[[int], object]:
+        """The ``run_shards`` injection point: a selected shard's first
+        ``times`` attempts raise before the real thunk runs (so the
+        injected fault costs no device work, like a preemption would)."""
+        attempts: dict[int, int] = {}
+
+        def chaotic(i: int):
+            attempts[i] = attempts.get(i, 0) + 1
+            if self.shard_should_fail(pool, i, attempts[i]):
+                raise ChaosShardFault(
+                    f"chaos: injected shard fault (pool={pool!r}, shard={i}, "
+                    f"attempt={attempts[i]})"
+                )
+            return shard_fn(i)
+
+        return chaotic
+
+    # ── fs scope ──────────────────────────────────────────────────────
+
+    def _fs_take(self, kind: str) -> bool:
+        with self._lock:
+            if self._fs_left.get(kind, 0) <= 0:
+                return False
+            self._fs_left[kind] -= 1
+        return True
+
+    def torn_line(self, line: str, site: str) -> str:
+        """Checkpoint-journal injection point: return ``line`` truncated
+        mid-record (the artifact a kill mid-append leaves) while the
+        budget lasts. The newline is kept so the tear stays confined to
+        this record — the run continues, and the reader's torn-line
+        skip + recompute-on-resume path is what gets exercised."""
+        if not self._fs_take("torn_write"):
+            return line
+        body = line.rstrip("\n")
+        cut = max(1, len(body) // 2)
+        self._record("fs", site, kind="torn_write", dropped_chars=len(body) - cut)
+        return body[:cut] + "\n"
+
+    def truncate_npz(self, nbytes: int, site: str) -> int | None:
+        """Checkpoint-writer injection point: the length to truncate an
+        ``nbytes``-long archive to (or None: budget spent / scope off),
+        so the on-disk file is exactly what a torn write would leave —
+        the load side must refuse it (CheckpointCorrupt), never hand
+        back wrong arrays. Size-based so the writer can stream the
+        archive to disk and ``os.truncate`` it, instead of buffering
+        it in memory for us to slice."""
+        if not self._fs_take("corrupt_npz"):
+            return None
+        cut = max(1, (nbytes * 3) // 5)
+        self._record("fs", site, kind="corrupt_npz", dropped_bytes=nbytes - cut)
+        return cut
+
+    # ── device scope ──────────────────────────────────────────────────
+
+    def drop_devices(self, healthy: Sequence) -> list:
+        """``probe_devices`` injection point: report the last ``drop``
+        devices unhealthy, simulating a preempted slice / dropped
+        tunnel. Deterministic — the same devices stay dead on re-probe,
+        so redistribution onto the surviving subset is what's tested."""
+        cfg = self.config.scope("device")
+        devs = list(healthy)
+        if cfg is None or int(cfg["drop"]) <= 0 or not devs:
+            return devs
+        if not self._device_unlimited:
+            with self._lock:
+                if self._device_left <= 0:
+                    return devs
+                self._device_left -= 1
+        k = min(int(cfg["drop"]), len(devs))
+        self._record("device", "probe_devices", dropped=k,
+                     remaining=len(devs) - k)
+        return devs[: len(devs) - k]
+
+    # ── stage scope ───────────────────────────────────────────────────
+
+    def maybe_fail_stage(self, method: str) -> None:
+        """Sweep-stage injection point: raise for the first ``times``
+        stages whose method name contains the configured substring."""
+        cfg = self.config.scope("stage")
+        if cfg is None or not cfg["fail"] or cfg["fail"] not in method:
+            return
+        with self._lock:
+            if self._stage_left <= 0:
+                return
+            self._stage_left -= 1
+        self._record("stage", method, fail=cfg["fail"])
+        raise ChaosStageFault(
+            f"chaos: injected stage fault on {method!r} (fail={cfg['fail']!r})"
+        )
+
+
+def plan_faults(
+    shard_fn: Callable[[int], object], fail_plan: dict[int, int]
+) -> Callable[[int], object]:
+    """Plan-based shard injection: ``fail_plan[i] = k`` makes shard
+    ``i``'s first ``k`` attempts raise :class:`ChaosShardFault`. The
+    exact-plan complement to the probabilistic ``shard`` scope (tests
+    that need "shard 3 fails twice" rather than "20% of shards fail"),
+    reporting through the same ``chaos_inject`` event channel."""
+    remaining = dict(fail_plan)
+
+    def chaotic(i: int):
+        if remaining.get(i, 0) > 0:
+            remaining[i] -= 1
+            _record_injection("shard", f"plan/{i}", shard=i)
+            raise ChaosShardFault(f"injected fault on shard {i}")
+        return shard_fn(i)
+
+    return chaotic
+
+
+# ── process-wide arming ───────────────────────────────────────────────
+
+_INJECTORS: dict[str, ChaosInjector] = {}
+_ARM_LOCK = threading.Lock()
+
+
+def active() -> ChaosInjector | None:
+    """The armed injector for the current ``ATE_TPU_CHAOS`` value, or
+    None when chaos is off. Injectors are cached per spec string so
+    fault *budgets* are shared across injection points — one arming
+    covers a whole run coherently. The cache lives until :func:`reset`:
+    ``run_sweep`` resets at run start so each sweep gets full budgets
+    (and so a malformed spec fails there, at config time); library
+    callers driving injection points directly should do the same, or
+    depleted budgets from an earlier run (including an A→B→A env
+    flip back to an already-armed spec) silently inject nothing."""
+    spec = os.environ.get(ENV_VAR, "").strip()
+    if not spec:
+        return None
+    inj = _INJECTORS.get(spec)
+    if inj is None:
+        with _ARM_LOCK:
+            inj = _INJECTORS.get(spec)
+            if inj is None:
+                inj = _INJECTORS[spec] = ChaosInjector(parse_chaos(spec))
+    return inj
+
+
+def reset() -> None:
+    """Drop all armed injectors (tests: fresh budgets per case)."""
+    with _ARM_LOCK:
+        _INJECTORS.clear()
+
+
+@contextlib.contextmanager
+def override(spec: str | None) -> Iterator[ChaosInjector | None]:
+    """Test helper: arm ``spec`` (None/"" disarms) for the duration of
+    the block with fresh budgets, restoring the env var after."""
+    old = os.environ.get(ENV_VAR)
+    reset()
+    if spec:
+        os.environ[ENV_VAR] = spec
+    else:
+        os.environ.pop(ENV_VAR, None)
+    try:
+        yield active()
+    finally:
+        if old is None:
+            os.environ.pop(ENV_VAR, None)
+        else:
+            os.environ[ENV_VAR] = old
+        reset()

@@ -1,0 +1,115 @@
+"""Error taxonomy for the resilience layer (ISSUE 3).
+
+The reference's only robustness is numerical (propensity clipping,
+``na.rm`` — SURVEY.md §5.3); a production sweep must instead decide, per
+exception, whether re-execution can help. That decision is a *type*
+question, made once here instead of ad hoc at every retry loop:
+
+* **fatal** — programming errors (``TypeError``, ``ValueError``,
+  ``AssertionError``, …). Retrying replays the same bug three times with
+  backoff in between and then reports a "shard failure" that was never a
+  shard's fault; these raise immediately.
+* **transient** — device/runtime/IO failures (``JaxRuntimeError``,
+  ``OSError``, plain ``RuntimeError``). The framework's unit of work is
+  idempotent (every shard owns its fold-in key), so re-execution is
+  recovery, bit-identically.
+* ``KeyboardInterrupt``/``SystemExit`` are ``BaseException`` and are
+  never caught by any retry or isolation layer.
+
+Also home to the typed failures the layer itself raises, so callers can
+``except`` precisely: :class:`CheckpointCorrupt` (a verified checkpoint
+failed its digest — never silently returns wrong arrays),
+:class:`DeadlineExceeded`, :class:`NonFiniteResult` (a computed row
+failed the finite-value guard) and the :class:`ChaosFault` family
+(injected by :mod:`.chaos`; transient by construction, so injected
+faults exercise exactly the recovery paths real ones would).
+"""
+
+from __future__ import annotations
+
+
+class ChaosFault(RuntimeError):
+    """Base of all deliberately injected faults. Subclasses
+    ``RuntimeError`` so the classifier treats injections as transient —
+    chaos must walk the same recovery path a real fault would."""
+
+
+class ChaosShardFault(ChaosFault):
+    """Injected in place of a shard thunk's result (``run_shards``)."""
+
+
+class ChaosStageFault(ChaosFault):
+    """Injected at a sweep stage boundary (``pipeline.stage``)."""
+
+
+class ChaosSpecError(ValueError):
+    """The ``ATE_TPU_CHAOS`` spec string does not parse. A ValueError —
+    a malformed chaos config is a programming error, fatal-fast, never
+    something to retry through."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """A shard pool's wall-clock deadline passed before the work did."""
+
+
+class NonFiniteResult(RuntimeError):
+    """An estimator produced a NaN/Inf point estimate from finite
+    inputs — recorded as a failed row, never as a silent garbage row."""
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A fitted-model checkpoint failed integrity verification. Always
+    names the offending path so operators can quarantine the file."""
+
+    def __init__(self, path: str, reason: str):
+        super().__init__(f"checkpoint {path!r} is corrupt: {reason}")
+        self.path = path
+        self.reason = reason
+
+
+#: Exception types where re-execution replays the bug: raise, don't
+#: retry. NotImplementedError subclasses RuntimeError, so it must be
+#: listed here to beat the transient check.
+FATAL_ERRORS: tuple[type[BaseException], ...] = (
+    TypeError,
+    ValueError,
+    AssertionError,
+    KeyError,
+    IndexError,
+    AttributeError,
+    NameError,
+    NotImplementedError,
+    RecursionError,
+)
+
+_TRANSIENT_CACHE: tuple[type[BaseException], ...] | None = None
+
+
+def transient_errors() -> tuple[type[BaseException], ...]:
+    """Types worth retrying. ``jax.errors.JaxRuntimeError`` (a
+    ``RuntimeError`` subclass on current jax, but listed explicitly in
+    case that changes) is resolved lazily so this module never forces a
+    backend import."""
+    global _TRANSIENT_CACHE
+    if _TRANSIENT_CACHE is None:
+        types: list[type[BaseException]] = [RuntimeError, OSError]
+        try:
+            from jax.errors import JaxRuntimeError
+
+            types.insert(0, JaxRuntimeError)
+        except Exception:  # noqa: BLE001 — jax absent/ancient: stdlib set suffices
+            pass
+        _TRANSIENT_CACHE = tuple(types)
+    return _TRANSIENT_CACHE
+
+
+def classify(exc: BaseException) -> str:
+    """``"fatal"`` or ``"transient"``. Fatal wins ties (e.g.
+    ``NotImplementedError`` is both a RuntimeError and a programming
+    error); unknown ``Exception`` subclasses are fatal — an error the
+    taxonomy has never seen must surface, not burn retry budget."""
+    if isinstance(exc, FATAL_ERRORS):
+        return "fatal"
+    if isinstance(exc, transient_errors()):
+        return "transient"
+    return "fatal"

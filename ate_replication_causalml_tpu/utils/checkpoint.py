@@ -16,19 +16,41 @@ inside the same archive — no pickle, so checkpoints are portable and
 inspectable (``np.load(path).files``). The L4 driver persists *result
 rows* via its own jsonl checkpoint (pipeline.py); this module is the
 model-level complement.
+
+Integrity (ISSUE 3): :func:`save_fitted` is atomic (tmp + ``os.replace``
+via the observability export helpers) and embeds a SHA-256 digest over
+the manifest and every array's contents; :func:`load_fitted` recomputes
+and compares it, raising :class:`CheckpointCorrupt` (naming the path)
+on any mismatch, unreadable archive, or missing manifest — a torn or
+bit-flipped checkpoint can fail loudly but can never hand back wrong
+arrays. Archives written before the digest existed load with a
+``checkpoint_unverified`` event. The ``fs:corrupt_npz`` chaos scope
+injects a truncated write here, which is how the refusal path is
+proven in tests.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import importlib
 import json
+import os
 from typing import Any
 
 import jax
 import numpy as np
 
+from ate_replication_causalml_tpu.observability import events as _events
+from ate_replication_causalml_tpu.observability.export import atomic_file
+from ate_replication_causalml_tpu.resilience import chaos
+from ate_replication_causalml_tpu.resilience.errors import CheckpointCorrupt
+
+__all__ = ["CheckpointCorrupt", "load_fitted", "save_fitted"]
+
 _ARR = "__array__"
+_MANIFEST = "__manifest__"
+_DIGEST = "__sha256__"
 
 
 def _is_namedtuple(obj) -> bool:
@@ -134,28 +156,103 @@ def _npz_path(path: str) -> str:
     return path if path.endswith(".npz") else path + ".npz"
 
 
+def _content_digest(manifest_bytes: bytes, arrays: dict[str, np.ndarray]) -> str:
+    """SHA-256 over the manifest and every array's identity (name,
+    dtype, shape, raw bytes) in sorted key order — the quantity the
+    loader re-derives to verify integrity. Computed over the CONTENT,
+    not the zip container, so recompression or archive-member reordering
+    cannot fake a corruption."""
+    h = hashlib.sha256()
+    h.update(manifest_bytes)
+    for key in sorted(arrays):
+        a = np.ascontiguousarray(arrays[key])
+        h.update(key.encode())
+        h.update(str(a.dtype).encode())
+        h.update(repr(a.shape).encode())
+        # Hash the array's buffer in place (same bytes a C-order
+        # tobytes() would produce) — a tobytes() copy would double peak
+        # memory on a hundreds-of-MB forest checkpoint.
+        h.update(memoryview(a).cast("B"))
+    return h.hexdigest()
+
+
 def save_fitted(path: str, obj: Any) -> None:
     """Write ``obj`` (fitted model / pytree of the kinds above) to one
-    compressed ``.npz`` (extension appended if missing)."""
+    compressed ``.npz`` (extension appended if missing) — atomically,
+    with the content digest embedded for :func:`load_fitted` to verify.
+    Under ``ATE_TPU_CHAOS`` ``fs:corrupt_npz`` the archive is written
+    deliberately truncated (the torn write the atomic rename otherwise
+    makes impossible), proving the loader's refusal path."""
     path = _npz_path(path)
     arrays: dict[str, np.ndarray] = {}
     manifest = _encode(obj, "root", arrays)
-    np.savez_compressed(
-        path, __manifest__=np.frombuffer(json.dumps(manifest).encode(), dtype=np.uint8),
-        **arrays,
-    )
+    manifest_bytes = json.dumps(manifest).encode()
+    arrays = {k: np.asarray(v) for k, v in arrays.items()}
+    digest = _content_digest(manifest_bytes, arrays)
+    # Stream the archive straight to the tmp file (atomic_file renames
+    # it over `path` on success) — a hundreds-of-MB forest checkpoint
+    # must not be buffered in memory on top of its arrays.
+    with atomic_file(path) as tmp:
+        # This IS the blessed atomic pattern: the open targets
+        # atomic_file's tmp, renamed over `path` only on success.
+        # graftlint: disable=JGL005
+        with open(tmp, "wb") as f:
+            np.savez_compressed(
+                f,
+                **{
+                    _MANIFEST: np.frombuffer(manifest_bytes, dtype=np.uint8),
+                    _DIGEST: np.frombuffer(digest.encode(), dtype=np.uint8),
+                },
+                **arrays,
+            )
+        inj = chaos.active()
+        if inj is not None:
+            cut = inj.truncate_npz(os.path.getsize(tmp), site=path)
+            if cut is not None:
+                os.truncate(tmp, cut)
 
 
-def load_fitted(path: str, device: bool = True) -> Any:
+def load_fitted(path: str, device: bool = True, verify: bool = True) -> Any:
     """Restore an object written by :func:`save_fitted`. With
     ``device=True`` arrays come back as ``jax.Array`` (placed by the
     default device policy) — except 64-bit arrays when x64 is disabled,
     which stay host NumPy rather than silently truncating (JAX converts
     them on first use; the x64 strict-parity tests get exact values).
-    ``device=False`` returns host NumPy throughout."""
-    with np.load(_npz_path(path)) as z:
-        manifest = json.loads(bytes(z["__manifest__"]).decode())
-        arrays = {k: z[k] for k in z.files if k != "__manifest__"}
+    ``device=False`` returns host NumPy throughout.
+
+    ``verify=True`` (default) recomputes the embedded SHA-256 and
+    raises :class:`CheckpointCorrupt` — naming ``path`` — on mismatch,
+    unreadable/torn archive, or missing manifest. Pre-digest legacy
+    archives load with a ``checkpoint_unverified`` event."""
+    path = _npz_path(path)
+    try:
+        with np.load(path) as z:
+            manifest_bytes = bytes(z[_MANIFEST])
+            stored_digest = (
+                bytes(z[_DIGEST]).decode() if _DIGEST in z.files else None
+            )
+            arrays = {
+                k: z[k] for k in z.files if k not in (_MANIFEST, _DIGEST)
+            }
+        manifest = json.loads(manifest_bytes.decode())
+    except FileNotFoundError:
+        raise
+    except Exception as e:  # zipfile/zlib/KeyError/json — a torn or
+        # foreign file must become the typed refusal, not whatever
+        # partial-read error the stack hit first.
+        raise CheckpointCorrupt(path, f"unreadable archive ({e})") from e
+    if verify:
+        if stored_digest is not None:
+            actual = _content_digest(manifest_bytes, arrays)
+            if actual != stored_digest:
+                raise CheckpointCorrupt(
+                    path,
+                    f"content digest mismatch (stored {stored_digest[:12]}…, "
+                    f"archive hashes to {actual[:12]}…)",
+                )
+        else:
+            _events.emit("checkpoint_unverified", status="warning", path=path,
+                         reason="no embedded digest (pre-ISSUE-3 archive)")
     if device:
         x64 = jax.config.read("jax_enable_x64")
 

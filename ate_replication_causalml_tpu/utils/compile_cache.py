@@ -36,7 +36,7 @@ def _host_tag() -> str:
         import jaxlib
 
         sig += jaxlib.__version__
-    except Exception:  # noqa: BLE001 — version probe only
+    except (ImportError, AttributeError):  # version probe only
         pass
     sig += jax.__version__
     return hashlib.sha1(sig.encode()).hexdigest()[:10]

@@ -12,6 +12,7 @@ glance. Matplotlib renders to PNG next to the result table.
 
 from __future__ import annotations
 
+import math
 from typing import Iterable, NamedTuple, Sequence
 
 from ate_replication_causalml_tpu.estimators.base import EstimatorResult
@@ -47,13 +48,16 @@ def pointrange_figure(
     oracle: EstimatorResult | None = None,
     title: str = "ATE estimates vs the RCT oracle",
     path: str | None = None,
+    footnote: str | None = None,
 ):
     """Horizontal pointrange chart of estimate ± CI per method.
 
     ``oracle`` (the unbiased RCT difference-in-means,
     ``ate_replication.Rmd:130``) renders as a vertical line + CI band
-    behind the marks. Returns a :class:`PointrangeChart` carrying the
-    Figure plus the plotted arrays; saves PNG when ``path`` is given.
+    behind the marks. ``footnote`` annotates the chart bottom-left —
+    the resilience layer uses it to name stages a degraded sweep could
+    not plot. Returns a :class:`PointrangeChart` carrying the Figure
+    plus the plotted arrays; saves PNG when ``path`` is given.
     """
     # Agg canvas bound to this figure only — never touches the process-
     # global backend (a notebook user's interactive backend stays live).
@@ -95,34 +99,52 @@ def pointrange_figure(
     if oracle is not None:
         ax.legend(loc="upper right", frameon=False, fontsize=8, labelcolor=_INK_2)
     fig.tight_layout()
+    if footnote:
+        fig.subplots_adjust(bottom=max(0.18, fig.subplotpars.bottom + 0.06))
+        fig.text(0.02, 0.02, footnote, fontsize=7.5, color=_INK_2)
     if path is not None:
         fig.savefig(path, facecolor=_SURFACE)
     return PointrangeChart(figure=fig, marks=marks, oracle_band=band)
 
 
+def _plottable(r: EstimatorResult) -> bool:
+    return getattr(r, "status", "ok") == "ok" and math.isfinite(r.ate)
+
+
 def notebook_figures(
     results: Iterable[EstimatorResult],
-    oracle: EstimatorResult,
+    oracle: EstimatorResult | None,
     outdir: str,
 ) -> list[str]:
     """The notebook's three charts, same stage boundaries:
     ``rct_naive_plot`` (oracle + naive), ``compare_regression``
-    (through the LASSO family), ``compare_CausalML`` (everything)."""
+    (through the LASSO family), ``compare_CausalML`` (everything).
+
+    Degraded sweeps (pipeline.py isolation policy) still render:
+    ``status="failed"`` rows are dropped from the marks and named in a
+    footnote instead, and ``oracle=None`` (a failed oracle stage) skips
+    the reference band rather than drawing a NaN span."""
     import os
 
-    rows = list(results)
+    rows_all = list(results)
+    rows = [r for r in rows_all if _plottable(r)]
+    failed = {r.method for r in rows_all if not _plottable(r)}
     by_method = {r.method: r for r in rows}
     paths = []
 
-    def save(name, subset, title):
+    def save(name, want_methods, title):
+        subset = [by_method[m] for m in want_methods if m in by_method]
+        missing = [m for m in want_methods if m in failed]
+        note = ("✗ failed, not shown: " + ", ".join(missing)) if missing else None
         p = os.path.join(outdir, f"{name}.png")
         # Render WITHOUT saving, validate, then write: a blank chart
         # must fail loudly — and must not overwrite the last good PNG
         # at this path before the check runs.
-        chart = pointrange_figure(subset, oracle=oracle, title=title)
+        chart = pointrange_figure(subset, oracle=oracle, title=title,
+                                  footnote=note)
         drawn = [m.method for m in chart.marks]
         want = [r.method for r in subset]
-        if drawn != want or chart.oracle_band is None:
+        if drawn != want or (oracle is not None and chart.oracle_band is None):
             raise RuntimeError(
                 f"figure {name!r} did not draw what was requested: "
                 f"drawn={drawn} wanted={want} band={chart.oracle_band}"
@@ -130,15 +152,16 @@ def notebook_figures(
         chart.figure.savefig(p, facecolor=_SURFACE)
         paths.append(p)
 
-    naive = [by_method[m] for m in ("naive",) if m in by_method]
-    save("rct_naive_plot", naive, "Naive estimate on the biased sample vs RCT oracle")
+    save("rct_naive_plot", ("naive",),
+         "Naive estimate on the biased sample vs RCT oracle")
 
     regression_methods = (
         "naive", "Direct Method", "Propensity_Weighting", "Propensity_Regression",
         "Propensity_Weighting_LASSOPS", "Single-equation LASSO", "Usual LASSO",
     )
-    reg = [by_method[m] for m in regression_methods if m in by_method]
-    save("compare_regression", reg, "Regression extensions vs RCT oracle")
+    save("compare_regression", regression_methods,
+         "Regression extensions vs RCT oracle")
 
-    save("compare_CausalML", rows, "All estimators vs RCT oracle")
+    save("compare_CausalML", [r.method for r in rows_all],
+         "All estimators vs RCT oracle")
     return paths

@@ -486,6 +486,82 @@ def test_jgl006_catches_mutation_in_compound_headers():
     assert _lines(src, "JGL006", relpath="pkg/observability/mod.py") == [7]
 
 
+# --------------------------------------------------------------- JGL007
+
+
+JGL007_BAD = """\
+def probe(x):
+    try:
+        risky(x)
+    except Exception:                       # line 4
+        pass
+    try:
+        risky(x)
+    except:                                 # line 8
+        pass
+    for v in x:
+        try:
+            risky(v)
+        except (ValueError, BaseException): # line 13
+            continue
+"""
+
+JGL007_BAD_RETRIABLE = """\
+from pkg.parallel.retry import run_shards
+
+outs = run_shards(fn, 8, retriable=(Exception,))    # line 3
+outs2 = run_shards(fn, 8, retriable=(OSError, BaseException))  # line 4
+"""
+
+JGL007_GOOD = """\
+import logging
+
+def probe(x):
+    try:
+        risky(x)
+    except (ValueError, OSError):
+        pass                        # narrow tuple: fine
+    try:
+        risky(x)
+    except Exception as e:
+        logging.warning("probe failed: %s", e)   # records: fine
+
+outs = run_shards(fn, 8)                          # classified default
+outs2 = run_shards(fn, 8, retriable=(OSError, RuntimeError))
+"""
+
+
+def test_jgl007_fires_on_silent_broad_handlers():
+    assert _lines(JGL007_BAD, "JGL007") == [4, 8, 13]
+
+
+def test_jgl007_fires_on_broad_retriable_tuples():
+    assert _lines(JGL007_BAD_RETRIABLE, "JGL007") == [3, 4]
+
+
+def test_jgl007_quiet_on_narrow_or_recording_handlers():
+    assert _lines(JGL007_GOOD, "JGL007") == []
+
+
+def test_jgl007_exempts_resilience_and_retry_paths():
+    for rel in (
+        "ate_replication_causalml_tpu/resilience/chaos.py",
+        "ate_replication_causalml_tpu/parallel/retry.py",
+    ):
+        assert _lines(JGL007_BAD, "JGL007", relpath=rel) == []
+    assert _lines(JGL007_BAD, "JGL007", relpath="pkg/parallel/mesh.py") == [4, 8, 13]
+
+
+def test_jgl007_suppression_comment_holds_it_back():
+    src = JGL007_BAD.replace(
+        "    except Exception:                       # line 4",
+        "    except Exception:  # graftlint: disable=JGL007",
+    )
+    res = lint_source(src, relpath="pkg/mod.py", select=["JGL007"])
+    assert [f.line for f in res.findings] == [8, 13]
+    assert [f.line for f in res.suppressed] == [4]
+
+
 # ----------------------------------------------------- suppressions etc.
 
 

@@ -1,0 +1,508 @@
+"""Resilience-layer tests (ISSUE 3): chaos grammar + deterministic
+injection, classified retry (fatal-fast vs transient), capped/jittered
+backoff, pool deadlines, device re-probe + redispatch, torn-checkpoint
+accounting, unique stale suffixes, resume-row validation, and verified
+model checkpoints. Everything here is cheap (no estimator fits, no new
+XLA shapes) — the crash-resume / chaos-sweep integration lives in
+``tests/test_resilience_sweep.py`` behind ``@pytest.mark.slow``."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from ate_replication_causalml_tpu import observability as obs
+from ate_replication_causalml_tpu.parallel.retry import (
+    BACKOFF_CAP_MULT,
+    backoff_delay,
+    inject_failures,
+    probe_devices,
+    require_all,
+    run_shards,
+)
+from ate_replication_causalml_tpu.resilience import chaos
+from ate_replication_causalml_tpu.resilience.errors import (
+    ChaosShardFault,
+    ChaosSpecError,
+    ChaosStageFault,
+    CheckpointCorrupt,
+    classify,
+)
+from ate_replication_causalml_tpu.utils.checkpoint import load_fitted, save_fitted
+
+
+@pytest.fixture(autouse=True)
+def _fresh_resilience():
+    """Telemetry on + empty, chaos disarmed, fresh budgets per test."""
+    obs.set_enabled(True)
+    obs.REGISTRY.reset()
+    obs.EVENTS.clear()
+    chaos.reset()
+    assert not os.environ.get(chaos.ENV_VAR)
+    yield
+    chaos.reset()
+    obs.set_enabled(None)
+
+
+def _event_names():
+    return [r["name"] for r in obs.EVENTS.records()]
+
+
+# ── chaos grammar ───────────────────────────────────────────────────────
+
+
+def test_chaos_grammar_parses_scopes_flags_and_values():
+    cfg = chaos.parse_chaos("shard:p=0.25,seed=7,times=2,pool=forest;"
+                            "fs:torn_write,corrupt_npz,times=3;"
+                            "device:drop=2;stage:fail=Belloni et.al")
+    assert cfg.scope("shard") == {"p": 0.25, "seed": 7, "times": 2, "pool": "forest"}
+    assert cfg.scope("fs") == {"torn_write": True, "corrupt_npz": True, "times": 3}
+    assert cfg.scope("device") == {"drop": 2, "times": 0}
+    assert cfg.scope("stage")["fail"] == "Belloni et.al"  # spaces/dots survive
+    assert cfg.scope("nonexistent") is None
+
+
+@pytest.mark.parametrize("bad", [
+    "bogus:p=1", "shard:nope=1", "shard:p=abc", "fs:torn_write=x,p=1",
+    "shard",  # scope with no ':' and no defaults armed is fine? -> shard alone
+])
+def test_chaos_grammar_rejects_malformed_specs(bad):
+    if bad == "shard":  # bare scope name is legal (all defaults)
+        assert chaos.parse_chaos(bad).scope("shard")["p"] == 0.0
+        return
+    with pytest.raises(ChaosSpecError):
+        chaos.parse_chaos(bad)
+
+
+def test_chaos_active_is_env_driven_and_budgeted():
+    assert chaos.active() is None
+    with chaos.override("fs:torn_write") as inj:
+        assert inj is chaos.active()  # cached per spec: budgets persist
+        assert inj.torn_line('{"x": 1}\n', site="s").endswith("\n")
+        # budget of 1 spent: second append passes through untouched
+        assert inj.torn_line('{"y": 2}\n', site="s") == '{"y": 2}\n'
+    assert chaos.active() is None
+
+
+# ── shard scope through run_shards ──────────────────────────────────────
+
+
+def _shard(i: int) -> float:
+    key = jax.random.fold_in(jax.random.key(0), i)
+    return float(jax.random.normal(key, ()).sum())
+
+
+def test_shard_chaos_recovers_bit_identically():
+    clean = [_shard(i) for i in range(5)]
+    with chaos.override("shard:p=1.0,seed=3"):
+        outs = run_shards(_shard, 5, max_attempts=3, backoff_s=0.0)
+    # p=1: every shard's first attempt raised, every retry recovered.
+    assert [o.attempts for o in outs] == [2, 2, 2, 2, 2]
+    assert require_all(outs) == clean
+    snap = obs.REGISTRY.snapshot()["counters"]
+    assert snap["chaos_injections_total"]["scope=shard"] == 5.0
+    assert _event_names().count("chaos_inject") == 5
+
+
+def test_shard_chaos_selection_is_seed_deterministic():
+    def selected(seed):
+        inj = chaos.ChaosInjector(chaos.parse_chaos(f"shard:p=0.5,seed={seed}"))
+        return [inj.shard_should_fail("pool", i, 1) for i in range(32)]
+
+    a, b, c = selected(1), selected(1), selected(2)
+    assert a == b            # pure function of (seed, pool, shard)
+    assert a != c            # and the seed actually matters
+    assert 4 < sum(a) < 28   # p=0.5 behaves like a probability
+
+
+def test_shard_chaos_pool_filter():
+    inj = chaos.ChaosInjector(chaos.parse_chaos("shard:p=1.0,pool=forest"))
+    assert not inj.shard_should_fail("lasso_folds", 0, 1)
+    assert inj.shard_should_fail("forest_classifier", 0, 1)
+
+
+def test_exhausted_chaos_budget_degrades_not_raises():
+    with chaos.override("shard:p=1.0,times=9"):  # > max_attempts
+        outs = run_shards(_shard, 2, max_attempts=2, backoff_s=0.0)
+    assert [o.ok for o in outs] == [False, False]
+    assert all("ChaosShardFault" in o.error for o in outs)
+    with pytest.raises(RuntimeError, match="2/2 shards failed"):
+        require_all(outs)
+
+
+# ── classified retry ────────────────────────────────────────────────────
+
+
+@pytest.mark.parametrize("exc", [TypeError, ValueError, AssertionError, KeyError])
+def test_programming_errors_raise_immediately(exc):
+    calls = []
+
+    def buggy(i):
+        calls.append(i)
+        raise exc("bug")
+
+    with pytest.raises(exc):
+        run_shards(buggy, 4, max_attempts=3, backoff_s=0.0)
+    assert calls == [0]  # no retry burned on a bug, no later shards run
+    assert "shard_fatal" in _event_names()
+
+
+def test_unknown_exception_type_is_fatal():
+    class Weird(Exception):
+        pass
+
+    with pytest.raises(Weird):
+        run_shards(lambda i: (_ for _ in ()).throw(Weird("?")), 2,
+                   max_attempts=3, backoff_s=0.0)
+    assert classify(Weird("?")) == "fatal"
+
+
+def test_transient_errors_still_retry():
+    attempts = {"n": 0}
+
+    def flaky(i):
+        attempts["n"] += 1
+        if attempts["n"] == 1:
+            raise OSError("tunnel dropped")
+        return i
+
+    outs = run_shards(flaky, 1, max_attempts=3, backoff_s=0.0)
+    assert outs[0].ok and outs[0].attempts == 2
+
+
+def test_explicit_retriable_tuple_still_supported():
+    # Opt-in mode: listed types retry, everything else propagates.
+    def flaky(i):
+        raise ValueError("listed on purpose")
+
+    outs = run_shards(flaky, 1, max_attempts=2, backoff_s=0.0,
+                      retriable=(ValueError,))
+    assert not outs[0].ok and outs[0].attempts == 2
+
+
+def test_shard_chaos_stays_transient_under_explicit_retriable():
+    """An injected fault stands in for a preemption, so it must walk the
+    retry path even when the caller opted into a narrow tuple that does
+    not list ChaosFault."""
+    with chaos.override("shard:p=1.0,seed=3"):
+        outs = run_shards(_shard, 3, max_attempts=3, backoff_s=0.0,
+                          retriable=(OSError,))
+    assert all(o.ok and o.attempts == 2 for o in outs)
+    assert require_all(outs) == [_shard(i) for i in range(3)]
+
+
+# ── backoff: cap + deterministic jitter ─────────────────────────────────
+
+
+def test_backoff_deterministic_jittered_and_capped():
+    base = 0.1
+    d1 = [backoff_delay("p", 3, a, base) for a in range(1, 9)]
+    d2 = [backoff_delay("p", 3, a, base) for a in range(1, 9)]
+    assert d1 == d2                                   # no Math.random anywhere
+    assert d1[0] >= base and d1[1] > d1[0]            # exponential start
+    assert all(d <= BACKOFF_CAP_MULT * base for d in d1)
+    assert d1[-1] == BACKOFF_CAP_MULT * base          # cap reached
+    # jitter decorrelates shards at the same attempt
+    assert backoff_delay("p", 0, 1, base) != backoff_delay("p", 1, 1, base)
+    assert backoff_delay("p", 0, 1, 0.0) == 0.0
+
+
+def test_run_shards_sleeps_the_advertised_schedule(monkeypatch):
+    slept = []
+    monkeypatch.setattr("ate_replication_causalml_tpu.parallel.retry.time.sleep",
+                        slept.append)
+    fn = inject_failures(lambda i: i, {0: 3})
+    run_shards(fn, 1, max_attempts=4, backoff_s=0.05, pool="bk")
+    assert slept == [backoff_delay("bk", 0, a, 0.05) for a in (1, 2, 3)]
+
+
+# ── deadline ────────────────────────────────────────────────────────────
+
+
+def test_deadline_cuts_remaining_shards_but_keeps_done_work():
+    import time as _time
+
+    def slow(i):
+        _time.sleep(0.06)
+        return i
+
+    outs = run_shards(slow, 4, max_attempts=2, backoff_s=0.0,
+                      deadline_s=0.05, pool="dl")
+    assert outs[0].ok                     # started before the deadline
+    assert [o.ok for o in outs[1:]] == [False, False, False]
+    assert all(o.deadline and "DeadlineExceeded" in o.error for o in outs[1:])
+    assert all(o.attempts == 0 for o in outs[1:])  # no attempt started late
+    names = _event_names()
+    assert names.count("shard_deadline") == 3
+    assert "pool_deadline" in names
+    # Typed aggregation: deadline cuts raise DeadlineExceeded (a
+    # RuntimeError subclass), so callers can route capacity pressure
+    # separately from exhausted retries.
+    from ate_replication_causalml_tpu.resilience.errors import DeadlineExceeded
+
+    with pytest.raises(DeadlineExceeded, match="3/4 shards failed"):
+        require_all(outs)
+
+
+def test_deadline_never_sleeps_past_itself(monkeypatch):
+    slept = []
+    monkeypatch.setattr("ate_replication_causalml_tpu.parallel.retry.time.sleep",
+                        slept.append)
+    fn = inject_failures(lambda i: i, {0: 9})
+    outs = run_shards(fn, 1, max_attempts=9, backoff_s=10.0, deadline_s=0.5)
+    assert not outs[0].ok and "DeadlineExceeded" in outs[0].error
+    assert outs[0].attempts == 1  # the un-affordable backoff cuts, not spins
+    assert slept == []  # a 10 s backoff against a 0.5 s deadline: skip it
+
+
+# ── device re-probe + redispatch ────────────────────────────────────────
+
+
+def test_device_origin_failures_trigger_reprobe_and_redispatch():
+    fails = {"n": 0}
+
+    def dying(i):
+        fails["n"] += 1
+        raise jax.errors.JaxRuntimeError("device lost")
+
+    probes, redispatched = [], []
+
+    def probe():
+        probes.append(True)
+        return ["dev0"]
+
+    def redispatch(healthy):
+        redispatched.append(list(healthy))
+        return lambda i: ("healthy", i)
+
+    outs = run_shards(dying, 3, max_attempts=3, backoff_s=0.0,
+                      probe=probe, redispatch=redispatch, reprobe_after=2)
+    # 2 device-origin failures -> re-probe -> remaining attempts/shards
+    # run on the healthy subset.
+    assert probes and redispatched == [["dev0"]]
+    assert outs[0].ok and outs[0].result == ("healthy", 0)
+    assert all(o.ok for o in outs)
+    assert "device_reprobe" in _event_names()
+
+
+def test_probe_devices_chaos_drop():
+    n = jax.device_count()
+    with chaos.override("device:drop=2"):
+        healthy = probe_devices()
+        assert len(healthy) == n - 2
+        # deterministic: the same devices stay dead on re-probe
+        assert probe_devices() == healthy
+    assert len(probe_devices()) == n
+
+
+# ── checkpoint journal: torn lines, stale suffixes, row validation ──────
+
+
+def _write_ckpt(path, fingerprint, rows, torn_tail=None):
+    lines = [json.dumps({"method": "__config__", "fingerprint": fingerprint})]
+    lines += [json.dumps(r) for r in rows]
+    text = "\n".join(lines) + "\n"
+    if torn_tail is not None:
+        text += torn_tail  # no trailing newline: a kill mid-append
+    with open(path, "w") as f:
+        f.write(text)
+
+
+ROW = {"method": "naive", "ate": 0.01, "lower_ci": 0.0, "upper_ci": 0.02,
+       "se": 0.005, "status": "ok", "seconds": 0.1}
+
+
+def test_torn_checkpoint_lines_are_skipped_and_counted(tmp_path):
+    from ate_replication_causalml_tpu.pipeline import _Checkpoint
+
+    p = str(tmp_path / "results.jsonl")
+    _write_ckpt(p, "fp", [ROW], torn_tail='{"method": "Direct Me')
+    ck = _Checkpoint(p, "fp", log=lambda s: None)
+    assert ck.get("naive") == ROW          # completed rows survive
+    assert ck.get("Direct Method") is None
+    snap = obs.REGISTRY.snapshot()["counters"]
+    assert snap["checkpoint_torn_lines_total"][""] == 1.0
+    assert "checkpoint_torn_lines" in _event_names()
+
+
+def test_stale_suffix_never_clobbers_prior_results(tmp_path):
+    from ate_replication_causalml_tpu.pipeline import _Checkpoint
+
+    p = str(tmp_path / "results.jsonl")
+    # A .stale from an earlier config change, holding real results.
+    with open(p + ".stale", "w") as f:
+        f.write("precious old results\n")
+    _write_ckpt(p, "fp-old", [ROW])
+    _Checkpoint(p, "fp-new", log=lambda s: None)
+    assert open(p + ".stale").read() == "precious old results\n"
+    assert os.path.exists(p + ".stale.1")  # the new set-aside
+    # And a third config change takes .stale.2.
+    _write_ckpt(p, "fp-older", [ROW])
+    _Checkpoint(p, "fp-newest", log=lambda s: None)
+    assert os.path.exists(p + ".stale.2")
+
+
+def test_chaos_torn_write_confines_damage_to_one_row(tmp_path):
+    from ate_replication_causalml_tpu.pipeline import _Checkpoint
+
+    p = str(tmp_path / "results.jsonl")
+    ck = _Checkpoint(p, "fp", log=lambda s: None)
+    with chaos.override("fs:torn_write"):
+        ck.put(dict(ROW))                          # torn on disk
+        ck.put(dict(ROW, method="Direct Method"))  # budget spent: intact
+    assert ck.get("naive") is not None  # current run keeps the memory copy
+    reread = _Checkpoint(p, "fp", log=lambda s: None)
+    assert reread.get("naive") is None             # resume recomputes it
+    assert reread.get("Direct Method") == dict(ROW, method="Direct Method")
+    snap = obs.REGISTRY.snapshot()["counters"]
+    assert snap["checkpoint_torn_lines_total"][""] == 1.0
+
+
+@pytest.mark.parametrize("rec,why", [
+    ({k: v for k, v in ROW.items() if k != "ate"}, "missing key 'ate'"),
+    (dict(ROW, status="failed"), "status='failed'"),
+    (dict(ROW, ate=None), "non-numeric ate None"),
+    (dict(ROW, ate=float("nan")), "non-finite ate nan"),
+])
+def test_row_resumable_rejects_bad_rows(rec, why):
+    from ate_replication_causalml_tpu.pipeline import _row_resumable
+
+    ok, reason = _row_resumable(rec)
+    assert not ok and why in reason
+
+
+def test_row_resumable_accepts_legacy_rows_without_status():
+    from ate_replication_causalml_tpu.pipeline import _row_resumable
+
+    legacy = {k: v for k, v in ROW.items() if k != "status"}
+    assert _row_resumable(legacy) == (True, "")
+
+
+# ── verified model checkpoints ──────────────────────────────────────────
+
+
+def _obj():
+    return {"w": np.arange(6.0).reshape(2, 3), "meta": {"depth": 4}}
+
+
+def test_save_load_roundtrip_with_digest(tmp_path):
+    p = str(tmp_path / "m.npz")
+    save_fitted(p, _obj())
+    with np.load(p) as z:
+        assert "__sha256__" in z.files
+    r = load_fitted(p, device=False)
+    np.testing.assert_array_equal(r["w"], _obj()["w"])
+
+
+def test_truncated_archive_raises_checkpoint_corrupt(tmp_path):
+    p = str(tmp_path / "m.npz")
+    save_fitted(p, _obj())
+    data = open(p, "rb").read()
+    with open(p, "wb") as f:
+        f.write(data[: len(data) // 2])
+    with pytest.raises(CheckpointCorrupt, match="m.npz"):
+        load_fitted(p, device=False)
+
+
+def test_silent_tamper_fails_the_digest(tmp_path):
+    """Corruption the zip CRC layer cannot see (a member rewritten as a
+    valid archive) must still refuse to load."""
+    p = str(tmp_path / "m.npz")
+    save_fitted(p, _obj())
+    with np.load(p) as z:
+        members = {k: z[k] for k in z.files}
+    members["arr_0"] = members["arr_0"] + 1.0
+    np.savez_compressed(p, **members)
+    with pytest.raises(CheckpointCorrupt, match="digest mismatch"):
+        load_fitted(p, device=False)
+
+
+def test_missing_file_stays_file_not_found(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        load_fitted(str(tmp_path / "absent.npz"))
+
+
+def test_legacy_archive_without_digest_loads_with_event(tmp_path):
+    p = str(tmp_path / "legacy.npz")
+    manifest = json.dumps({"__dict__": {"v": 1}}).encode()
+    np.savez_compressed(p, __manifest__=np.frombuffer(manifest, dtype=np.uint8))
+    assert load_fitted(p, device=False) == {"v": 1}
+    assert "checkpoint_unverified" in _event_names()
+
+
+def test_chaos_corrupt_npz_is_refused_on_load(tmp_path):
+    p = str(tmp_path / "m.npz")
+    with chaos.override("fs:corrupt_npz"):
+        save_fitted(p, _obj())
+        with pytest.raises(CheckpointCorrupt, match="m.npz"):
+            load_fitted(p, device=False)
+        save_fitted(p, _obj())  # budget spent: this write is clean
+    np.testing.assert_array_equal(
+        load_fitted(p, device=False)["w"], _obj()["w"])
+    assert any(r["name"] == "chaos_inject" for r in obs.EVENTS.records())
+
+
+# ── degraded sweeps still render ────────────────────────────────────────
+
+
+def _failed_row(method):
+    from ate_replication_causalml_tpu.estimators.base import EstimatorResult
+
+    nan = float("nan")
+    return EstimatorResult(method=method, ate=nan, lower_ci=nan,
+                           upper_ci=nan, se=nan, status="failed")
+
+
+def test_report_md_annotates_failed_rows(tmp_path):
+    from ate_replication_causalml_tpu.estimators.base import (
+        EstimatorResult,
+        ResultTable,
+    )
+    from ate_replication_causalml_tpu.pipeline import SweepReport, write_report_md
+
+    ok = EstimatorResult(method="naive", ate=0.01, lower_ci=0.0, upper_ci=0.02)
+    report = SweepReport(
+        oracle=EstimatorResult(method="oracle", ate=0.09, lower_ci=0.08,
+                               upper_ci=0.10),
+        results=ResultTable([ok, _failed_row("Belloni et.al")]),
+        n_dropped=10, n_biased=100,
+        timings_s={"naive": 0.1},
+        failures={"Belloni et.al": {"error": "ChaosStageFault: injected",
+                                    "attempts": 2, "seconds": 0.3}},
+    )
+    md = open(write_report_md(report, str(tmp_path))).read()
+    assert "| Belloni et.al | ✗ failed | — | — |" in md
+    assert "### Degraded stages" in md
+    assert "ChaosStageFault: injected" in md
+    assert "| naive | 0.0100 |" in md
+
+
+def test_figures_render_partial_sweep_with_failure_footnote(tmp_path):
+    from ate_replication_causalml_tpu.estimators.base import EstimatorResult
+    from ate_replication_causalml_tpu.viz import notebook_figures
+
+    oracle = EstimatorResult(method="oracle", ate=0.09, lower_ci=0.08,
+                             upper_ci=0.10)
+    rows = [
+        EstimatorResult(method="naive", ate=0.01, lower_ci=0.0, upper_ci=0.02),
+        _failed_row("Direct Method"),
+    ]
+    paths = notebook_figures(rows, oracle, str(tmp_path))
+    assert len(paths) == 3 and all(os.path.getsize(p) > 0 for p in paths)
+    # A failed oracle stage drops the band instead of drawing NaNs.
+    paths2 = notebook_figures(rows, None, str(tmp_path))
+    assert len(paths2) == 3
+
+
+def test_stage_chaos_raises_only_for_matching_method():
+    inj = chaos.ChaosInjector(chaos.parse_chaos("stage:fail=Belloni"))
+    inj.maybe_fail_stage("naive")  # no match: no-op
+    with pytest.raises(ChaosStageFault):
+        inj.maybe_fail_stage("Belloni et.al")
+    inj.maybe_fail_stage("Belloni et.al")  # budget of 1 spent
+
+
+def test_chaos_shard_fault_is_transient():
+    assert classify(ChaosShardFault("x")) == "transient"

@@ -1,0 +1,192 @@
+"""Sweep-level resilience integration (ISSUE 3), @slow: a killed sweep
+resumes bit-identically from its checkpoint, and a chaos-mode sweep
+(injected shard faults + torn journal line + an isolated stage failure)
+completes, degrades gracefully, and matches a fault-free run on every
+row it computed. Slow tier: each case pays full XLA compiles for its
+own sweep shapes."""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from ate_replication_causalml_tpu import observability as obs
+from ate_replication_causalml_tpu.data.pipeline import PrepConfig
+from ate_replication_causalml_tpu.pipeline import (
+    SWEEP_METHODS,
+    SweepConfig,
+    run_sweep,
+)
+from ate_replication_causalml_tpu.resilience import chaos
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: Smallest sweep that still exercises every estimator; its shapes are
+#: unique to this module so nothing here competes with tier-1 budgets.
+NANO = dataclasses.replace(
+    SweepConfig().quick(),
+    prep=PrepConfig(n_obs=1000),
+    synthetic_pool=2500,
+    dr_trees=12, dml_trees=12, cf_trees=12, cf_nuisance_trees=12,
+    forest_depth=4, balance_iters=400,
+)
+
+_CHILD = """\
+import dataclasses, os, sys
+from ate_replication_causalml_tpu.data.pipeline import PrepConfig
+from ate_replication_causalml_tpu.pipeline import SweepConfig, run_sweep
+
+cfg = dataclasses.replace(
+    SweepConfig().quick(),
+    prep=PrepConfig(n_obs=1000),
+    synthetic_pool=2500,
+    dr_trees=12, dml_trees=12, cf_trees=12, cf_nuisance_trees=12,
+    forest_depth=4, balance_iters=400,
+)
+out = sys.argv[1]
+die_after = int(sys.argv[2])
+done = {"n": 0}
+
+def log(s):
+    print(s, flush=True)
+    if ": ate=" in s and "[resume]" not in s:
+        done["n"] += 1
+        if done["n"] == die_after:
+            os._exit(42)  # kill between stages, skipping every finally
+
+run_sweep(cfg, outdir=out, plots=False, log=log)
+print("SWEEP_DONE", flush=True)
+"""
+
+
+def _child_sweep(outdir: str, die_after: int = -1) -> subprocess.CompletedProcess:
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.pop(chaos.ENV_VAR, None)
+    return subprocess.run(
+        [sys.executable, "-c", _CHILD, outdir, str(die_after)],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=900,
+    )
+
+
+def _rows(path: str) -> dict[str, dict]:
+    out = {}
+    with open(path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("method") != "__config__":
+                out[rec["method"]] = rec
+    return out
+
+
+def _payload(rec: dict) -> dict:
+    return {k: rec.get(k) for k in ("ate", "lower_ci", "upper_ci", "se", "status")}
+
+
+def test_killed_sweep_resumes_bit_identically(tmp_path):
+    out = str(tmp_path / "killed")
+    proc = _child_sweep(out, die_after=4)
+    assert proc.returncode == 42, proc.stderr[-2000:]
+    survivors = _rows(os.path.join(out, "results.jsonl"))
+    assert len(survivors) == 4  # oracle + 3 estimator rows landed pre-kill
+
+    # Rerun with the same outdir: survivors resume, the rest compute.
+    proc2 = _child_sweep(out)
+    assert proc2.returncode == 0, proc2.stderr[-2000:]
+    assert proc2.stdout.count("[resume]") == 4
+    assert "SWEEP_DONE" in proc2.stdout
+    final = _rows(os.path.join(out, "results.jsonl"))
+    assert set(final) == set(SWEEP_METHODS) | {"oracle"}
+    for m, rec in survivors.items():
+        assert _payload(final[m]) == _payload(rec), m  # resumed untouched
+
+    # Fault-free reference run in a fresh outdir: every row bit-equal
+    # (the jsonl float repr round-trips exactly, so dict equality is
+    # bit-identity).
+    ref_out = str(tmp_path / "ref")
+    proc3 = _child_sweep(ref_out)
+    assert proc3.returncode == 0, proc3.stderr[-2000:]
+    ref = _rows(os.path.join(ref_out, "results.jsonl"))
+    assert set(ref) == set(final)
+    for m in ref:
+        assert _payload(final[m]) == _payload(ref[m]), m
+
+
+CHAOS_SPEC = (
+    "shard:p=0.3,seed=11;"          # ~30% of dispatches fail once, retried
+    "fs:torn_write;"                # first journal append lands torn
+    "stage:fail=residual_balancing"  # one estimator exhausts its budget
+)
+
+
+def test_chaos_sweep_degrades_and_matches_fault_free_run(tmp_path):
+    o_chaos = str(tmp_path / "chaos")
+    o_clean = str(tmp_path / "clean")
+    logs: list[str] = []
+    obs.REGISTRY.reset()
+    obs.EVENTS.clear()
+    with chaos.override(CHAOS_SPEC):
+        rep_chaos = run_sweep(NANO, outdir=o_chaos, plots=False,
+                              log=logs.append)
+
+    # The sweep completed and degraded exactly where told to.
+    assert [r.method for r in rep_chaos.results] == list(SWEEP_METHODS)
+    assert "residual_balancing" in rep_chaos.failures
+    failed_row = rep_chaos.results["residual_balancing"]
+    assert failed_row.status == "failed"
+    assert any("[FAILED] residual_balancing" in l for l in logs)
+    md = open(os.path.join(o_chaos, "REPORT.md")).read()
+    assert "| residual_balancing | ✗ failed | — | — |" in md
+    assert "### Degraded stages" in md
+    # Chaos is auditable: injections counted and exported.
+    metrics = json.load(open(os.path.join(o_chaos, "metrics.json")))
+    chaos_c = metrics["counters"]["chaos_injections_total"]
+    assert sum(chaos_c.values()) >= 2  # shard faults + torn write + stage
+    assert "scope=stage" in chaos_c
+    # The torn journal line is on disk (first append, the oracle row).
+    journal = open(os.path.join(o_chaos, "results.jsonl")).read().splitlines()
+    torn = [l for l in journal if l.strip() and not _parses(l)]
+    assert len(torn) == 1
+
+    # Fault-free reference: every successfully computed chaos row is
+    # bit-identical to it (retried shards replay their own keys).
+    chaos.reset()
+    rep_clean = run_sweep(NANO, outdir=o_clean, plots=False,
+                          log=lambda s: None)
+    assert not rep_clean.failures
+    for m in SWEEP_METHODS:
+        if m == "residual_balancing":
+            continue
+        assert rep_chaos.results[m].ate == rep_clean.results[m].ate, m
+        assert rep_chaos.results[m].se == rep_clean.results[m].se or (
+            rep_chaos.results[m].se != rep_chaos.results[m].se
+            and rep_clean.results[m].se != rep_clean.results[m].se
+        ), m  # equal, or both NaN (the no-SE LASSO rows)
+    assert rep_chaos.oracle.ate == rep_clean.oracle.ate
+
+    # Resume the chaos outdir with chaos off: the failed row and the
+    # torn row recompute; the sweep now matches the clean run fully.
+    logs2: list[str] = []
+    rep_resumed = run_sweep(NANO, outdir=o_chaos, plots=False,
+                            log=logs2.append)
+    assert any("[retry] residual_balancing" in l for l in logs2)
+    assert not rep_resumed.failures
+    for m in SWEEP_METHODS:
+        assert rep_resumed.results[m].ate == rep_clean.results[m].ate, m
+    md2 = open(os.path.join(o_chaos, "REPORT.md")).read()
+    assert "✗ failed" not in md2
+
+
+def _parses(line: str) -> bool:
+    try:
+        json.loads(line)
+        return True
+    except json.JSONDecodeError:
+        return False

@@ -87,8 +87,8 @@ def test_notebook_figures_fail_on_blank(tmp_path, monkeypatch):
 
     real = viz.pointrange_figure
 
-    def blank(results, oracle=None, title="", path=None):
-        chart = real([], oracle=oracle, title=title, path=path)
+    def blank(results, oracle=None, title="", path=None, **kw):
+        chart = real([], oracle=oracle, title=title, path=path, **kw)
         return chart
 
     monkeypatch.setattr(viz, "pointrange_figure", blank)
