@@ -694,9 +694,15 @@ class UnlockedSharedState(Rule):
         "observability/ class mutates lock-guarded shared state outside "
         "`with self._lock`"
     )
+    #: what the finding message calls the guarded state (subclasses
+    #: rescope the rule — JGL008 covers the sweep scheduler/checkpoint).
+    _context = "registry/event-log shared state"
+
+    def _in_scope(self, relpath: str) -> bool:
+        return "observability/" in relpath
 
     def check(self, module: ModuleInfo) -> Iterator[Finding]:
-        if "observability/" not in module.relpath:
+        if not self._in_scope(module.relpath):
             return
         for node in ast.walk(module.tree):
             if isinstance(node, ast.ClassDef):
@@ -719,10 +725,16 @@ class UnlockedSharedState(Rule):
         locks: set[str] = set()
         shared: set[str] = set()
         for stmt in ast.walk(init):
-            if not isinstance(stmt, ast.Assign):
+            # Annotated assignments (`self.done: dict = {}`) declare
+            # shared containers just as often as plain ones do.
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                targets = [stmt.target]
+            else:
                 continue
             value = stmt.value
-            for t in stmt.targets:
+            for t in targets:
                 attr = _self_attr(t, self_name)
                 if attr is None:
                     continue
@@ -773,7 +785,7 @@ class UnlockedSharedState(Rule):
                 module,
                 node,
                 f"{cls.name}.{attr} is mutated outside `with self."
-                f"{sorted(locks)[0]}` — registry/event-log shared state "
+                f"{sorted(locks)[0]}` — {self._context} "
                 "must be mutated under the instance lock",
             )
 
@@ -822,7 +834,11 @@ class UnlockedSharedState(Rule):
             if root is None:
                 continue
             for node in ast.walk(root):
-                if isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
+                if isinstance(
+                    node, (ast.Assign, ast.AugAssign, ast.AnnAssign, ast.Delete)
+                ):
+                    if isinstance(node, ast.AnnAssign) and node.value is None:
+                        continue  # bare annotation: no mutation
                     targets = (
                         node.targets
                         if isinstance(node, (ast.Assign, ast.Delete))
@@ -844,6 +860,37 @@ class UnlockedSharedState(Rule):
                         if attr in shared:
                             out.append((node, attr))
         return out
+
+
+# ---------------------------------------------------------------- JGL008
+
+
+@register
+class UnlockedSchedulerState(UnlockedSharedState):
+    """ISSUE 4's failure class: the sweep scheduler's ready queue /
+    outcome buffer / nuisance-cache entries and the checkpoint
+    journal's in-memory row map are mutated from a worker pool; any
+    mutation outside the sanctioned instance lock can tear the ordered
+    commit sequence or interleave journal appends. Same engine as
+    JGL006, rescoped to ``scheduler/`` and the pipeline drivers (the
+    ``_Checkpoint`` class lives in ``pipeline.py``)."""
+
+    id = "JGL008"
+    name = "unlocked-scheduler-state"
+    description = (
+        "scheduler/ or pipeline checkpoint class mutates lock-guarded "
+        "shared state outside the sanctioned instance lock"
+    )
+    _context = "scheduler/checkpoint shared state"
+
+    def _in_scope(self, relpath: str) -> bool:
+        # Only the top-level driver (<pkg>/pipeline.py) hosts
+        # _Checkpoint; a bare endswith would also rope in
+        # data/pipeline.py and any future nested pipeline.py.
+        parts = relpath.replace("\\", "/").split("/")
+        return "scheduler/" in relpath or (
+            parts[-1] == "pipeline.py" and len(parts) <= 2
+        )
 
 
 # ---------------------------------------------------------------- JGL007

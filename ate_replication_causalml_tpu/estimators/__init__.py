@@ -8,6 +8,7 @@ and returns the uniform :class:`EstimatorResult` record (SURVEY.md §1:
 from ate_replication_causalml_tpu.estimators.aipw import (
     doubly_robust,
     doubly_robust_glm,
+    outcome_model_mu,
 )
 from ate_replication_causalml_tpu.estimators.balance import (
     approx_balance,
@@ -54,6 +55,7 @@ __all__ = [
     "doubly_robust_glm",
     "logistic_propensity",
     "naive_ate",
+    "outcome_model_mu",
     "prop_score_lasso",
     "prop_score_ols",
     "prop_score_weight",

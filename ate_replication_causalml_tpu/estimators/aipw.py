@@ -92,6 +92,16 @@ def _outcome_model_mu(x, w, y):
     )
 
 
+def outcome_model_mu(frame: CausalFrame) -> tuple[jax.Array, jax.Array]:
+    """The shared AIPW nuisance, public: ``(mu0, mu1)`` from the
+    full-sample logit outcome model. Both doubly-robust estimators
+    consume exactly this fit (same inputs, same function), which makes
+    it a declared artifact in the sweep scheduler (ISSUE 4) — pass the
+    result back through ``doubly_robust(..., mu=...)`` /
+    ``doubly_robust_glm(..., mu=...)`` to share one fit."""
+    return _outcome_model_mu(frame.x, frame.w, frame.y)
+
+
 def _aipw_result(
     frame: CausalFrame,
     p: jax.Array,
@@ -102,10 +112,14 @@ def _aipw_result(
     boot_indices,
     sharded: bool,
     compat: str = "r",
+    mu: tuple[jax.Array, jax.Array] | None = None,
 ) -> EstimatorResult:
     w, y = frame.w, frame.y
     cs = _control_sign(compat)
-    mu0, mu1 = _outcome_model_mu(frame.x, w, y)
+    # ``mu`` lets the sweep scheduler share one outcome-model fit across
+    # both DR stages; fitting here is bit-identical (same jitted fn,
+    # same inputs).
+    mu0, mu1 = mu if mu is not None else _outcome_model_mu(frame.x, w, y)
     tau = aipw_tau(w, y, p, mu0, mu1, compat=compat)
     if bootstrap_se:
         if boot_indices is not None:
@@ -139,16 +153,25 @@ def doubly_robust_glm(
     sharded: bool = False,
     method: str = "Doubly Robust with logistic regression PS",
     compat: str = "r",
+    p: jax.Array | None = None,
+    mu: tuple[jax.Array, jax.Array] | None = None,
 ) -> EstimatorResult:
     """AIPW with in-sample GLM propensity, no clipping
-    (``ate_functions.R:211-264``). ``compat``: see :func:`aipw_tau`."""
+    (``ate_functions.R:211-264``). ``compat``: see :func:`aipw_tau`.
+
+    ``p``/``mu`` accept precomputed nuisances (the sweep scheduler's
+    shared artifacts): ``p`` must be the in-sample logistic propensity
+    — exactly :func:`~..ipw.logistic_propensity` — and ``mu`` the
+    :func:`outcome_model_mu` pair; omitted, both are fit here from the
+    same functions, bit-identically."""
     _control_sign(compat)  # reject typos before the nuisance fit
-    p = logistic_glm(add_intercept(frame.x), frame.w).fitted
+    if p is None:
+        p = logistic_glm(add_intercept(frame.x), frame.w).fitted
     if bootstrap_se and key is None and boot_indices is None:
         key = jax.random.key(0)
     return _aipw_result(
         frame, p, method, bootstrap_se, n_boot, key, boot_indices, sharded,
-        compat,
+        compat, mu=mu,
     )
 
 
@@ -162,18 +185,20 @@ def doubly_robust(
     sharded: bool = False,
     method: str = "Doubly Robust with Random Forest PS",
     compat: str = "r",
+    mu: tuple[jax.Array, jax.Array] | None = None,
 ) -> EstimatorResult:
     """AIPW with a pluggable propensity model and the reference's
     clip-to-interior rule (``ate_functions.R:149-207``). The canonical
     ``propensity_fn`` is a random-forest OOB propensity (the reference
     uses ``randomForest`` OOB votes); see ``models.forest`` once the
     forest engine lands — any callable ``CausalFrame -> (n,) probs``
-    works."""
+    works. ``mu``: precomputed :func:`outcome_model_mu` pair (the
+    sweep's shared artifact)."""
     _control_sign(compat)  # reject typos before the forest fit
     p = clip_propensity(jnp.asarray(propensity_fn(frame)))
     if bootstrap_se and key is None and boot_indices is None:
         key = jax.random.key(0)
     return _aipw_result(
         frame, p, method, bootstrap_se, n_boot, key, boot_indices, sharded,
-        compat,
+        compat, mu=mu,
     )

@@ -452,7 +452,25 @@ def exact_order_stats(x: jax.Array, ranks: jax.Array) -> jax.Array:
     bounded at the 1M-row flagship even if XLA materializes it — the
     unchunked form OOMed the 16 GB chip when a second fit's binning ran
     while the first fit's (T, n) forest arrays were still resident
-    (bench.py's min-of-two protocol)."""
+    (bench.py's min-of-two protocol).
+
+    Ranks are validated host-side when they are concrete (they are at
+    every call site — linspace-derived constants stay concrete even
+    under an enclosing trace): an out-of-range rank would otherwise
+    leave ``lo`` at its 0xFFFFFFFF search bound, which decodes to a NaN
+    bit pattern and silently poisons the caller's quantiles (ADVICE
+    r5). Traced ranks skip the check — the binary search itself is
+    rank-shape-agnostic."""
+    ranks = jnp.asarray(ranks)
+    n = x.shape[0]
+    if not isinstance(ranks, jax.core.Tracer) and ranks.size:
+        rmin, rmax = int(ranks.min()), int(ranks.max())
+        if rmin < 0 or rmax >= n:
+            raise ValueError(
+                f"exact_order_stats: rank(s) out of range for n={n} rows "
+                f"(min rank {rmin}, max rank {rmax}; valid range is "
+                f"[0, {n - 1}])"
+            )
     keys = _f32_sort_key(x)  # (n, p)
     p = x.shape[1]
     r = ranks.shape[0]

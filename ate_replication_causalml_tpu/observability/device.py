@@ -80,6 +80,14 @@ def install_jax_monitoring() -> bool:
             "faults injected by the chaos harness").inc(0)
     counter("checkpoint_torn_lines_total",
             "unparsable results.jsonl lines skipped on resume").inc(0)
+    # Scheduler/cache families (ISSUE 4): present on every instrumented
+    # run — a sequential sweep reports zero prefetches, not a missing
+    # key (scripts/check_metrics_schema.py REQUIRED_COUNTERS).
+    counter("nuisance_cache_requests_total",
+            "nuisance artifact cache requests by artifact and hit/miss"
+            ).inc(0)
+    counter("scheduler_prefetch_total",
+            "compile-prefetch lane outcomes by stage and status").inc(0)
     if _installed:
         return True
     try:

@@ -127,17 +127,24 @@ class EventLog:
             self._records.append(rec)
 
     @contextlib.contextmanager
-    def span(self, name: str, **attrs) -> Iterator[Span | _NullSpan]:
+    def span(self, name: str, parent_id: str | None = None,
+             **attrs) -> Iterator[Span | _NullSpan]:
         """Open a span; the record lands in the log when the block
         exits. Exceptions mark status ``error`` (with the exception type
-        in attrs) and propagate."""
+        in attrs) and propagate.
+
+        Parentage is per-thread by default; ``parent_id`` overrides it
+        for work handed across threads (the concurrent sweep's worker
+        pool opens stage spans on threads where the ``run_sweep`` span
+        is not on the local stack)."""
         if not enabled():
             with _null_ctx() as sp:
                 yield sp
             return
         stack = self._stack()
-        parent = stack[-1].span_id if stack else None
-        sp = Span(name, self._next_id(), parent, dict(attrs))
+        if parent_id is None:
+            parent_id = stack[-1].span_id if stack else None
+        sp = Span(name, self._next_id(), parent_id, dict(attrs))
         stack.append(sp)
         try:
             yield sp
@@ -190,8 +197,8 @@ class EventLog:
 EVENTS = EventLog()
 
 
-def span(name: str, **attrs):
-    return EVENTS.span(name, **attrs)
+def span(name: str, parent_id: str | None = None, **attrs):
+    return EVENTS.span(name, parent_id=parent_id, **attrs)
 
 
 def emit(name: str, status: str = "event", **attrs) -> None:

@@ -265,6 +265,18 @@ def lognet_binomial(
     return ElnetPath(lambdas=lams, intercepts=intercepts, coefs=coefs)
 
 
+def default_foldid(key: jax.Array, n: int, nfolds: int = 10) -> jax.Array:
+    """The fold assignment :func:`cv_glmnet` derives from ``key`` when
+    no ``foldid`` is given — exposed so the sweep scheduler can compute
+    fold masks once as a declared artifact and pass them in explicitly.
+    jax PRNG results are jit-invariant, so
+    ``cv_glmnet(x, y, key=k)`` and
+    ``cv_glmnet(x, y, foldid=default_foldid(k, n))`` are bit-identical
+    (asserted in tests/test_lasso.py)."""
+    base = jnp.resize(jnp.arange(1, nfolds + 1), (n,))
+    return jax.random.permutation(key, base)
+
+
 def r_compat_foldid(n: int, nfolds: int, rng) -> np.ndarray:
     """cv.glmnet's fold assignment: ``sample(rep(seq(nfolds), length=N))``
     under R's RNG (host-side, for the parity contract)."""
@@ -338,8 +350,7 @@ def _cv_glmnet_impl(
     if foldid is None:
         if key is None:
             key = jax.random.key(0)
-        base = jnp.resize(jnp.arange(1, nfolds + 1), (n,))
-        foldid = jax.random.permutation(key, base)
+        foldid = default_foldid(key, n, nfolds)
     foldid = jnp.asarray(foldid)
 
     fit = elnet_gaussian if family == "gaussian" else lognet_binomial

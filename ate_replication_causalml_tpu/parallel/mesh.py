@@ -18,6 +18,7 @@ insert collectives).
 from __future__ import annotations
 
 import contextlib
+import threading
 from typing import Sequence
 
 import jax
@@ -61,7 +62,17 @@ TREE_AXIS = "tree"
 FOLD_AXIS = "fold"
 DATA_AXIS = "data"
 
-_ACTIVE_MESH: Mesh | None = None
+# The process-wide default mesh (``set_mesh`` / lazy ``make_mesh``)
+# plus a per-thread ``use_mesh`` override. The override is thread-local
+# on purpose (ISSUE 4): the concurrent sweep runs stage bodies on
+# worker threads, and a mesh-lane stage sitting inside
+# ``use_mesh(fold_mesh)`` must not hand the fold mesh to an unlaned
+# stage calling ``get_mesh()`` from another thread — that caller would
+# launch a collective outside the lane, exactly the rendezvous
+# interleaving the lane serializes against.
+_DEFAULT_MESH: Mesh | None = None
+_DEFAULT_MESH_LOCK = threading.Lock()
+_TLS = threading.local()
 
 
 def make_mesh(
@@ -83,27 +94,33 @@ def make_mesh(
 
 
 def set_mesh(mesh: Mesh) -> None:
-    global _ACTIVE_MESH
-    _ACTIVE_MESH = mesh
+    global _DEFAULT_MESH
+    with _DEFAULT_MESH_LOCK:
+        _DEFAULT_MESH = mesh
 
 
 def get_mesh() -> Mesh:
-    """The active mesh, defaulting to a single-axis mesh over all devices."""
-    global _ACTIVE_MESH
-    if _ACTIVE_MESH is None:
-        _ACTIVE_MESH = make_mesh()
-    return _ACTIVE_MESH
+    """The active mesh: this thread's ``use_mesh`` override if one is
+    live, else the process default (a single-axis mesh over all
+    devices, built lazily)."""
+    override = getattr(_TLS, "mesh", None)
+    if override is not None:
+        return override
+    global _DEFAULT_MESH
+    with _DEFAULT_MESH_LOCK:
+        if _DEFAULT_MESH is None:
+            _DEFAULT_MESH = make_mesh()
+        return _DEFAULT_MESH
 
 
 @contextlib.contextmanager
 def use_mesh(mesh: Mesh):
-    global _ACTIVE_MESH
-    prev = _ACTIVE_MESH
-    _ACTIVE_MESH = mesh
+    prev = getattr(_TLS, "mesh", None)
+    _TLS.mesh = mesh
     try:
         yield mesh
     finally:
-        _ACTIVE_MESH = prev
+        _TLS.mesh = prev
 
 
 def shard_axis_size(mesh: Mesh, axis_name: str) -> int:

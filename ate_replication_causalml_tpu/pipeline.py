@@ -22,11 +22,26 @@ What the notebook lacks, the driver adds (SURVEY.md §5):
   partial sweeps with failures annotated; a finite-value guard keeps
   NaN/Inf point estimates out of the result set. The ``ATE_TPU_CHAOS``
   fault injector (resilience/chaos.py) exercises all of it on demand.
+* **Concurrent scheduling** (ISSUE 4) — the sweep is a DAG, not a
+  list: stages declare the nuisance artifacts they consume (logistic
+  propensity, LASSO PS path, fold masks, RF OOB propensity, the AIPW
+  outcome-model mu pair) and a bounded worker pool
+  (``scheduler/engine.py``) executes ready stages concurrently over a
+  fit-once artifact cache, while journal/report/figure/log order stays
+  the fixed notebook order and every row is bit-identical to the
+  sequential sweep (per-stage fold-in keys make stage numerics
+  order-independent). ``--sequential`` (or
+  ``ATE_TPU_SWEEP_SEQUENTIAL=1``) is the single-threaded escape hatch;
+  ``ATE_TPU_SWEEP_WORKERS`` bounds the pool; a background compile-
+  prefetch lane primes the persistent compile cache for upcoming
+  stages when that cache is enabled (``ATE_TPU_SWEEP_PREFETCH``
+  overrides).
 
 CLI::
 
     python -m ate_replication_causalml_tpu.pipeline --out results/ \
-        [--csv socialpresswgeooneperhh_NEIGH.csv] [--quick] [--no-plots]
+        [--csv socialpresswgeooneperhh_NEIGH.csv] [--quick] [--no-plots] \
+        [--sequential] [--workers N]
 """
 
 from __future__ import annotations
@@ -35,6 +50,7 @@ import dataclasses
 import json
 import math
 import os
+import threading
 from typing import Callable, Iterable
 
 import jax
@@ -72,7 +88,14 @@ from ate_replication_causalml_tpu.models.forest import rf_oob_propensity
 from ate_replication_causalml_tpu.resilience import chaos
 from ate_replication_causalml_tpu.resilience.errors import (
     ChaosSpecError,
+    ChaosStageFault,
     NonFiniteResult,
+)
+from ate_replication_causalml_tpu.scheduler import (
+    ArtifactSpec,
+    StageSpec,
+    SweepEngine,
+    default_workers,
 )
 from ate_replication_causalml_tpu.utils.profiling import StageTimer, xla_trace
 
@@ -188,6 +211,12 @@ class _Checkpoint:
 
     def __init__(self, path: str | None, fingerprint: str, log=print):
         self.path = path
+        # Appends are serialized (ISSUE 4): the scheduler's ordered
+        # committer is single-flight by construction, but the journal's
+        # torn-line/resume semantics are load-bearing enough that the
+        # writer enforces its own mutual exclusion too (graftlint
+        # JGL008 checks it).
+        self._lock = threading.Lock()
         self.done: dict[str, dict] = {}
         if path and os.path.exists(path):
             recs = []
@@ -235,18 +264,20 @@ class _Checkpoint:
 
     def put(self, rec: dict) -> None:
         rec = _jsonsafe(rec)
-        self.done[rec["method"]] = rec
-        if self.path:
-            line = json.dumps(rec) + "\n"
-            inj = chaos.active()
-            if inj is not None:
-                # fs:torn_write chaos: persist this row torn, the way a
-                # kill mid-append would. The in-memory copy above keeps
-                # the CURRENT run correct; the reader's torn-line skip +
-                # recompute-on-resume is the path under test.
-                line = inj.torn_line(line, site=self.path)
-            with open(self.path, "a") as f:
-                f.write(line)
+        with self._lock:
+            self.done[rec["method"]] = rec
+            if self.path:
+                line = json.dumps(rec) + "\n"
+                inj = chaos.active()
+                if inj is not None:
+                    # fs:torn_write chaos: persist this row torn, the
+                    # way a kill mid-append would. The in-memory copy
+                    # above keeps the CURRENT run correct; the reader's
+                    # torn-line skip + recompute-on-resume is the path
+                    # under test.
+                    line = inj.torn_line(line, site=self.path)
+                with open(self.path, "a") as f:
+                    f.write(line)
 
 
 def _unused_stale_path(path: str) -> str:
@@ -298,12 +329,47 @@ def build_frames(
     return df, df_mod, len(dropped)
 
 
+def _resolve_scheduler(
+    scheduler: str | None, workers: int | None, log: Callable[[str], None]
+) -> int:
+    """Worker-pool width from the scheduler mode + env knobs. Mode is
+    deliberately NOT part of the checkpoint fingerprint: concurrent and
+    sequential sweeps are bit-identical, so either may resume the
+    other's journal."""
+    mode = scheduler
+    if mode is None:
+        mode = (
+            "sequential"
+            if os.environ.get("ATE_TPU_SWEEP_SEQUENTIAL", "").strip().lower()
+            in ("1", "true", "yes", "on")
+            else "concurrent"
+        )
+    if mode not in ("sequential", "concurrent"):
+        raise ValueError(
+            f"scheduler must be 'sequential' or 'concurrent', got {mode!r}"
+        )
+    if mode == "concurrent" and os.environ.get("ATE_TPU_TRACE_DIR"):
+        # jax.profiler traces are process-global; two stages tracing
+        # concurrently would collide. Profiled runs are sequential.
+        log("ATE_TPU_TRACE_DIR set — forcing sequential sweep "
+            "(profiler traces cannot overlap)")
+        mode = "sequential"
+    if mode == "sequential":
+        return 1
+    # Clamp like default_workers clamps the env var: --workers 0/-1 must
+    # not reach the engine as a zero-thread pool.
+    return default_workers() if workers is None else max(1, workers)
+
+
 def run_sweep(
     config: SweepConfig = SweepConfig(),
     csv_path: str | None = None,
     outdir: str | None = None,
     plots: bool = True,
     log: Callable[[str], None] = print,
+    scheduler: str | None = None,
+    workers: int | None = None,
+    prefetch: bool | None = None,
 ) -> SweepReport:
     """The full notebook run, checkpointed and timed.
 
@@ -315,13 +381,29 @@ def run_sweep(
     Prometheus textfile land next to ``report.json`` (all written
     atomically). ``ATE_TPU_TELEMETRY=0`` disables all of it; estimator
     outputs are bit-identical either way.
+
+    Scheduling (ISSUE 4): ``scheduler`` is ``"concurrent"`` (default;
+    DAG worker pool over the shared nuisance cache) or ``"sequential"``
+    (single-threaded escape hatch — same numbers, same journal).
+    ``workers`` bounds the pool (default ``ATE_TPU_SWEEP_WORKERS`` or
+    ``min(4, cpus)``); ``prefetch`` overrides the compile-prefetch
+    lane's default (on iff the persistent compile cache is enabled).
     """
     # Cache counters must exist in metrics.json even when the embedding
     # process never enabled the persistent cache (idempotent).
     obs.install_jax_monitoring()
     try:
-        with obs.span("run_sweep", out=outdir or "", csv=csv_path or "synthetic"):
-            report = _run_sweep_impl(config, csv_path, outdir, plots, log)
+        with obs.span("run_sweep", out=outdir or "",
+                      csv=csv_path or "synthetic") as root_sp:
+            report = _run_sweep_impl(
+                config, csv_path, outdir, plots, log,
+                n_workers=_resolve_scheduler(scheduler, workers, log),
+                prefetch=prefetch,
+                # Stage spans are opened on worker threads, where the
+                # run_sweep span is not on the thread-local stack —
+                # parentage rides explicitly.
+                root_span_id=getattr(root_sp, "span_id", None),
+            )
         return report
     finally:
         # Export in a finally: a failing run is exactly the run whose
@@ -342,12 +424,32 @@ def run_sweep(
                 log(f"telemetry export failed: {e!r}")
 
 
+@dataclasses.dataclass
+class _StageOutcome:
+    """What a stage body hands the ordered committer: the result row
+    plus everything the commit needs to journal/log it in declared
+    order (ISSUE 4 — side effects are the committer's job; bodies may
+    finish in any order)."""
+
+    kind: str                   # "resumed" | "computed" | "failed"
+    res: EstimatorResult
+    record: dict | None = None  # checkpoint row (computed/failed)
+    extras: dict = dataclasses.field(default_factory=dict)
+    seconds: float = 0.0
+    retry_why: str = ""         # non-resumable cached row's reason
+    error: str = ""
+    attempts: int = 0
+
+
 def _run_sweep_impl(
     config: SweepConfig,
     csv_path: str | None,
     outdir: str | None,
     plots: bool,
     log: Callable[[str], None],
+    n_workers: int = 1,
+    prefetch: bool | None = None,
+    root_span_id: str | None = None,
 ) -> SweepReport:
     if outdir:
         os.makedirs(outdir, exist_ok=True)
@@ -425,13 +527,34 @@ def _run_sweep_impl(
         "sweep_stage_total", "sweep stages by resume-vs-computed status"
     )
 
-    def stage(method: str, fn: Callable[[], object]) -> EstimatorResult:
-        """Run one estimator with timing + checkpointing + telemetry,
-        under the config's isolation policy. ``fn`` returns an
-        EstimatorResult, or (EstimatorResult, extras-dict) — extras ride
-        the checkpoint record (read back via ``ckpt.get``). The stage
-        span's status records whether the row was computed, resumed
-        from the checkpoint, or failed-and-degraded.
+    # Chaos stage faults are PLANNED, in declared order, before any
+    # worker starts (chaos.plan_stage_faults): the `times` budget is
+    # order-sensitive, and worker completion order must never decide
+    # which stages it selects. Bodies read the plan; the injection
+    # event/counter fires at raise time (chaos.record_stage_fault), so
+    # an aborted sweep never reports a fault on a stage that was
+    # skipped.
+    fault_plan: set[str] = set()
+
+    def _make_stage(
+        method: str,
+        fn: Callable[[object], object],
+        needs: tuple[str, ...] = (),
+        warm: Callable[[], object] | None = None,
+        exclusive: str | None = None,
+    ) -> tuple[StageSpec, bool]:
+        """One estimator as a scheduler stage, under the config's
+        isolation policy. ``fn(cache)`` returns an EstimatorResult, or
+        (EstimatorResult, extras-dict) — extras ride the checkpoint
+        record (read back via ``ckpt.get``). The stage span's status
+        records whether the row was computed, resumed from the
+        checkpoint, or failed-and-degraded.
+
+        The resume decision is made HERE, at build time (it is a pure
+        function of the loaded checkpoint): a resumed stage declares no
+        artifact needs, so a fully checkpointed rerun schedules no
+        nuisance fits at all — the old lazy ``_p_log`` guarantee, now by
+        construction. Returns (spec, resumed).
 
         Degradation (``fail_policy="degrade"``): an exception (or a
         non-finite ATE — the finite-value guard) becomes a
@@ -442,147 +565,330 @@ def _run_sweep_impl(
         crashing on them. ``KeyboardInterrupt``/``SystemExit`` always
         propagate: an operator's ^C is not an estimator failure."""
         cached = ckpt.get(method)
-        with obs.span("sweep_stage", method=method) as sp:
-            if cached is not None:
-                resumable, why = _row_resumable(cached)
-                if resumable:
+        resumable, why = _row_resumable(cached) if cached is not None else (False, "")
+        if cached is not None and resumable:
+            def run_resumed(cache, method=method, cached=cached):
+                with obs.span("sweep_stage", parent_id=root_span_id,
+                              method=method) as sp:
                     sp.set_status("resumed")
-                    stage_c.inc(1, method=method, status="resumed")
-                    log(f"  [resume] {method}: ate={cached['ate']:.4f}")
                     nanf = lambda v: float("nan") if v is None else v
                     res = EstimatorResult(
                         method=cached["method"], ate=cached["ate"],
-                        lower_ci=nanf(cached["lower_ci"]), upper_ci=nanf(cached["upper_ci"]),
+                        lower_ci=nanf(cached["lower_ci"]),
+                        upper_ci=nanf(cached["upper_ci"]),
                         se=nanf(cached["se"]),
                     )
-                    timer.seconds[method] = cached.get("seconds", 0.0)
-                    return res
-                obs.emit("checkpoint_row_rejected", status="retrying",
-                         method=method, reason=why)
-                log(f"  [retry] {method}: checkpoint row not resumable "
-                    f"({why}); recomputing")
-            sp.set_status("computed")
-            # The prior attempt count rides the same hand-editable row
-            # _row_resumable guards, so tolerate garbage here too.
-            prior = cached.get("attempts") if cached else 0
-            attempts = (
-                int(prior) + 1
-                if isinstance(prior, (int, float)) and not isinstance(prior, bool)
-                else 1
-            )
-            try:
-                # xla_trace sanitizes the label itself (method names carry
-                # spaces/parens/dots — e.g. ``Causal Forest(GRF)``).
-                with timer.stage(method), xla_trace(method):
-                    inj = chaos.active()
-                    if inj is not None:
-                        inj.maybe_fail_stage(method)
-                    out = fn()
-                res, extras = out if isinstance(out, tuple) else (out, {})
-                if not math.isfinite(res.ate):
-                    raise NonFiniteResult(
-                        f"estimator returned ATE {res.ate!r} from finite "
-                        f"inputs — refusing to record a garbage row"
+                    return _StageOutcome(
+                        "resumed", res, seconds=cached.get("seconds", 0.0)
                     )
-            except (KeyboardInterrupt, SystemExit, ChaosSpecError):
-                # ^C is not an estimator failure, and a malformed chaos
-                # spec (env edited mid-run) is an operator error — both
-                # must abort, never degrade.
-                raise
-            except Exception as e:
-                if config.fail_policy != "degrade":
+
+            return StageSpec(method, run_resumed, needs=()), True
+
+        retry_why = why if cached is not None else ""
+
+        def run(cache, method=method, fn=fn, cached=cached,
+                retry_why=retry_why):
+            with obs.span("sweep_stage", parent_id=root_span_id,
+                          method=method) as sp:
+                if retry_why:
+                    obs.emit("checkpoint_row_rejected", status="retrying",
+                             method=method, reason=retry_why)
+                sp.set_status("computed")
+                # The prior attempt count rides the same hand-editable
+                # row _row_resumable guards, so tolerate garbage too.
+                prior = cached.get("attempts") if cached else 0
+                attempts = (
+                    int(prior) + 1
+                    if isinstance(prior, (int, float))
+                    and not isinstance(prior, bool)
+                    else 1
+                )
+                try:
+                    # xla_trace sanitizes the label itself (method names
+                    # carry spaces/parens/dots — ``Causal Forest(GRF)``).
+                    with timer.stage(method), xla_trace(method):
+                        if method in fault_plan:
+                            inj_now = chaos.active()
+                            if inj_now is not None:
+                                inj_now.record_stage_fault(method)
+                            raise ChaosStageFault(
+                                f"chaos: injected stage fault on {method!r}"
+                            )
+                        out = fn(cache)
+                    res, extras = out if isinstance(out, tuple) else (out, {})
+                    if not math.isfinite(res.ate):
+                        raise NonFiniteResult(
+                            f"estimator returned ATE {res.ate!r} from finite "
+                            f"inputs — refusing to record a garbage row"
+                        )
+                except (KeyboardInterrupt, SystemExit, ChaosSpecError):
+                    # ^C is not an estimator failure, and a malformed
+                    # chaos spec (env edited mid-run) is an operator
+                    # error — both must abort, never degrade.
                     raise
-                dt = timer.seconds.get(method, 0.0)
-                err = f"{type(e).__name__}: {e}"
-                sp.set_status("failed")
-                sp.set_attr("error", err)
-                stage_c.inc(1, method=method, status="failed")
-                obs.emit("sweep_stage_failed", status="error", method=method,
-                         error=err, attempts=attempts)
-                report.failures[method] = {
-                    "error": err, "attempts": attempts, "seconds": round(dt, 3),
-                }
-                nan = float("nan")
-                res = EstimatorResult(method=method, ate=nan, lower_ci=nan,
-                                      upper_ci=nan, se=nan, status="failed")
-                ckpt.put(dict(res.to_dict(), error=err, attempts=attempts,
-                              seconds=round(dt, 3)))
-                log(f"  [FAILED] {method}: {err} (attempt {attempts}, "
-                    f"{dt:.1f}s) — degrading, sweep continues")
-                return res
-            dt = timer.seconds[method]
-            sp.set_attr("seconds", round(dt, 3))
-            stage_c.inc(1, method=method, status="computed")
-            ckpt.put(dict(res.to_dict(), seconds=round(dt, 3),
-                          attempts=attempts, **extras))
-            log(f"  {method}: ate={res.ate:.4f} ci=[{res.lower_ci:.4f},{res.upper_ci:.4f}] "
-                f"({dt:.1f}s)")
-            return res
+                except Exception as e:
+                    if config.fail_policy != "degrade":
+                        raise
+                    dt = timer.seconds.get(method, 0.0)
+                    err = f"{type(e).__name__}: {e}"
+                    sp.set_status("failed")
+                    sp.set_attr("error", err)
+                    obs.emit("sweep_stage_failed", status="error",
+                             method=method, error=err, attempts=attempts)
+                    nan = float("nan")
+                    res = EstimatorResult(method=method, ate=nan,
+                                          lower_ci=nan, upper_ci=nan,
+                                          se=nan, status="failed")
+                    return _StageOutcome(
+                        "failed", res,
+                        record=dict(res.to_dict(), error=err,
+                                    attempts=attempts, seconds=round(dt, 3)),
+                        seconds=dt, retry_why=retry_why, error=err,
+                        attempts=attempts,
+                    )
+                dt = timer.seconds[method]
+                sp.set_attr("seconds", round(dt, 3))
+                return _StageOutcome(
+                    "computed", res,
+                    record=dict(res.to_dict(), seconds=round(dt, 3),
+                                attempts=attempts, **extras),
+                    extras=extras, seconds=dt, retry_why=retry_why,
+                    attempts=attempts,
+                )
 
-    # ── The sweep, in notebook order (Rmd:128-272) ────────────────────
-    report.oracle = stage("oracle", lambda: naive_ate(df, method="oracle"))
-    add = report.results.append
+        return StageSpec(method, run, needs=needs, warm=warm,
+                         exclusive=exclusive), False
 
-    add(stage("naive", lambda: naive_ate(df_mod)))
-    add(stage("Direct Method", lambda: ate_condmean_ols(df_mod)))
+    def commit(spec: StageSpec, outcome: _StageOutcome) -> None:
+        """Declared-order side effects: journal append, report/timer
+        bookkeeping, log lines. The engine runs commits strictly in
+        stage order, single-flight — so results.jsonl keeps the same
+        notebook ordering a sequential sweep writes, whatever order the
+        bodies finished in."""
+        method = spec.name
+        res = outcome.res
+        if outcome.retry_why:
+            log(f"  [retry] {method}: checkpoint row not resumable "
+                f"({outcome.retry_why}); recomputing")
+        if outcome.kind == "resumed":
+            stage_c.inc(1, method=method, status="resumed")
+            timer.seconds[method] = outcome.seconds
+            log(f"  [resume] {method}: ate={res.ate:.4f}")
+            return
+        if outcome.kind == "failed":
+            stage_c.inc(1, method=method, status="failed")
+            report.failures[method] = {
+                "error": outcome.error, "attempts": outcome.attempts,
+                "seconds": round(outcome.seconds, 3),
+            }
+            ckpt.put(outcome.record)
+            log(f"  [FAILED] {method}: {outcome.error} (attempt "
+                f"{outcome.attempts}, {outcome.seconds:.1f}s) — degrading, "
+                f"sweep continues")
+            return
+        stage_c.inc(1, method=method, status="computed")
+        ckpt.put(outcome.record)
+        if "incorrect_ate" in outcome.extras:
+            log(f"  Incorrect ATE: {outcome.extras['incorrect_ate']:.3f} "
+                f"(SE: {outcome.extras['incorrect_se']:.3f})"
+                f"  [deliberate negative example, Rmd:262]")
+        log(f"  {method}: ate={res.ate:.4f} ci=[{res.lower_ci:.4f},"
+            f"{res.upper_ci:.4f}] ({outcome.seconds:.1f}s)")
 
-    # Shared logistic propensity (Rmd:164-168), fit lazily so a fully
-    # checkpointed rerun never pays for it.
-    _p_log = []
+    # ── Nuisance artifacts (ISSUE 4): fit-once, keyed by the run
+    # fingerprint plus the config knobs each fit reads. Every fit uses
+    # the same fold-in key / same jitted function the sequential stages
+    # used, so sharing is bit-identical by construction. ──────────────
+    from ate_replication_causalml_tpu.estimators.aipw import (
+        _outcome_model_mu,
+        outcome_model_mu,
+    )
+    from ate_replication_causalml_tpu.estimators.ipw import (
+        _psols_core,
+        _psw_core,
+    )
+    from ate_replication_causalml_tpu.ops.lasso import default_foldid
 
-    def p_logistic():
-        if not _p_log:
-            _p_log.append(logistic_propensity(df_mod.x, df_mod.w))
-        return _p_log[0]
+    _sds = lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
+    x_s, w_s, y_s = _sds(df_mod.x), _sds(df_mod.w), _sds(df_mod.y)
 
-    add(stage("Propensity_Weighting",
-              lambda: prop_score_weight(df_mod, p_logistic())))
-    add(stage("Propensity_Regression",
-              lambda: prop_score_ols(df_mod, p_logistic())))
-    add(stage("Propensity_Weighting_LASSOPS",
-              lambda: with_folds(lambda: prop_score_weight(
-                  df_mod, prop_score_lasso(df_mod, key=key_for("ps_lasso"),
-                                           fold_axis=fold_axis),
-                  method="Propensity_Weighting_LASSOPS"))))
-    add(stage("Single-equation LASSO",
-              lambda: with_folds(lambda: ate_condmean_lasso(
-                  df_mod, key=key_for("seq_lasso"), fold_axis=fold_axis))))
-    add(stage("Usual LASSO",
-              lambda: with_folds(lambda: ate_lasso(
-                  df_mod, key=key_for("usual_lasso"), fold_axis=fold_axis))))
-    add(stage("Doubly Robust with Random Forest PS",
-              lambda: doubly_robust(
-                  df_mod,
-                  lambda f: rf_oob_propensity(
-                      f, key=key_for("dr_rf_prop"), n_trees=config.dr_trees,
-                      depth=config.forest_depth, mesh=tree_mesh),
-                  key=key_for("dr_rf"))))
-    add(stage("Doubly Robust with logistic regression PS",
-              lambda: doubly_robust_glm(df_mod, key=key_for("dr_glm"))))
-    add(stage("Belloni et.al",
-              lambda: with_folds(lambda: belloni(
-                  df_mod, key=key_for("belloni"), fold_axis=fold_axis))))
-    add(stage("Double Machine Learning",
-              lambda: double_ml(df_mod, n_trees=config.dml_trees,
-                                depth=config.forest_depth, key=key_for("dml"),
-                                mesh=tree_mesh)))
-    add(stage("residual_balancing",
-              lambda: residual_balance_ate(df_mod, key=key_for("balance"),
-                                           max_iters=config.balance_iters)))
+    # Multi-device collective programs (fold/tree shard_map) must keep a
+    # single global launch order — two collective launches racing from
+    # different host threads interleave per-device executions and
+    # deadlock the rendezvous. Nodes in the "mesh" lane serialize among
+    # themselves; everything else overlaps freely. Single-device runs
+    # have no collectives and no lane.
+    mesh_lane = "mesh" if mesh_devices > 1 else None
 
-    # Causal forest: the result row plus the notebook's 'incorrect' demo
-    # (Rmd:258-262). The demo values ride the checkpoint record as stage
-    # extras.
-    def cf_fn():
+    def materialized(fit):
+        """Wrap a mesh-lane artifact fit so its value leaves the lane as
+        a HOST-materialized, unsharded array. Two jobs: (1) a consumer
+        stage outside the lane must never hold a device-sharded input —
+        jitted ops on one compile to cross-device collectives, exactly
+        the launches the lane exists to serialize; (2) np.asarray is a
+        device sync, so the lane is released only after the artifact's
+        collective work has fully drained, not merely been enqueued."""
+        def wrapped(c):
+            return jax.numpy.asarray(np.asarray(fit(c)))
+
+        return wrapped
+
+    artifacts = [
+        # In-sample logistic propensity (Rmd:164-168) — consumed by both
+        # propensity stages AND the DR-GLM stage (the same GLM fit).
+        ArtifactSpec(
+            "p_logistic",
+            fit=lambda c: logistic_propensity(df_mod.x, df_mod.w),
+            key=(fingerprint,),
+            warm=lambda: logistic_propensity.lower(x_s, w_s).compile(),
+        ),
+        # The AIPW outcome-model (mu0, mu1) both doubly-robust stages
+        # share (ate_functions.R:156-166 — one fit, two consumers).
+        ArtifactSpec(
+            "outcome_mu",
+            fit=lambda c: outcome_model_mu(df_mod),
+            key=(fingerprint,),
+            warm=lambda: _outcome_model_mu.lower(x_s, w_s, y_s).compile(),
+        ),
+        # CV fold masks: the exact assignment cv_glmnet derives from
+        # each stage's fold-in key (ops.lasso.default_foldid is
+        # jit-invariant, asserted in tests/test_lasso.py).
+        ArtifactSpec(
+            "folds:ps_lasso",
+            fit=lambda c: default_foldid(key_for("ps_lasso"), df_mod.n),
+            key=(fingerprint, "ps_lasso"),
+        ),
+        ArtifactSpec(
+            "folds:seq_lasso",
+            fit=lambda c: default_foldid(key_for("seq_lasso"), df_mod.n),
+            key=(fingerprint, "seq_lasso"),
+        ),
+        ArtifactSpec(
+            "folds:usual_lasso",
+            fit=lambda c: default_foldid(key_for("usual_lasso"), df_mod.n),
+            key=(fingerprint, "usual_lasso"),
+        ),
+        # LASSO-logit propensity path at lambda.1se (ate_functions.R:
+        # 133-146) — consumes its fold masks, feeds the IPW stage.
+        ArtifactSpec(
+            "lasso_ps",
+            fit=materialized(lambda c: with_folds(lambda: prop_score_lasso(
+                df_mod, foldid=c.get("folds:ps_lasso"),
+                fold_axis=fold_axis))),
+            needs=("folds:ps_lasso",),
+            key=(fingerprint,),
+            exclusive=mesh_lane,
+        ),
+        # RF OOB vote-fraction propensity (ate_functions.R:169-174).
+        ArtifactSpec(
+            "rf_oob_propensity",
+            fit=materialized(lambda c: rf_oob_propensity(
+                df_mod, key=key_for("dr_rf_prop"), n_trees=config.dr_trees,
+                depth=config.forest_depth, mesh=tree_mesh)),
+            key=(fingerprint, config.dr_trees, config.forest_depth),
+            exclusive=mesh_lane,
+        ),
+    ]
+
+    # ── The sweep, in notebook order (Rmd:128-272). The declaration
+    # list IS the commit/journal/report order, whatever the worker pool
+    # does. ───────────────────────────────────────────────────────────
+    def cf_fn(cache):
         cf = causal_forest_report(
             df_mod, key=key_for("causal_forest"), n_trees=config.cf_trees,
             nuisance_trees=config.cf_nuisance_trees, mesh=tree_mesh)
-        log(f"  Incorrect ATE: {cf.incorrect_ate:.3f} (SE: {cf.incorrect_se:.3f})"
-            f"  [deliberate negative example, Rmd:262]")
         return cf.result, {"incorrect_ate": cf.incorrect_ate,
                            "incorrect_se": cf.incorrect_se}
 
-    add(stage("Causal Forest(GRF)", cf_fn))
+    stage_decls: list[tuple] = [
+        ("oracle", lambda c: naive_ate(df, method="oracle"), (), None, None),
+        ("naive", lambda c: naive_ate(df_mod), (), None, None),
+        ("Direct Method", lambda c: ate_condmean_ols(df_mod), (), None, None),
+        ("Propensity_Weighting",
+         lambda c: prop_score_weight(df_mod, c.get("p_logistic")),
+         ("p_logistic",),
+         lambda: _psw_core.lower(
+             x_s, w_s, y_s,
+             jax.ShapeDtypeStruct((df_mod.n,), df_mod.x.dtype)).compile(),
+         None),
+        ("Propensity_Regression",
+         lambda c: prop_score_ols(df_mod, c.get("p_logistic")),
+         ("p_logistic",),
+         lambda: _psols_core.lower(
+             w_s, y_s,
+             jax.ShapeDtypeStruct((df_mod.n,), df_mod.w.dtype)).compile(),
+         None),
+        ("Propensity_Weighting_LASSOPS",
+         lambda c: prop_score_weight(
+             df_mod, c.get("lasso_ps"),
+             method="Propensity_Weighting_LASSOPS"),
+         ("lasso_ps",), None, None),
+        ("Single-equation LASSO",
+         lambda c: with_folds(lambda: ate_condmean_lasso(
+             df_mod, foldid=c.get("folds:seq_lasso"),
+             fold_axis=fold_axis)),
+         ("folds:seq_lasso",), None, mesh_lane),
+        ("Usual LASSO",
+         lambda c: with_folds(lambda: ate_lasso(
+             df_mod, foldid=c.get("folds:usual_lasso"),
+             fold_axis=fold_axis)),
+         ("folds:usual_lasso",), None, mesh_lane),
+        ("Doubly Robust with Random Forest PS",
+         lambda c: doubly_robust(
+             df_mod, lambda f: c.get("rf_oob_propensity"),
+             key=key_for("dr_rf"), mu=c.get("outcome_mu")),
+         ("rf_oob_propensity", "outcome_mu"), None, None),
+        ("Doubly Robust with logistic regression PS",
+         lambda c: doubly_robust_glm(
+             df_mod, key=key_for("dr_glm"), p=c.get("p_logistic"),
+             mu=c.get("outcome_mu")),
+         ("p_logistic", "outcome_mu"), None, None),
+        ("Belloni et.al",
+         lambda c: with_folds(lambda: belloni(
+             df_mod, key=key_for("belloni"), fold_axis=fold_axis)),
+         (), None, mesh_lane),
+        ("Double Machine Learning",
+         lambda c: double_ml(df_mod, n_trees=config.dml_trees,
+                             depth=config.forest_depth, key=key_for("dml"),
+                             mesh=tree_mesh),
+         (), None, mesh_lane),
+        ("residual_balancing",
+         lambda c: residual_balance_ate(df_mod, key=key_for("balance"),
+                                        max_iters=config.balance_iters),
+         (), None, None),
+        # Causal forest: the result row plus the notebook's 'incorrect'
+        # demo (Rmd:258-262). The demo values ride the checkpoint
+        # record as stage extras.
+        ("Causal Forest(GRF)", cf_fn, (), None, mesh_lane),
+    ]
+
+    stages: list[StageSpec] = []
+    to_compute: list[str] = []
+    for method, fn, needs, warm, lane in stage_decls:
+        spec, resumed = _make_stage(method, fn, needs=needs, warm=warm,
+                                    exclusive=lane)
+        stages.append(spec)
+        if not resumed:
+            to_compute.append(method)
+
+    inj = chaos.active()
+    if inj is not None:
+        # Resumed stages never reached the injector sequentially either
+        # (they return before the chaos point) — plan over the rest.
+        fault_plan.update(inj.plan_stage_faults(to_compute))
+
+    engine = SweepEngine(
+        artifacts, stages, commit=commit, workers=n_workers,
+        prefetch=prefetch,
+    )
+    if n_workers > 1:
+        log(f"scheduler: concurrent sweep, {n_workers} workers"
+            + (", compile prefetch on" if engine.prefetch else ""))
+    outcomes = engine.run()
+
+    report.oracle = outcomes["oracle"].res
+    for m in SWEEP_METHODS:
+        report.results.append(outcomes[m].res)
     cf_rec = ckpt.get("Causal Forest(GRF)") or {}
     report.incorrect_cf_ate = cf_rec.get("incorrect_ate")
     report.incorrect_cf_se = cf_rec.get("incorrect_se")
@@ -765,13 +1071,21 @@ def main(argv: Iterable[str] | None = None) -> SweepReport:
                     help="path to socialpresswgeooneperhh_NEIGH.csv (else synthetic)")
     ap.add_argument("--quick", action="store_true", help="small smoke-run sizes")
     ap.add_argument("--no-plots", action="store_true")
+    ap.add_argument("--sequential", action="store_true",
+                    help="single-threaded sweep (debugging escape hatch; "
+                         "bit-identical to the concurrent default)")
+    ap.add_argument("--workers", type=int, default=None,
+                    help="worker-pool width for the concurrent sweep "
+                         "(default: ATE_TPU_SWEEP_WORKERS or min(4, cpus))")
     args = ap.parse_args(argv if argv is None else list(argv))
 
     config = SweepConfig()
     if args.quick:
         config = config.quick()
     report = run_sweep(config, csv_path=args.csv, outdir=args.out,
-                       plots=not args.no_plots)
+                       plots=not args.no_plots,
+                       scheduler="sequential" if args.sequential else None,
+                       workers=args.workers)
     print(repr(report.results))
     return report
 

@@ -285,20 +285,53 @@ class ChaosInjector:
 
     # ── stage scope ───────────────────────────────────────────────────
 
+    def take_stage_fault(self, method: str, *, record: bool = True) -> bool:
+        """Whether this stage draws an injected fault (consuming one
+        unit of the ``times`` budget). Selection is the substring match;
+        the budget makes it first-``times``-matches — *in whatever order
+        this is called*, which is why the concurrent sweep driver plans
+        all stage faults up front in declared order
+        (:meth:`plan_stage_faults`) instead of racing workers for the
+        budget."""
+        cfg = self.config.scope("stage")
+        if cfg is None or not cfg["fail"] or cfg["fail"] not in method:
+            return False
+        with self._lock:
+            if self._stage_left <= 0:
+                return False
+            self._stage_left -= 1
+        if record:
+            self._record("stage", method, fail=cfg["fail"])
+        return True
+
+    def record_stage_fault(self, method: str) -> None:
+        """Emit the injection event/counter for a *planned* stage fault
+        at the moment it is actually raised. Planning selects without
+        recording so an aborted sweep never reports a fault injected on
+        a stage that was skipped."""
+        cfg = self.config.scope("stage")
+        self._record("stage", method, fail=cfg["fail"] if cfg else "")
+
+    def plan_stage_faults(self, methods: Sequence[str]) -> frozenset[str]:
+        """Consume the stage-fault budget against ``methods`` in the
+        given (declared) order and return the set that must fail —
+        the deterministic plan the concurrent sweep injects from, so
+        worker completion order can never change *which* stages the
+        budget selects. Selection is recorded when the fault is raised
+        (:meth:`record_stage_fault`), not here."""
+        return frozenset(
+            m for m in methods if self.take_stage_fault(m, record=False)
+        )
+
     def maybe_fail_stage(self, method: str) -> None:
         """Sweep-stage injection point: raise for the first ``times``
         stages whose method name contains the configured substring."""
-        cfg = self.config.scope("stage")
-        if cfg is None or not cfg["fail"] or cfg["fail"] not in method:
-            return
-        with self._lock:
-            if self._stage_left <= 0:
-                return
-            self._stage_left -= 1
-        self._record("stage", method, fail=cfg["fail"])
-        raise ChaosStageFault(
-            f"chaos: injected stage fault on {method!r} (fail={cfg['fail']!r})"
-        )
+        if self.take_stage_fault(method):
+            cfg = self.config.scope("stage")
+            raise ChaosStageFault(
+                f"chaos: injected stage fault on {method!r} "
+                f"(fail={cfg['fail']!r})"
+            )
 
 
 def plan_faults(

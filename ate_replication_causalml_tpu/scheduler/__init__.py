@@ -1,0 +1,43 @@
+"""Concurrent sweep scheduler (ISSUE 4).
+
+Three layers, one import surface:
+
+* :mod:`~.dag` — stage/artifact declarations and DAG validation;
+* :mod:`~.cache` — the fit-once nuisance artifact cache;
+* :mod:`~.engine` — the bounded worker pool with declaration-ordered
+  commits (``workers=1`` is the sequential escape hatch);
+* :mod:`~.prefetch` — the background compile-prefetch lane.
+
+The L5 driver (``pipeline.py``) is the production consumer; the specs
+are plain callables so tests can schedule synthetic DAGs without jax.
+"""
+
+from ate_replication_causalml_tpu.scheduler.cache import NuisanceCache
+from ate_replication_causalml_tpu.scheduler.dag import (
+    ArtifactSpec,
+    Dag,
+    DagError,
+    StageSpec,
+    validate,
+)
+from ate_replication_causalml_tpu.scheduler.engine import (
+    SweepEngine,
+    default_workers,
+)
+from ate_replication_causalml_tpu.scheduler.prefetch import (
+    CompilePrefetcher,
+    default_enabled as prefetch_default_enabled,
+)
+
+__all__ = [
+    "ArtifactSpec",
+    "CompilePrefetcher",
+    "Dag",
+    "DagError",
+    "NuisanceCache",
+    "StageSpec",
+    "SweepEngine",
+    "default_workers",
+    "prefetch_default_enabled",
+    "validate",
+]

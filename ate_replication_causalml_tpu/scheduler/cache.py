@@ -1,0 +1,131 @@
+"""Fit-once nuisance artifact cache (ISSUE 4, tentpole part 2).
+
+Replaces the driver's ad-hoc ``_p_log`` lazy list: every shared
+nuisance (logistic propensity, LASSO PS path, fold masks, RF OOB
+propensity, outcome-model mu0/mu1) is an :class:`~.dag.ArtifactSpec`
+and is fit at most once per (name, key) — the key carries the data
+fingerprint and the config knobs the fit reads, so distinct configs can
+never share an artifact even if a cache instance were reused across
+runs.
+
+Concurrency contract: the cache is the synchronization point between
+stages that race for the same artifact. A per-entry lock serializes the
+fit; losers of the race block and then read the winner's value (a
+cache *hit* — they never refit). Failures are deliberately NOT
+memoized: the sequential sweep refits a failed shared nuisance on the
+next consumer (each consumer stage degrades independently), and the
+concurrent sweep must behave identically.
+
+Hit/miss traffic lands in the ``nuisance_cache_requests_total`` counter
+(labels ``artifact=``, ``status=hit|miss``) and each fit is a
+``nuisance_fit`` span — the metrics families
+``scripts/check_metrics_schema.py`` validates.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Iterable
+
+from ate_replication_causalml_tpu import observability as obs
+from ate_replication_causalml_tpu.scheduler.dag import ArtifactSpec, DagError
+
+
+class NuisanceCache:
+    """Thread-safe fit-once store over a set of artifact specs."""
+
+    def __init__(self, specs: Iterable[ArtifactSpec] = ()):
+        self._lock = threading.Lock()
+        self._specs: dict[str, ArtifactSpec] = {}
+        self._values: dict[tuple, object] = {}
+        self._entry_locks: dict[tuple, threading.Lock] = {}
+        self._lane_locks: dict[str, threading.RLock] = {}
+        self._hits: dict[str, int] = {}
+        self._misses: dict[str, int] = {}
+        for spec in specs:
+            self.register(spec)
+
+    def register(self, spec: ArtifactSpec) -> None:
+        with self._lock:
+            if spec.name in self._specs:
+                raise DagError(f"artifact {spec.name!r} registered twice")
+            self._specs[spec.name] = spec
+
+    def spec(self, name: str) -> ArtifactSpec:
+        with self._lock:
+            return self._specs[name]
+
+    def _entry_lock(self, key: tuple) -> threading.Lock:
+        with self._lock:
+            lk = self._entry_locks.get(key)
+            if lk is None:
+                lk = self._entry_locks[key] = threading.Lock()
+            return lk
+
+    def lane_lock(self, lane: str) -> threading.RLock:
+        """Re-entrant lock shared with the engine for one exclusive lane.
+
+        The engine's scheduling skip keeps two laned *nodes* from
+        overlapping, but a failed laned artifact is refit by whichever
+        consumer stage requests it next — possibly an unlaned stage body
+        on another worker thread. Both the engine (around a laned node's
+        execution) and :meth:`get` (around a laned artifact's fit) hold
+        this lock, so that refit can never launch its collective
+        concurrently with a laned node. Re-entrant because the engine's
+        own artifact node reaches the fit through :meth:`get` on the
+        same thread; always acquired BEFORE the per-entry lock so the
+        two orderings cannot deadlock."""
+        with self._lock:
+            lk = self._lane_locks.get(lane)
+            if lk is None:
+                lk = self._lane_locks[lane] = threading.RLock()
+            return lk
+
+    def get(self, name: str) -> object:
+        """The artifact's value, fitting it on first request.
+
+        Counted as a hit when the value already exists (including when
+        this thread blocked on another thread's in-flight fit), a miss
+        when this call runs the fit. An exception from the fit
+        propagates to THIS caller and leaves no entry behind.
+        """
+        spec = self.spec(name)
+        key = (name, spec.key)
+        c = obs.counter(
+            "nuisance_cache_requests_total",
+            "nuisance artifact cache requests by artifact and hit/miss",
+        )
+        with self._lock:
+            if key in self._values:
+                self._hits[name] = self._hits.get(name, 0) + 1
+                value = self._values[key]
+                c.inc(1, artifact=name, status="hit")
+                return value
+        guard = (
+            self.lane_lock(spec.exclusive)
+            if spec.exclusive is not None
+            else contextlib.nullcontext()
+        )
+        with guard:
+            with self._entry_lock(key):
+                # Double-check: the thread we waited on may have fit it.
+                with self._lock:
+                    if key in self._values:
+                        self._hits[name] = self._hits.get(name, 0) + 1
+                        value = self._values[key]
+                        c.inc(1, artifact=name, status="hit")
+                        return value
+                c.inc(1, artifact=name, status="miss")
+                with obs.span("nuisance_fit", artifact=name):
+                    value = spec.fit(self)
+                with self._lock:
+                    self._misses[name] = self._misses.get(name, 0) + 1
+                    self._values[key] = value
+                return value
+
+    def stats(self) -> dict[str, dict[str, int]]:
+        """``{"hits": {...}, "misses": {...}}`` by artifact name (tests
+        and the engine's end-of-run summary)."""
+        with self._lock:
+            return {"hits": dict(self._hits), "misses": dict(self._misses)}

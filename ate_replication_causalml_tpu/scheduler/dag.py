@@ -1,0 +1,176 @@
+"""Stage DAG model for the concurrent sweep (ISSUE 4).
+
+Chernozhukov et al. (2018, arXiv:1608.00060) makes the sweep's real
+shape explicit: AIPW / DML / Belloni / IPW are different *combinations*
+of a small set of shared cross-fit nuisances, so the estimator sweep is
+a DAG over nuisance artifacts, not a list of independent blobs. This
+module is the declaration layer: estimator stages name the artifacts
+they consume, artifacts name the artifacts *they* consume (the LASSO
+propensity path consumes its fold masks), and :func:`validate` turns
+the declarations into the dependency structure the engine schedules.
+
+Nothing here imports jax or runs work — specs carry plain callables.
+The split matters for testing: the adversarial-interleaving tests in
+``tests/test_scheduler.py`` drive the engine with synthetic specs, no
+estimators involved.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Iterable
+
+
+class DagError(ValueError):
+    """A malformed sweep declaration: duplicate node names, a stage or
+    artifact consuming an artifact nobody declared, or an artifact
+    dependency cycle. Raised at build time — a bad DAG must fail before
+    any estimator runs, not deadlock the worker pool."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ArtifactSpec:
+    """One fit-once nuisance artifact.
+
+    ``fit`` receives the :class:`~.cache.NuisanceCache` as a resolver so
+    an artifact can consume other artifacts (declared in ``needs``).
+    ``key`` is the cache identity *beyond* the name — data fingerprint
+    and the config knobs the fit reads — so two sweeps with different
+    configs can never share an artifact (ISSUE 4 cache contract).
+    """
+
+    name: str
+    fit: Callable[[object], object]
+    needs: tuple[str, ...] = ()
+    key: tuple = ()
+    #: optional compile-prefetch hook: AOT lower+compile this artifact's
+    #: executables (see prefetch.py). Must be side-effect-free on
+    #: numerics.
+    warm: Callable[[], object] | None = None
+    #: nodes sharing a non-None lane name never execute concurrently.
+    #: The sweep uses lane "mesh" for every node that launches a
+    #: multi-device collective program: two collective launches racing
+    #: from different host threads can interleave their per-device
+    #: executions and deadlock the rendezvous (observed on the 8-virtual-
+    #: device CPU backend), so collectives keep a single global launch
+    #: order while non-collective stages overlap freely.
+    exclusive: str | None = None
+
+
+@dataclasses.dataclass(frozen=True)
+class StageSpec:
+    """One sweep stage (estimator, oracle, ...) in declared order.
+
+    ``run`` receives the cache resolver; ``needs`` names the artifacts
+    it consumes. The engine guarantees that journal/report/log commit
+    order follows declaration order regardless of completion order, so
+    the declaration list IS the notebook order contract.
+    """
+
+    name: str
+    run: Callable[[object], object]
+    needs: tuple[str, ...] = ()
+    warm: Callable[[], object] | None = None
+    #: see ArtifactSpec.exclusive.
+    exclusive: str | None = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Dag:
+    """Validated DAG: specs by name plus the artifact depth map used to
+    order artifact nodes ahead of their first consumer."""
+
+    artifacts: dict[str, ArtifactSpec]
+    stages: tuple[StageSpec, ...]
+    #: artifact name -> longest chain of artifact-to-artifact deps below
+    #: it (leaves are 0). Deeper artifacts must be fit first.
+    depth: dict[str, int]
+    #: artifact name -> index of the earliest declared stage that
+    #: (transitively) consumes it.
+    first_consumer: dict[str, int]
+
+
+def _closure(artifacts: dict[str, ArtifactSpec], roots: Iterable[str]) -> set[str]:
+    """All artifacts reachable from ``roots`` through ``needs`` edges."""
+    seen: set[str] = set()
+    todo = list(roots)
+    while todo:
+        name = todo.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        todo.extend(artifacts[name].needs)
+    return seen
+
+
+def validate(
+    artifacts: Iterable[ArtifactSpec], stages: Iterable[StageSpec]
+) -> Dag:
+    """Check the declarations and derive scheduling metadata.
+
+    Raises :class:`DagError` on duplicate names, unknown ``needs``
+    references, or artifact cycles. Stage-to-stage edges do not exist by
+    construction (stages only consume artifacts), so stages can never
+    form a cycle.
+    """
+    arts: dict[str, ArtifactSpec] = {}
+    for a in artifacts:
+        if a.name in arts:
+            raise DagError(f"duplicate artifact {a.name!r}")
+        arts[a.name] = a
+    stage_list = tuple(stages)
+    seen_stages: set[str] = set()
+    for s in stage_list:
+        if s.name in seen_stages or s.name in arts:
+            raise DagError(f"duplicate node name {s.name!r}")
+        seen_stages.add(s.name)
+    for a in arts.values():
+        for dep in a.needs:
+            if dep not in arts:
+                raise DagError(
+                    f"artifact {a.name!r} needs unknown artifact {dep!r}"
+                )
+    for s in stage_list:
+        for dep in s.needs:
+            if dep not in arts:
+                raise DagError(f"stage {s.name!r} needs unknown artifact {dep!r}")
+
+    # Artifact depth by DFS; a cycle surfaces as revisiting the active
+    # path. Iterative (the sweep DAG is tiny, but a declaration bug
+    # must produce DagError, not RecursionError).
+    depth: dict[str, int] = {}
+    state: dict[str, int] = {}  # 1 = on path, 2 = done
+    for root in arts:
+        if state.get(root) == 2:
+            continue
+        state[root] = 1
+        stack = [(root, iter(arts[root].needs))]
+        while stack:
+            name, deps = stack[-1]
+            for dep in deps:
+                st = state.get(dep)
+                if st == 2:
+                    continue
+                if st == 1:
+                    path = tuple(n for n, _ in stack)
+                    cyc = " -> ".join(path + (dep,))
+                    raise DagError(f"artifact dependency cycle: {cyc}")
+                state[dep] = 1
+                stack.append((dep, iter(arts[dep].needs)))
+                break
+            else:
+                stack.pop()
+                state[name] = 2
+                depth[name] = max(
+                    (depth[d] + 1 for d in arts[name].needs), default=0
+                )
+
+    first_consumer: dict[str, int] = {}
+    for i, s in enumerate(stage_list):
+        for name in _closure(arts, s.needs):
+            first_consumer.setdefault(name, i)
+            first_consumer[name] = min(first_consumer[name], i)
+    return Dag(
+        artifacts=arts, stages=stage_list, depth=depth,
+        first_consumer=first_consumer,
+    )

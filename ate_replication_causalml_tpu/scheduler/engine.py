@@ -1,0 +1,415 @@
+"""DAG-scheduled sweep engine (ISSUE 4, tentpole part 1).
+
+A bounded worker pool executes *ready* nodes — nuisance artifacts and
+estimator stages — concurrently. JAX releases the GIL during device
+execution and XLA compilation, so host threads overlap stage B's
+trace/compile with stage A's device compute; that overlap, not
+estimator-internal parallelism, is where the sweep's wall-clock goes.
+
+Determinism contract (the hard constraint, asserted in
+``tests/test_scheduler.py`` and the resilience sweep tests):
+
+* every stage computes exactly the function it computed sequentially,
+  on exactly the same inputs — per-stage fold-in keys
+  (``pipeline.key_for``) make stage numerics independent of execution
+  order, and the :class:`~.cache.NuisanceCache` guarantees a shared
+  artifact is fit once, by one thread, from its declared key;
+* **commit order is declaration order**: journal appends, report rows,
+  log lines and failure records run through an ordered committer —
+  stage k's commit runs only after stages 0..k-1 committed, whatever
+  order the bodies finished in. A crash therefore leaves the same
+  journal prefix shape a sequential run would (later finished-but-
+  uncommitted rows are recomputed on resume — checkpoint semantics
+  from ISSUE 3 survive unchanged);
+* an abort-class exception (``fail_policy="raise"``, a malformed
+  chaos spec) surfaces as the earliest *declared* failing stage. Nodes
+  declared *before* that stage keep running to completion so their
+  rows commit, and commits flush exactly up to the failing stage —
+  byte-for-byte the journal a sequential run leaves behind. Operator
+  aborts (^C, SystemExit) stop scheduling immediately instead: the
+  committed prefix is best-effort, just as it is sequentially.
+
+``workers=1`` is the ``--sequential`` escape hatch: the same node
+graph, executed inline on the calling thread in priority order (an
+artifact immediately before its first consumer — the lazy-fit order
+the old driver had), with the prefetch lane off. No threads are
+created at all, which is exactly what you want under a debugger.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import heapq
+import os
+import threading
+import time
+from typing import Callable, Iterable
+
+from ate_replication_causalml_tpu import observability as obs
+from ate_replication_causalml_tpu.scheduler.cache import NuisanceCache
+from ate_replication_causalml_tpu.scheduler.dag import (
+    ArtifactSpec,
+    StageSpec,
+    validate,
+)
+from ate_replication_causalml_tpu.scheduler.prefetch import (
+    CompilePrefetcher,
+    default_enabled,
+)
+
+_WORKERS_ENV = "ATE_TPU_SWEEP_WORKERS"
+
+
+def default_workers() -> int:
+    """Worker-pool width: ``ATE_TPU_SWEEP_WORKERS`` if set, else
+    ``min(4, cpu_count)`` — the sweep overlaps host trace/compile with
+    device compute, so width past a few threads only adds contention."""
+    env = os.environ.get(_WORKERS_ENV, "").strip()
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return max(1, min(4, os.cpu_count() or 1))
+
+
+class _Node:
+    __slots__ = (
+        "kind", "name", "priority", "deps", "exec", "stage_idx", "exclusive",
+    )
+
+    def __init__(self, kind, name, priority, deps, exec_fn, stage_idx,
+                 exclusive=None):
+        self.kind = kind            # "artifact" | "stage"
+        self.name = name
+        self.priority = priority
+        self.deps = deps            # tuple of node names
+        self.exec = exec_fn
+        self.stage_idx = stage_idx  # commit index for stages; the first
+        #                             consumer's index for artifacts
+        self.exclusive = exclusive  # lane name (see dag.ArtifactSpec)
+
+
+class SweepEngine:
+    """Execute a validated stage DAG over a shared nuisance cache."""
+
+    def __init__(
+        self,
+        artifacts: Iterable[ArtifactSpec],
+        stages: Iterable[StageSpec],
+        *,
+        commit: Callable[[StageSpec, object], None] | None = None,
+        workers: int | None = None,
+        prefetch: bool | None = None,
+        cache: NuisanceCache | None = None,
+    ):
+        arts = list(artifacts)
+        self.dag = validate(arts, stages)
+        self.cache = cache if cache is not None else NuisanceCache(arts)
+        # Clamp like default_workers clamps the env var: workers<=0 must
+        # not spawn zero threads and return an empty result dict.
+        self.workers = default_workers() if workers is None else max(1, workers)
+        self.prefetch = default_enabled() if prefetch is None else prefetch
+        self._commit_fn = commit
+        self._mu = threading.Condition()
+        # Shared scheduling state — every mutation below happens under
+        # self._mu (graftlint JGL008 enforces this).
+        self._ready: list[tuple] = []           # heap of (priority, name)
+        self._indegree: dict[str, int] = {}
+        self._dependents: dict[str, list[str]] = {}
+        self._started: set[str] = set()
+        self._inflight = 0
+        self._remaining = 0
+        self._results: dict[str, object] = {}
+        self._outcomes: dict[int, tuple[StageSpec, object]] = {}
+        self._next_commit = 0
+        self._commit_busy = False
+        self._abort: list[tuple[int, BaseException]] = []
+        self._busy_lanes: set[str] = set()
+        self._nodes = self._build_nodes()
+
+    # ── graph construction ────────────────────────────────────────────
+
+    def _build_nodes(self) -> dict[str, _Node]:
+        dag = self.dag
+        nodes: dict[str, _Node] = {}
+        # Only artifacts some stage transitively consumes are scheduled:
+        # a fully resumed sweep declares no needs and fits nothing.
+        needed = set(dag.first_consumer)
+        order = {name: i for i, name in enumerate(dag.artifacts)}
+        for name in needed:
+            spec = dag.artifacts[name]
+            prio = (dag.first_consumer[name], 0, -dag.depth[name], order[name])
+            nodes[name] = _Node(
+                "artifact", name, prio,
+                tuple(d for d in spec.needs if d in needed),
+                (lambda nm=name: self.cache.get(nm)),
+                dag.first_consumer[name],
+                exclusive=spec.exclusive,
+            )
+        for i, spec in enumerate(dag.stages):
+            nodes[spec.name] = _Node(
+                "stage", spec.name, (i, 1, 0, 0),
+                tuple(d for d in spec.needs if d in needed),
+                (lambda sp=spec: sp.run(self.cache)),
+                i,
+                exclusive=spec.exclusive,
+            )
+        return nodes
+
+    # ── public API ────────────────────────────────────────────────────
+
+    def run(self) -> dict[str, object]:
+        """Execute the DAG; returns ``{stage name: value}``.
+
+        Raises the earliest-declared aborting exception, with commits
+        flushed exactly up to (not including) that stage.
+        """
+        with self._mu:
+            self._remaining = len(self._nodes)
+            for name, node in self._nodes.items():
+                self._indegree[name] = len(node.deps)
+                for dep in node.deps:
+                    self._dependents.setdefault(dep, []).append(name)
+            for name, node in self._nodes.items():
+                if self._indegree[name] == 0:
+                    heapq.heappush(self._ready, (node.priority, name))
+        obs.gauge("scheduler_workers", "sweep worker-pool width").set(
+            float(self.workers)
+        )
+        prefetcher = None
+        if self.prefetch and self.workers > 1:
+            items = sorted(self._nodes.values(), key=lambda n: n.priority)
+            warm_of = {
+                **{a.name: a.warm for a in self.dag.artifacts.values()},
+                **{s.name: s.warm for s in self.dag.stages},
+            }
+            prefetcher = CompilePrefetcher(
+                [(n.name, warm_of.get(n.name)) for n in items],
+                started=self._was_started,
+            )
+            prefetcher.start()
+        try:
+            if self.workers == 1:
+                self._run_inline()
+            else:
+                threads = [
+                    threading.Thread(
+                        target=self._worker, name=f"sweep-worker-{i}",
+                        daemon=True,
+                    )
+                    for i in range(self.workers)
+                ]
+                for t in threads:
+                    t.start()
+                try:
+                    for t in threads:
+                        t.join()
+                except BaseException as e:  # noqa: BLE001 — a real ^C
+                    # lands HERE: CPython delivers SIGINT to the main
+                    # thread (blocked in join), never to a worker. Flag
+                    # the operator abort so workers stop taking nodes,
+                    # drain in-flight work, and surface the interrupt
+                    # through the normal abort path (commits truncate
+                    # before index 0 — the best-effort-prefix contract).
+                    self._operator_abort(e)
+                    for t in threads:
+                        t.join()
+        finally:
+            if prefetcher is not None:
+                prefetcher.stop(timeout=60.0)
+        self._flush_commits()
+        with self._mu:
+            if self._abort:
+                idx, exc = min(self._abort, key=lambda ae: ae[0])
+                obs.emit("scheduler_abort", status="error",
+                         stage_index=idx, error=type(exc).__name__)
+                raise exc
+            return dict(self._results)
+
+    # ── execution ─────────────────────────────────────────────────────
+
+    def _was_started(self, name: str) -> bool:
+        with self._mu:
+            return name in self._started
+
+    def _operator_abort(self, exc: BaseException) -> None:
+        """Record an operator abort delivered OUTSIDE a stage body (a
+        real ^C interrupts the main thread's join, not a worker).
+        Index −1 sorts before every stage: workers stop taking nodes,
+        no further commits flush, and ``run()`` re-raises ``exc``."""
+        with self._mu:
+            self._abort.append((-1, exc))
+            self._mu.notify_all()
+
+    def _take(self) -> _Node | None:
+        """Next ready node by priority, or None when drained.
+        Blocks while work is in flight that may unlock more nodes.
+
+        Lanes are a SCHEDULING constraint, not a blocking lock: a ready
+        node whose lane is occupied is skipped (left queued) and the
+        worker takes the next ready node instead — a pool of two must
+        not idle one worker behind a long mesh-lane stage while unlaned
+        stages sit ready (measured: that turned the 2-worker sweep into
+        sequential-plus-overhead).
+
+        After an abort, nodes declared *before* the earliest aborting
+        stage keep being scheduled (the committed journal prefix must
+        match a sequential run's, and sequentially every earlier stage
+        finished before the failing one raised); later nodes are
+        skipped. Operator aborts (^C/SystemExit) stop scheduling
+        outright."""
+        with self._mu:
+            while True:
+                if self._remaining == 0:
+                    return None
+                stop_at: int | None = None
+                if self._abort:
+                    if any(
+                        isinstance(e, (KeyboardInterrupt, SystemExit))
+                        for _, e in self._abort
+                    ):
+                        return None
+                    stop_at = min(a for a, _ in self._abort)
+                skipped: list[tuple] = []
+                picked: _Node | None = None
+                while self._ready:
+                    prio, name = heapq.heappop(self._ready)
+                    node = self._nodes[name]
+                    if stop_at is not None and node.stage_idx >= stop_at:
+                        skipped.append((prio, name))
+                        continue
+                    if (
+                        node.exclusive is not None
+                        and node.exclusive in self._busy_lanes
+                    ):
+                        skipped.append((prio, name))
+                        continue
+                    picked = node
+                    break
+                for item in skipped:
+                    heapq.heappush(self._ready, item)
+                if picked is not None:
+                    self._started.add(picked.name)
+                    self._inflight += 1
+                    if picked.exclusive is not None:
+                        self._busy_lanes.add(picked.exclusive)
+                    return picked
+                if stop_at is not None and self._inflight == 0:
+                    # Aborted and nothing in flight can unlock an
+                    # earlier-declared node — drain the pool.
+                    return None
+                self._mu.wait()
+
+    def _finish(self, node: _Node, value, error: BaseException | None) -> None:
+        with self._mu:
+            self._remaining -= 1
+            self._inflight -= 1
+            if node.exclusive is not None:
+                self._busy_lanes.discard(node.exclusive)
+            for dep_name in self._dependents.get(node.name, ()):
+                self._indegree[dep_name] -= 1
+                if self._indegree[dep_name] == 0:
+                    dep = self._nodes[dep_name]
+                    heapq.heappush(self._ready, (dep.priority, dep_name))
+            if node.kind == "stage":
+                if error is None:
+                    self._results[node.name] = value
+                    self._outcomes[node.stage_idx] = (
+                        self.dag.stages[node.stage_idx], value
+                    )
+                else:
+                    self._abort.append((node.stage_idx, error))
+            elif error is not None and isinstance(
+                error, (KeyboardInterrupt, SystemExit)
+            ):
+                # An operator abort inside an artifact fit stops the
+                # run; an ordinary artifact failure does not — each
+                # consumer stage retries the fit under its own
+                # isolation policy, exactly as the lazy sequential
+                # driver did.
+                self._abort.append((node.stage_idx, error))
+            self._mu.notify_all()
+
+    def _exec(self, node: _Node) -> None:
+        t0 = time.perf_counter()
+        value, error = None, None
+        try:
+            # Lane exclusivity (multi-device collective launches — see
+            # dag.ArtifactSpec.exclusive) is enforced two ways: the
+            # scheduling skip in _take/_finish keeps two laned NODES
+            # from overlapping, and the re-entrant lane lock below
+            # additionally fences the cache's refit path — a consumer
+            # stage retrying a FAILED laned artifact (cache.get inside
+            # an unlaned stage body) must not launch that collective
+            # while a laned node is executing.
+            guard = (
+                self.cache.lane_lock(node.exclusive)
+                if node.exclusive is not None
+                else contextlib.nullcontext()
+            )
+            with guard:
+                value = node.exec()
+        except BaseException as e:  # noqa: BLE001 — routed to the
+            # declared-order abort/degrade logic in _finish; never
+            # swallowed (graftlint JGL007: errors become the run's
+            # exception or the consumer stage's failure row).
+            error = e
+            if node.kind == "artifact" and not isinstance(
+                e, (KeyboardInterrupt, SystemExit)
+            ):
+                obs.emit("artifact_fit_failed", status="error",
+                         artifact=node.name,
+                         error=f"{type(e).__name__}: {e}")
+        obs.histogram(
+            "scheduler_node_seconds", "per-node execution seconds"
+        ).observe(time.perf_counter() - t0, kind=node.kind)
+        self._finish(node, value, error)
+        self._flush_commits()
+
+    def _worker(self) -> None:
+        while True:
+            node = self._take()
+            if node is None:
+                return
+            self._exec(node)
+
+    def _run_inline(self) -> None:
+        """The workers=1 path: the identical worker loop run on the
+        calling thread — same graph, same commit ordering, zero threads
+        (the ``--sequential`` debugging contract)."""
+        self._worker()
+
+    # ── ordered commit ────────────────────────────────────────────────
+
+    def _flush_commits(self) -> None:
+        """Run pending commits in declaration order. Single committer at
+        a time; commits never run while the engine lock is held (they do
+        journal I/O and user logging)."""
+        while True:
+            with self._mu:
+                if self._commit_busy:
+                    return
+                idx = self._next_commit
+                if idx not in self._outcomes:
+                    return
+                if self._abort and idx >= min(a for a, _ in self._abort):
+                    return
+                spec, value = self._outcomes.pop(idx)
+                self._commit_busy = True
+            try:
+                if self._commit_fn is not None:
+                    self._commit_fn(spec, value)
+            except BaseException as e:  # noqa: BLE001 — a commit
+                # failure (disk full mid-journal-append) aborts the run
+                # at this stage, like a sequential write failure would.
+                with self._mu:
+                    self._abort.append((idx, e))
+                    self._commit_busy = False
+                    self._next_commit = idx + 1
+                    self._mu.notify_all()
+                return
+            with self._mu:
+                self._commit_busy = False
+                self._next_commit = idx + 1
+                self._mu.notify_all()

@@ -1,0 +1,116 @@
+"""Compile prefetch lane (ISSUE 4, tentpole part 3).
+
+NEXT.md's open item 3 is a ~25-30 s trace + first-dispatch cold-start
+tail at the flagship shape: the host sits in tracing/lowering while the
+device idles, stage after stage. The prefetch lane attacks the part
+that is cacheable: a single background thread walks the *upcoming*
+nodes in schedule order and runs their ``warm`` hooks — AOT
+``.lower(...).compile()`` of the stage's jitted entry points on the
+run's real shapes — so the persistent compile cache
+(``utils/compile_cache.py``) is primed before the stage's turn arrives.
+When the foreground stage then calls the same function, XLA's
+compilation step is a cache read; only trace+lowering remains.
+
+Policy: prefetch only pays off when compiled executables are reusable
+across call sites — i.e. when the persistent compile cache is enabled
+(the production ``pipeline.main()`` path) — so :func:`default_enabled`
+keys off that, with ``ATE_TPU_SWEEP_PREFETCH=1/0`` as the explicit
+override. On a cache-less CPU test run, warming would compile every
+executable twice for nothing.
+
+The lane must never affect results: warm hooks compile, they do not
+execute estimator numerics, and every failure is swallowed into the
+``scheduler_prefetch_total{status=error}`` counter plus a
+``prefetch_error`` event (never silently —
+graftlint JGL007).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Sequence
+
+from ate_replication_causalml_tpu import observability as obs
+
+_ENV = "ATE_TPU_SWEEP_PREFETCH"
+
+
+def default_enabled() -> bool:
+    """Prefetch default: on when the persistent compile cache is
+    configured (compiles are reusable), off otherwise; the env knob
+    overrides either way."""
+    env = os.environ.get(_ENV, "").strip()
+    if env in ("0", "1"):
+        return env == "1"
+    try:
+        import jax
+
+        return bool(jax.config.jax_compilation_cache_dir)
+    except Exception:  # noqa: BLE001 — no jax / old config: no prefetch
+        return False
+
+
+class CompilePrefetcher:
+    """Background thread running ``warm`` hooks in schedule order.
+
+    ``items`` are ``(name, warm)`` pairs in the order the engine expects
+    to need them; ``started`` is a callback telling the lane whether the
+    foreground already claimed a node (warming it then is wasted work —
+    the stage is already tracing it on the hot path, and XLA dedupes
+    concurrent identical compiles at the cache layer anyway).
+    """
+
+    def __init__(
+        self,
+        items: Sequence[tuple[str, Callable[[], object] | None]],
+        started: Callable[[str], bool] = lambda name: False,
+    ):
+        self._items = [(n, w) for n, w in items if w is not None]
+        self._started = started
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._counter = obs.counter(
+            "scheduler_prefetch_total",
+            "compile-prefetch lane outcomes by stage and status",
+        )
+        self._hist = obs.histogram(
+            "scheduler_prefetch_seconds", "per-node prefetch compile seconds"
+        )
+
+    def start(self) -> None:
+        if not self._items:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="compile-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout: float | None = None) -> None:
+        """Signal the lane to stop after the current hook and join.
+        Called when the sweep finishes — a leftover warm compile must
+        not outlive the run's telemetry export."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def _run(self) -> None:
+        for name, warm in self._items:
+            if self._stop.is_set():
+                return
+            if self._started(name):
+                self._counter.inc(1, node=name, status="skipped")
+                continue
+            t0 = time.perf_counter()
+            try:
+                warm()
+            except Exception as e:  # noqa: BLE001 — a prefetch failure
+                # must never fail the sweep; it is recorded, not raised
+                # (the foreground stage will compile for itself).
+                self._counter.inc(1, node=name, status="error")
+                obs.emit("prefetch_error", status="error", node=name,
+                         error=f"{type(e).__name__}: {e}")
+                continue
+            self._hist.observe(time.perf_counter() - t0, node=name)
+            self._counter.inc(1, node=name, status="compiled")

@@ -494,6 +494,177 @@ def _timed(fn):
     return time.perf_counter() - t0, v
 
 
+# --sweep-quick / default-mode sweep scale (ISSUE 4): small enough that
+# five in-process legs (untimed warmup + two interleaved timed cold
+# legs per mode, min-of-two) stay minutes, big enough that stages have
+# real compile+compute to overlap. Env-tunable for smoke runs.
+SWEEP_BENCH_ROWS = int(os.environ.get("ATE_BENCH_SWEEP_ROWS", 1_200))
+
+
+def _sweep_quick_via_child(n_obs):
+    """Run ``--sweep-quick`` in a child with 8 virtual CPU devices.
+
+    The sweep's production configuration is the tree+fold mesh, and on
+    a 2-core CPU host with ONE device the concurrent sweep only adds
+    intra-op thread contention (measured 0.85×) — XLA:CPU already
+    saturates the cores per stage. Virtual-device provisioning must
+    happen before backend init, which in default bench mode is long
+    gone, so the record is produced by a child process (the same
+    pattern as _cpu_child_reexec) whose TIMED legs still share one
+    process — the pairing the metric is about. ATE_NO_COMPILE_CACHE
+    keeps the child off any shared host-tag cache (the foreign-
+    toolchain hazard documented there); the child then builds its own
+    fresh local cache (_ensure_sweep_compile_cache) for the
+    cold-trace/warm-cache protocol."""
+    import subprocess
+
+    from ate_replication_causalml_tpu.utils.hostdevices import (
+        xla_flags_with_device_count,
+    )
+
+    env = dict(os.environ, ATE_BENCH_SWEEP_CHILD="1",
+               ATE_NO_COMPILE_CACHE="1", JAX_PLATFORMS="cpu")
+    env.pop("ATE_TPU_METRICS_DIR", None)  # parent owns the export
+    env["XLA_FLAGS"], _ = xla_flags_with_device_count(
+        env.get("XLA_FLAGS", ""), 8
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--sweep-quick",
+         "--rows", str(n_obs)],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)), timeout=1800,
+    )
+    sys.stderr.write(proc.stderr)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"--sweep-quick child failed (rc={proc.returncode}): "
+            f"{proc.stderr[-500:]}"
+        )
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    # Re-emit through the registry so the PARENT's metrics.json carries
+    # the record too (the child's registry died with it).
+    return obs.bench_record(**rec)
+
+
+def _ensure_sweep_compile_cache():
+    """The sweep bench's cold-start protocol needs a WARM persistent
+    compile cache (that is the production scenario NEXT.md item 3
+    describes: process cold, cache primed). When the embedding process
+    has none configured, point jax at a fresh local temp dir — created
+    and filled by this machine's own warmup leg, so the foreign-
+    toolchain SIGILL hazard compile_cache.py documents cannot apply."""
+    if getattr(jax.config, "jax_compilation_cache_dir", None):
+        return
+    import atexit
+    import shutil
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix="ate_sweep_bench_cache_")
+    # The dir must outlive every leg (jax reads executables back from
+    # it all run long) but not the process — reclaim it at exit.
+    atexit.register(shutil.rmtree, cache_dir, ignore_errors=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+
+def bench_sweep_quick(n_obs=SWEEP_BENCH_ROWS):
+    """Paired same-process sweep wall-clock: sequential vs concurrent
+    (ISSUE 4 acceptance metric, ``sweep_wall_clock_quick``).
+
+    Protocol — the production COLD-START scenario (NEXT.md item 3:
+    process cold, persistent compile cache warm): one untimed warmup
+    leg pays process one-time costs and primes the persistent compile
+    cache; then ``jax.clear_caches()`` before each timed leg, so every
+    leg re-traces every stage and reads its executables back from the
+    cache. Stage B's host-side trace/lowering and cache reads overlap
+    stage A's compute in the concurrent legs — the overlap the
+    scheduler exists for. Two legs per mode, interleaved, min-of-two
+    (the repo's paired-run convention).
+
+    Read the number against the hardware (measured on the 2-core CPU
+    CI image, and worth keeping in mind wherever this runs): the quick
+    sweep there is ~55% GIL-bound host dispatch — a sequential warm
+    leg runs at 1.45/2 cores CPU utilization and a concurrent one at
+    the SAME 1.44 — so stage concurrency conserves wall-clock warm
+    (measured tie, ±1%) and LOSES cold-trace (~0.8×: first-touch
+    tracing is GIL-serial and shared executables get duplicate-traced
+    across workers). The overlap pays where execution leaves the host
+    — a real accelerator computing while another stage traces, the
+    regime the remote-compile TPU toolchain's 1-5 s/executable tax
+    lives in — which is what this record exists to track per round;
+    vs_baseline < 1 on a CPU-only round is the hardware talking, not
+    the scheduler. The sweep runs its production configuration
+    (tree+fold mesh when >1 device); on a single-device CPU host the
+    measurement delegates to a virtual-device child (see
+    _sweep_quick_via_child). All timed legs are asserted bit-identical
+    — a speedup that changed a number would be a bug report, not a
+    benchmark.
+    """
+    import dataclasses
+
+    from ate_replication_causalml_tpu.data.pipeline import PrepConfig
+    from ate_replication_causalml_tpu.pipeline import SweepConfig, run_sweep
+    from ate_replication_causalml_tpu.scheduler import default_workers
+
+    if (
+        jax.default_backend() == "cpu"
+        and jax.device_count() == 1
+        and not os.environ.get("ATE_BENCH_SWEEP_CHILD")
+    ):
+        return _sweep_quick_via_child(n_obs)
+
+    _ensure_sweep_compile_cache()
+    cfg = dataclasses.replace(
+        SweepConfig().quick(),
+        prep=PrepConfig(n_obs=n_obs),
+        synthetic_pool=max(2 * n_obs + 500, 3_000),
+        dr_trees=16, dml_trees=16, cf_trees=16, cf_nuisance_trees=16,
+        forest_depth=4, balance_iters=600,
+    )
+    quiet = lambda s: None
+    run = lambda mode: run_sweep(cfg, outdir=None, plots=False,
+                                 log=quiet, scheduler=mode)
+    run("sequential")  # warmup: one-time costs + persistent-cache fill
+    samples: dict[str, list] = {"sequential": [], "concurrent": []}
+    legs: list[tuple[str, object]] = []
+    for mode in ("sequential", "concurrent", "sequential", "concurrent"):
+        jax.clear_caches()
+        dt, rep = _timed(lambda: run(mode))
+        samples[mode].append(dt)
+        legs.append((mode, rep))
+    ref = legs[0][1]
+    for i, (mode, rep) in enumerate(legs[1:], start=2):
+        for r in ref.results:
+            c = rep.results[r.method]
+            same = lambda a, b: a == b or (a != a and b != b)  # NaN == NaN
+            assert same(r.ate, c.ate) and same(r.se, c.se), (
+                f"{mode} leg {i} diverged on {r.method}: {r} vs {c}"
+            )
+    seq_s = min(samples["sequential"])
+    con_s = min(samples["concurrent"])
+    workers = default_workers()
+    print(
+        f"# sweep_quick rows={n_obs} cold-trace sequential={seq_s:.2f}s "
+        f"concurrent={con_s:.2f}s workers={workers} "
+        f"speedup={seq_s / con_s:.2f}x",
+        file=sys.stderr,
+    )
+    return obs.bench_record(
+        metric="sweep_wall_clock_quick",
+        value=round(con_s, 3),
+        unit="s",
+        # >1 means the concurrent sweep beats the sequential one.
+        vs_baseline=round(seq_s / con_s, 2),
+        sequential_s=round(seq_s, 3),
+        concurrent_s=round(con_s, 3),
+        sequential_samples_s=[round(s, 3) for s in samples["sequential"]],
+        concurrent_samples_s=[round(s, 3) for s in samples["concurrent"]],
+        workers=workers,
+        rows=n_obs,
+        protocol="cold-trace-warm-compile-cache",
+    )
+
+
 def main():
     """Run the selected bench mode, then export the telemetry registry
     (metrics.json / events.jsonl / metrics.prom) to
@@ -515,6 +686,12 @@ def main():
 
 
 def _main():
+    if "--sweep-quick" in sys.argv:
+        rows = SWEEP_BENCH_ROWS
+        if "--rows" in sys.argv:
+            rows = int(sys.argv[sys.argv.index("--rows") + 1])
+        print(json.dumps(bench_sweep_quick(rows)))
+        return None
     if "--mesh-scaling" in sys.argv:
         return bench_mesh_scaling()
     if "--sharded" in sys.argv:
@@ -618,6 +795,12 @@ def _main():
     forest_record, predict_record = bench_forest(
         DEFAULT_FOREST_ROWS, with_predict=True
     )
+    # The concurrent-sweep record (ISSUE 4) runs last — its five quick
+    # sweep legs (one untimed warmup + two timed per mode) are the
+    # lightest stage — and prints first, keeping the flagship forest
+    # line LAST for single-line parsers.
+    sweep_record = bench_sweep_quick()
+    print(json.dumps(sweep_record))
     print(json.dumps(aipw_record))
     print(json.dumps(predict_record))
     print(json.dumps(forest_record))

@@ -30,13 +30,18 @@ import sys
 EXPECTED_SCHEMA_VERSION = 1
 
 # Counter families every instrumented run exports, zero or not: "no
-# retries happened" must be a recorded 0, not a missing key.
+# retries happened" must be a recorded 0, not a missing key. The
+# scheduler/cache families (ISSUE 4) joined the contract when the
+# concurrent sweep landed: "nothing was prefetched" and "no artifact
+# was requested" are recorded zeros too.
 REQUIRED_COUNTERS = (
     "shard_attempts_total",
     "shard_retries_total",
     "shard_failures_total",
     "compile_cache_hits_total",
     "compile_cache_misses_total",
+    "nuisance_cache_requests_total",
+    "scheduler_prefetch_total",
 )
 
 _EVENT_FIELDS = (

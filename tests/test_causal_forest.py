@@ -112,6 +112,10 @@ def test_oob_excludes_in_sample_trees(std_case):
     assert np.all((~ins).sum(axis=0) > 0)
 
 
+# @slow: ~14 s fit to check one parameter rides the fitted object;
+# the variance/CI numerics themselves are covered by the little-bags
+# tests and tests/test_tree_pallas.py (tier-1 budget).
+@pytest.mark.slow
 def test_ci_group_size_travels_with_forest():
     frame, _, _ = _heterogeneous_problem(n=500)
     fitted = _fit_small(frame, n_trees=24, ci_group_size=4)
@@ -211,6 +215,9 @@ def test_leaf_index_cache_matches_and_skips_routing(monkeypatch):
     assert calls["n"] > 0
 
 
+# @slow: statistical-stability property over repeated fits (~12 s);
+# not a regression gate for plumbing changes (tier-1 budget).
+@pytest.mark.slow
 def test_little_bags_variance_stable_at_large_cate_level():
     """V_between is accumulated as centered moments: with a CATE level
     that dwarfs the between-group spread (tau ~ 50), naive raw-moment
@@ -248,6 +255,9 @@ def test_little_bags_variance_stable_at_large_cate_level():
     assert variances["large"].mean() > 0.1 * variances["small"].mean() > 0.0
 
 
+# @slow: depth-capability check (~17 s of deep-level compiles); default
+# depths are exercised by every other forest test (tier-1 budget).
+@pytest.mark.slow
 def test_deep_trees_supported():
     """grf grows unbounded-depth trees (min_node-limited); the level-wise
     engine must handle depths past the default 8 — shapes, leaf one-hot

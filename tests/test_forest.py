@@ -4,6 +4,7 @@ estimators (AIPW-RF, DML)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from ate_replication_causalml_tpu.estimators.aipw import doubly_robust
 from ate_replication_causalml_tpu.estimators.dml import chernozhukov, double_ml
@@ -85,22 +86,38 @@ def test_quantile_bins_bit_identical_to_jnp_quantile():
     )
 
 
-def test_grow_floors_bit_identical():
-    """The uniform-width kernel floors (round 5 — fewer Mosaic
-    instantiations on TPU) must not change ANY bit of the level loop's
-    outputs: padded histogram columns are never selected (ids < live m)
-    and are sliced away; zero-padded route-table rows are never indexed.
-    Asserted on the shared streaming_level_loop directly, since the
-    production growers pick floors by backend.
+def test_exact_order_stats_rejects_out_of_range_ranks():
+    """ADVICE r5: an out-of-range rank used to fall through the binary
+    search with lo at its 0xFFFFFFFF bound — which decodes to a NaN bit
+    pattern and silently poisons the quantiles. Ranks are concrete at
+    every call site, so the bounds check is host-side and raises."""
+    from ate_replication_causalml_tpu.models.forest import exact_order_stats
 
-    The histogram backend here must be the (interpret-mode) Pallas
-    kernel — the engine the floors actually pad in production. Its
-    per-column accumulation order is fixed by the kernel's row-tile
-    loop, independent of M, so padding is bit-exact; the XLA matmul
-    backend makes NO such guarantee (its reduction blocking follows the
-    output shape — observed one-ulp histogram shifts under the suite's
-    opt-level-1 flags), which is one more reason the floors are applied
-    only on the kernel path."""
+    x = jnp.asarray(RNG.normal(size=(50, 3)), jnp.float32)
+    with pytest.raises(ValueError, match=r"out of range.*max rank 50"):
+        exact_order_stats(x, jnp.asarray([0, 50], jnp.int32))  # n == 50
+    with pytest.raises(ValueError, match="out of range"):
+        exact_order_stats(x, jnp.asarray([-1], jnp.int32))
+    # Boundary ranks stay valid…
+    ok = exact_order_stats(x, jnp.asarray([0, 49], jnp.int32))
+    np.testing.assert_array_equal(
+        np.asarray(ok),
+        np.asarray(jnp.sort(x, axis=0))[np.asarray([0, 49])].T,
+    )
+    # …and the enclosing-jit call sites keep working (linspace-derived
+    # ranks are concrete at trace time; the check runs there).
+    edges = quantile_bins(x, 8)
+    assert edges.shape == (3, 7)
+    # Traced ranks (shape-only knowledge) skip the host-side check.
+    traced = jax.jit(lambda r: exact_order_stats(x, r))(
+        jnp.asarray([0, 49], jnp.int32)
+    )
+    np.testing.assert_array_equal(np.asarray(traced), np.asarray(ok))
+
+
+def _run_grow_floors_compare(backend):
+    """Shared body of the grow-floor bit-identity contract: run(1, 1)
+    vs run(16, 32) on the given Pallas histogram/route backend."""
     from ate_replication_causalml_tpu.models.forest import streaming_level_loop
     from ate_replication_causalml_tpu.ops.hist_pallas import bin_histogram
     from ate_replication_causalml_tpu.ops.tree_pallas import (
@@ -138,11 +155,11 @@ def test_grow_floors_bit_identical():
             codes, depth, n_bins,
             hist_fn=lambda ids, m: bin_histogram(
                 codes, ids, weights, max_nodes=m, n_bins=n_bins,
-                backend="pallas_interpret",
+                backend=backend,
             ),
             tables_fn=tables_fn,
             route_fn=lambda ids, bf, bb: route_bits(
-                codes_t, ids, bf, bb, backend="pallas_interpret"
+                codes_t, ids, bf, bb, backend=backend
             ),
             hist_floor=hist_floor,
             route_floor=route_floor,
@@ -152,6 +169,41 @@ def test_grow_floors_bit_identical():
     padded = run(16, 32)
     for a, b in zip(base, padded):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_grow_floors_bit_identical():
+    """The uniform-width kernel floors (round 5 — fewer Mosaic
+    instantiations on TPU) must not change ANY bit of the level loop's
+    outputs: padded histogram columns are never selected (ids < live m)
+    and are sliced away; zero-padded route-table rows are never indexed.
+    Asserted on the shared streaming_level_loop directly, since the
+    production growers pick floors by backend.
+
+    The histogram backend here must be the (interpret-mode) Pallas
+    kernel — the engine the floors actually pad in production. Its
+    per-column accumulation order is fixed by the kernel's row-tile
+    loop, independent of M, so padding is bit-exact; the XLA matmul
+    backend makes NO such guarantee (its reduction blocking follows the
+    output shape — observed one-ulp histogram shifts under the suite's
+    opt-level-1 flags), which is one more reason the floors are applied
+    only on the kernel path."""
+    _run_grow_floors_compare("pallas_interpret")
+
+
+@pytest.mark.tpu
+@pytest.mark.skipif(
+    jax.default_backend() != "tpu",
+    reason="compiled Mosaic kernels need real TPU hardware",
+)
+def test_grow_floors_bit_identical_tpu_pallas():
+    """ADVICE r5: the interpret-mode variant above validates the
+    padding logic, but a future Mosaic kernel change could break
+    M-independence only in the COMPILED kernel (tile-size selection,
+    accumulation layout). On real hardware, run the same run(1,1) ==
+    run(16,32) comparison through the production `pallas` backend so
+    CI-on-TPU catches that class; skipped on CPU where Mosaic cannot
+    compile."""
+    _run_grow_floors_compare("pallas")
 
 
 def test_route_rows_blocked_exact():
@@ -244,7 +296,6 @@ def test_forest_apply_shapes_and_determinism():
     np.testing.assert_array_equal(np.asarray(forest.split_feat), np.asarray(forest2.split_feat))
 
 
-import pytest
 
 
 @pytest.fixture(scope="module")
@@ -308,6 +359,9 @@ def test_double_ml(prep_small, dml_r_default):
     assert res_p.se != res.se
 
 
+# @slow: the heavier crossfit='full' variant; test_double_ml keeps the
+# default path (and its R-reference comparison) in tier-1 (budget).
+@pytest.mark.slow
 def test_double_ml_full_crossfit(prep_small, dml_r_default):
     """crossfit='full' (textbook DML: out-of-fold nuisances everywhere,
     one pooled residual OLS) must also de-bias the biased sample, and

@@ -486,6 +486,58 @@ def test_jgl006_catches_mutation_in_compound_headers():
     assert _lines(src, "JGL006", relpath="pkg/observability/mod.py") == [7]
 
 
+# --------------------------------------------------------------- JGL008
+
+
+JGL008_BAD = """\
+import threading
+
+class Engine:
+    def __init__(self):
+        self._mu = threading.Condition()
+        self._ready: list = []
+        self._outcomes = {}
+
+    def finish(self, idx, out):
+        self._outcomes[idx] = out           # line 10: unlocked store
+        self._ready.append(idx)             # line 11: unlocked append
+
+    def take(self):
+        with self._mu:
+            return self._ready.pop()        # locked: fine
+"""
+
+JGL008_GOOD = """\
+import threading
+
+class Checkpoint:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.done: dict = {}
+
+    def put(self, rec):
+        with self._lock:
+            self.done[rec["method"]] = rec
+"""
+
+
+def test_jgl008_fires_in_scheduler_and_pipeline_scope_only():
+    # Annotated container assignments (`self._ready: list = []`) count
+    # as shared state; threading.Condition counts as the lock.
+    assert _lines(JGL008_BAD, "JGL008", relpath="pkg/scheduler/engine.py") == [10, 11]
+    assert _lines(JGL008_BAD, "JGL008", relpath="pkg/pipeline.py") == [10, 11]
+    # Out of scope for JGL008 — and JGL006 keeps its own scope.
+    assert _lines(JGL008_BAD, "JGL008", relpath="pkg/ops/mod.py") == []
+    # Only the top-level driver hosts _Checkpoint: a nested pipeline.py
+    # (e.g. data/pipeline.py) must not be roped in.
+    assert _lines(JGL008_BAD, "JGL008", relpath="pkg/data/pipeline.py") == []
+    assert _lines(JGL008_BAD, "JGL006", relpath="pkg/scheduler/engine.py") == []
+
+
+def test_jgl008_quiet_on_locked_checkpoint_class():
+    assert _lines(JGL008_GOOD, "JGL008", relpath="pkg/pipeline.py") == []
+
+
 # --------------------------------------------------------------- JGL007
 
 
@@ -618,7 +670,8 @@ def test_parse_error_reported_and_unsuppressible():
 def test_rule_registry_has_at_least_six_active_rules():
     jgl = [r for r in RULES if r.startswith("JGL") and r != PARSE_ERROR_ID]
     assert len(jgl) >= 6
-    assert {"JGL001", "JGL002", "JGL003", "JGL004", "JGL005", "JGL006"} <= set(jgl)
+    assert {"JGL001", "JGL002", "JGL003", "JGL004", "JGL005", "JGL006",
+            "JGL008"} <= set(jgl)
 
 
 def test_reporters_render():

@@ -2,6 +2,7 @@
 
 import jax
 import numpy as np
+import pytest
 
 from ate_replication_causalml_tpu.estimators.belloni import belloni, interaction_expand
 from ate_replication_causalml_tpu.estimators.ipw import prop_score_weight
@@ -103,6 +104,10 @@ def test_belloni_collinear_selection_both_compats(prep_small):
         assert np.isfinite(res.ate) and np.isfinite(res.se) and res.se > 0
 
 
+# @slow: ~26 s of CPU coordinate descent for a statistical-property
+# check (de-biasing beats naive); the cheap finite/compat/collinear
+# Belloni tests above keep tier-1 regression coverage (tier-1 budget).
+@pytest.mark.slow
 def test_belloni_recovers_signal(prep_small):
     _, frame_mod, _ = prep_small
     res = belloni(frame_mod, key=jax.random.key(3))

@@ -160,6 +160,47 @@ def test_fold_sharded_cv_glmnet_matches_vmap():
     np.testing.assert_allclose(np.asarray(coef_p), np.asarray(coef_s), rtol=1e-10, atol=1e-12)
 
 
+def test_use_mesh_override_is_thread_confined():
+    """ISSUE 4: the concurrent sweep runs stage bodies on worker
+    threads, so a mesh-lane stage's ``use_mesh(fold_mesh)`` must not
+    leak into ``get_mesh()`` on another thread — an unlaned stage
+    picking up the fold mesh would launch a collective outside the
+    lane."""
+    import threading
+
+    from ate_replication_causalml_tpu.parallel.mesh import (
+        FOLD_AXIS,
+        get_mesh,
+        make_mesh,
+    )
+
+    default = get_mesh()
+    fold_mesh = make_mesh((FOLD_AXIS,))
+    inside = threading.Event()
+    release = threading.Event()
+    seen = {}
+
+    def laned():
+        with use_mesh(fold_mesh):
+            seen["laned"] = get_mesh()
+            inside.set()
+            release.wait(10)
+        seen["laned_after"] = get_mesh()
+
+    t = threading.Thread(target=laned)
+    t.start()
+    try:
+        assert inside.wait(10)
+        # While the override is live on the worker thread, every other
+        # thread still sees the process default.
+        assert get_mesh() is default
+    finally:
+        release.set()
+        t.join(10)
+    assert seen["laned"] is fold_mesh
+    assert seen["laned_after"] is default
+
+
 def test_tree_sharded_causal_forest_matches_host():
     """VERDICT r2 #3: the flagship causal-forest grow shards little-bag
     groups over the mesh tree axis. Key partitioning differs from the

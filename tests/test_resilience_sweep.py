@@ -190,3 +190,60 @@ def _parses(line: str) -> bool:
         return True
     except json.JSONDecodeError:
         return False
+
+
+def test_concurrent_chaos_matches_sequential_clean_and_cross_mode_resume(tmp_path):
+    """ISSUE 4 acceptance: chaos + the concurrent scheduler + resume,
+    cross-checked against the SEQUENTIAL scheduler. A chaotic concurrent
+    sweep must (a) degrade exactly where told, (b) keep the journal in
+    declared order despite worker completion order, (c) be bit-identical
+    to a fault-free sequential run on every computed row, and (d) heal
+    fully when the sequential scheduler resumes the concurrent run's
+    checkpoint (mode is not part of the fingerprint — either mode may
+    resume the other's journal)."""
+    o_seq = str(tmp_path / "seq")
+    o_chaos = str(tmp_path / "chaos")
+    rep_seq = run_sweep(NANO, outdir=o_seq, plots=False, log=lambda s: None,
+                        scheduler="sequential")
+    assert not rep_seq.failures
+
+    logs: list[str] = []
+    with chaos.override(CHAOS_SPEC):
+        rep_chaos = run_sweep(NANO, outdir=o_chaos, plots=False,
+                              log=logs.append, scheduler="concurrent",
+                              workers=4)
+    assert "residual_balancing" in rep_chaos.failures
+    assert any("[FAILED] residual_balancing" in l for l in logs)
+
+    # (b) journal order: the torn line (first append — the oracle row)
+    # stays in place; every parsable row follows declared order.
+    journal = open(os.path.join(o_chaos, "results.jsonl")).read().splitlines()
+    parsable = [json.loads(l)["method"] for l in journal
+                if l.strip() and _parses(l)]
+    expected = ["__config__", "oracle"] + list(SWEEP_METHODS)
+    assert parsable == [m for m in expected if m in parsable]
+    nonempty = [l for l in journal if l.strip()]
+    assert len(nonempty) - len(parsable) == 1  # exactly one torn row
+    assert "oracle" not in parsable  # the torn row is the first append
+
+    # (c) every computed row bit-identical to the sequential clean run.
+    for m in SWEEP_METHODS:
+        if m == "residual_balancing":
+            continue
+        assert rep_chaos.results[m].ate == rep_seq.results[m].ate, m
+        assert rep_chaos.results[m].se == rep_seq.results[m].se or (
+            rep_chaos.results[m].se != rep_chaos.results[m].se
+            and rep_seq.results[m].se != rep_seq.results[m].se
+        ), m
+    assert rep_chaos.oracle.ate == rep_seq.oracle.ate
+
+    # (d) sequential resume of the concurrent chaotic outdir: failed +
+    # torn rows recompute; the result matches the sequential clean run.
+    chaos.reset()
+    logs2: list[str] = []
+    rep_resumed = run_sweep(NANO, outdir=o_chaos, plots=False,
+                            log=logs2.append, scheduler="sequential")
+    assert any("[retry] residual_balancing" in l for l in logs2)
+    assert not rep_resumed.failures
+    for m in SWEEP_METHODS:
+        assert rep_resumed.results[m].ate == rep_seq.results[m].ate, m

@@ -1,0 +1,412 @@
+"""Scheduler unit tests (ISSUE 4) — pure-python synthetic DAGs, no jax:
+determinism under forced adversarial completion orders (results and
+commit order must match the sequential run bit-for-bit), nuisance-cache
+fit-once/keying semantics, lane exclusivity, abort ordering, and the
+compile-prefetch lane's bookkeeping. Cheap by design (memory note:
+tier-1 additions must not cost device compute)."""
+
+import threading
+import time
+
+import pytest
+
+from ate_replication_causalml_tpu.scheduler import (
+    ArtifactSpec,
+    DagError,
+    NuisanceCache,
+    StageSpec,
+    SweepEngine,
+    validate,
+)
+from ate_replication_causalml_tpu.scheduler.prefetch import CompilePrefetcher
+
+
+# ── DAG validation ────────────────────────────────────────────────────
+
+def test_validate_rejects_bad_declarations():
+    a = ArtifactSpec("a", fit=lambda c: 1)
+    with pytest.raises(DagError, match="duplicate artifact"):
+        validate([a, a], [])
+    with pytest.raises(DagError, match="unknown artifact"):
+        validate([a], [StageSpec("s", run=lambda c: 1, needs=("nope",))])
+    with pytest.raises(DagError, match="unknown artifact"):
+        validate([ArtifactSpec("b", fit=lambda c: 1, needs=("nope",))], [])
+    with pytest.raises(DagError, match="duplicate node name"):
+        validate([a], [StageSpec("a", run=lambda c: 1)])
+    loop = [
+        ArtifactSpec("x", fit=lambda c: 1, needs=("y",)),
+        ArtifactSpec("y", fit=lambda c: 1, needs=("x",)),
+    ]
+    with pytest.raises(DagError, match="cycle"):
+        validate(loop, [])
+
+
+def test_validate_metadata():
+    arts = [
+        ArtifactSpec("base", fit=lambda c: 1),
+        ArtifactSpec("derived", fit=lambda c: 1, needs=("base",)),
+    ]
+    stages = [
+        StageSpec("s0", run=lambda c: 1),
+        StageSpec("s1", run=lambda c: 1, needs=("derived",)),
+    ]
+    dag = validate(arts, stages)
+    assert dag.depth == {"base": 0, "derived": 1}
+    # s1 (index 1) is the first consumer of BOTH (transitively).
+    assert dag.first_consumer == {"base": 1, "derived": 1}
+
+
+# ── nuisance cache ────────────────────────────────────────────────────
+
+def test_cache_fits_once_under_contention():
+    calls = []
+
+    def fit(c):
+        calls.append(threading.get_ident())
+        time.sleep(0.02)  # widen the race window
+        return object()
+
+    cache = NuisanceCache([ArtifactSpec("a", fit=fit, key=("k",))])
+    got = []
+    threads = [
+        threading.Thread(target=lambda: got.append(cache.get("a")))
+        for _ in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(calls) == 1, "artifact fit more than once"
+    assert all(v is got[0] for v in got), "consumers saw different objects"
+    stats = cache.stats()
+    assert stats["misses"] == {"a": 1}
+    assert stats["hits"]["a"] == 7
+
+
+def test_cache_distinct_keys_never_share():
+    # Two caches model two runs whose configs differ: the same artifact
+    # NAME with a different key must refit, never alias.
+    vals = iter([11, 22])
+    mk = lambda key: NuisanceCache(
+        [ArtifactSpec("a", fit=lambda c: next(vals), key=key)]
+    )
+    c1, c2 = mk(("fp1", 250)), mk(("fp1", 251))
+    assert c1.get("a") == 11 and c2.get("a") == 22
+    # Same key, same cache: shared.
+    assert c1.get("a") == 11
+
+
+def test_cache_artifact_consumes_artifact_and_failures_not_memoized():
+    tries = {"n": 0}
+
+    def flaky(c):
+        tries["n"] += 1
+        if tries["n"] == 1:
+            raise RuntimeError("first fit dies")
+        return 5
+
+    cache = NuisanceCache([
+        ArtifactSpec("base", fit=flaky, key=()),
+        ArtifactSpec("derived", fit=lambda c: c.get("base") + 1,
+                     needs=("base",), key=()),
+    ])
+    with pytest.raises(RuntimeError):
+        cache.get("derived")
+    # The failure was not cached: the next consumer retries and wins
+    # (the sequential driver's lazy-refit semantics).
+    assert cache.get("derived") == 6
+    assert tries["n"] == 2
+
+
+# ── engine determinism under adversarial interleavings ────────────────
+
+def _build(track, gates=None):
+    """A sweep-shaped DAG: one shared artifact, five stages (two
+    consumers), values chosen so any cross-talk or double-fit shows up
+    in the results."""
+    fits = []
+
+    def fit(c):
+        fits.append("art")
+        return 100
+
+    arts = [ArtifactSpec("art", fit=fit, key=("k",))]
+
+    def mk(i, needs):
+        def run(c):
+            if gates is not None:
+                gates[f"s{i}"].wait(timeout=30)
+            base = c.get("art") if needs else 0
+            track["finished"].append(f"s{i}")
+            return base + i
+
+        return StageSpec(f"s{i}", run=run, needs=needs)
+
+    stages = [mk(i, ("art",) if i in (1, 3) else ()) for i in range(5)]
+    return arts, stages, fits
+
+
+@pytest.mark.parametrize("perm", [
+    [4, 3, 2, 1, 0], [2, 0, 4, 1, 3], [1, 4, 0, 3, 2],
+])
+def test_forced_completion_orders_commit_in_declared_order(perm):
+    track = {"finished": []}
+    gates = {f"s{i}": threading.Event() for i in range(5)}
+    arts, stages, fits = _build(track, gates)
+    committed = []
+    engine = SweepEngine(
+        arts, stages,
+        commit=lambda spec, value: committed.append((spec.name, value)),
+        workers=5, prefetch=False,
+    )
+
+    def release():
+        # Adversarial completion order: stages may only finish in the
+        # permutation's order, whatever the pool wanted to do.
+        for i in perm:
+            gates[f"s{i}"].set()
+            time.sleep(0.01)
+
+    rel = threading.Thread(target=release)
+    rel.start()
+    results = engine.run()
+    rel.join()
+    # Commits in DECLARED order, results exactly the sequential values,
+    # the shared artifact fit exactly once.
+    assert committed == [(f"s{i}", (100 if i in (1, 3) else 0) + i)
+                        for i in range(5)]
+    assert results == {f"s{i}": (100 if i in (1, 3) else 0) + i
+                       for i in range(5)}
+    assert fits == ["art"]
+
+
+def test_sequential_inline_matches_concurrent():
+    seq_track, con_track = {"finished": []}, {"finished": []}
+    committed_seq, committed_con = [], []
+    arts, stages, _ = _build(seq_track)
+    SweepEngine(
+        arts, stages,
+        commit=lambda s, v: committed_seq.append((s.name, v)),
+        workers=1, prefetch=False,
+    ).run()
+    arts, stages, _ = _build(con_track)
+    SweepEngine(
+        arts, stages,
+        commit=lambda s, v: committed_con.append((s.name, v)),
+        workers=4, prefetch=False,
+    ).run()
+    assert committed_seq == committed_con
+    # workers=1 executes bodies in declared order too (the inline
+    # escape hatch), with the artifact fit lazily before its first
+    # consumer — the old driver's order.
+    assert seq_track["finished"] == [f"s{i}" for i in range(5)]
+
+
+def test_abort_surfaces_earliest_declared_failure_and_truncates_commits():
+    committed = []
+
+    def mk(i):
+        def run(c):
+            if i in (2, 4):
+                raise ValueError(f"boom {i}")
+            return i
+
+        return StageSpec(f"s{i}", run=run)
+
+    engine = SweepEngine(
+        [], [mk(i) for i in range(5)],
+        commit=lambda s, v: committed.append(s.name),
+        workers=3, prefetch=False,
+    )
+    with pytest.raises(ValueError, match="boom 2"):
+        engine.run()
+    # Commits flushed exactly up to the failing stage — the journal
+    # shape a sequential abort leaves.
+    assert committed == ["s0", "s1"]
+
+
+def test_abort_drains_earlier_declared_stages_before_raising():
+    # s1 aborts while s0 is still blocked behind its artifact's fit —
+    # sequentially s0 would have finished before s1 ever ran, so the
+    # engine must keep scheduling nodes declared before the abort and
+    # leave the same committed prefix ["s0"].
+    committed = []
+    gate = threading.Event()
+
+    def slow_fit(c):
+        assert gate.wait(timeout=30)
+        return 7
+
+    arts = [ArtifactSpec("slow", fit=slow_fit, key=())]
+    stages = [
+        StageSpec("s0", run=lambda c: c.get("slow"), needs=("slow",)),
+        StageSpec("s1", run=lambda c: (_ for _ in ()).throw(
+            ValueError("boom 1"))),
+    ]
+    engine = SweepEngine(
+        arts, stages,
+        commit=lambda s, v: committed.append(s.name),
+        workers=2, prefetch=False,
+    )
+
+    def release_after_abort():
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            with engine._mu:
+                if engine._abort:
+                    break
+            time.sleep(0.005)
+        gate.set()
+
+    rel = threading.Thread(target=release_after_abort)
+    rel.start()
+    with pytest.raises(ValueError, match="boom 1"):
+        engine.run()
+    rel.join()
+    assert committed == ["s0"]
+
+
+def test_operator_abort_stops_scheduling_and_reraises():
+    # A real ^C interrupts the MAIN thread's join, not a worker;
+    # run() flags it via _operator_abort so workers stop taking nodes,
+    # nothing commits past the flag, and the interrupt re-raises.
+    ran = []
+    committed = []
+    stages = [
+        StageSpec(f"s{i}", run=lambda c, i=i: ran.append(i))
+        for i in range(3)
+    ]
+    eng = SweepEngine(
+        [], stages, workers=4, prefetch=False,
+        commit=lambda spec, value: committed.append(spec.name),
+    )
+    eng._operator_abort(KeyboardInterrupt("operator ^C"))
+    with pytest.raises(KeyboardInterrupt):
+        eng.run()
+    assert ran == [] and committed == []
+
+
+def test_failed_lane_artifact_refit_cannot_overlap_lane_nodes():
+    # A failed mesh-lane artifact is refit by its consumer stage — an
+    # UNLANED body on a worker thread. That refit launches the same
+    # collective the lane serializes, so it must hold the lane lock:
+    # here s1 (laned) becomes ready only once the refit is mid-flight,
+    # and the two bodies must still never overlap.
+    active = {"n": 0, "max": 0}
+    mu = threading.Lock()
+    refit_started = threading.Event()
+    tries = {"n": 0}
+
+    def enter():
+        with mu:
+            active["n"] += 1
+            active["max"] = max(active["max"], active["n"])
+
+    def leave():
+        with mu:
+            active["n"] -= 1
+
+    def flaky_laned_fit(c):
+        tries["n"] += 1
+        if tries["n"] == 1:
+            raise RuntimeError("first fit dies")
+        refit_started.set()
+        enter()
+        time.sleep(0.2)
+        leave()
+        return 42
+
+    def s1_body(c):
+        enter()
+        time.sleep(0.05)
+        leave()
+        return 1
+
+    arts = [
+        ArtifactSpec("a", fit=flaky_laned_fit, key=(), exclusive="mesh"),
+        ArtifactSpec("b", fit=lambda c: refit_started.wait(timeout=30),
+                     key=()),
+    ]
+    stages = [
+        StageSpec("s0", run=lambda c: c.get("a"), needs=("a",)),
+        StageSpec("s1", run=s1_body, needs=("b",), exclusive="mesh"),
+    ]
+    res = SweepEngine(arts, stages, workers=2, prefetch=False).run()
+    assert res == {"s0": 42, "s1": 1}
+    assert tries["n"] == 2
+    assert active["max"] == 1, "refit of a laned artifact overlapped a lane node"
+
+
+def test_workers_below_one_clamps_to_inline():
+    # workers=-1 must not spawn a zero-thread pool that returns {}.
+    res = SweepEngine(
+        [], [StageSpec("s0", run=lambda c: 5)], workers=-1, prefetch=False
+    ).run()
+    assert res == {"s0": 5}
+
+
+def test_resumed_stages_schedule_no_artifact_fits():
+    fits = []
+    arts = [ArtifactSpec("a", fit=lambda c: fits.append(1) or 1, key=())]
+    # The pipeline drops `needs` for resumed stages; nobody consumes the
+    # artifact, so the engine must not schedule its fit at all.
+    stages = [StageSpec("s0", run=lambda c: 0, needs=())]
+    res = SweepEngine(arts, stages, workers=2, prefetch=False).run()
+    assert res == {"s0": 0}
+    assert fits == []
+
+
+def test_exclusive_lane_serializes():
+    active = {"n": 0, "max": 0}
+    lock = threading.Lock()
+
+    def mk(i, lane):
+        def run(c):
+            with lock:
+                active["n"] += 1
+                active["max"] = max(active["max"], active["n"])
+            time.sleep(0.03)
+            with lock:
+                active["n"] -= 1
+            return i
+
+        return StageSpec(f"s{i}", run=run, exclusive=lane)
+
+    SweepEngine(
+        [], [mk(i, "mesh") for i in range(4)], workers=4, prefetch=False
+    ).run()
+    assert active["max"] == 1, "lane nodes overlapped"
+
+    active["max"] = 0
+    SweepEngine(
+        [], [mk(i, None) for i in range(4)], workers=4, prefetch=False
+    ).run()
+    # Unlaned stages are allowed to overlap (4 workers, 30ms bodies —
+    # at least two should coexist even on a loaded box).
+    assert active["max"] >= 2, "no concurrency at all without a lane"
+
+
+# ── prefetch lane ─────────────────────────────────────────────────────
+
+def test_prefetcher_warms_skips_and_swallows_errors():
+    warmed = []
+    drained = threading.Event()  # the last hook signals completion, so
+    # stop() can't race the worker thread out of processing any items
+
+    def boom():
+        raise RuntimeError("compile exploded")
+
+    pf = CompilePrefetcher(
+        [
+            ("cold", lambda: warmed.append("cold")),
+            ("started", lambda: warmed.append("started")),
+            ("nohook", None),
+            ("bad", boom),
+            ("last", drained.set),
+        ],
+        started=lambda name: name == "started",
+    )
+    pf.start()
+    assert drained.wait(10), "prefetch thread never drained its items"
+    pf.stop(timeout=10)
+    assert warmed == ["cold"]  # started skipped, bad swallowed, nohook dropped
