@@ -1,4 +1,4 @@
-"""The graftlint rule set (JGL001–JGL007).
+"""The graftlint rule set (JGL001–JGL009).
 
 Each rule targets a failure class that has actually bitten (or nearly
 bitten) this codebase on TPU — see ADVICE.md and the rule docstrings.
@@ -977,4 +977,77 @@ class SilentExceptionSwallow(Rule):
                         "this retries programming errors; use the "
                         "classified default (retriable=None) or list the "
                         "transient types",
+                    )
+
+
+# ---------------------------------------------------------------- JGL009
+
+#: The one module family allowed to read the wall clock: the telemetry
+#: layer records BOTH clocks deliberately (span records carry
+#: ``start_unix`` next to ``start_mono_s``; the trace header anchors
+#: the monotonic origin to wall time).
+_WALLCLOCK_EXEMPT_DIR = "observability/"
+
+_WALLCLOCK_CALL = "time.time"
+
+
+@register
+class WallClockDuration(Rule):
+    """ISSUE 5's timeline contract: every duration in the trace /
+    overlap analysis comes from the monotonic clock, because
+    ``time.time()`` can step (NTP slew, manual clock set) and a stepped
+    difference silently corrupts span durations, backoff budgets and
+    bench numbers. Outside ``observability/`` (which records both
+    clocks on purpose, keeping the wall-clock anchor in ONE place),
+    any ``time.time()`` difference must be ``time.monotonic()`` /
+    ``time.perf_counter()`` instead."""
+
+    id = "JGL009"
+    name = "wallclock-duration"
+    description = (
+        "time.time() used in duration arithmetic outside observability/ "
+        "— use time.monotonic()/time.perf_counter()"
+    )
+
+    def _is_walltime_call(self, module: ModuleInfo, node: ast.AST) -> bool:
+        return (
+            isinstance(node, ast.Call)
+            and module.resolve(node.func) == _WALLCLOCK_CALL
+        )
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if _WALLCLOCK_EXEMPT_DIR in module.relpath:
+            return
+        # Names bound from time.time() anywhere in the module
+        # (name-based, not scope-exact — the linter's stated precision).
+        tainted: set[str] = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)) and getattr(
+                node, "value", None
+            ) is not None and self._is_walltime_call(module, node.value):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        tainted.add(t.id)
+
+        def is_wall(operand: ast.expr) -> bool:
+            if self._is_walltime_call(module, operand):
+                return True
+            return isinstance(operand, ast.Name) and operand.id in tainted
+
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+                if is_wall(node.left) or is_wall(node.right):
+                    yield self.finding(
+                        module,
+                        node,
+                        "duration computed from time.time() — the wall "
+                        "clock can step (NTP), silently corrupting the "
+                        "difference; use time.monotonic()/"
+                        "time.perf_counter() (observability/ owns the "
+                        "wall-clock anchor)",
                     )

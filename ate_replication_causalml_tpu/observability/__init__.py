@@ -52,15 +52,24 @@ from ate_replication_causalml_tpu.observability.registry import (
     sanitize_label,
     set_enabled,
 )
+from ate_replication_causalml_tpu.observability.trace import (
+    MetricSampler,
+    build_trace,
+    trace_enabled,
+    write_trace_json,
+)
 
 __all__ = [
-    "EVENTS", "EventLog", "MetricsRegistry", "REGISTRY", "SCHEMA_VERSION",
+    "EVENTS", "EventLog", "MetricSampler", "MetricsRegistry", "REGISTRY",
+    "SCHEMA_VERSION",
     "atomic_file", "atomic_write_json", "atomic_write_text",
-    "bench_record", "counter",
+    "bench_record", "build_trace", "counter",
     "emit", "enabled", "gauge", "histogram", "install_jax_monitoring",
     "instrument_dispatch", "record_compiled_cost", "record_device_memory",
-    "sanitize_label", "set_enabled", "span", "watch_cache_dir",
+    "sanitize_label", "set_enabled", "span", "trace_enabled",
+    "watch_cache_dir",
     "write_events_jsonl", "write_metrics_json", "write_run_artifacts",
+    "write_trace_json",
 ]
 
 

@@ -39,7 +39,7 @@ class Span:
 
     __slots__ = (
         "name", "span_id", "parent_id", "status", "attrs",
-        "start_unix", "start_mono", "thread",
+        "start_unix", "start_mono", "thread", "thread_name",
     )
 
     def __init__(self, name: str, span_id: str, parent_id: str | None,
@@ -52,6 +52,9 @@ class Span:
         self.start_unix = time.time()
         self.start_mono = time.monotonic()
         self.thread = threading.get_ident()
+        # The trace exporter (observability/trace.py) names timeline
+        # tracks after threads; the ident alone is an opaque integer.
+        self.thread_name = threading.current_thread().name
 
     def set_status(self, status: str) -> None:
         self.status = status
@@ -71,6 +74,7 @@ class Span:
             "end_mono_s": end_mono,
             "dur_s": end_mono - self.start_mono,
             "thread": self.thread,
+            "thread_name": self.thread_name,
             "attrs": self.attrs,
         }
 

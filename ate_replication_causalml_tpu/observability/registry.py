@@ -200,6 +200,20 @@ class MetricsRegistry:
         with self._lock:
             return iter(list(self._metrics.values()))
 
+    def peek(self, name: str) -> dict[str, float] | None:
+        """Current samples of one metric as ``{label_key: value}`` (the
+        ``sum`` for histograms), or None when the family was never
+        created. Collector hooks do NOT run — this is the cheap read the
+        trace counter-sampler takes on a timer; a full :meth:`snapshot`
+        scans the compile-cache directory every call."""
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                return None
+            if m.kind == "histogram":
+                return {k: float(v["sum"]) for k, v in m.samples.items()}
+            return dict(m.samples)
+
     def snapshot(self) -> dict:
         """Versioned plain-dict snapshot (the metrics.json payload)."""
         for fn in list(self._collectors):

@@ -1,0 +1,380 @@
+"""Chrome/Perfetto trace export of the span EventLog (ISSUE 5 tentpole).
+
+The event log already records every span with both clocks, the owning
+thread, and parentage; this module renders those records in the
+catapult trace-event format (the JSON ``chrome://tracing`` / Perfetto's
+legacy importer read), so a sweep's schedule becomes a picture instead
+of a JSONL scroll:
+
+* one timeline track per thread that emitted spans (the scheduler's
+  ``sweep-worker-N`` threads, the main thread) plus dedicated tracks
+  for each exclusive lane (``lane:mesh``), the compile-prefetch lane
+  and the ordered committer — records carry the routing in their attrs
+  (``track=`` for a hard override, ``lane=`` for the additional lane-
+  occupancy slice);
+* flow arrows from each nuisance-artifact fit to the stages that
+  declared it in ``needs`` (the attribution the scheduler stamps on its
+  ``scheduler_node`` spans), so Perfetto draws the DAG on the timeline;
+* counter tracks from ``metric_sample`` point events (see
+  :class:`MetricSampler`) — nuisance-cache traffic, backoff seconds,
+  device memory — sampled out of the metrics registry while the run is
+  in flight;
+* point events (chaos injections, retries, prefetch errors) as instant
+  markers on the track of their *enclosing span* — a chaos fault shows
+  up on the worker/lane that was running the faulted stage.
+
+All timestamps are the records' monotonic clock, shifted so the trace
+starts at zero; the wall-clock anchor for the origin rides in the
+header (``otherData.wall_anchor_unix``), so absolute times are
+recoverable without ever mixing the two clocks inside the timeline.
+
+The exporter is pure stdlib (no jax) and a pure function of the record
+list — ``scripts/analyze_trace.py`` re-reads its output and
+``observability/critical_path.py`` computes the run's critical path and
+overlap report from it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from ate_replication_causalml_tpu.observability import events as _events
+from ate_replication_causalml_tpu.observability import registry as _registry
+
+TRACE_BASENAME = "trace.json"
+OVERLAP_BASENAME = "overlap_report.json"
+
+#: trace.json layout version (otherData.trace_schema_version).
+TRACE_SCHEMA_VERSION = 1
+
+_TRACE_ENV = "ATE_TPU_TRACE"
+
+#: record name -> trace category. Categories are the analyzer's parse
+#: contract: ``node`` slices are the scheduler's execution intervals,
+#: ``lane`` slices are their duplicated lane-occupancy view (never
+#: counted as busy time twice), ``commit`` and ``prefetch`` feed the
+#: serialization-blame section.
+_CATEGORIES = {
+    "scheduler_node": "node",
+    "commit": "commit",
+    "prefetch_compile": "prefetch",
+}
+
+_PID = 1
+
+#: track-category sort order in the Perfetto UI: workers first, then
+#: lanes, the prefetch lane, the committer, counters last.
+_SORT = {"worker": 0, "lane": 100, "prefetch": 200, "committer": 300,
+         "counter": 400}
+
+
+def trace_enabled() -> bool:
+    """Host-trace master switch: on whenever telemetry is on, unless
+    ``ATE_TPU_TRACE=0``. Tracing is a read of the already-collected
+    event log — it never touches estimator numerics."""
+    if not _registry.enabled():
+        return False
+    return os.environ.get(_TRACE_ENV, "1") != "0"
+
+
+def _track_of(rec: dict) -> tuple[str, str]:
+    """(category, name) of the PRIMARY track a record renders on."""
+    attrs = rec.get("attrs") or {}
+    track = attrs.get("track")
+    if track == "committer":
+        return ("committer", "committer")
+    if track == "prefetch":
+        return ("prefetch", "prefetch")
+    if track:
+        return ("worker", str(track))
+    name = rec.get("thread_name") or f"thread-{rec.get('thread', '?')}"
+    return ("worker", str(name))
+
+
+def _is_instant(rec: dict) -> bool:
+    # emit() records start == end; treat sub-microsecond spans the same
+    # (they would render as zero-width slices anyway).
+    return (rec["end_mono_s"] - rec["start_mono_s"]) * 1e6 < 1.0
+
+
+def build_trace(records: list[dict] | None = None,
+                meta: dict | None = None) -> dict:
+    """Render ``records`` (default: the global event log) as a catapult
+    trace object. ``meta`` merges into ``otherData`` — the sweep driver
+    passes run identity, worker count and wall seconds so the analyzer
+    and the Perfetto header agree on the run's envelope."""
+    if records is None:
+        records = _events.EVENTS.records()
+    records = [r for r in records if "start_mono_s" in r]
+    events: list[dict] = []
+    if records:
+        origin_rec = min(records, key=lambda r: r["start_mono_s"])
+        origin = origin_rec["start_mono_s"]
+        wall_anchor = origin_rec["start_unix"]
+    else:
+        origin, wall_anchor = 0.0, None
+    ts = lambda mono_s: (mono_s - origin) * 1e6  # µs from trace origin
+
+    # ── track registry: deterministic tids from (category, name) ─────
+    tracks: dict[tuple[str, str], int] = {}
+
+    def tid(cat: str, name: str) -> int:
+        key = (cat, name)
+        if key not in tracks:
+            tracks[key] = len(tracks) + 1
+        return tracks[key]
+
+    # Primary track per span id — instants resolve to their *enclosing
+    # span's* track so a chaos injection lands on the worker/lane that
+    # was running the faulted stage, not on a synthetic thread row.
+    by_id = {r["span_id"]: r for r in records}
+    track_cache: dict[str, tuple[str, str]] = {}
+
+    def resolve_track(rec: dict, hops: int = 0) -> tuple[str, str]:
+        sid = rec["span_id"]
+        if sid in track_cache:
+            return track_cache[sid]
+        out = _track_of(rec)
+        if _is_instant(rec) and "track" not in (rec.get("attrs") or {}):
+            parent = by_id.get(rec.get("parent_id") or "")
+            if parent is not None and hops < 16:
+                out = resolve_track(parent, hops + 1)
+        track_cache[sid] = out
+        return out
+
+    flow_id = 0
+    artifact_slices: dict[str, dict] = {}
+    stage_slices: list[dict] = []
+    counter_series: set[str] = set()
+
+    for rec in sorted(records, key=lambda r: (r["start_mono_s"], r["span_id"])):
+        attrs = rec.get("attrs") or {}
+        if rec["name"] == "metric_sample":
+            # Counter track: one series per metric name.
+            metric = str(attrs.get("metric", "metric"))
+            counter_series.add(metric)
+            events.append({
+                "name": metric, "cat": "counter", "ph": "C", "pid": _PID,
+                "tid": tid("counter", "counters"),
+                "ts": ts(rec["start_mono_s"]),
+                "args": {"value": attrs.get("value", 0.0)},
+            })
+            continue
+        cat = _CATEGORIES.get(rec["name"], "span")
+        tcat, tname = resolve_track(rec)
+        args = {"status": rec.get("status"), "span_id": rec["span_id"]}
+        args.update({
+            k: v for k, v in attrs.items()
+            if isinstance(v, (str, int, float, bool)) and k != "track"
+        })
+        label = str(
+            attrs.get("node") or attrs.get("method") or attrs.get("stage")
+            or attrs.get("artifact") or rec["name"]
+        )
+        if _is_instant(rec):
+            events.append({
+                "name": label, "cat": cat, "ph": "i", "s": "t", "pid": _PID,
+                "tid": tid(tcat, tname), "ts": ts(rec["start_mono_s"]),
+                "args": args,
+            })
+            continue
+        slice_ev = {
+            "name": label, "cat": cat, "ph": "X", "pid": _PID,
+            "tid": tid(tcat, tname), "ts": ts(rec["start_mono_s"]),
+            "dur": (rec["end_mono_s"] - rec["start_mono_s"]) * 1e6,
+            "args": args,
+        }
+        events.append(slice_ev)
+        lane = attrs.get("lane")
+        if lane:
+            # Duplicate slice on the lane-occupancy track: the worker
+            # tracks show who ran what; the lane track shows WHY two
+            # collective launches never overlapped.
+            events.append(dict(slice_ev, cat="lane",
+                               tid=tid("lane", f"lane:{lane}")))
+        if cat == "node":
+            if attrs.get("kind") == "artifact":
+                artifact_slices[str(attrs.get("node"))] = slice_ev
+            elif attrs.get("needs"):
+                stage_slices.append(slice_ev)
+
+    # ── flow arrows: artifact fit -> each consuming stage ─────────────
+    for stage_ev in stage_slices:
+        needs = [n for n in str(stage_ev["args"].get("needs", "")).split(",") if n]
+        for need in needs:
+            src = artifact_slices.get(need)
+            if src is None:
+                continue  # resumed/never-scheduled artifact: no slice
+            flow_id += 1
+            common = {"cat": "dep", "name": need, "id": flow_id, "pid": _PID}
+            events.append(dict(common, ph="s", tid=src["tid"],
+                               ts=src["ts"] + src["dur"]))
+            events.append(dict(common, ph="f", bp="e", tid=stage_ev["tid"],
+                               ts=stage_ev["ts"]))
+
+    # ── metadata: names + deterministic sort order ────────────────────
+    meta_events = [{
+        "name": "process_name", "ph": "M", "pid": _PID,
+        "args": {"name": "ate-sweep"},
+    }]
+    for (tcat, tname), t in sorted(tracks.items(), key=lambda kv: kv[1]):
+        meta_events.append({
+            "name": "thread_name", "ph": "M", "pid": _PID, "tid": t,
+            "args": {"name": tname},
+        })
+        meta_events.append({
+            "name": "thread_sort_index", "ph": "M", "pid": _PID, "tid": t,
+            "args": {"sort_index": _SORT.get(tcat, 0) + t},
+        })
+    other = {
+        "trace_schema_version": TRACE_SCHEMA_VERSION,
+        "clock": "monotonic",
+        "time_unit": "us",
+        "mono_origin_s": origin,
+        "wall_anchor_unix": wall_anchor,
+        "counter_series": sorted(counter_series),
+    }
+    if meta:
+        other.update(meta)
+    return {
+        "traceEvents": meta_events + events,
+        "displayTimeUnit": "ms",
+        "otherData": other,
+    }
+
+
+def write_trace_json(path: str, records: list[dict] | None = None,
+                     meta: dict | None = None,
+                     trace: dict | None = None) -> str | None:
+    """Atomically write the catapult trace to ``path``; returns the path
+    or None when tracing is disabled (no husk files). Callers that also
+    analyze the trace pass the prebuilt ``trace`` object — the write
+    recipe (compact separators + trailing newline, atomic) lives only
+    here."""
+    if not trace_enabled():
+        return None
+    from ate_replication_causalml_tpu.observability.export import (
+        atomic_write_text,
+    )
+
+    if trace is None:
+        trace = build_trace(records, meta=meta)
+    # Compact separators: a quick sweep's trace is ~1-2k events and the
+    # file is read by machines (Perfetto, the analyzer), not humans.
+    atomic_write_text(path, json.dumps(trace, separators=(",", ":")) + "\n")
+    return path
+
+
+def write_trace_artifacts(outdir: str, trace: dict,
+                          overlap_needs_nodes: bool = False) -> list[str]:
+    """Write the ``trace.json`` + ``overlap_report.json`` pair into
+    ``outdir`` — THE one write recipe both the sweep driver and bench
+    use. With ``overlap_needs_nodes``, the overlap report is skipped
+    when the trace scheduled no nodes (a forest-only bench has no DAG
+    to analyze); the sweep always writes it (a fully resumed run's
+    empty report is itself the answer). Returns the paths written
+    ([] when tracing is disabled)."""
+    if not trace_enabled():
+        return []
+    from ate_replication_causalml_tpu.observability import (
+        critical_path as _cpath,
+    )
+    from ate_replication_causalml_tpu.observability.export import (
+        atomic_write_json,
+    )
+
+    tpath = os.path.join(outdir, TRACE_BASENAME)
+    write_trace_json(tpath, trace=trace)
+    paths = [tpath]
+    if overlap_needs_nodes and not _cpath.nodes_from_trace(trace):
+        return paths
+    opath = os.path.join(outdir, OVERLAP_BASENAME)
+    atomic_write_json(opath, _cpath.overlap_report(trace))
+    paths.append(opath)
+    return paths
+
+
+class MetricSampler:
+    """Background sampler turning registry metrics into counter tracks.
+
+    Every ``interval_s`` the sampler reads the configured metric
+    families (``registry.peek`` — no collector hooks, so a tick is a
+    dict copy under the registry lock, never a filesystem scan) and
+    emits one ``metric_sample`` point event per family with the summed
+    value. The exporter renders those as Perfetto counter tracks.
+
+    The default 0.5 s interval is deliberate: samples share the span
+    event log's 100k-record ring, and a chattier sampler on an
+    hour-long run would evict the early scheduler spans — exactly the
+    records the critical-path analyzer needs. At 0.5 s, four families
+    cost ~29k records/hour, well inside the ring.
+
+    The sweep driver starts a sampler only for multi-worker runs — the
+    ``--sequential`` escape hatch promises a zero-thread process, so
+    sequential runs take a single inline :meth:`sample_once` at the end
+    instead (the track exists; it just has one point).
+    """
+
+    DEFAULT_METRICS = (
+        "nuisance_cache_requests_total",
+        "shard_backoff_seconds_total",
+        "device_memory_bytes",
+        "scheduler_prefetch_total",
+    )
+
+    def __init__(self, metrics: tuple[str, ...] | None = None,
+                 interval_s: float = 0.5):
+        self.metrics = tuple(metrics) if metrics is not None else self.DEFAULT_METRICS
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def sample_once(self) -> None:
+        if not _registry.enabled():
+            return
+        for name in self.metrics:
+            samples = _registry.REGISTRY.peek(name)
+            if not samples:
+                continue
+            _events.emit(
+                "metric_sample", status="sample", metric=name,
+                value=float(sum(samples.values())),
+            )
+
+    def start(self) -> None:
+        if self._thread is not None or not _registry.enabled():
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="trace-sampler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout: float | None = 5.0) -> None:
+        """Stop the loop and take one final sample so the counter
+        tracks end at the run's closing values."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        self.sample_once()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.sample_once()
+
+
+def run_meta(workers: int | None = None, wall_s: float | None = None,
+             **extra) -> dict:
+    """The ``otherData`` payload the sweep driver attaches: worker-pool
+    width and the run's wall seconds (the analyzer's denominator), plus
+    free-form identity fields."""
+    out: dict = {"exported_unix": time.time()}
+    if workers is not None:
+        out["workers"] = int(workers)
+    if wall_s is not None:
+        out["wall_s"] = float(wall_s)
+    out.update(extra)
+    return out

@@ -51,6 +51,7 @@ import json
 import math
 import os
 import threading
+import time
 from typing import Callable, Iterable
 
 import jax
@@ -97,7 +98,12 @@ from ate_replication_causalml_tpu.scheduler import (
     SweepEngine,
     default_workers,
 )
-from ate_replication_causalml_tpu.utils.profiling import StageTimer, xla_trace
+from ate_replication_causalml_tpu.utils.profiling import (
+    StageTimer,
+    xla_trace,
+    xprof_annotation,
+    xprof_run,
+)
 
 
 # The sweep's result-row manifest, in notebook order (Rmd:128-272) —
@@ -348,11 +354,18 @@ def _resolve_scheduler(
         raise ValueError(
             f"scheduler must be 'sequential' or 'concurrent', got {mode!r}"
         )
-    if mode == "concurrent" and os.environ.get("ATE_TPU_TRACE_DIR"):
-        # jax.profiler traces are process-global; two stages tracing
-        # concurrently would collide. Profiled runs are sequential.
-        log("ATE_TPU_TRACE_DIR set — forcing sequential sweep "
-            "(profiler traces cannot overlap)")
+    if mode == "concurrent" and (
+        os.environ.get("ATE_TPU_TRACE_DIR") or os.environ.get("ATE_TPU_XPROF")
+    ):
+        # DEVICE capture only (ISSUE 5 satellite): jax.profiler state is
+        # process-global — per-stage trace sessions (ATE_TPU_TRACE_DIR)
+        # collide outright, and a whole-run capture (ATE_TPU_XPROF)
+        # would interleave concurrent stages' device programs into an
+        # unreadable timeline. Host-span tracing (trace.json via
+        # ATE_TPU_TRACE) needs no profiler and stays concurrent.
+        log("device profiling armed (ATE_TPU_TRACE_DIR/ATE_TPU_XPROF) — "
+            "forcing sequential sweep; host-span tracing alone does not "
+            "require this")
         mode = "sequential"
     if mode == "sequential":
         return 1
@@ -382,6 +395,16 @@ def run_sweep(
     atomically). ``ATE_TPU_TELEMETRY=0`` disables all of it; estimator
     outputs are bit-identical either way.
 
+    Tracing (ISSUE 5): with an ``outdir`` the run additionally exports
+    ``trace.json`` (Chrome/Perfetto catapult timeline — worker, lane,
+    prefetch and committer tracks, artifact→stage flow arrows, counter
+    tracks) and ``overlap_report.json`` (critical path, per-lane
+    busy/wait, overlap efficiency, serialization blame) — disable with
+    ``ATE_TPU_TRACE=0``. ``ATE_TPU_XPROF=<dir>`` adds one whole-run
+    device capture with per-stage ``TraceAnnotation`` names matching
+    the host spans (device capture forces the sequential scheduler;
+    host tracing does not).
+
     Scheduling (ISSUE 4): ``scheduler`` is ``"concurrent"`` (default;
     DAG worker pool over the shared nuisance cache) or ``"sequential"``
     (single-threaded escape hatch — same numbers, same journal).
@@ -392,12 +415,25 @@ def run_sweep(
     # Cache counters must exist in metrics.json even when the embedding
     # process never enabled the persistent cache (idempotent).
     obs.install_jax_monitoring()
+    n_workers = _resolve_scheduler(scheduler, workers, log)
+    # Everything this run logs starts after t_start — the trace export
+    # below filters the (process-global, ring-buffered) event log down
+    # to THIS run's records by that boundary.
+    t_start = time.monotonic()
+    sampler = None
+    if outdir and n_workers > 1 and obs.trace_enabled():
+        # Counter tracks for the trace (nuisance-cache traffic, backoff,
+        # device memory). Multi-worker runs only: the --sequential
+        # escape hatch promises a zero-thread process, so sequential
+        # runs take a single inline sample at export time instead.
+        sampler = obs.MetricSampler()
+        sampler.start()
     try:
         with obs.span("run_sweep", out=outdir or "",
                       csv=csv_path or "synthetic") as root_sp:
             report = _run_sweep_impl(
                 config, csv_path, outdir, plots, log,
-                n_workers=_resolve_scheduler(scheduler, workers, log),
+                n_workers=n_workers,
                 prefetch=prefetch,
                 # Stage spans are opened on worker threads, where the
                 # run_sweep span is not on the thread-local stack —
@@ -410,18 +446,64 @@ def run_sweep(
         # telemetry (retry events, partial stage counters) matters
         # most. Device-memory gauges first (TPU reports them; CPU has
         # none), then the exporter trio — metrics.json / events.jsonl /
-        # metrics.prom — beside report.json, after the root span has
-        # closed so the event log contains the complete run.
+        # metrics.prom — plus trace.json / overlap_report.json (ISSUE
+        # 5), beside report.json, after the root span has closed so the
+        # event log contains the complete run. Each step is guarded
+        # separately: in particular the sampler MUST stop even when an
+        # earlier export step raises — a leaked daemon sampler would
+        # keep feeding metric_sample events into the process-global
+        # ring and double-rate the next run's counter tracks.
         if outdir:
             try:
                 obs.record_device_memory(context="run_sweep")
+            except Exception as e:  # noqa: BLE001 — observer must not
+                # replace the run's real exception with a probe error.
+                log(f"telemetry export failed: {e!r}")
+        if sampler is not None:
+            sampler.stop()
+        elif outdir and obs.trace_enabled():
+            obs.MetricSampler().sample_once()
+        if outdir:
+            try:
                 written = obs.write_run_artifacts(outdir)
+                written += _write_trace_artifacts(
+                    outdir, t_start, n_workers, csv_path
+                )
                 if written:
                     log(f"telemetry: {', '.join(written)}")
             except Exception as e:  # noqa: BLE001 — observer must not
                 # replace the run's real exception (full disk, outdir
                 # deleted mid-run) with an export error.
                 log(f"telemetry export failed: {e!r}")
+
+
+def _write_trace_artifacts(
+    outdir: str, t_start: float, workers: int, csv_path: str | None
+) -> list[str]:
+    """trace.json (catapult/Perfetto) + overlap_report.json beside
+    metrics.json — the ISSUE 5 pair. The event log is process-global
+    and ring-buffered, so records are filtered to this run's monotonic
+    window first; the run's wall seconds and worker count ride the
+    trace header for the analyzer's denominator. No-op (no husk files)
+    when tracing is off (``ATE_TPU_TRACE=0`` or telemetry disabled)."""
+    from ate_replication_causalml_tpu.observability import trace as _trace
+
+    if not _trace.trace_enabled():
+        return []
+    records = [
+        r for r in obs.EVENTS.records()
+        if r.get("start_mono_s", 0.0) >= t_start - 1e-6
+    ]
+    run_rec = next((r for r in records if r["name"] == "run_sweep"), None)
+    tr = _trace.build_trace(records, meta=_trace.run_meta(
+        workers=workers,
+        wall_s=run_rec["dur_s"] if run_rec else None,
+        out=outdir, csv=csv_path or "synthetic",
+        # A nonzero ring-eviction count warns the analyzer that the
+        # window may be missing its earliest records.
+        events_dropped=obs.EVENTS.dropped,
+    ))
+    return _trace.write_trace_artifacts(outdir, tr)
 
 
 @dataclasses.dataclass
@@ -604,9 +686,13 @@ def _run_sweep_impl(
                     else 1
                 )
                 try:
-                    # xla_trace sanitizes the label itself (method names
-                    # carry spaces/parens/dots — ``Causal Forest(GRF)``).
-                    with timer.stage(method), xla_trace(method):
+                    # xla_trace/xprof_annotation sanitize the label
+                    # themselves (method names carry spaces/parens/dots
+                    # — ``Causal Forest(GRF)``); the annotation name
+                    # matches the host span so the XLA timeline lines
+                    # up with the host tracks (ISSUE 5c).
+                    with timer.stage(method), xla_trace(method), \
+                            xprof_annotation(method):
                         if method in fault_plan:
                             inj_now = chaos.active()
                             if inj_now is not None:
@@ -879,12 +965,15 @@ def _run_sweep_impl(
 
     engine = SweepEngine(
         artifacts, stages, commit=commit, workers=n_workers,
-        prefetch=prefetch,
+        prefetch=prefetch, span_parent=root_span_id,
     )
     if n_workers > 1:
         log(f"scheduler: concurrent sweep, {n_workers} workers"
             + (", compile prefetch on" if engine.prefetch else ""))
-    outcomes = engine.run()
+    # One whole-run device capture under $ATE_TPU_XPROF (no-op without
+    # it); stage bodies carry matching TraceAnnotations.
+    with xprof_run("run_sweep"):
+        outcomes = engine.run()
 
     report.oracle = outcomes["oracle"].res
     for m in SWEEP_METHODS:

@@ -75,7 +75,13 @@ def _record_injection(scope: str, site: str, **detail) -> None:
     """The single audit channel every injected fault reports through:
     one counter family + one ``chaos_inject`` event shape, shared by
     the injector and the plan-based wrapper so the two can never
-    diverge."""
+    diverge.
+
+    ``chaos_inject`` is a point event, emitted from inside the faulted
+    work's own span — so the trace exporter (observability/trace.py)
+    renders every injection as an instant marker on the worker/lane
+    track that was running the victim, exactly where a reader of the
+    timeline would look for the cause of the failure slice."""
     _registry.counter(
         "chaos_injections_total", "faults injected by the chaos harness"
     ).inc(1, scope=scope)

@@ -102,6 +102,7 @@ class SweepEngine:
         workers: int | None = None,
         prefetch: bool | None = None,
         cache: NuisanceCache | None = None,
+        span_parent: str | None = None,
     ):
         arts = list(artifacts)
         self.dag = validate(arts, stages)
@@ -111,6 +112,10 @@ class SweepEngine:
         self.workers = default_workers() if workers is None else max(1, workers)
         self.prefetch = default_enabled() if prefetch is None else prefetch
         self._commit_fn = commit
+        # Explicit span parentage for the trace layer (ISSUE 5): node /
+        # commit / prefetch spans open on threads where the caller's
+        # root span is not on the thread-local stack.
+        self._span_parent = span_parent
         self._mu = threading.Condition()
         # Shared scheduling state — every mutation below happens under
         # self._mu (graftlint JGL008 enforces this).
@@ -187,6 +192,7 @@ class SweepEngine:
             prefetcher = CompilePrefetcher(
                 [(n.name, warm_of.get(n.name)) for n in items],
                 started=self._was_started,
+                span_parent=self._span_parent,
             )
             prefetcher.start()
         try:
@@ -334,33 +340,47 @@ class SweepEngine:
     def _exec(self, node: _Node) -> None:
         t0 = time.perf_counter()
         value, error = None, None
-        try:
-            # Lane exclusivity (multi-device collective launches — see
-            # dag.ArtifactSpec.exclusive) is enforced two ways: the
-            # scheduling skip in _take/_finish keeps two laned NODES
-            # from overlapping, and the re-entrant lane lock below
-            # additionally fences the cache's refit path — a consumer
-            # stage retrying a FAILED laned artifact (cache.get inside
-            # an unlaned stage body) must not launch that collective
-            # while a laned node is executing.
-            guard = (
-                self.cache.lane_lock(node.exclusive)
-                if node.exclusive is not None
-                else contextlib.nullcontext()
-            )
-            with guard:
-                value = node.exec()
-        except BaseException as e:  # noqa: BLE001 — routed to the
-            # declared-order abort/degrade logic in _finish; never
-            # swallowed (graftlint JGL007: errors become the run's
-            # exception or the consumer stage's failure row).
-            error = e
-            if node.kind == "artifact" and not isinstance(
-                e, (KeyboardInterrupt, SystemExit)
-            ):
-                obs.emit("artifact_fit_failed", status="error",
-                         artifact=node.name,
-                         error=f"{type(e).__name__}: {e}")
+        # The node's execution interval, with lane/worker/dependency
+        # attribution (ISSUE 5): the trace exporter renders these spans
+        # as the per-worker timeline tracks, duplicates laned ones onto
+        # the lane-occupancy track, and draws artifact->stage flow
+        # arrows from the ``needs`` list.
+        with obs.span(
+            "scheduler_node", parent_id=self._span_parent,
+            node=node.name, kind=node.kind, lane=node.exclusive or "",
+            worker=threading.current_thread().name,
+            stage_idx=node.stage_idx, needs=",".join(node.deps),
+        ) as nsp:
+            try:
+                # Lane exclusivity (multi-device collective launches —
+                # see dag.ArtifactSpec.exclusive) is enforced two ways:
+                # the scheduling skip in _take/_finish keeps two laned
+                # NODES from overlapping, and the re-entrant lane lock
+                # below additionally fences the cache's refit path — a
+                # consumer stage retrying a FAILED laned artifact
+                # (cache.get inside an unlaned stage body) must not
+                # launch that collective while a laned node is
+                # executing.
+                guard = (
+                    self.cache.lane_lock(node.exclusive)
+                    if node.exclusive is not None
+                    else contextlib.nullcontext()
+                )
+                with guard:
+                    value = node.exec()
+            except BaseException as e:  # noqa: BLE001 — routed to the
+                # declared-order abort/degrade logic in _finish; never
+                # swallowed (graftlint JGL007: errors become the run's
+                # exception or the consumer stage's failure row).
+                error = e
+                nsp.set_status("error")
+                nsp.set_attr("error_type", type(e).__name__)
+                if node.kind == "artifact" and not isinstance(
+                    e, (KeyboardInterrupt, SystemExit)
+                ):
+                    obs.emit("artifact_fit_failed", status="error",
+                             artifact=node.name,
+                             error=f"{type(e).__name__}: {e}")
         obs.histogram(
             "scheduler_node_seconds", "per-node execution seconds"
         ).observe(time.perf_counter() - t0, kind=node.kind)
@@ -399,7 +419,13 @@ class SweepEngine:
                 self._commit_busy = True
             try:
                 if self._commit_fn is not None:
-                    self._commit_fn(spec, value)
+                    # track="committer": the trace's dedicated committer
+                    # track — ordered-commit stall time must be visible
+                    # as its own lane, not buried in a worker's track.
+                    with obs.span("commit", parent_id=self._span_parent,
+                                  stage=spec.name, stage_idx=idx,
+                                  track="committer"):
+                        self._commit_fn(spec, value)
             except BaseException as e:  # noqa: BLE001 — a commit
                 # failure (disk full mid-journal-append) aborts the run
                 # at this stage, like a sequential write failure would.

@@ -66,9 +66,13 @@ class CompilePrefetcher:
         self,
         items: Sequence[tuple[str, Callable[[], object] | None]],
         started: Callable[[str], bool] = lambda name: False,
+        span_parent: str | None = None,
     ):
         self._items = [(n, w) for n, w in items if w is not None]
         self._started = started
+        # Explicit parentage: prefetch spans open on the lane's own
+        # thread, where the run's root span is not on the local stack.
+        self._span_parent = span_parent
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._counter = obs.counter(
@@ -104,7 +108,13 @@ class CompilePrefetcher:
                 continue
             t0 = time.perf_counter()
             try:
-                warm()
+                # track="prefetch": the trace's dedicated prefetch-lane
+                # track — warm compiles must be visibly overlapped with
+                # (not interleaved into) the worker tracks.
+                with obs.span("prefetch_compile",
+                              parent_id=self._span_parent,
+                              node=name, track="prefetch"):
+                    warm()
             except Exception as e:  # noqa: BLE001 — a prefetch failure
                 # must never fail the sweep; it is recorded, not raised
                 # (the foreground stage will compile for itself).

@@ -14,6 +14,15 @@ wall-clock metric, so timing is a first-class subsystem:
 * :func:`xla_trace` — wraps ``jax.profiler.trace`` when a trace dir is
   set (``ATE_TPU_TRACE_DIR`` env var or argument) and is a no-op
   otherwise, so production code can leave the hook in place.
+* :func:`xprof_run` / :func:`xprof_annotation` — the ISSUE 5 device-
+  profile correlation pair: with ``ATE_TPU_XPROF=<dir>`` the sweep
+  captures ONE whole-run ``jax.profiler.trace`` and each stage enters a
+  ``jax.profiler.TraceAnnotation`` named like its host span, so the XLA
+  timeline lines up with the host trace's tracks name-for-name. Device
+  capture is process-global, so the driver falls back to the
+  sequential scheduler while either xprof env var is set; the host
+  trace (``observability/trace.py``) needs no profiler and keeps
+  working under the concurrent engine.
 
 All three are thin emitters into the unified telemetry layer
 (``observability/``): stage durations land in the
@@ -34,6 +43,7 @@ import jax
 from ate_replication_causalml_tpu import observability as obs
 
 _TRACE_ENV = "ATE_TPU_TRACE_DIR"
+_XPROF_ENV = "ATE_TPU_XPROF"
 
 
 class StageTimer:
@@ -106,4 +116,48 @@ def xla_trace(label: str = "trace", trace_dir: str | None = None) -> Iterator[No
         1, label=label
     )
     with jax.profiler.trace(path):
+        yield
+
+
+def xprof_dir() -> str | None:
+    """The device-profile correlation dir (``ATE_TPU_XPROF``), or None."""
+    return os.environ.get(_XPROF_ENV) or None
+
+
+@contextlib.contextmanager
+def xprof_run(label: str = "run") -> Iterator[None]:
+    """One whole-run ``jax.profiler.trace`` under ``$ATE_TPU_XPROF``
+    (no-op without it). Unlike :func:`xla_trace`'s per-stage capture
+    dirs, a single capture spans the run, and stages are told apart by
+    their :func:`xprof_annotation` names — the host-span names — so the
+    XLA timeline and the host trace line up."""
+    d = xprof_dir()
+    if not d:
+        yield
+        return
+    label = obs.sanitize_label(label)
+    path = os.path.join(d, label)
+    os.makedirs(path, exist_ok=True)
+    obs.counter("xprof_trace_total", "whole-run xprof captures").inc(
+        1, label=label
+    )
+    with jax.profiler.trace(path):
+        yield
+
+
+@contextlib.contextmanager
+def xprof_annotation(label: str) -> Iterator[None]:
+    """``jax.profiler.TraceAnnotation`` named like the host span
+    (sanitized identically), active only under ``$ATE_TPU_XPROF``.
+    Annotations are per-thread and nestable — safe wherever a host span
+    is safe — but the driver still serializes the sweep while a device
+    capture is armed (process-global profiler state)."""
+    if not xprof_dir():
+        yield
+        return
+    annot = getattr(jax.profiler, "TraceAnnotation", None)
+    if annot is None:  # very old jaxlib: correlation simply degrades
+        yield
+        return
+    with annot(obs.sanitize_label(label)):
         yield

@@ -672,7 +672,12 @@ def main():
     run still leaves its partial counters behind for diagnosis. The
     bench records themselves flow THROUGH the registry
     (observability.bench_record), so the printed BENCH lines and the
-    exported metrics.json cannot disagree."""
+    exported metrics.json cannot disagree.
+
+    Tracing (ISSUE 5): unless ``ATE_TPU_TRACE=0``, the export also
+    writes ``trace.json`` (every record's spans on the Perfetto
+    timeline) and — when the run scheduled sweep stages, e.g.
+    ``--sweep-quick`` — ``overlap_report.json`` beside it."""
     try:
         return _main()
     finally:
@@ -680,9 +685,22 @@ def main():
         if outdir and not _delegated_to_child:
             try:
                 obs.write_run_artifacts(outdir)
+                _write_bench_trace(outdir)
             except Exception as e:  # noqa: BLE001 — an export error must
                 # not replace the bench's real exception/exit status
                 print(f"# telemetry export failed: {e!r}", file=sys.stderr)
+
+
+def _write_bench_trace(outdir):
+    """trace.json for the whole bench process; the overlap report only
+    when the run actually scheduled sweep nodes (a forest-only bench
+    has no DAG to analyze)."""
+    from ate_replication_causalml_tpu.observability import trace as _trace
+
+    if not _trace.trace_enabled():
+        return
+    tr = _trace.build_trace(meta=_trace.run_meta(tool="bench"))
+    _trace.write_trace_artifacts(outdir, tr, overlap_needs_nodes=True)
 
 
 def _main():

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Critical-path / overlap analysis of an exported sweep trace.
+
+Usage::
+
+    python scripts/analyze_trace.py results/trace.json
+    python scripts/analyze_trace.py results/          # finds trace.json
+    python scripts/analyze_trace.py results/trace.json --out report.json
+
+Reads the catapult ``trace.json`` the sweep driver (or bench) exports,
+recomputes the overlap report — critical path through the scheduler's
+node intervals, per-lane busy/wait, overlap efficiency, serialization
+blame — writes it as ``overlap_report.json`` next to the trace (or to
+``--out``) and prints a human summary. A pure function of the trace:
+re-running on the same file reproduces the same report, so the analyzer
+can be applied to any saved run without the code that produced it.
+
+Pure stdlib, no JAX — importable on a laptop against a trace captured
+on a TPU host.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import types
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+# Import ONLY the observability subpackage (stdlib at import time; jax
+# is lazy inside device.py): executing the parent package's __init__
+# would pull the estimator stack and with it jax — wrong for an
+# analyzer that must run on saved artifacts anywhere.
+if "ate_replication_causalml_tpu" not in sys.modules:
+    _pkg = types.ModuleType("ate_replication_causalml_tpu")
+    _pkg.__path__ = [os.path.join(_REPO_ROOT, "ate_replication_causalml_tpu")]
+    sys.modules["ate_replication_causalml_tpu"] = _pkg
+
+from ate_replication_causalml_tpu.observability import (  # noqa: E402
+    critical_path as cp,
+)
+from ate_replication_causalml_tpu.observability.export import (  # noqa: E402
+    atomic_write_json,
+)
+
+
+def render_summary(report: dict) -> str:
+    lines = [
+        f"wall {report['wall_s']:.3f}s, {report['workers']} worker(s), "
+        f"{report['nodes']} nodes",
+        f"busy Σ {report['busy_total_s']:.3f}s -> overlap efficiency "
+        f"{report['overlap_efficiency']:.2%}",
+        f"critical path {report['critical_path_s']:.3f}s "
+        f"({report['critical_path_share']:.0%} of wall), longest node "
+        f"{report['longest_node_s']:.3f}s",
+        "",
+        "tracks:",
+    ]
+    for name, t in sorted(report["tracks"].items()):
+        lines.append(
+            f"  {name:<24s} busy {t['busy_s']:8.3f}s  wait "
+            f"{t['wait_s']:8.3f}s  util {t['utilization']:.0%}  "
+            f"({t['nodes']} nodes)"
+        )
+    ser = report["serialization"]
+    for lane, s in sorted(ser.get("lanes", {}).items()):
+        lines.append(
+            f"  lane:{lane:<19s} busy {s['busy_s']:8.3f}s  occupancy "
+            f"{s['occupancy']:.0%}  ({s['nodes']} nodes)"
+        )
+    com = ser.get("committer", {})
+    lines.append(
+        f"  committer: {com.get('commits', 0)} commits, "
+        f"{com.get('busy_s', 0.0):.3f}s busy"
+    )
+    if ser.get("prefetch"):
+        lines.append(f"  prefetch: {ser['prefetch']}")
+    lines += ["", "critical path (name  dur  wait-behind-predecessor):"]
+    for entry in report["critical_path"]:
+        lane = f" [{entry['lane']}]" if entry.get("lane") else ""
+        lines.append(
+            f"  {entry['name']:<44.44s}{lane} {entry['dur_s']:8.3f}s  "
+            f"+{entry['wait_s']:.3f}s"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("trace", help="trace.json, or a results/ directory "
+                                  "containing one")
+    ap.add_argument("--out", default=None,
+                    help="overlap report path (default: "
+                         "overlap_report.json beside the trace)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the report JSON instead of the summary")
+    args = ap.parse_args(argv)
+
+    tpath = args.trace
+    if os.path.isdir(tpath):
+        tpath = os.path.join(tpath, "trace.json")
+    try:
+        with open(tpath) as f:
+            trace = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"analyze_trace: cannot read {tpath}: {e}", file=sys.stderr)
+        return 2
+    try:
+        report = cp.overlap_report(trace)
+    except (KeyError, TypeError, ValueError, AttributeError) as e:
+        # Hand-edited/truncated traces (valid JSON, wrong shape) get a
+        # clean diagnosis + exit 2, not a traceback — the same contract
+        # check_metrics_schema.py keeps for corrupted reports.
+        print(f"analyze_trace: {tpath} is not a valid exported trace "
+              f"({type(e).__name__}: {e}) — validate with "
+              f"scripts/check_metrics_schema.py", file=sys.stderr)
+        return 2
+    out = args.out or os.path.join(os.path.dirname(tpath) or ".",
+                                   "overlap_report.json")
+    atomic_write_json(out, report)
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(render_summary(report))
+    print(f"# wrote {out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

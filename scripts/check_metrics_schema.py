@@ -15,6 +15,13 @@ parent references resolving, non-negative durations). With
 ``sweep_stage_total`` label — the quick-sweep acceptance gate for all
 13 ``SWEEP_METHODS`` plus the oracle.
 
+In directory mode, ``trace.json`` (catapult trace-event shape: known
+phases, complete events with non-negative durations, flow ends binding
+to a start, every used track named by metadata) and
+``overlap_report.json`` (required keys plus internal consistency —
+Σ busy ≤ wall × workers, critical path ≥ the longest node) are
+validated too when present (ISSUE 5).
+
 Importable: the telemetry integration test drives :func:`validate_pair`
 directly. Pure stdlib — runnable on any saved ``results/`` directory
 without JAX.
@@ -142,6 +149,128 @@ def validate_events(lines: list[str]) -> list[str]:
     return errors
 
 
+_TRACE_PHASES = {"X", "i", "C", "M", "s", "f", "t", "b", "e"}
+
+_OVERLAP_KEYS = (
+    "schema_version", "wall_s", "workers", "nodes", "tracks",
+    "busy_total_s", "overlap_efficiency", "critical_path",
+    "critical_path_s", "longest_node_s", "serialization",
+)
+
+
+def validate_trace(trace: dict) -> list[str]:
+    """Catapult-shape checks on an exported ``trace.json``."""
+    errors: list[str] = []
+    events = trace.get("traceEvents")
+    if not isinstance(events, list):
+        return ["trace: traceEvents is not a list"]
+    other = trace.get("otherData", {})
+    if not isinstance(other, dict) or "wall_anchor_unix" not in other:
+        errors.append("trace: otherData lacks the wall_anchor_unix anchor")
+    named_tids = set()
+    used_tids = set()
+    flow_starts = set()
+    flow_ends = []
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            errors.append(f"trace: event {i} is not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in _TRACE_PHASES:
+            errors.append(f"trace: event {i} has unknown phase {ph!r}")
+            continue
+        if "name" not in ev or "pid" not in ev:
+            errors.append(f"trace: event {i} missing name/pid")
+        if ph == "M":
+            if ev.get("name") == "thread_name":
+                named_tids.add(ev.get("tid"))
+            continue
+        if not isinstance(ev.get("ts"), (int, float)) or ev["ts"] < -1e-9:
+            errors.append(f"trace: event {i} ({ev.get('name')}) bad ts")
+        used_tids.add(ev.get("tid"))
+        if ph == "X" and (
+            not isinstance(ev.get("dur"), (int, float)) or ev["dur"] < 0
+        ):
+            errors.append(f"trace: slice {i} ({ev.get('name')}) bad dur")
+        if ph == "s":
+            flow_starts.add((ev.get("cat"), ev.get("id")))
+        elif ph == "f":
+            flow_ends.append((i, ev.get("cat"), ev.get("id")))
+    for i, cat, fid in flow_ends:
+        if (cat, fid) not in flow_starts:
+            errors.append(f"trace: flow end {i} has no matching start "
+                          f"(cat={cat!r}, id={fid!r})")
+    for t in used_tids - named_tids:
+        errors.append(f"trace: tid {t!r} has events but no thread_name "
+                      "metadata")
+    return errors
+
+
+def validate_overlap(report: dict, tol: float = 1e-6) -> list[str]:
+    """Key and internal-consistency checks on ``overlap_report.json``."""
+    errors: list[str] = []
+    for key in _OVERLAP_KEYS:
+        if key not in report:
+            errors.append(f"overlap: missing key {key!r}")
+    if errors:
+        return errors
+    wall, workers = report["wall_s"], report["workers"]
+    if not isinstance(workers, int) or workers < 1:
+        errors.append(f"overlap: workers {workers!r} is not a positive int")
+        return errors
+    # Numeric fields must BE numeric before any consistency arithmetic:
+    # a hand-edited/corrupted report must produce FAIL lines, not a
+    # TypeError traceback out of the validator.
+    for key in ("wall_s", "busy_total_s", "critical_path_s",
+                "longest_node_s"):
+        v = report[key]
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            errors.append(f"overlap: {key} {v!r} is not a number")
+    if errors:
+        return errors
+    if report["busy_total_s"] > wall * workers + max(tol, 1e-3 * wall):
+        errors.append(
+            f"overlap: busy_total_s {report['busy_total_s']} exceeds "
+            f"wall*workers {wall * workers}"
+        )
+    if report["critical_path_s"] + tol < report["longest_node_s"]:
+        errors.append(
+            f"overlap: critical_path_s {report['critical_path_s']} shorter "
+            f"than longest_node_s {report['longest_node_s']}"
+        )
+    if report["nodes"] and not report["critical_path"]:
+        errors.append("overlap: nodes present but critical_path empty")
+    eff = report["overlap_efficiency"]
+    if not isinstance(eff, (int, float)) or eff < 0:
+        errors.append(f"overlap: bad overlap_efficiency {eff!r}")
+    for entry in report["critical_path"]:
+        if not {"name", "dur_s", "wait_s"} <= set(entry):
+            errors.append(f"overlap: malformed critical_path entry {entry!r}")
+            break
+    return errors
+
+
+def validate_trace_files(outdir: str) -> list[str]:
+    """Validate trace.json / overlap_report.json in ``outdir`` when
+    present (tracing is optional; absence is not an error)."""
+    errors: list[str] = []
+    tpath = os.path.join(outdir, "trace.json")
+    if os.path.exists(tpath):
+        try:
+            with open(tpath) as f:
+                errors += validate_trace(json.load(f))
+        except (OSError, json.JSONDecodeError) as e:
+            errors.append(f"trace: cannot read {tpath}: {e}")
+    opath = os.path.join(outdir, "overlap_report.json")
+    if os.path.exists(opath):
+        try:
+            with open(opath) as f:
+                errors += validate_overlap(json.load(f))
+        except (OSError, json.JSONDecodeError) as e:
+            errors.append(f"overlap: cannot read {opath}: {e}")
+    return errors
+
+
 def validate_pair(metrics_path: str, events_path: str,
                   require_stages: list[str] | None = None) -> list[str]:
     errors: list[str] = []
@@ -168,7 +297,9 @@ def main(argv: list[str] | None = None) -> int:
                     help="comma-separated stage names that must appear in "
                          "sweep_stage_total")
     args = ap.parse_args(argv)
+    trace_dir = None
     if len(args.paths) == 1 and os.path.isdir(args.paths[0]):
+        trace_dir = args.paths[0]
         metrics_path = os.path.join(args.paths[0], "metrics.json")
         events_path = os.path.join(args.paths[0], "events.jsonl")
     elif len(args.paths) == 2:
@@ -180,6 +311,8 @@ def main(argv: list[str] | None = None) -> int:
         if args.require_stages else None
     )
     errors = validate_pair(metrics_path, events_path, require_stages=stages)
+    if trace_dir is not None:
+        errors += validate_trace_files(trace_dir)
     for e in errors:
         print(f"FAIL {e}", file=sys.stderr)
     if errors:

@@ -13,17 +13,30 @@ Stages (classifier shape: n rows, depth 9, p=21, 64 bins, K=2 weights):
   leaf      — depth-9 segment_sum leaf stats
   full      — the real _grow_chunk, per tree, for cross-checking
 
+Every stage measurement is also a span in the unified event log, and
+the run exports a Perfetto ``trace.json`` (``--trace-out``) — the same
+exporter the sweep driver uses — so per-level stage costs can be read
+on a timeline next to any other capture instead of only as stderr
+prints.
+
 Usage: python scripts/profile_grow.py [--rows 1000000] [--trees 8]
+                                      [--trace-out /tmp/profile_grow_trace.json]
 """
 
 import argparse
+import os
 import sys
 import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ate_replication_causalml_tpu import observability as obs
 from ate_replication_causalml_tpu.utils.compile_cache import enable_persistent_cache
 
 enable_persistent_cache()
@@ -42,13 +55,19 @@ from ate_replication_causalml_tpu.ops.hist_pallas import (  # noqa: E402
 R = 8  # repeats inside one dispatch
 
 
-def timed(fn, *args):
-    out = fn(*args)
-    _ = float(jax.tree_util.tree_leaves(out)[0].ravel()[0])  # compile+sync
-    t0 = time.perf_counter()
-    out = fn(*args)
-    _ = float(jax.tree_util.tree_leaves(out)[0].ravel()[0])
-    return (time.perf_counter() - t0) / R
+def timed(fn, *args, stage="stage"):
+    """Compile+sync, then time R in-dispatch repeats — recorded as a
+    ``profile_stage`` span (the trace exporter's input) with the
+    per-repeat milliseconds in its attrs."""
+    with obs.span("profile_stage", stage=stage) as sp:
+        out = fn(*args)
+        _ = float(jax.tree_util.tree_leaves(out)[0].ravel()[0])  # compile+sync
+        t0 = time.perf_counter()
+        out = fn(*args)
+        _ = float(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+        dt = (time.perf_counter() - t0) / R
+        sp.set_attr("ms_per_repeat", round(dt * 1e3, 3))
+    return dt
 
 
 def grow_no_hist(args):
@@ -109,10 +128,12 @@ def grow_no_hist(args):
         return jax.vmap(one)(keys).sum()
 
     keys = jax.random.split(jax.random.key(7), tc)
-    _ = float(grow(keys))
-    t0 = time.perf_counter()
-    _ = float(grow(keys))
-    dt = (time.perf_counter() - t0) / tc
+    with obs.span("profile_stage", stage="no_hist_grow") as sp:
+        _ = float(grow(keys))
+        t0 = time.perf_counter()
+        _ = float(grow(keys))
+        dt = (time.perf_counter() - t0) / tc
+        sp.set_attr("ms_per_tree", round(dt * 1e3, 3))
     print(f"no-hist grow: {dt * 1e3:8.2f} ms/tree (chunk of {tc}, "
           f"rows={n} depth={depth})", file=sys.stderr)
 
@@ -124,9 +145,13 @@ def main():
     ap.add_argument("--trees", type=int, default=8)
     ap.add_argument("--bf16", action="store_true")
     ap.add_argument("--no-hist", action="store_true")
+    ap.add_argument("--trace-out", default="/tmp/profile_grow_trace.json",
+                    help="Perfetto trace path ('' disables)")
     args = ap.parse_args()
     if args.no_hist:
-        return grow_no_hist(args)
+        grow_no_hist(args)
+        _export_trace(args)
+        return
     n, p, n_bins = args.rows, 21, 64
     depth = args.depth
 
@@ -173,7 +198,7 @@ def main():
             )
             return h.ravel()[0]
 
-        t = timed(rep(body), ids, weights)
+        t = timed(rep(body), ids, weights, stage=f"hist_l{l}")
         hist_ms.append(t * 1e3)
         print(f"hist  level {l} (m={m:3d}): {t * 1e3:8.2f} ms", file=sys.stderr)
 
@@ -189,7 +214,7 @@ def main():
             nxt = route_rows(oh + eps, bf, bb, codes_f, ids)
             return nxt.sum().astype(jnp.float32)
 
-        t = timed(rep(body), node_ids[l], bf, bb)
+        t = timed(rep(body), node_ids[l], bf, bb, stage=f"route_l{l}")
         route_ms.append(t * 1e3)
         print(f"route level {l} (m={m:3d}): {t * 1e3:8.2f} ms", file=sys.stderr)
 
@@ -209,7 +234,7 @@ def main():
             flat = sc.reshape(m, p * n_bins)
             return jnp.argmin(flat, axis=1).sum().astype(jnp.float32)
 
-        t = timed(rep(body), h)
+        t = timed(rep(body), h, stage=f"score_l{l}")
         score_ms.append(t * 1e3)
         print(f"score level {l} (m={m:3d}): {t * 1e3:8.2f} ms", file=sys.stderr)
 
@@ -228,7 +253,7 @@ def main():
             back = jnp.matmul(oh, node_mom[:, 1:4])          # (rows, 3)
             return back.ravel()[0] + node_mom.ravel()[0]
 
-        t = timed(rep(body), node_ids[l], mom)
+        t = timed(rep(body), node_ids[l], mom, stage=f"moment_l{l}")
         mo_ms.append(t * 1e3)
         print(f"moment level {l} (m={m:3d}): {t * 1e3:8.2f} ms", file=sys.stderr)
 
@@ -237,7 +262,7 @@ def main():
         return jnp.matmul(oh.T, mom).ravel()[0]
 
     ids_pay = jax.random.randint(jax.random.key(998), (n,), 0, 1 << depth, jnp.int32)
-    t_pay = timed(rep(payload_body), ids_pay, mom)
+    t_pay = timed(rep(payload_body), ids_pay, mom, stage="leaf_payload")
     print(f"leaf payload onehot (m={1 << depth}): {t_pay * 1e3:8.2f} ms",
           file=sys.stderr)
     print(f"# causal extras ms/tree: moments={sum(mo_ms):.1f} "
@@ -250,7 +275,7 @@ def main():
         s = jax.ops.segment_sum(c + eps, ids, num_segments=1 << depth)
         return s.ravel()[0]
 
-    t_leaf = timed(rep(leaf_body), ids_leaf, counts)
+    t_leaf = timed(rep(leaf_body), ids_leaf, counts, stage="leaf_segsum")
     print(f"leaf  segsum (m={1 << depth}): {t_leaf * 1e3:8.2f} ms", file=sys.stderr)
 
     tot = sum(hist_ms) + sum(route_ms) + sum(score_ms) + t_leaf * 1e3
@@ -277,14 +302,34 @@ def main():
         )
         return out
 
-    out = full()
-    _ = float(out[2].sum())
-    t0 = time.perf_counter()
-    out = full()
-    _ = float(out[2].sum())
-    t_full = (time.perf_counter() - t0) / tc
+    with obs.span("profile_stage", stage="full_grow_chunk") as sp:
+        out = full()
+        _ = float(out[2].sum())
+        t0 = time.perf_counter()
+        out = full()
+        _ = float(out[2].sum())
+        t_full = (time.perf_counter() - t0) / tc
+        sp.set_attr("ms_per_tree", round(t_full * 1e3, 3))
     print(f"full grow chunk: {t_full * 1e3:8.2f} ms/tree (chunk of {tc})",
           file=sys.stderr)
+
+    _export_trace(args)
+
+
+def _export_trace(args):
+    """Write the collected profile_stage spans as a Perfetto trace —
+    shared by the full ablation and the --no-hist path."""
+    if not args.trace_out:
+        return
+    path = obs.write_trace_json(
+        args.trace_out,
+        meta={"tool": "profile_grow", "rows": args.rows,
+              "depth": args.depth, "bf16": bool(args.bf16),
+              "no_hist": bool(args.no_hist)},
+    )
+    if path:
+        print(f"# trace: {path} (ui.perfetto.dev / "
+              f"scripts/analyze_trace.py)", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -5,16 +5,30 @@ construction), but each stage is synced and timed separately:
 nuisance-Y fit, OOB(Y), nuisance-W fit, OOB(W), causal grow, CATE+AIPW.
 Run twice: first pass includes compiles, second is steady.
 
+Timing runs through the unified telemetry layer (StageTimer spans →
+the event log), so besides the stderr summary the run exports a
+Perfetto ``trace.json`` (``--trace-out``; open in ui.perfetto.dev or
+analyze with ``scripts/analyze_trace.py``) instead of existing only as
+ad-hoc prints.
+
 Usage: python scripts/stage_time_1m.py [--rows 1000000] [--trees 2000]
+                                       [--trace-out /tmp/stage_time_trace.json]
 """
 
 import argparse
-import time
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 
 import jax
 import jax.numpy as jnp
 
+from ate_replication_causalml_tpu import observability as obs
 from ate_replication_causalml_tpu.utils.compile_cache import enable_persistent_cache
+from ate_replication_causalml_tpu.utils.profiling import StageTimer
 
 enable_persistent_cache()
 
@@ -45,41 +59,44 @@ def make(n):
 def run(frame, n_trees, seed, label):
     x, w, y = frame.x, frame.w, frame.y
     ky, kw_, kc = jax.random.split(jax.random.key(seed), 3)
-    t = {}
+    # One StageTimer per pass: each stage is a span in the event log
+    # (the trace exporter's input) AND a seconds entry for the summary
+    # line — one clock, one record, no ad-hoc perf_counter bookkeeping.
+    timer = StageTimer()
 
-    t0 = time.perf_counter()
-    fy = fit_forest_regressor(x, y, ky, n_trees=500, depth=9)
-    _ = float(fy.train_leaf.sum())
-    t["fit_y"] = time.perf_counter() - t0
+    with obs.span("bench_leg", leg=label, trees=n_trees):
+        with timer.stage("fit_y"):
+            fy = fit_forest_regressor(x, y, ky, n_trees=500, depth=9)
+            _ = float(fy.train_leaf.sum())
 
-    t0 = time.perf_counter()
-    y_hat = forest_oob_mean(fy, x)
-    _ = float(y_hat.sum())
-    t["oob_y"] = time.perf_counter() - t0
-    del fy
+        with timer.stage("oob_y"):
+            y_hat = forest_oob_mean(fy, x)
+            _ = float(y_hat.sum())
+        del fy
 
-    t0 = time.perf_counter()
-    fw = fit_forest_regressor(x, w, kw_, n_trees=500, depth=9)
-    _ = float(fw.train_leaf.sum())
-    t["fit_w"] = time.perf_counter() - t0
+        with timer.stage("fit_w"):
+            fw = fit_forest_regressor(x, w, kw_, n_trees=500, depth=9)
+            _ = float(fw.train_leaf.sum())
 
-    t0 = time.perf_counter()
-    w_hat = forest_oob_mean(fw, x)
-    _ = float(w_hat.sum())
-    t["oob_w"] = time.perf_counter() - t0
-    del fw
+        with timer.stage("oob_w"):
+            w_hat = forest_oob_mean(fw, x)
+            _ = float(w_hat.sum())
+        del fw
 
-    t0 = time.perf_counter()
-    forest = grow_causal_forest(x, w - w_hat, y - y_hat, kc, n_trees=n_trees, depth=8)
-    _ = float(forest.leaf_stats.sum())
-    t["grow"] = time.perf_counter() - t0
+        with timer.stage("grow"):
+            forest = grow_causal_forest(
+                x, w - w_hat, y - y_hat, kc, n_trees=n_trees, depth=8
+            )
+            _ = float(forest.leaf_stats.sum())
 
-    t0 = time.perf_counter()
-    fitted = FittedCausalForest(forest=forest, y_hat=y_hat, w_hat=w_hat, x=x, y=y, w=w)
-    eff = average_treatment_effect(fitted)
-    ate, se = float(eff.estimate), float(eff.std_err)
-    t["cate_aipw"] = time.perf_counter() - t0
+        with timer.stage("cate_aipw"):
+            fitted = FittedCausalForest(
+                forest=forest, y_hat=y_hat, w_hat=w_hat, x=x, y=y, w=w
+            )
+            eff = average_treatment_effect(fitted)
+            ate, se = float(eff.estimate), float(eff.std_err)
 
+    t = timer.seconds
     total = sum(t.values())
     stages = " ".join(f"{k}={v:.1f}s" for k, v in t.items())
     print(f"# [{label}] total={total:.1f}s {stages} ate={ate:.4f} se={se:.4f}")
@@ -91,11 +108,22 @@ def main():
     ap.add_argument("--rows", type=int, default=1_000_000)
     ap.add_argument("--trees", type=int, default=2000)
     ap.add_argument("--once", action="store_true", help="skip the steady pass")
+    ap.add_argument("--trace-out", default="/tmp/stage_time_trace.json",
+                    help="Perfetto trace path ('' disables)")
     args = ap.parse_args()
     frame = make(args.rows)
     run(frame, args.trees, 1, "first")
     if not args.once:
         run(frame, args.trees, 2, "steady")
+    if args.trace_out:
+        path = obs.write_trace_json(
+            args.trace_out,
+            meta={"tool": "stage_time_1m", "rows": args.rows,
+                  "trees": args.trees},
+        )
+        if path:
+            print(f"# trace: {path} (ui.perfetto.dev / "
+                  f"scripts/analyze_trace.py)")
 
 
 if __name__ == "__main__":

@@ -7,6 +7,12 @@ op-level trace, not another per-stage A/B. This captures a
 jax.profiler trace of a small warm fit at --rows and prints the top
 device ops by total self-time, grouped by fusion name.
 
+The compile/warm/traced legs are spans in the unified event log, and a
+host-side Perfetto ``trace.json`` is exported next to the xplane
+capture (``<trace-dir>/host_trace.json``) — the wall anchor in its
+header lines the host legs up against the device timeline in the same
+Perfetto session.
+
 Usage:
   python scripts/trace_fit.py --rows 1000000 --trees 32 [--mode causal|classifier]
 """
@@ -17,9 +23,14 @@ import os
 import sys
 import time
 
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
 import jax
 import jax.numpy as jnp
 
+from ate_replication_causalml_tpu import observability as obs
 from ate_replication_causalml_tpu.utils.compile_cache import enable_persistent_cache
 
 enable_persistent_cache()
@@ -113,20 +124,30 @@ def main():
 
     if not args.parse_only:
         run = build_fit(args.mode, args.rows, args.trees)
-        t0 = time.perf_counter()
-        run(1)  # compile
+        with obs.span("profile_stage", stage="compile_first"):
+            t0 = time.perf_counter()
+            run(1)  # compile
         print(f"# compile+first {time.perf_counter() - t0:.1f}s", file=sys.stderr)
-        t0 = time.perf_counter()
-        run(2)  # warm
-        warm = time.perf_counter() - t0
+        with obs.span("profile_stage", stage="warm"):
+            t0 = time.perf_counter()
+            run(2)  # warm
+            warm = time.perf_counter() - t0
         print(f"# warm {warm:.1f}s ({warm * 1000 / args.trees:.1f} ms/tree)",
               file=sys.stderr)
         os.makedirs(args.trace_dir, exist_ok=True)
         with jax.profiler.trace(args.trace_dir):
-            t0 = time.perf_counter()
-            run(3)
-            traced = time.perf_counter() - t0
+            with obs.span("profile_stage", stage="traced_run"):
+                t0 = time.perf_counter()
+                run(3)
+                traced = time.perf_counter() - t0
         print(f"# traced run {traced:.1f}s", file=sys.stderr)
+        host = obs.write_trace_json(
+            os.path.join(args.trace_dir, "host_trace.json"),
+            meta={"tool": "trace_fit", "rows": args.rows,
+                  "trees": args.trees, "mode": args.mode},
+        )
+        if host:
+            print(f"# host trace: {host}", file=sys.stderr)
     parse_trace(args.trace_dir)
 
 

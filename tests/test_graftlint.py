@@ -614,6 +614,69 @@ def test_jgl007_suppression_comment_holds_it_back():
     assert [f.line for f in res.suppressed] == [4]
 
 
+# --------------------------------------------------------------- JGL009
+
+
+JGL009_BAD = """\
+import time
+
+def slow(work):
+    t0 = time.time()
+    work()
+    dt = time.time() - t0               # line 6
+    deadline = start - time.time()      # line 7: either operand
+    return dt, deadline
+"""
+
+JGL009_BAD_ALIASED = """\
+from time import time
+
+def slow(work):
+    begin = time()
+    work()
+    return time() - begin               # line 6
+"""
+
+JGL009_GOOD = """\
+import time
+
+def slow(work):
+    t0 = time.perf_counter()
+    work()
+    dt = time.perf_counter() - t0       # monotonic duration: fine
+    stamp = time.time()                 # timestamp, no arithmetic: fine
+    return {"dur_s": dt, "created_unix": stamp}
+"""
+
+
+def test_jgl009_fires_on_walltime_durations():
+    assert _lines(JGL009_BAD, "JGL009") == [6, 7]
+
+
+def test_jgl009_resolves_from_time_import():
+    assert _lines(JGL009_BAD_ALIASED, "JGL009") == [6]
+
+
+def test_jgl009_quiet_on_monotonic_and_bare_timestamps():
+    assert _lines(JGL009_GOOD, "JGL009") == []
+    # A tainted name in a subtraction IS a duration, even at module
+    # level (name-based taint, the linter's stated precision).
+    tainted = JGL009_GOOD + "start_unix = time.time()\nage = start_unix - 5\n"
+    assert _lines(tainted, "JGL009") == [10]
+
+
+def test_jgl009_exempts_observability_and_honors_suppressions():
+    rel = "ate_replication_causalml_tpu/observability/events.py"
+    assert _lines(JGL009_BAD, "JGL009", relpath=rel) == []
+    src = JGL009_BAD.replace(
+        "    dt = time.time() - t0               # line 6",
+        "    dt = time.time() - t0  # graftlint: disable=JGL009",
+    )
+    res = lint_source(src, relpath="pkg/mod.py", select=["JGL009"])
+    assert [f.line for f in res.findings] == [7]
+    assert [f.line for f in res.suppressed] == [6]
+
+
 # ----------------------------------------------------- suppressions etc.
 
 
@@ -671,7 +734,7 @@ def test_rule_registry_has_at_least_six_active_rules():
     jgl = [r for r in RULES if r.startswith("JGL") and r != PARSE_ERROR_ID]
     assert len(jgl) >= 6
     assert {"JGL001", "JGL002", "JGL003", "JGL004", "JGL005", "JGL006",
-            "JGL008"} <= set(jgl)
+            "JGL008", "JGL009"} <= set(jgl)
 
 
 def test_reporters_render():

@@ -62,6 +62,21 @@ def test_full_sweep_and_resume(tmp_path):
     for fig in report.figure_paths:
         assert os.path.basename(fig) in md
 
+    # Trace artifacts (ISSUE 5) ride the same concurrent run for free:
+    # catapult-valid trace.json + an internally consistent overlap
+    # report (Σ busy ≤ wall × workers; critical path ≥ longest node).
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+    import check_metrics_schema as _cms
+
+    assert _cms.validate_trace_files(out) == []
+    rep_ov = json.load(open(os.path.join(out, "overlap_report.json")))
+    assert rep_ov["nodes"] == 21  # 14 stages + 7 artifacts
+    assert rep_ov["busy_total_s"] <= rep_ov["wall_s"] * rep_ov["workers"] + 1e-6
+    assert rep_ov["critical_path_s"] >= rep_ov["longest_node_s"] - 1e-9
+    assert "mesh" in rep_ov["serialization"]["lanes"]
+
     # The journal keeps the declared notebook order even though the
     # default scheduler ran stages concurrently (ISSUE 4: commits are
     # ordered; completion order must never leak into results.jsonl).

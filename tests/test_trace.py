@@ -1,0 +1,415 @@
+"""Trace timeline & critical-path tests (ISSUE 5): catapult exporter
+round-trip, critical-path math on hand-built DAGs with known answers,
+the overlap report for a micro sweep in sequential and concurrent
+modes, and bit-identity of sweep rows with tracing on vs off."""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from ate_replication_causalml_tpu import observability as obs
+from ate_replication_causalml_tpu.observability import critical_path as cp
+from ate_replication_causalml_tpu.observability import trace as trace_mod
+from ate_replication_causalml_tpu.observability.events import EventLog
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+import check_metrics_schema as cms  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    obs.set_enabled(True)
+    obs.REGISTRY.reset()
+    obs.EVENTS.clear()
+    yield
+    obs.set_enabled(None)
+
+
+# ── exporter round-trip (no jax) ────────────────────────────────────────
+
+
+def _scheduler_log() -> EventLog:
+    """A miniature scheduler run: artifact A feeding stage S1, a laned
+    stage S2, a commit, a prefetch compile, a chaos-style instant inside
+    S1, and a counter sample."""
+    log = EventLog()
+    with log.span("run_sweep", out="x"):
+        with log.span("scheduler_node", node="A", kind="artifact", lane="",
+                      worker="w0", stage_idx=1, needs=""):
+            time.sleep(0.002)
+        with log.span("scheduler_node", node="S2", kind="stage",
+                      lane="mesh", worker="w0", stage_idx=2, needs=""):
+            time.sleep(0.002)
+        with log.span("scheduler_node", node="S1", kind="stage", lane="",
+                      worker="w0", stage_idx=1, needs="A"):
+            log.emit("chaos_inject", status="injected", scope="stage",
+                     site="S1")
+            time.sleep(0.002)
+        with log.span("commit", stage="S1", stage_idx=1, track="committer"):
+            pass
+        with log.span("prefetch_compile", node="S2", track="prefetch"):
+            pass
+        log.emit("metric_sample", status="sample",
+                 metric="nuisance_cache_requests_total", value=2.0)
+    return log
+
+
+def test_exporter_roundtrip_is_catapult_valid_and_stable():
+    log = _scheduler_log()
+    trace = trace_mod.build_trace(log.records())
+    assert cms.validate_trace(trace) == []
+    # Deterministic: same records -> byte-identical trace (stable tids,
+    # stable ordering) — the "tracks stable" exporter contract.
+    assert trace == trace_mod.build_trace(log.records())
+
+    events = trace["traceEvents"]
+    tracks = {
+        ev["args"]["name"]: ev["tid"]
+        for ev in events
+        if ev.get("ph") == "M" and ev["name"] == "thread_name"
+    }
+    # Worker thread, lane, prefetch and committer all have tracks.
+    assert {"MainThread", "lane:mesh", "committer", "prefetch"} <= set(tracks)
+
+    # Spans nest: every X slice lies inside the run_sweep envelope.
+    slices = [ev for ev in events if ev.get("ph") == "X"]
+    run = next(ev for ev in slices if ev["name"] == "run_sweep")
+    for ev in slices:
+        assert ev["ts"] >= run["ts"] - 1e-6
+        assert ev["ts"] + ev["dur"] <= run["ts"] + run["dur"] + 1e-6
+
+    # The laned node renders on BOTH its worker track and the lane track.
+    s2 = [ev for ev in slices if ev["name"] == "S2" and ev["cat"] in ("node", "lane")]
+    assert {ev["tid"] for ev in s2} == {tracks["MainThread"], tracks["lane:mesh"]}
+
+    # Wall anchor: monotonic origin + unix anchor both present.
+    other = trace["otherData"]
+    assert other["clock"] == "monotonic"
+    assert isinstance(other["wall_anchor_unix"], float)
+
+
+def test_flows_link_artifact_to_consumer_slices():
+    log = _scheduler_log()
+    trace = trace_mod.build_trace(log.records())
+    events = trace["traceEvents"]
+    starts = [ev for ev in events if ev.get("ph") == "s" and ev["cat"] == "dep"]
+    ends = [ev for ev in events if ev.get("ph") == "f" and ev["cat"] == "dep"]
+    assert len(starts) == len(ends) == 1  # A -> S1, the only declared edge
+    a = next(ev for ev in events if ev.get("ph") == "X" and ev["name"] == "A")
+    s1 = next(ev for ev in events
+              if ev.get("ph") == "X" and ev["name"] == "S1"
+              and ev["cat"] == "node")
+    assert starts[0]["id"] == ends[0]["id"]
+    assert abs(starts[0]["ts"] - (a["ts"] + a["dur"])) < 1e-6
+    assert abs(ends[0]["ts"] - s1["ts"]) < 1e-6
+
+
+def test_instants_and_counters_land_on_the_right_tracks():
+    log = _scheduler_log()
+    trace = trace_mod.build_trace(log.records())
+    events = trace["traceEvents"]
+    tracks = {
+        ev["args"]["name"]: ev["tid"]
+        for ev in events
+        if ev.get("ph") == "M" and ev["name"] == "thread_name"
+    }
+    # The chaos instant inherits its ENCLOSING span's track (the worker
+    # running S1), not a synthetic one of its own.
+    inst = next(ev for ev in events
+                if ev.get("ph") == "i" and ev["name"] == "chaos_inject")
+    assert inst["tid"] == tracks["MainThread"]
+    counters = [ev for ev in events if ev.get("ph") == "C"]
+    assert [c["name"] for c in counters] == ["nuisance_cache_requests_total"]
+    assert counters[0]["args"]["value"] == 2.0
+    assert "nuisance_cache_requests_total" in trace["otherData"]["counter_series"]
+
+
+def test_trace_validator_rejects_garbage():
+    log = _scheduler_log()
+    trace = trace_mod.build_trace(log.records())
+    bad = json.loads(json.dumps(trace))
+    bad["traceEvents"].append({"name": "x", "ph": "??", "pid": 1, "ts": 0})
+    assert any("unknown phase" in e for e in cms.validate_trace(bad))
+    bad2 = json.loads(json.dumps(trace))
+    bad2["traceEvents"].append(
+        {"name": "orphan", "cat": "dep", "ph": "f", "id": 999, "pid": 1,
+         "tid": 1, "ts": 0}
+    )
+    assert any("no matching start" in e for e in cms.validate_trace(bad2))
+    bad3 = json.loads(json.dumps(trace))
+    bad3["traceEvents"].append(
+        {"name": "stray", "ph": "X", "pid": 1, "tid": 777, "ts": 0, "dur": 1}
+    )
+    assert any("no thread_name" in e for e in cms.validate_trace(bad3))
+
+
+# ── critical-path math (no jax) ─────────────────────────────────────────
+
+
+def _mk_trace(nodes, workers=None, wall_s=None):
+    """Hand-build a catapult trace for analyzer tests. ``nodes`` are
+    (name, kind, lane, track, start_s, dur_s, needs) tuples."""
+    tracks = {}
+    events = []
+    for name, kind, lane, track, start, dur, needs in nodes:
+        tid = tracks.setdefault(track, len(tracks) + 1)
+        events.append({
+            "name": name, "cat": "node", "ph": "X", "pid": 1, "tid": tid,
+            "ts": start * 1e6, "dur": dur * 1e6,
+            "args": {"node": name, "kind": kind, "lane": lane,
+                     "needs": ",".join(needs), "stage_idx": 0},
+        })
+    meta = [
+        {"name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+         "args": {"name": track}}
+        for track, tid in tracks.items()
+    ]
+    other = {"wall_anchor_unix": 0.0}
+    if workers is not None:
+        other["workers"] = workers
+    if wall_s is not None:
+        other["wall_s"] = wall_s
+    return {"traceEvents": meta + events, "otherData": other}
+
+
+def test_critical_path_dependency_chain_beats_isolated_node():
+    # w1: A[0,5] -> (dep) w2: S1[5.1, 3.9]; w3: S3[0,8] isolated.
+    trace = _mk_trace([
+        ("A", "artifact", "", "w1", 0.0, 5.0, ()),
+        ("S1", "stage", "", "w2", 5.1, 3.9, ("A",)),
+        ("S3", "stage", "", "w3", 0.0, 8.0, ()),
+    ], workers=3, wall_s=9.0)
+    rep = cp.overlap_report(trace)
+    assert [e["name"] for e in rep["critical_path"]] == ["A", "S1"]
+    assert rep["critical_path_s"] == pytest.approx(8.9)
+    # S1's wait behind its predecessor is the 0.1 s scheduling gap.
+    assert rep["critical_path"][1]["wait_s"] == pytest.approx(0.1)
+    assert rep["longest_node_s"] == pytest.approx(8.0)
+    assert rep["critical_path_s"] >= rep["longest_node_s"]
+    assert rep["busy_total_s"] == pytest.approx(16.9)
+    assert rep["overlap_efficiency"] == pytest.approx(16.9 / 27.0, abs=1e-3)
+
+
+def test_critical_path_single_long_node_wins():
+    trace = _mk_trace([
+        ("A", "artifact", "", "w1", 0.0, 5.0, ()),
+        ("S1", "stage", "", "w1", 5.0, 3.0, ("A",)),
+        ("S2", "stage", "", "w2", 0.0, 10.0, ()),
+    ], workers=2, wall_s=10.0)
+    rep = cp.overlap_report(trace)
+    assert [e["name"] for e in rep["critical_path"]] == ["S2"]
+    assert rep["critical_path_s"] == pytest.approx(10.0)
+
+
+def test_critical_path_sequential_is_the_full_execution_order():
+    # One track: the same-track edges chain EVERY node, so the path is
+    # the whole run in execution order and its length is the busy sum.
+    seq = [
+        ("A", "artifact", "", "main", 0.0, 1.0, ()),
+        ("S1", "stage", "", "main", 1.0, 2.0, ("A",)),
+        ("S2", "stage", "mesh", "main", 3.0, 0.5, ()),
+        ("S3", "stage", "", "main", 3.5, 1.5, ()),
+    ]
+    rep = cp.overlap_report(_mk_trace(seq, workers=1, wall_s=5.0))
+    assert [e["name"] for e in rep["critical_path"]] == ["A", "S1", "S2", "S3"]
+    assert rep["critical_path_s"] == pytest.approx(5.0)
+    assert rep["overlap_efficiency"] == pytest.approx(1.0)
+    assert rep["serialization"]["lanes"] == {
+        "mesh": {"busy_s": 0.5, "nodes": 1, "occupancy": 0.1}
+    }
+    assert cms.validate_overlap(rep) == []
+
+
+def test_overlap_validator_rejects_inconsistency():
+    rep = cp.overlap_report(_mk_trace(
+        [("A", "artifact", "", "w1", 0.0, 2.0, ())], workers=1, wall_s=2.0
+    ))
+    assert cms.validate_overlap(rep) == []
+    broken = dict(rep, busy_total_s=99.0)
+    assert any("exceeds" in e for e in cms.validate_overlap(broken))
+    broken2 = dict(rep, critical_path_s=0.0, longest_node_s=5.0)
+    assert any("shorter" in e for e in cms.validate_overlap(broken2))
+    assert any("missing key" in e for e in cms.validate_overlap({}))
+
+
+def test_metric_sampler_units():
+    obs.counter("nuisance_cache_requests_total").inc(3, artifact="a")
+    sampler = obs.MetricSampler()
+    sampler.sample_once()
+    recs = [r for r in obs.EVENTS.records() if r["name"] == "metric_sample"]
+    # Only the families that exist produce samples.
+    assert [r["attrs"]["metric"] for r in recs] == [
+        "nuisance_cache_requests_total"
+    ]
+    assert recs[0]["attrs"]["value"] == 3.0
+    obs.set_enabled(False)
+    sampler.sample_once()
+    assert [r for r in obs.EVENTS.records() if r["name"] == "metric_sample"] == recs
+
+
+# ── micro-sweep integration ─────────────────────────────────────────────
+
+
+#: The sequential engine executes nodes in priority order — each
+#: artifact immediately before its earliest declared consumer — so the
+#: critical path of a sequential run is THIS list, deterministically
+#: (the acceptance contract; drifts when stage/artifact declarations in
+#: pipeline.py change).
+SEQUENTIAL_ORDER = [
+    "oracle", "naive", "Direct Method",
+    "p_logistic", "Propensity_Weighting", "Propensity_Regression",
+    "folds:ps_lasso", "lasso_ps", "Propensity_Weighting_LASSOPS",
+    "folds:seq_lasso", "Single-equation LASSO",
+    "folds:usual_lasso", "Usual LASSO",
+    "outcome_mu", "rf_oob_propensity",
+    "Doubly Robust with Random Forest PS",
+    "Doubly Robust with logistic regression PS",
+    "Belloni et.al", "Double Machine Learning", "residual_balancing",
+    "Causal Forest(GRF)",
+]
+
+
+def _rows(outdir):
+    rows = {}
+    for line in open(os.path.join(outdir, "results.jsonl")):
+        if not line.strip():
+            continue
+        rec = json.loads(line)
+        if rec["method"] != "__config__":
+            rows[rec["method"]] = (rec["ate"], rec["se"], rec["lower_ci"],
+                                   rec["upper_ci"])
+    return rows
+
+
+@pytest.fixture(scope="module")
+def seq_traced(tmp_path_factory):
+    """ONE traced sequential micro sweep shared by the integration
+    tests below (the suite's tier-1 budget: every extra micro sweep is
+    ~10 s of wall-clock)."""
+    from test_pipeline_driver import MICRO
+
+    from ate_replication_causalml_tpu.pipeline import run_sweep
+
+    obs.set_enabled(True)
+    obs.REGISTRY.reset()
+    obs.EVENTS.clear()
+    out = str(tmp_path_factory.mktemp("trace_sweep") / "seq")
+    run_sweep(MICRO, outdir=out, plots=False, log=lambda s: None,
+              scheduler="sequential")
+    return out
+
+
+def test_sweep_trace_sequential_deterministic_and_bit_identical(
+    seq_traced, tmp_path
+):
+    """Sequential micro sweep with tracing: catapult-valid trace.json,
+    deterministic critical path (= the declared execution order), a
+    clean overlap report, the analyzer CLI reproducing it, and rows
+    bit-identical to an untraced run."""
+    from test_pipeline_driver import MICRO
+
+    from ate_replication_causalml_tpu.pipeline import run_sweep
+
+    out = seq_traced
+    tpath = os.path.join(out, "trace.json")
+    opath = os.path.join(out, "overlap_report.json")
+    assert os.path.exists(tpath) and os.path.exists(opath)
+    assert cms.validate_trace_files(out) == []
+
+    trace = json.load(open(tpath))
+    assert trace["otherData"]["workers"] == 1
+    rep = json.load(open(opath))
+    assert [e["name"] for e in rep["critical_path"]] == SEQUENTIAL_ORDER
+    assert rep["workers"] == 1
+    # Sequential: one worker track carries every node; busy ≤ wall.
+    assert rep["busy_total_s"] <= rep["wall_s"] + 1e-6
+    assert rep["critical_path_s"] >= rep["longest_node_s"] - 1e-9
+    # The mesh lane exists on this 8-device test backend.
+    assert "mesh" in rep["serialization"]["lanes"]
+    assert rep["serialization"]["committer"]["commits"] == 14  # 13 + oracle
+
+    # Flow arrows: the shared logistic propensity feeds ≥ 2 stages.
+    flows = [ev for ev in trace["traceEvents"]
+             if ev.get("ph") == "s" and ev.get("cat") == "dep"]
+    assert sum(ev["name"] == "p_logistic" for ev in flows) >= 2
+
+    # Analyzer CLI reproduces the report bit-for-bit from the trace.
+    import analyze_trace
+
+    out2 = str(tmp_path / "cli_report.json")
+    assert analyze_trace.main([tpath, "--out", out2]) == 0
+    assert json.load(open(out2)) == rep
+
+    # ATE_TPU_TRACE=0 gating, cheaply (a resume recomputes nothing):
+    # no trace artifacts, same rows. The full recompute-untraced
+    # comparison is the @slow cross-mode test below — computation can't
+    # see the exporter at all (it runs after the last commit), and the
+    # strictly stronger telemetry-off bit-identity is tier-1 in
+    # test_observability.
+    import shutil
+
+    out_off = str(tmp_path / "seq_off")
+    os.makedirs(out_off)
+    shutil.copy(os.path.join(out, "results.jsonl"),
+                os.path.join(out_off, "results.jsonl"))
+    os.environ["ATE_TPU_TRACE"] = "0"
+    try:
+        run_sweep(MICRO, outdir=out_off, plots=False, log=lambda s: None,
+                  scheduler="sequential")
+        assert not os.path.exists(os.path.join(out_off, "trace.json"))
+        assert not os.path.exists(
+            os.path.join(out_off, "overlap_report.json")
+        )
+        # metrics/events still export — only the trace gate is off.
+        assert os.path.exists(os.path.join(out_off, "metrics.json"))
+    finally:
+        os.environ.pop("ATE_TPU_TRACE", None)
+    assert _rows(out_off) == _rows(out)
+
+
+@pytest.mark.slow
+def test_sweep_trace_concurrent_internally_consistent(seq_traced, tmp_path):
+    """Concurrent micro sweep with tracing: valid artifacts, Σ busy ≤
+    wall × workers, critical path ≥ longest node, and rows bit-identical
+    to the sequential reference.
+
+    @slow for the tier-1 budget: the cheap concurrent-mode coverage
+    rides the TINY default-scheduler sweep in
+    test_pipeline_driver.test_full_sweep_and_resume (no extra sweep);
+    this test adds the dedicated 2-worker run with the background
+    counter sampler and the cross-mode row comparison."""
+    from test_pipeline_driver import MICRO
+
+    from ate_replication_causalml_tpu.pipeline import run_sweep
+
+    out = str(tmp_path / "con")
+    run_sweep(MICRO, outdir=out, plots=False, log=lambda s: None,
+              scheduler="concurrent", workers=2)
+    assert cms.validate_trace_files(out) == []
+    rep = json.load(open(os.path.join(out, "overlap_report.json")))
+    assert rep["workers"] == 2
+    assert rep["nodes"] == len(SEQUENTIAL_ORDER)
+    assert rep["busy_total_s"] <= rep["wall_s"] * 2 + 1e-6
+    assert rep["critical_path_s"] >= rep["longest_node_s"] - 1e-9
+    assert 0.0 < rep["overlap_efficiency"] <= 1.0 + 1e-9
+    # Multi-worker runs sample counter tracks in the background.
+    trace = json.load(open(os.path.join(out, "trace.json")))
+    assert any(ev.get("ph") == "C" for ev in trace["traceEvents"])
+    # Journal order stays declared order; values match the sequential
+    # run bit-for-bit (same process, same executables — ISSUE 4's
+    # contract, now asserted THROUGH the tracing layer being on).
+    assert _rows(out) == _rows(seq_traced)
+    # And the full recompute with tracing OFF matches both: the
+    # acceptance bit-identity of traced vs untraced rows.
+    out_off = str(tmp_path / "untraced")
+    os.environ["ATE_TPU_TRACE"] = "0"
+    try:
+        run_sweep(MICRO, outdir=out_off, plots=False, log=lambda s: None,
+                  scheduler="sequential")
+        assert not os.path.exists(os.path.join(out_off, "trace.json"))
+    finally:
+        os.environ.pop("ATE_TPU_TRACE", None)
+    assert _rows(out_off) == _rows(seq_traced)
