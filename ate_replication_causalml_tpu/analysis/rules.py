@@ -872,23 +872,27 @@ class UnlockedSchedulerState(UnlockedSharedState):
     journal's in-memory row map are mutated from a worker pool; any
     mutation outside the sanctioned instance lock can tear the ordered
     commit sequence or interleave journal appends. Same engine as
-    JGL006, rescoped to ``scheduler/`` and the pipeline drivers (the
-    ``_Checkpoint`` class lives in ``pipeline.py``)."""
+    JGL006, rescoped to ``scheduler/``, ``serving/`` and the pipeline
+    drivers (the ``_Checkpoint`` class lives in ``pipeline.py``).
+    ``serving/`` joined with ISSUE 6: the daemon is the most
+    thread-shared code in the tree — per-connection reader threads, the
+    coalescer's dispatcher, and the degraded-mode reload thread all
+    touch the same model/executable/queue state."""
 
     id = "JGL008"
     name = "unlocked-scheduler-state"
     description = (
-        "scheduler/ or pipeline checkpoint class mutates lock-guarded "
-        "shared state outside the sanctioned instance lock"
+        "scheduler/, serving/ or pipeline checkpoint class mutates "
+        "lock-guarded shared state outside the sanctioned instance lock"
     )
-    _context = "scheduler/checkpoint shared state"
+    _context = "scheduler/serving/checkpoint shared state"
 
     def _in_scope(self, relpath: str) -> bool:
         # Only the top-level driver (<pkg>/pipeline.py) hosts
         # _Checkpoint; a bare endswith would also rope in
         # data/pipeline.py and any future nested pipeline.py.
         parts = relpath.replace("\\", "/").split("/")
-        return "scheduler/" in relpath or (
+        return "scheduler/" in relpath or "serving/" in relpath or (
             parts[-1] == "pipeline.py" and len(parts) <= 2
         )
 

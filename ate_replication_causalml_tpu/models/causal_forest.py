@@ -893,13 +893,12 @@ def predict_cate(
     )
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "oob", "tree_chunk", "row_chunk", "row_backend", "variance_compat"
-    ),
+_PREDICT_CATE_STATICS = (
+    "oob", "tree_chunk", "row_chunk", "row_backend", "variance_compat"
 )
-def _predict_cate_traced(
+
+
+def _predict_cate_impl(
     forest: CausalForest,
     x: jax.Array,
     oob: bool,
@@ -909,7 +908,10 @@ def _predict_cate_traced(
     row_backend: str,
     variance_compat: str,
 ) -> CatePredictions:
-    """:func:`predict_cate`'s jitted body (``row_backend`` concrete)."""
+    """:func:`predict_cate`'s traceable body (``row_backend`` concrete).
+    Jitted twice below: :data:`_predict_cate_traced` (the dispatcher's
+    body) and :data:`_predict_cate_donated` (the serving variant that
+    donates the query buffer — see :func:`lower_predict_cate`)."""
     if oob and x.shape[0] != forest.in_sample.shape[1]:
         raise ValueError(
             "oob=True is only valid for the training matrix: forest was "
@@ -1111,9 +1113,62 @@ def _predict_cate_traced(
     return CatePredictions(cate=tau, variance=variance)
 
 
+_predict_cate_traced = functools.partial(
+    jax.jit, static_argnames=_PREDICT_CATE_STATICS
+)(_predict_cate_impl)
+
+# Serving variant (ISSUE 6): identical computation, but the query
+# buffer is DONATED — the daemon pads every micro-batch into a fresh
+# device array, so XLA may reuse that buffer for outputs instead of
+# holding both live per in-flight batch. Split from the dispatcher's
+# jit because donation is part of the executable's calling convention:
+# offline callers (tests, notebook predict) must keep their inputs.
+_predict_cate_donated = functools.partial(
+    jax.jit, static_argnames=_PREDICT_CATE_STATICS, donate_argnums=(1,)
+)(_predict_cate_impl)
+
+
 # The dispatcher keeps the jitted body's cache controls (tests rebuild
 # traces with monkeypatched internals via predict_cate.clear_cache()).
 predict_cate.clear_cache = _predict_cate_traced.clear_cache
+
+
+def lower_predict_cate(
+    forest: CausalForest,
+    batch: int,
+    *,
+    oob: bool = False,
+    tree_chunk: int = 32,
+    row_chunk: int = 65536,
+    row_backend: str | None = None,
+    variance_compat: str = "unbiased",
+    donate: bool | None = None,
+) -> jax.stages.Lowered:
+    """AOT-lower the CATE predict executable for a fixed ``(batch, p)``
+    query shape (ISSUE 6, the serving daemon's startup phase).
+
+    Returns a ``jax.stages.Lowered``; ``.compile()`` yields the
+    executable the daemon dispatches as ``compiled(forest, x, None)``
+    (the trailing ``None`` is the empty ``leaf_index`` pytree — serving
+    rows are new data, never the cached training routing). The forest
+    enters as a RUNTIME argument, not a closed-over constant, so a
+    degraded-mode checkpoint reload with identical shapes reuses the
+    same executable without recompiling.
+
+    ``donate=None`` donates the query buffer only on TPU — the CPU
+    backend ignores donation with a warning per call, which a daemon
+    would emit thousands of times."""
+    if row_backend is None:
+        row_backend = "pallas" if jax.default_backend() == "tpu" else "matmul"
+    if donate is None:
+        donate = jax.default_backend() == "tpu"
+    p = forest.bin_edges.shape[0]
+    x_spec = jax.ShapeDtypeStruct((int(batch), p), jnp.float32)
+    fn = _predict_cate_donated if donate else _predict_cate_traced
+    return fn.lower(
+        forest, x_spec, oob, tree_chunk, row_chunk, None, row_backend,
+        variance_compat,
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("clip",))

@@ -1309,6 +1309,32 @@ def _mean_reduce(leaf_vals):
     return leaf_vals.mean(axis=0), votes.mean(axis=0)
 
 
+def _predict_forest_new_rows(forest: Forest, x: jax.Array) -> ForestPredictions:
+    """:func:`predict_forest` restricted to ``oob=False`` as one
+    traceable body — the AOT serving target. The oob branch needs the
+    concrete training-matrix fingerprint check, which a fixed-shape
+    serving executable can never perform (and serving rows are new data
+    by definition)."""
+    codes = binarize(x, forest.bin_edges)
+    prob, vote = _mean_reduce(forest_apply(forest, codes))
+    return ForestPredictions(prob=prob, vote=vote)
+
+
+_predict_forest_serving = jax.jit(_predict_forest_new_rows)
+
+
+def lower_predict_forest(forest: Forest, batch: int) -> jax.stages.Lowered:
+    """AOT-lower the classifier-forest predict executable for a fixed
+    ``(batch, p)`` query shape (ISSUE 6 — the serving-parity entry point
+    next to :func:`~..models.causal_forest.lower_predict_cate`).
+    ``.compile()`` yields the executable dispatched as
+    ``compiled(forest, x)``; the forest is a runtime argument, so a
+    same-shape reload reuses the executable."""
+    p = forest.bin_edges.shape[0]
+    x_spec = jax.ShapeDtypeStruct((int(batch), p), jnp.float32)
+    return _predict_forest_serving.lower(forest, x_spec)
+
+
 def fit_forest_sharded(
     x: jax.Array,
     y: jax.Array,

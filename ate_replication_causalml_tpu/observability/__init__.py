@@ -22,6 +22,7 @@ import time
 from typing import Callable
 
 from ate_replication_causalml_tpu.observability.device import (
+    compile_event_count,
     install_jax_monitoring,
     record_compiled_cost,
     record_device_memory,
@@ -42,9 +43,12 @@ from ate_replication_causalml_tpu.observability.export import (
     write_run_artifacts,
 )
 from ate_replication_causalml_tpu.observability.registry import (
+    DEFAULT_LATENCY_BUCKETS,
     REGISTRY,
     SCHEMA_VERSION,
+    BucketHistogram,
     MetricsRegistry,
+    bucket_histogram,
     counter,
     enabled,
     gauge,
@@ -60,10 +64,12 @@ from ate_replication_causalml_tpu.observability.trace import (
 )
 
 __all__ = [
-    "EVENTS", "EventLog", "MetricSampler", "MetricsRegistry", "REGISTRY",
-    "SCHEMA_VERSION",
+    "DEFAULT_LATENCY_BUCKETS",
+    "EVENTS", "EventLog", "BucketHistogram", "MetricSampler",
+    "MetricsRegistry", "REGISTRY", "SCHEMA_VERSION",
     "atomic_file", "atomic_write_json", "atomic_write_text",
-    "bench_record", "build_trace", "counter",
+    "bench_record", "bucket_histogram", "build_trace",
+    "compile_event_count", "counter",
     "emit", "enabled", "gauge", "histogram", "install_jax_monitoring",
     "instrument_dispatch", "record_compiled_cost", "record_device_memory",
     "sanitize_label", "set_enabled", "span", "trace_enabled",

@@ -32,6 +32,7 @@ import os
 
 from ate_replication_causalml_tpu.observability.registry import (
     REGISTRY,
+    bucket_histogram,
     counter,
     enabled,
     gauge,
@@ -48,6 +49,17 @@ _CACHE_EVENT_COUNTERS = {
 _CACHE_DURATION_METRICS = {
     "/jax/compilation_cache/compile_time_saved_sec": "compile_cache_time_saved_seconds",
     "/jax/compilation_cache/cache_retrieval_time_sec": "compile_cache_retrieval_seconds",
+}
+
+#: jax.monitoring duration events that mean "jax traced / lowered /
+#: backend-compiled something", bridged into jax_compiles_total{kind=}.
+#: This counter is the serving daemon's steady-state no-compile PROOF
+#: (ISSUE 6): after startup, a serving window must leave it unchanged —
+#: asserted from the registry, not inferred from timings.
+_COMPILE_EVENT_KINDS = {
+    "/jax/core/compile/jaxpr_trace_duration": "trace",
+    "/jax/core/compile/jaxpr_to_mlir_module_duration": "lower",
+    "/jax/core/compile/backend_compile_duration": "backend_compile",
 }
 
 _installed = False
@@ -88,6 +100,18 @@ def install_jax_monitoring() -> bool:
             ).inc(0)
     counter("scheduler_prefetch_total",
             "compile-prefetch lane outcomes by stage and status").inc(0)
+    # Serving families (ISSUE 6): the daemon's request/reject counters
+    # and the compile-event bridge are contract families too — a bench
+    # that never serves exports explicit zeros, and the bucket-histogram
+    # ladder is fixed here once so every emitter shares it.
+    counter("serving_requests_total",
+            "CATE serving requests by terminal status").inc(0)
+    counter("serving_rejected_total",
+            "CATE serving rejections by reason").inc(0)
+    counter("jax_compiles_total",
+            "jax trace/lower/backend-compile events by kind").inc(0)
+    bucket_histogram("serving_request_seconds",
+                     "served request latency (enqueue to reply)")
     if _installed:
         return True
     try:
@@ -104,6 +128,12 @@ def install_jax_monitoring() -> bool:
         name = _CACHE_DURATION_METRICS.get(event)
         if name is not None:
             histogram(name).observe(duration_secs)
+        kind = _COMPILE_EVENT_KINDS.get(event)
+        if kind is not None:
+            counter("jax_compiles_total").inc(1, kind=kind)
+            histogram("jax_compile_seconds",
+                      "jax trace/lower/compile durations by kind"
+                      ).observe(duration_secs, kind=kind)
 
     try:
         monitoring.register_event_listener(on_event)
@@ -112,6 +142,17 @@ def install_jax_monitoring() -> bool:
         return False
     _installed = True
     return True
+
+
+def compile_event_count() -> float:
+    """Total jax trace/lower/backend-compile events recorded so far (all
+    kinds summed). The serving daemon marks this at the end of its
+    startup phase and asserts a zero delta over the serving window —
+    the "steady state provably never traces or compiles" enforcement.
+    Requires :func:`install_jax_monitoring` to be active; 0.0 before
+    any event."""
+    vals = REGISTRY.peek("jax_compiles_total")
+    return float(sum(vals.values())) if vals else 0.0
 
 
 def _scan_cache_dir(cache_dir: str) -> tuple[int, int]:

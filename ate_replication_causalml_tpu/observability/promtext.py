@@ -43,7 +43,17 @@ def _prom_labels(label_key: str) -> str:
     return "{" + ",".join(parts) + "}"
 
 
-def _render_sections(counters: dict, gauges: dict, histograms: dict) -> str:
+def _labels_with_le(label_key: str, le: str) -> str:
+    """Registry label-key plus the Prometheus ``le`` bucket label."""
+    base = _prom_labels(label_key)
+    pair = f'le="{le}"'
+    if not base:
+        return "{" + pair + "}"
+    return base[:-1] + "," + pair + "}"
+
+
+def _render_sections(counters: dict, gauges: dict, histograms: dict,
+                     bucket_histograms: dict | None = None) -> str:
     lines: list[str] = []
 
     def family(name: str, ptype: str, samples: dict, render_sample):
@@ -70,6 +80,25 @@ def _render_sections(counters: dict, gauges: dict, histograms: dict) -> str:
             lines.append(f"{pname}_sum{lb} {s['sum']!r}")
             lines.append(f"{pname}_min{lb} {s['min']!r}")
             lines.append(f"{pname}_max{lb} {s['max']!r}")
+    # Bucketed histograms are REAL Prometheus histograms: cumulative
+    # `_bucket{le=...}` series ending at +Inf == `_count`, so quantiles
+    # recompute server-side via histogram_quantile().
+    for name, samples in sorted((bucket_histograms or {}).items()):
+        pname = _prom_name(name)
+        lines.append(f"# TYPE {pname} histogram")
+        for key, s in sorted(samples.items()):
+            cum = 0
+            for bound, c in zip(s["bounds"], s["buckets"]):
+                cum += c
+                lines.append(
+                    f"{pname}_bucket{_labels_with_le(key, repr(bound))} {cum}"
+                )
+            lines.append(
+                f"{pname}_bucket{_labels_with_le(key, '+Inf')} {s['count']}"
+            )
+            lb = _prom_labels(key)
+            lines.append(f"{pname}_sum{lb} {s['sum']!r}")
+            lines.append(f"{pname}_count{lb} {s['count']}")
     return "\n".join(lines) + ("\n" if lines else "")
 
 
@@ -83,7 +112,7 @@ def render_prom_text(
 def render_prom_from_snapshot(snap: dict) -> str:
     return _render_sections(
         snap.get("counters", {}), snap.get("gauges", {}),
-        snap.get("histograms", {}),
+        snap.get("histograms", {}), snap.get("bucket_histograms", {}),
     )
 
 

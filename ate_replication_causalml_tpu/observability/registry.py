@@ -16,11 +16,12 @@ estimator outputs are bit-identical with telemetry on or off.
 
 from __future__ import annotations
 
+import bisect
 import os
 import re
 import threading
 import time
-from typing import Callable, Iterator
+from typing import Callable, Iterator, Sequence
 
 _ENV = "ATE_TPU_TELEMETRY"
 _enabled_cache: bool | None = None
@@ -124,7 +125,8 @@ class Histogram:
     Deliberately bucket-free — the consumers here (regression triage,
     the bench records) want totals and extremes, and a summary exports
     to the Prometheus text format without fixing bucket boundaries
-    that million-row and 2k-row runs would never share.
+    that million-row and 2k-row runs would never share. Tail-latency
+    consumers (the serving daemon) use :class:`BucketHistogram`.
     """
 
     kind = "histogram"
@@ -153,6 +155,88 @@ class Histogram:
                 s["min"] = min(s["min"], value)
                 s["max"] = max(s["max"], value)
                 s["last"] = value
+
+
+#: Default bucket bounds for :class:`BucketHistogram`: log-spaced
+#: (factor 2) from 100 µs to ~52 s — one fixed ladder that resolves
+#: both a sub-millisecond served request and a multi-second AOT
+#: compile, so every serving latency family shares comparable buckets.
+DEFAULT_LATENCY_BUCKETS: tuple[float, ...] = tuple(
+    1e-4 * 2.0**k for k in range(20)
+)
+
+
+class BucketHistogram:
+    """Bucketed histogram: fixed ascending upper bounds plus an
+    overflow bucket, with count/sum/min/max per label set (ISSUE 6).
+
+    The summary :class:`Histogram` deliberately has no buckets — right
+    for bench totals, useless for tail latency. A serving daemon needs
+    p50/p95/p99 over thousands of requests without keeping raw samples,
+    which is exactly what fixed buckets buy: quantiles are estimated at
+    snapshot time as the upper bound of the bucket where the cumulative
+    count crosses the quantile (Prometheus-style, conservative), clamped
+    to the observed max. Bounds are fixed at family creation —
+    re-registering with different bounds raises, since merged samples
+    across mismatched ladders would be garbage.
+    """
+
+    kind = "bucket_histogram"
+
+    def __init__(self, name: str, help: str, lock: threading.RLock,
+                 bounds: Sequence[float] = DEFAULT_LATENCY_BUCKETS):
+        bounds = tuple(float(b) for b in bounds)
+        if not bounds or any(
+            b2 <= b1 for b1, b2 in zip(bounds, bounds[1:])
+        ):
+            raise ValueError(
+                f"bucket histogram {name}: bounds must be non-empty and "
+                f"strictly ascending, got {bounds!r}"
+            )
+        self.name = name
+        self.help = help
+        self.bounds = bounds
+        self._lock = lock
+        self.samples: dict[str, dict] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        if not enabled():
+            return
+        value = float(value)
+        idx = bisect.bisect_left(self.bounds, value)  # le semantics
+        key = _label_key(labels)
+        with self._lock:
+            s = self.samples.get(key)
+            if s is None:
+                s = self.samples[key] = {
+                    "count": 0, "sum": 0.0, "min": value, "max": value,
+                    "buckets": [0] * (len(self.bounds) + 1),
+                }
+            s["count"] += 1
+            s["sum"] += value
+            s["min"] = min(s["min"], value)
+            s["max"] = max(s["max"], value)
+            s["buckets"][idx] += 1
+
+    def _quantile(self, s: dict, q: float) -> float:
+        target = q * s["count"]
+        cum = 0
+        for i, c in enumerate(s["buckets"]):
+            cum += c
+            if cum >= target and c:
+                if i >= len(self.bounds):
+                    return s["max"]
+                return min(self.bounds[i], s["max"])
+        return s["max"]
+
+    def snapshot_sample(self, s: dict) -> dict:
+        """The metrics.json payload for one label set: raw buckets plus
+        the bounds ladder (so a saved snapshot is self-describing) and
+        the derived p50/p95/p99."""
+        out = dict(s, buckets=list(s["buckets"]), bounds=list(self.bounds))
+        for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+            out[key] = self._quantile(s, q)
+        return out
 
 
 class MetricsRegistry:
@@ -191,6 +275,37 @@ class MetricsRegistry:
     def histogram(self, name: str, help: str = "") -> Histogram:
         return self._get(Histogram, name, help)
 
+    def bucket_histogram(
+        self, name: str, help: str = "",
+        bounds: Sequence[float] | None = None,
+    ) -> BucketHistogram:
+        """Bucketed (quantile-capable) histogram family. ``bounds``
+        fixes the ladder on first creation (default log-spaced
+        :data:`DEFAULT_LATENCY_BUCKETS`); passing different bounds for
+        an existing family raises — samples across mismatched ladders
+        cannot be merged."""
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = BucketHistogram(
+                    name, help, self._lock,
+                    bounds=DEFAULT_LATENCY_BUCKETS if bounds is None
+                    else bounds,
+                )
+                self._metrics[name] = m
+            elif not isinstance(m, BucketHistogram):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}"
+                )
+            elif bounds is not None and tuple(
+                float(b) for b in bounds
+            ) != m.bounds:
+                raise ValueError(
+                    f"bucket histogram {name!r} already registered with "
+                    f"bounds {m.bounds!r}"
+                )
+            return m
+
     def add_collector(self, fn: Callable[[], None]) -> None:
         with self._lock:
             if fn not in self._collectors:
@@ -210,7 +325,7 @@ class MetricsRegistry:
             m = self._metrics.get(name)
             if m is None:
                 return None
-            if m.kind == "histogram":
+            if m.kind in ("histogram", "bucket_histogram"):
                 return {k: float(v["sum"]) for k, v in m.samples.items()}
             return dict(m.samples)
 
@@ -230,6 +345,7 @@ class MetricsRegistry:
             "counters": {},
             "gauges": {},
             "histograms": {},
+            "bucket_histograms": {},
         }
         with self._lock:
             for m in self._metrics.values():
@@ -238,8 +354,12 @@ class MetricsRegistry:
                     # while telemetry was disabled) are noise, not data.
                     continue
                 section = out[m.kind + "s"]
+                render = getattr(m, "snapshot_sample", None)
                 section[m.name] = {
-                    k: (dict(v) if isinstance(v, dict) else v)
+                    k: (
+                        render(v) if render is not None
+                        else dict(v) if isinstance(v, dict) else v
+                    )
                     for k, v in m.samples.items()
                 }
         return out
@@ -265,3 +385,9 @@ def gauge(name: str, help: str = "") -> Gauge:
 
 def histogram(name: str, help: str = "") -> Histogram:
     return REGISTRY.histogram(name, help)
+
+
+def bucket_histogram(
+    name: str, help: str = "", bounds: Sequence[float] | None = None
+) -> BucketHistogram:
+    return REGISTRY.bucket_histogram(name, help, bounds=bounds)

@@ -23,6 +23,15 @@ item is ``key=value`` or a bare flag. Scopes and their keys:
 * ``stage`` — ``fail=<substring>``: the first ``times`` sweep stages
   whose method name contains the substring raise
   :class:`~.errors.ChaosStageFault` (exercising graceful degradation).
+* ``serve`` — ``p`` (selection probability per request id), ``seed``,
+  ``times`` (faulting attempts per selected id, default 1): the serving
+  daemon consults :meth:`ChaosInjector.take_serve_fault` per request;
+  a selected request's first ``times`` attempts draw
+  :class:`~.errors.ChaosServeFault` — the daemon answers with a typed
+  retry-after reject and its degraded-mode recovery. Selection hashes
+  the CLIENT-supplied request id, so with a client that retries under
+  the same id the planned reject set is identical run to run and a
+  chaos-free rerun of the same stream is bit-identical.
 
 Injection decisions are pure functions of ``(seed, scope, site)`` —
 never of call order or a global RNG — so a chaos run is reproducible
@@ -61,6 +70,7 @@ _SCOPE_SCHEMA: dict[str, dict[str, type]] = {
     "fs": {"torn_write": bool, "corrupt_npz": bool, "times": int},
     "device": {"drop": int, "times": int},
     "stage": {"fail": str, "times": int},
+    "serve": {"p": float, "seed": int, "times": int},
 }
 
 _SCOPE_DEFAULTS: dict[str, dict[str, object]] = {
@@ -68,6 +78,7 @@ _SCOPE_DEFAULTS: dict[str, dict[str, object]] = {
     "fs": {"torn_write": False, "corrupt_npz": False, "times": 1},
     "device": {"drop": 0, "times": 0},  # times=0: every probe
     "stage": {"fail": "", "times": 1},
+    "serve": {"p": 0.0, "seed": 0, "times": 1},
 }
 
 
@@ -184,6 +195,7 @@ class ChaosInjector:
         self._device_unlimited = bool(dev) and int(dev["times"]) == 0
         stage = config.scope("stage")
         self._stage_left = int(stage["times"]) if stage else 0
+        self._serve_attempts: dict[str, int] = {}
 
     # ── bookkeeping ───────────────────────────────────────────────────
 
@@ -328,6 +340,35 @@ class ChaosInjector:
         return frozenset(
             m for m in methods if self.take_stage_fault(m, record=False)
         )
+
+    # ── serve scope ───────────────────────────────────────────────────
+
+    def take_serve_fault(self, request_id: str | int) -> bool:
+        """Serving-request injection point: whether THIS attempt of
+        ``request_id`` draws an injected fault. Selection is the pure
+        ``(seed, "serve", id)`` hash — per id, not per arrival order —
+        and a selected id's first ``times`` attempts fault (mirroring
+        the ``shard`` scope's per-site semantics), so a client that
+        retries under the same id converges: attempt ``times``+1 is
+        served. With client-stable ids the planned reject set is
+        identical run to run regardless of server-side concurrency."""
+        cfg = self.config.scope("serve")
+        if cfg is None or cfg["p"] <= 0.0:
+            return False
+        rid = str(request_id)
+        # Selection first (pure hash, stateless): attempt bookkeeping is
+        # kept ONLY for selected ids, so a long soak at small p does not
+        # grow the attempts dict by every request id ever seen.
+        if _unit(int(cfg["seed"]), "serve", rid) >= float(cfg["p"]):
+            return False
+        with self._lock:
+            attempt = self._serve_attempts.get(rid, 0) + 1
+            self._serve_attempts[rid] = attempt
+        if attempt > int(cfg["times"]):
+            return False
+        self._record("serve", f"req/{rid}", request_id=rid,
+                     attempt=attempt)
+        return True
 
     def maybe_fail_stage(self, method: str) -> None:
         """Sweep-stage injection point: raise for the first ``times``

@@ -42,6 +42,14 @@ class ChaosStageFault(ChaosFault):
     """Injected at a sweep stage boundary (``pipeline.stage``)."""
 
 
+class ChaosServeFault(ChaosFault):
+    """Injected at the serving daemon's request boundary (``serving/``).
+    The daemon answers it with a typed reject-with-retry-after and walks
+    its degraded-mode recovery (checkpoint re-verify + reload), so the
+    injection proves the client-visible contract: never a crash, never a
+    wrong value, just a bounded retry."""
+
+
 class ChaosSpecError(ValueError):
     """The ``ATE_TPU_CHAOS`` spec string does not parse. A ValueError —
     a malformed chaos config is a programming error, fatal-fast, never

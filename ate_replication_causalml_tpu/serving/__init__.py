@@ -1,0 +1,61 @@
+"""CATE serving daemon (ISSUE 6): AOT-compiled predict-as-a-service.
+
+The subsystem splits along the jax boundary:
+
+* no-jax core (importable anywhere, unit-tested in tier-1):
+  :mod:`.protocol` (length-prefixed framing), :mod:`.coalescer`
+  (deadline-window micro-batching onto compiled buckets),
+  :mod:`.admission` (bounded-depth admission control + the
+  lifecycle/reload state machine), :mod:`.client`;
+* the daemon itself (:mod:`.daemon`): verified checkpoint load, one
+  AOT-compiled predict executable per declared batch bucket, a
+  dispatcher whose steady state provably never compiles, and
+  degraded-mode serving under the ``serve:`` chaos scope.
+
+Entry points: ``scripts/serve.py`` (daemon CLI),
+``scripts/serve_client.py`` (load-gen/demo client), ``bench.py
+--serving`` (the ``serving_quick`` record).
+"""
+
+from ate_replication_causalml_tpu.serving.admission import (
+    AdmissionController,
+    InvalidTransition,
+    ReloadSupervisor,
+    ServingLifecycle,
+)
+from ate_replication_causalml_tpu.serving.client import (
+    CateClient,
+    ServingError,
+    ServingUnavailable,
+)
+from ate_replication_causalml_tpu.serving.coalescer import (
+    Batch,
+    BucketPlan,
+    Coalescer,
+    PendingRequest,
+)
+from ate_replication_causalml_tpu.serving.protocol import (
+    ProtocolError,
+    encode_frame,
+    decode_frame,
+    read_frame,
+    write_frame,
+)
+
+__all__ = [
+    "AdmissionController", "Batch", "BucketPlan", "CateClient",
+    "CateServer", "Coalescer", "InvalidTransition", "PendingRequest",
+    "ProtocolError", "RejectedRequest", "ReloadSupervisor", "ServeConfig",
+    "ServingError", "ServingLifecycle", "ServingUnavailable",
+    "decode_frame", "encode_frame", "read_frame", "write_frame",
+]
+
+
+def __getattr__(name):
+    # The daemon pulls in jax at startup; resolve it lazily so the
+    # no-jax core (client hosts, tier-1 protocol tests) stays light.
+    if name in ("CateServer", "ServeConfig", "RejectedRequest"):
+        from ate_replication_causalml_tpu.serving import daemon
+
+        return getattr(daemon, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

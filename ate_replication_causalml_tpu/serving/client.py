@@ -1,0 +1,142 @@
+"""Client for the CATE serving daemon (ISSUE 6 — no jax).
+
+Speaks the length-prefixed protocol over either transport:
+
+* TCP — :meth:`CateClient.connect` (the production shape: many clients,
+  one daemon, micro-batching across connections);
+* subprocess stdio — :meth:`CateClient.spawn_stdio` (hermetic tests and
+  one-shot tooling: the client owns the daemon's lifetime).
+
+Typed rejects (``overloaded`` / ``serve_fault`` / ``degraded``) are
+retried after the server's ``retry_after_s`` hint under the SAME
+request id — ids are the client's idempotency key: the chaos harness
+selects faults by id, so a retrying client converges deterministically
+and a chaos run's final answers are bit-identical to a fault-free run.
+"""
+
+from __future__ import annotations
+
+import itertools
+import subprocess
+import socket
+import time
+
+import numpy as np
+
+from ate_replication_causalml_tpu.serving import protocol
+
+
+class ServingError(RuntimeError):
+    """Terminal (non-retryable) server reply; carries the wire code."""
+
+    def __init__(self, code: str, message: str):
+        super().__init__(f"{code}: {message}")
+        self.code = code
+
+
+class ServingUnavailable(ServingError):
+    """Retry budget exhausted on retryable rejects."""
+
+    def __init__(self, code: str, message: str, attempts: int):
+        super().__init__(code, f"{message} (after {attempts} attempts)")
+        self.attempts = attempts
+
+
+#: Reject codes worth retrying after the server's hint.
+RETRYABLE = ("overloaded", "serve_fault", "degraded", "starting")
+
+
+class CateClient:
+    """One connection to a serving daemon."""
+
+    def __init__(self, rstream, wstream, *, proc=None, sock=None):
+        self._r = rstream
+        self._w = wstream
+        self._proc = proc
+        self._sock = sock
+        self._seq = itertools.count(1)
+
+    @classmethod
+    def connect(cls, host: str, port: int, timeout: float = 10.0
+                ) -> "CateClient":
+        sock = socket.create_connection((host, port), timeout=timeout)
+        sock.settimeout(timeout)
+        rw = sock.makefile("rwb")
+        return cls(rw, rw, sock=sock)
+
+    @classmethod
+    def spawn_stdio(cls, argv: list[str], **popen_kw) -> "CateClient":
+        """Launch ``argv`` (a ``scripts/serve.py --stdio`` command line)
+        and speak the protocol over its pipes; stderr passes through."""
+        proc = subprocess.Popen(
+            argv, stdin=subprocess.PIPE, stdout=subprocess.PIPE, **popen_kw
+        )
+        return cls(proc.stdout, proc.stdin, proc=proc)
+
+    def close(self) -> None:
+        for stream in (self._w, self._r):
+            try:
+                stream.close()
+            except OSError:
+                pass
+        if self._sock is not None:
+            self._sock.close()
+        if self._proc is not None:
+            self._proc.wait(timeout=10)
+
+    # ── ops ──────────────────────────────────────────────────────────
+
+    def _roundtrip(self, header: dict, arrays=None):
+        protocol.write_frame(self._w, header, arrays)
+        frame = protocol.read_frame(self._r)
+        if frame is None:
+            raise ServingError("closed", "server closed the connection")
+        return frame
+
+    def predict(
+        self,
+        x: np.ndarray,
+        request_id: str | None = None,
+        max_retries: int = 16,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """``(cate, variance)`` for the rows of ``x``. Retryable rejects
+        honor the server's retry-after under the same id; anything else
+        raises :class:`ServingError` typed with the wire code."""
+        rid = str(request_id) if request_id is not None else f"c{next(self._seq)}"
+        x = np.ascontiguousarray(x, dtype=np.float32)
+        for attempt in range(1, max_retries + 2):
+            header, arrays = self._roundtrip(
+                {"op": "predict", "id": rid}, {"x": x}
+            )
+            if header.get("ok"):
+                return arrays["cate"], arrays["variance"]
+            code = header.get("error", "error")
+            if code not in RETRYABLE or attempt > max_retries:
+                if code in RETRYABLE:
+                    raise ServingUnavailable(
+                        code, header.get("message", ""), attempt
+                    )
+                raise ServingError(code, header.get("message", ""))
+            time.sleep(float(header.get("retry_after_s", 0.05)))
+        raise AssertionError("unreachable")
+
+    def ping(self) -> dict:
+        header, _ = self._roundtrip({"op": "ping"})
+        return header
+
+    def stats(self) -> dict:
+        header, _ = self._roundtrip({"op": "stats"})
+        if not header.get("ok"):
+            raise ServingError(header.get("error", "error"),
+                               header.get("message", ""))
+        return header["stats"]
+
+    def shutdown(self) -> None:
+        """Ask the daemon to exit (acknowledged before it stops)."""
+        self._roundtrip({"op": "shutdown"})
+
+    def __enter__(self) -> "CateClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,230 @@
+"""Request coalescer: micro-batch within a deadline window, pad to the
+nearest compiled bucket (ISSUE 6, the serving core — no jax).
+
+The daemon AOT-compiles one predict executable per declared batch size
+(the :class:`BucketPlan`). Requests arrive one at a time; dispatching
+each alone would waste the large buckets, while waiting indefinitely to
+fill one would trade worst-case latency for throughput. The
+:class:`Coalescer` takes the standard middle road: accumulate FIFO, and
+close a batch the moment it cannot grow (the next waiter would overflow
+the largest bucket) or the moment the OLDEST waiter's deadline window
+expires — so no request waits more than ``window_s`` for co-travellers,
+and a burst packs densely without any timer firing.
+
+The batch then rides the smallest bucket that fits (pad rows are zeros,
+masked out by construction: every per-row aggregation in the predict
+executable is row-independent, so garbage rows produce garbage outputs
+that are simply never sliced back — the bit-identity tests pin this).
+
+All timing is injectable (``clock=``) so the deadline math is testable
+without sleeping, and monotonic — wall-clock jumps must not flush or
+starve batches (graftlint JGL009).
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import threading
+import time
+from typing import Callable, NamedTuple
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketPlan:
+    """The declared batch shapes the daemon compiled, ascending."""
+
+    sizes: tuple[int, ...]
+
+    def __post_init__(self):
+        if not self.sizes:
+            raise ValueError("bucket plan needs at least one batch size")
+        sizes = tuple(int(s) for s in self.sizes)
+        if any(s < 1 for s in sizes) or any(
+            b <= a for a, b in zip(sizes, sizes[1:])
+        ):
+            raise ValueError(
+                f"bucket sizes must be positive and strictly ascending, "
+                f"got {self.sizes!r}"
+            )
+        object.__setattr__(self, "sizes", sizes)
+
+    @classmethod
+    def parse(cls, spec: str) -> "BucketPlan":
+        """Parse the ``ATE_TPU_SERVE_BUCKETS`` form (``"1,8,64,256"``).
+        Order-insensitive and duplicate-tolerant on input; the plan
+        itself is canonical (sorted, deduped)."""
+        try:
+            sizes = sorted({int(s) for s in spec.split(",") if s.strip()})
+        except ValueError as e:
+            raise ValueError(f"bad bucket spec {spec!r}: {e}") from e
+        return cls(tuple(sizes))
+
+    @property
+    def max_rows(self) -> int:
+        return self.sizes[-1]
+
+    def bucket_for(self, rows: int) -> int | None:
+        """Smallest declared size that fits ``rows`` (None when even the
+        largest bucket is too small — the caller rejects, typed)."""
+        if rows < 1:
+            raise ValueError(f"rows must be >= 1, got {rows}")
+        idx = bisect.bisect_left(self.sizes, rows)
+        return None if idx == len(self.sizes) else self.sizes[idx]
+
+
+class PendingRequest:
+    """One admitted request travelling through the coalescer. The
+    producer blocks on :meth:`wait`; the dispatcher fills exactly one of
+    ``result`` / ``error`` and fires the event. Timing marks are
+    monotonic and used for the latency histogram."""
+
+    __slots__ = (
+        "request_id", "x", "rows", "enqueued_mono", "resolved_mono",
+        "result", "error", "_done",
+    )
+
+    def __init__(self, request_id: str, x, rows: int, enqueued_mono: float):
+        self.request_id = request_id
+        self.x = x
+        self.rows = rows
+        self.enqueued_mono = enqueued_mono
+        self.resolved_mono: float | None = None
+        self.result = None
+        self.error: BaseException | None = None
+        self._done = threading.Event()
+
+    def resolve(self, result, now: float) -> None:
+        self.result = result
+        self.resolved_mono = now
+        self._done.set()
+
+    def fail(self, error: BaseException, now: float) -> None:
+        self.error = error
+        self.resolved_mono = now
+        self._done.set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self._done.wait(timeout)
+
+
+class Batch(NamedTuple):
+    """A closed batch: the requests, their real row total, the compiled
+    bucket it rides, and the fill ratio the metrics report."""
+
+    requests: tuple[PendingRequest, ...]
+    rows: int
+    bucket: int
+    fill: float
+
+
+class Coalescer:
+    """FIFO micro-batcher with a per-oldest-waiter deadline window.
+
+    Thread model: producers call :meth:`submit`; ONE dispatcher thread
+    loops on :meth:`next_batch`. All shared state lives under the
+    condition's lock (graftlint JGL008 — ``serving/`` is in the
+    unlocked-shared-state rule's scope by design)."""
+
+    def __init__(
+        self,
+        plan: BucketPlan,
+        window_s: float,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if window_s < 0:
+            raise ValueError(f"window_s must be >= 0, got {window_s}")
+        self.plan = plan
+        self.window_s = float(window_s)
+        self._clock = clock
+        self._cond = threading.Condition()
+        self._pending: list[PendingRequest] = []
+        self._closed = False
+
+    def submit(self, req: PendingRequest) -> None:
+        """Enqueue an admitted request (rows already validated against
+        ``plan.max_rows`` by the admission layer; oversize here is a
+        programming error and raises)."""
+        if req.rows > self.plan.max_rows:
+            raise ValueError(
+                f"request of {req.rows} rows exceeds the largest bucket "
+                f"({self.plan.max_rows}); the daemon must reject it typed"
+            )
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("coalescer is closed")
+            self._pending.append(req)
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        """Stop accepting work and wake the dispatcher; queued requests
+        still drain (each remaining :meth:`next_batch` call flushes
+        immediately instead of waiting out the window)."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def pending_depth(self) -> int:
+        with self._cond:
+            return len(self._pending)
+
+    # ── batch math ───────────────────────────────────────────────────
+
+    def _pack_due(self, now: float) -> Batch | None:
+        """Close a batch if one is due. The FIFO prefix that fits the
+        largest bucket is the candidate; it closes when (a) it IS the
+        largest bucket, (b) the next waiter would not fit (flushing
+        beats head-of-line blocking), (c) the oldest waiter's window
+        expired, or (d) the coalescer is draining. Re-acquires the
+        condition (an RLock underneath), so it is safe both from
+        :meth:`next_batch` and standalone in tests."""
+        with self._cond:
+            take: list[PendingRequest] = []
+            total = 0
+            for req in self._pending:
+                if total + req.rows > self.plan.max_rows:
+                    break
+                take.append(req)
+                total += req.rows
+            if not take:
+                return None
+            full = (
+                total == self.plan.max_rows
+                or len(take) < len(self._pending)
+            )
+            expired = now - take[0].enqueued_mono >= self.window_s
+            if not (full or expired or self._closed):
+                return None
+            del self._pending[: len(take)]
+            bucket = self.plan.bucket_for(total)
+            return Batch(tuple(take), total, bucket, total / bucket)
+
+    def next_batch(self, timeout: float | None = None) -> Batch | None:
+        """Dispatcher entry: block until a batch closes, the coalescer
+        is closed AND drained (returns None forever after), or
+        ``timeout`` elapses (returns None; the dispatcher re-loops so a
+        stop flag can be observed)."""
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._cond:
+            while True:
+                now = self._clock()
+                batch = self._pack_due(now)
+                if batch is not None:
+                    return batch
+                if self._closed and not self._pending:
+                    return None
+                # Sleep until the oldest waiter's window would expire,
+                # the caller's timeout, or a submit/close notification.
+                wait = None
+                if self._pending:
+                    wait = self._pending[0].enqueued_mono + self.window_s - now
+                if deadline is not None:
+                    remaining = deadline - now
+                    if remaining <= 0:
+                        return None
+                    wait = remaining if wait is None else min(wait, remaining)
+                if wait is not None and wait <= 0:
+                    # The packing condition will see the expiry on the
+                    # next loop iteration with a fresh clock read.
+                    wait = 1e-4
+                self._cond.wait(wait)

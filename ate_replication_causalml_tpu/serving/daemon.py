@@ -1,0 +1,617 @@
+"""CATE serving daemon (ISSUE 6, the tentpole).
+
+The predict path does 1M rows of CATE + variance in ~1.4 s steady, but
+every fresh process pays a ~25-30 s trace/deserialize tail (NEXT.md §3:
+"irreducible without ahead-of-time tracing or a persistent daemon").
+This is the daemon: a long-lived process that pays the tail ONCE, as an
+explicit startup phase, and then serves τ̂(x) (+ variance) queries whose
+steady state provably never traces or compiles.
+
+Startup phases (each a span + a ``serving_startup_seconds`` gauge):
+
+1. **load** — ``utils/checkpoint.load_fitted`` with SHA-256
+   verification; a torn or tampered forest checkpoint refuses to serve.
+2. **aot** — one ``jax.jit(...).lower().compile()`` predict executable
+   per declared batch bucket (``lower_predict_cate``; the same AOT
+   machinery as ``scheduler/prefetch.py``), forest as a *runtime*
+   argument so reloads reuse executables.
+3. **warm** — one zero-batch dispatch per bucket, absorbing the
+   first-dispatch transfer/conversion compiles.
+
+After warm, the compile-event counter (``jax_compiles_total``, bridged
+from ``jax.monitoring``) is marked; :meth:`CateServer.stop` asserts the
+serving window left it unchanged — the no-compile guarantee is enforced
+from the metrics registry, not hoped.
+
+The serving core is the no-jax trio this module wires together:
+admission (bounded depth, typed reject-on-overload), the coalescer
+(micro-batch within a deadline window, pad to the nearest compiled
+bucket), and the lifecycle/reload supervisor (degraded-mode serving:
+on a fault — injected via the ``serve:`` chaos scope or real — requests
+get typed retry-after rejects while the checkpoint is re-verified and
+reloaded, then serving resumes; values after recovery are bit-identical
+because the model is the same verified bytes).
+
+Every protocol request gets a ``serving_request`` span; latencies ride
+the ``serving_request_seconds`` bucket histogram, queue depth and batch
+fill the registry, and everything exports through the same atomic
+``metrics.json`` path as the sweep.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+
+from ate_replication_causalml_tpu import observability as obs
+from ate_replication_causalml_tpu.resilience import chaos
+from ate_replication_causalml_tpu.serving import protocol
+from ate_replication_causalml_tpu.serving.admission import (
+    AdmissionController,
+    ReloadSupervisor,
+    ServingLifecycle,
+)
+from ate_replication_causalml_tpu.serving.coalescer import (
+    Batch,
+    BucketPlan,
+    Coalescer,
+    PendingRequest,
+)
+
+ENV_BUCKETS = "ATE_TPU_SERVE_BUCKETS"
+ENV_WINDOW_MS = "ATE_TPU_SERVE_WINDOW_MS"
+ENV_DEPTH = "ATE_TPU_SERVE_DEPTH"
+ENV_RETRY_AFTER_MS = "ATE_TPU_SERVE_RETRY_AFTER_MS"
+
+DEFAULT_BUCKETS = "1,8,64,256"
+DEFAULT_WINDOW_MS = 2.0
+DEFAULT_DEPTH = 64
+DEFAULT_RETRY_AFTER_MS = 50.0
+
+
+class RejectedRequest(RuntimeError):
+    """A typed reject: carries the wire ``error`` code and the
+    retry-after hint. Raised out of :meth:`CateServer.serve_one` only
+    for callers that asked (``raise_rejects=True``); the protocol layer
+    turns it into a reject frame instead."""
+
+    def __init__(self, code: str, message: str, retry_after_s: float | None):
+        super().__init__(f"{code}: {message}")
+        self.code = code
+        self.message = message
+        self.retry_after_s = retry_after_s
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Daemon configuration; :meth:`from_env` reads the
+    ``ATE_TPU_SERVE_*`` knobs documented in the README."""
+
+    checkpoint: str
+    buckets: BucketPlan = dataclasses.field(
+        default_factory=lambda: BucketPlan.parse(DEFAULT_BUCKETS)
+    )
+    window_s: float = DEFAULT_WINDOW_MS / 1e3
+    max_depth: int = DEFAULT_DEPTH
+    retry_after_s: float = DEFAULT_RETRY_AFTER_MS / 1e3
+    row_backend: str | None = None
+    variance_compat: str = "unbiased"
+    donate: bool | None = None
+    tree_chunk: int = 32
+    #: stop() raises if the serving window recorded any compile event;
+    #: the enforcement knob exists for diagnostics, not for production.
+    strict_no_compile: bool = True
+
+    @classmethod
+    def from_env(cls, checkpoint: str, **overrides) -> "ServeConfig":
+        env = os.environ
+        base = dict(
+            buckets=BucketPlan.parse(env.get(ENV_BUCKETS, DEFAULT_BUCKETS)),
+            window_s=float(env.get(ENV_WINDOW_MS, DEFAULT_WINDOW_MS)) / 1e3,
+            max_depth=int(env.get(ENV_DEPTH, DEFAULT_DEPTH)),
+            retry_after_s=float(
+                env.get(ENV_RETRY_AFTER_MS, DEFAULT_RETRY_AFTER_MS)
+            ) / 1e3,
+        )
+        base.update(overrides)
+        return cls(checkpoint=checkpoint, **base)
+
+
+class CateServer:
+    """The serving core: verified load → AOT → warm → steady dispatch.
+
+    Thread model: any number of producer threads call
+    :meth:`serve_one` / :meth:`submit`; ONE dispatcher thread owns the
+    device (jax dispatch is serialized by design — the scheduler PR
+    established that concurrent device entry buys nothing on one chip
+    and can deadlock collectives). Shared state (the model reference,
+    the executable table) is mutated only under ``self._lock``
+    (graftlint JGL008 covers ``serving/``).
+    """
+
+    def __init__(self, config: ServeConfig):
+        self.config = config
+        self.lifecycle = ServingLifecycle()
+        self.admission = AdmissionController(config.max_depth)
+        self.coalescer = Coalescer(config.buckets, config.window_s)
+        self._lock = threading.RLock()
+        self._model = None
+        self._executables: dict[int, object] = {}
+        self._n_features: int | None = None
+        # None until startup completes: a daemon stopped before its
+        # warm phase has no serving window to enforce.
+        self._compile_mark: float | None = None
+        self._startup_s: dict[str, float] = {}
+        self._dispatcher: threading.Thread | None = None
+        self._reloader = ReloadSupervisor(
+            self.lifecycle, self._load_checkpoint, self._install_model
+        )
+        self._requests = obs.counter(
+            "serving_requests_total", "CATE serving requests by terminal status"
+        )
+        self._rejects = obs.counter(
+            "serving_rejected_total", "CATE serving rejections by reason"
+        )
+        self._batches = obs.counter(
+            "serving_batches_total", "dispatched micro-batches by bucket"
+        )
+        self._latency = obs.bucket_histogram(
+            "serving_request_seconds", "served request latency (enqueue to reply)"
+        )
+        self._fill = obs.bucket_histogram(
+            "serving_batch_fill",
+            "micro-batch fill ratio (real rows / bucket rows)",
+            bounds=(0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
+        )
+
+    # ── startup ──────────────────────────────────────────────────────
+
+    def _load_checkpoint(self):
+        """SHA-256-verified model load; accepts a ``FittedCausalForest``
+        or a bare ``CausalForest`` checkpoint. Raises
+        ``CheckpointCorrupt`` (startup: refuse to serve; degraded
+        reload: stay degraded) on any integrity failure."""
+        from ate_replication_causalml_tpu.models.causal_forest import (
+            CausalForest,
+            FittedCausalForest,
+        )
+        from ate_replication_causalml_tpu.utils.checkpoint import load_fitted
+
+        obj = load_fitted(self.config.checkpoint, verify=True)
+        forest = obj.forest if isinstance(obj, FittedCausalForest) else obj
+        if not isinstance(forest, CausalForest):
+            raise TypeError(
+                f"checkpoint {self.config.checkpoint!r} holds "
+                f"{type(obj).__name__}, not a causal forest"
+            )
+        return forest
+
+    def _install_model(self, forest) -> None:
+        """Swap the served model (startup and verified reloads). The
+        executables are keyed to the forest's SHAPES — a reload with a
+        different geometry would need a re-AOT, which degraded mode
+        refuses (same-shape redeploys are the supported hot path)."""
+        with self._lock:
+            old = self._model
+            if old is not None and (
+                old.split_feat.shape != forest.split_feat.shape
+                or old.bin_edges.shape != forest.bin_edges.shape
+            ):
+                raise ValueError(
+                    "reloaded checkpoint changed forest geometry "
+                    f"({old.split_feat.shape} -> {forest.split_feat.shape}); "
+                    "restart the daemon to re-AOT"
+                )
+            self._model = forest
+            self._n_features = int(forest.bin_edges.shape[0])
+
+    def startup(self) -> dict[str, float]:
+        """Run the three startup phases; returns their seconds (also
+        exported as ``serving_startup_seconds{phase=}`` gauges)."""
+        from ate_replication_causalml_tpu.models.causal_forest import (
+            lower_predict_cate,
+        )
+
+        obs.install_jax_monitoring()
+        import jax
+
+        phases: dict[str, float] = {}
+        with obs.span("serving_startup", checkpoint=self.config.checkpoint):
+            t0 = time.perf_counter()
+            with obs.span("serving_load"):
+                self._install_model(self._load_checkpoint())
+            phases["load"] = time.perf_counter() - t0
+
+            t0 = time.perf_counter()
+            with self._lock:
+                model = self._model
+            for bucket in self.config.buckets.sizes:
+                with obs.span("serving_aot_compile", bucket=bucket):
+                    compiled = lower_predict_cate(
+                        model,
+                        bucket,
+                        oob=False,
+                        tree_chunk=self.config.tree_chunk,
+                        row_backend=self.config.row_backend,
+                        variance_compat=self.config.variance_compat,
+                        donate=self.config.donate,
+                    ).compile()
+                with self._lock:
+                    self._executables[bucket] = compiled
+            phases["aot"] = time.perf_counter() - t0
+
+            t0 = time.perf_counter()
+            with obs.span("serving_warm"):
+                p = self._n_features
+                for bucket in self.config.buckets.sizes:
+                    zeros = jax.device_put(np.zeros((bucket, p), np.float32))
+                    out = self._executables[bucket](model, zeros, None)
+                    np.asarray(out.cate), np.asarray(out.variance)
+            phases["warm"] = time.perf_counter() - t0
+
+        g = obs.gauge(
+            "serving_startup_seconds", "daemon startup phase durations"
+        )
+        for phase, secs in phases.items():
+            g.set(secs, phase=phase)
+        with self._lock:
+            self._startup_s = dict(phases)
+            self._compile_mark = obs.compile_event_count()
+        self.lifecycle.mark_ready()
+        self._start_dispatcher()
+        return phases
+
+    def _start_dispatcher(self) -> None:
+        with self._lock:
+            t = threading.Thread(
+                target=self._dispatch_loop, name="serving-dispatch",
+                daemon=True,
+            )
+            self._dispatcher = t
+        t.start()
+
+    # ── request path (producers) ─────────────────────────────────────
+
+    def _reject(self, code: str, message: str,
+                retry_after_s: float | None = None) -> RejectedRequest:
+        self._rejects.inc(1, reason=code)
+        self._requests.inc(1, status=f"rejected_{code}")
+        return RejectedRequest(code, message, retry_after_s)
+
+    def submit(self, request_id: str, x: np.ndarray) -> PendingRequest:
+        """Admission + chaos + coalesce. Returns the pending handle the
+        caller waits on; raises :class:`RejectedRequest` for every typed
+        refusal (the protocol layer converts those to reject frames).
+        The admission slot is released by the dispatcher on resolve."""
+        try:
+            x = np.ascontiguousarray(x, dtype=np.float32)
+        except (TypeError, ValueError) as e:
+            # String/object/datetime queries must become a typed reject,
+            # not a connection-killing exception.
+            raise self._reject(
+                "bad_request", f"x does not convert to float32 ({e})"
+            ) from e
+        if x.ndim != 2:
+            raise self._reject("bad_request", f"x must be 2-D, got {x.shape}")
+        with self._lock:
+            p = self._n_features
+        if p is not None and x.shape[1] != p:
+            raise self._reject(
+                "bad_request", f"x has {x.shape[1]} features, model wants {p}"
+            )
+        rows = x.shape[0]
+        if rows < 1 or rows > self.config.buckets.max_rows:
+            raise self._reject(
+                "bad_request",
+                f"rows must be in [1, {self.config.buckets.max_rows}], "
+                f"got {rows} (chunk larger queries client-side)",
+            )
+        inj = chaos.active()
+        if inj is not None and inj.take_serve_fault(request_id):
+            # The injected fault walks the REAL degraded path: recovery
+            # re-verifies and reloads the checkpoint in the background
+            # while this (and any concurrent) request is refused typed.
+            self._reloader.report_fault(f"chaos:req/{request_id}")
+            raise self._reject(
+                "serve_fault",
+                "injected serving fault; degraded-mode recovery running",
+                self.config.retry_after_s,
+            )
+        if not self.lifecycle.can_serve():
+            state = self.lifecycle.state
+            raise self._reject(
+                "degraded" if state == "degraded" else state,
+                f"daemon is {state}",
+                self.config.retry_after_s,
+            )
+        if not self.admission.try_admit():
+            raise self._reject(
+                "overloaded",
+                f"admission queue at max depth {self.config.max_depth}",
+                self.config.retry_after_s,
+            )
+        req = PendingRequest(
+            str(request_id), x, rows, time.monotonic()
+        )
+        try:
+            self.coalescer.submit(req)
+        except BaseException:
+            self.admission.release()
+            raise
+        return req
+
+    def serve_one(
+        self, request_id: str, x: np.ndarray, timeout: float | None = 30.0
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Blocking request path: submit, wait, return
+        ``(cate, variance)`` for exactly the submitted rows. Every call
+        gets a ``serving_request`` span; rejects raise
+        :class:`RejectedRequest`, dispatch failures re-raise the
+        dispatcher's error."""
+        with obs.span("serving_request", request_id=str(request_id),
+                      rows=int(np.shape(x)[0]) if np.ndim(x) == 2 else -1
+                      ) as sp:
+            try:
+                req = self.submit(request_id, x)
+            except RejectedRequest as rej:
+                sp.set_status("rejected")
+                sp.set_attr("reject", rej.code)
+                raise
+            if not req.wait(timeout):
+                sp.set_status("error")
+                self._requests.inc(1, status="timeout")
+                raise TimeoutError(
+                    f"request {request_id!r} not served in {timeout}s"
+                )
+            if req.error is not None:
+                sp.set_status("error")
+                self._requests.inc(1, status="error")
+                self._latency.observe(
+                    req.resolved_mono - req.enqueued_mono, status="error"
+                )
+                raise req.error
+            self._requests.inc(1, status="ok")
+            self._latency.observe(
+                req.resolved_mono - req.enqueued_mono, status="ok"
+            )
+            return req.result
+
+    # ── dispatch (the single device-owning thread) ───────────────────
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            batch = self.coalescer.next_batch(timeout=0.25)
+            if batch is None:
+                if self.lifecycle.state == "stopped":
+                    return
+                continue
+            self._dispatch(batch)
+
+    def _dispatch(self, batch: Batch) -> None:
+        import jax
+
+        with self._lock:
+            model = self._model
+            compiled = self._executables[batch.bucket]
+            p = self._n_features
+        now = time.monotonic
+        with obs.span("serving_batch", bucket=batch.bucket,
+                      rows=batch.rows, requests=len(batch.requests)):
+            try:
+                padded = np.zeros((batch.bucket, p), np.float32)
+                off = 0
+                for req in batch.requests:
+                    padded[off:off + req.rows] = req.x
+                    off += req.rows
+                out = compiled(model, jax.device_put(padded), None)
+                cate = np.asarray(out.cate)
+                var = np.asarray(out.variance)
+            except Exception as e:
+                # A dispatch failure fails THIS batch's requests typed
+                # and walks degraded recovery; the daemon itself
+                # survives (never-crash is the serving contract).
+                for req in batch.requests:
+                    req.fail(e, now())
+                    self.admission.release()
+                self._reloader.report_fault(
+                    f"dispatch:{type(e).__name__}"
+                )
+                return
+            off = 0
+            for req in batch.requests:
+                req.resolve(
+                    (cate[off:off + req.rows].copy(),
+                     var[off:off + req.rows].copy()),
+                    now(),
+                )
+                off += req.rows
+                self.admission.release()
+        self._batches.inc(1, bucket=batch.bucket)
+        self._fill.observe(batch.fill, bucket=batch.bucket)
+
+    # ── proof + shutdown ─────────────────────────────────────────────
+
+    def compile_events_in_window(self) -> float:
+        """Compile/trace events since startup marked the counter — the
+        steady-state no-compile proof term. MUST be 0 while serving
+        (0.0 before startup completes: no window yet)."""
+        with self._lock:
+            mark = self._compile_mark
+        if mark is None:
+            return 0.0
+        return obs.compile_event_count() - mark
+
+    def startup_seconds(self) -> dict[str, float]:
+        with self._lock:
+            return dict(self._startup_s)
+
+    def stats(self) -> dict:
+        """The ``stats`` op payload: state, depth, startup phases, and
+        the no-compile window term."""
+        return {
+            "state": self.lifecycle.state,
+            "queue_depth": self.admission.depth,
+            "pending": self.coalescer.pending_depth(),
+            "buckets": list(self.config.buckets.sizes),
+            "startup_seconds": self.startup_seconds(),
+            "compile_events_in_window": self.compile_events_in_window(),
+            "faults": self.lifecycle.fault_count,
+            "reloads": self.lifecycle.reload_count,
+        }
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Drain, stop the dispatcher, export telemetry (when
+        ``$ATE_TPU_METRICS_DIR`` is set) and ENFORCE the no-compile
+        guarantee: any compile event inside the serving window raises
+        (``strict_no_compile=False`` downgrades to an error event for
+        diagnostics runs)."""
+        self._reloader.join(timeout)
+        self.coalescer.close()
+        self.lifecycle.mark_stopped()
+        with self._lock:
+            t = self._dispatcher
+        if t is not None:
+            t.join(timeout)
+        leaked = self.compile_events_in_window()
+        obs.gauge(
+            "serving_compile_events_in_window",
+            "compile events recorded during the serving window (must be 0)",
+        ).set(leaked)
+        outdir = os.environ.get("ATE_TPU_METRICS_DIR")
+        if outdir:
+            try:
+                obs.write_run_artifacts(outdir)
+            except Exception as e:
+                # Telemetry export must never mask the serving outcome.
+                obs.emit("serving_export_failed", status="error",
+                         error=f"{type(e).__name__}: {e}")
+        if leaked:
+            obs.emit("serving_compile_in_window", status="error",
+                     events=leaked)
+            if self.config.strict_no_compile:
+                raise RuntimeError(
+                    f"serving window recorded {leaked:g} jax compile/trace "
+                    "events — the steady state must never compile"
+                )
+
+
+# ── wire serving (socket / stdio) ────────────────────────────────────
+
+
+def _handle_op(server: CateServer, header: dict, arrays: dict):
+    """One request frame → one reply ``(header, arrays, stop?)``."""
+    op = header.get("op")
+    rid = str(header.get("id", ""))
+    if op == "predict":
+        x = arrays.get("x")
+        if x is None:
+            return {"ok": False, "id": rid, "error": "bad_request",
+                    "message": "predict needs an 'x' array"}, {}, False
+        try:
+            cate, var = server.serve_one(rid, x)
+        except RejectedRequest as rej:
+            reply = {"ok": False, "id": rid, "error": rej.code,
+                     "message": rej.message}
+            if rej.retry_after_s is not None:
+                reply["retry_after_s"] = rej.retry_after_s
+            return reply, {}, False
+        except Exception as e:
+            # The wire contract is "always a reply": any request-scoped
+            # failure — dispatch error, timeout, a validation case the
+            # typed rejects missed — becomes an error frame, never a
+            # dead connection (recorded; the daemon itself survives).
+            obs.emit("serving_request_error", status="error",
+                     request_id=rid, error=f"{type(e).__name__}: {e}")
+            return {"ok": False, "id": rid, "error": "error",
+                    "message": f"{type(e).__name__}: {e}"}, {}, False
+        return (
+            {"ok": True, "id": rid},
+            {"cate": cate, "variance": var},
+            False,
+        )
+    if op == "ping":
+        return {"ok": True, "op": "ping",
+                "state": server.lifecycle.state}, {}, False
+    if op == "stats":
+        return {"ok": True, "op": "stats", "stats": server.stats()}, {}, False
+    if op == "shutdown":
+        return {"ok": True, "op": "shutdown"}, {}, True
+    return {"ok": False, "error": "bad_request",
+            "message": f"unknown op {op!r}"}, {}, False
+
+
+def serve_stream(server: CateServer, rstream, wstream) -> bool:
+    """Serve one connection's framed request loop. Returns True when a
+    ``shutdown`` op asked the whole daemon to exit."""
+    while True:
+        try:
+            frame = protocol.read_frame(rstream)
+        except protocol.ProtocolError as e:
+            # A torn/corrupt frame kills THIS connection (there is no
+            # way to resynchronize a length-prefixed stream), never the
+            # daemon.
+            obs.emit("serving_protocol_error", status="error", error=str(e))
+            return False
+        if frame is None:
+            return False
+        header, arrays = frame
+        reply, out_arrays, stop = _handle_op(server, header, arrays)
+        protocol.write_frame(wstream, reply, out_arrays)
+        if stop:
+            return True
+
+
+def serve_stdio(server: CateServer) -> None:
+    """Serve a single peer over stdin/stdout (the subprocess transport;
+    logs belong on stderr)."""
+    import sys
+
+    serve_stream(server, sys.stdin.buffer, sys.stdout.buffer)
+    server.stop()
+
+
+def serve_socket(server: CateServer, host: str = "127.0.0.1",
+                 port: int = 0) -> None:
+    """Accept loop: one reader thread per connection, all feeding the
+    shared coalescer (this is where micro-batching pays). Returns after
+    a ``shutdown`` op. Binds ``port`` (0 = ephemeral; the bound port is
+    printed to stderr and exported as a gauge for discovery)."""
+    import sys
+
+    stop_evt = threading.Event()
+    with socket.create_server((host, port)) as srv:
+        srv.settimeout(0.25)
+        bound = srv.getsockname()[1]
+        obs.gauge("serving_port", "bound TCP port").set(bound)
+        print(f"# serving on {host}:{bound}", file=sys.stderr, flush=True)
+
+        def _conn(conn: socket.socket) -> None:
+            with conn:
+                rw = conn.makefile("rwb")
+                try:
+                    if serve_stream(server, rw, rw):
+                        stop_evt.set()
+                finally:
+                    rw.close()
+
+        threads: list[threading.Thread] = []
+        while not stop_evt.is_set():
+            # Prune finished connections each pass — a long-lived daemon
+            # accepts millions of short connections and must not retain
+            # one dead Thread object per connection.
+            threads = [t for t in threads if t.is_alive()]
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            t = threading.Thread(target=_conn, args=(conn,), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(1.0)
+    server.stop()

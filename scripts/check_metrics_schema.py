@@ -8,8 +8,9 @@ Usage::
         [--require-stages "naive,oracle,..."]
 
 Checks ``metrics.json`` (schema version, section shapes, the counter
-families every instrumented run must carry — shard retry and compile
-cache) and ``events.jsonl`` (versioned header, span record fields,
+families every instrumented run must carry — shard retry, compile
+cache, serving — and bucket-histogram internal consistency when the
+section is present) and ``events.jsonl`` (versioned header, span record fields,
 parent references resolving, non-negative durations). With
 ``--require-stages``, every named stage must appear as a
 ``sweep_stage_total`` label — the quick-sweep acceptance gate for all
@@ -49,6 +50,12 @@ REQUIRED_COUNTERS = (
     "compile_cache_misses_total",
     "nuisance_cache_requests_total",
     "scheduler_prefetch_total",
+    # Serving families (ISSUE 6): "nothing was served" and "jax never
+    # compiled" are recorded zeros, not missing keys — the latter is
+    # the daemon's steady-state no-compile proof instrument.
+    "serving_requests_total",
+    "serving_rejected_total",
+    "jax_compiles_total",
 )
 
 _EVENT_FIELDS = (
@@ -81,6 +88,24 @@ def validate_metrics(snap: dict, require_stages: list[str] | None = None) -> lis
                         )
                 elif not isinstance(val, (int, float)):
                     errors.append(f"metrics: {section}.{name}[{key!r}] non-numeric")
+    # bucket_histograms (ISSUE 6) is optional — artifacts written before
+    # the family existed lack the section — but when present every
+    # sample must be internally consistent (the quantiles are derived
+    # data; a hand-edited snapshot must FAIL here, not mislead a reader).
+    bh = snap.get("bucket_histograms")
+    if bh is not None:
+        if not isinstance(bh, dict):
+            errors.append("metrics: bucket_histograms is not a mapping")
+        else:
+            for name, samples in bh.items():
+                if not isinstance(samples, dict):
+                    errors.append(
+                        f"metrics: bucket_histograms.{name} is not a "
+                        "label->sample map"
+                    )
+                    continue
+                for key, s in samples.items():
+                    errors += _check_bucket_sample(name, key, s)
     counters = snap.get("counters", {})
     for name in REQUIRED_COUNTERS:
         if name not in counters:
@@ -99,6 +124,31 @@ def validate_metrics(snap: dict, require_stages: list[str] | None = None) -> lis
                     f"metrics: sweep_stage_total has no sample for "
                     f"method={stage!r}"
                 )
+    return errors
+
+
+def _check_bucket_sample(name: str, key: str, s: dict) -> list[str]:
+    """One bucket-histogram sample: required keys, ladder/bucket length
+    agreement, bucket counts summing to count, ordered quantiles."""
+    where = f"metrics: bucket_histogram {name}[{key!r}]"
+    if not (isinstance(s, dict)
+            and {"count", "sum", "min", "max", "buckets", "bounds",
+                 "p50", "p95", "p99"} <= set(s)):
+        return [f"{where} lacks count/sum/min/max/buckets/bounds/p50/p95/p99"]
+    errors = []
+    bounds, buckets = s["bounds"], s["buckets"]
+    if not (isinstance(bounds, list) and isinstance(buckets, list)
+            and len(buckets) == len(bounds) + 1):
+        errors.append(f"{where}: buckets must be len(bounds)+1")
+    elif any(b2 <= b1 for b1, b2 in zip(bounds, bounds[1:])):
+        errors.append(f"{where}: bounds not strictly ascending")
+    elif sum(buckets) != s["count"]:
+        errors.append(
+            f"{where}: bucket counts sum to {sum(buckets)} != count "
+            f"{s['count']}"
+        )
+    if not (s["p50"] <= s["p95"] <= s["p99"] <= s["max"] + 1e-9):
+        errors.append(f"{where}: quantiles out of order")
     return errors
 
 

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Start the CATE serving daemon (ISSUE 6).
+
+Usage::
+
+    python scripts/serve.py --checkpoint forest.npz --port 7777
+    python scripts/serve.py --checkpoint forest.npz --stdio
+
+Loads the SHA-256-verified forest checkpoint, AOT-compiles one predict
+executable per declared batch bucket, then serves ``predict`` / ``ping``
+/ ``stats`` / ``shutdown`` ops over the length-prefixed protocol
+(``serving/protocol.py``) — TCP (``--port``, 0 = ephemeral, bound port
+printed to stderr) or stdin/stdout (``--stdio``; all logs go to
+stderr). Knobs default from the ``ATE_TPU_SERVE_*`` env vars (see the
+README's CATE serving section); flags override.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--checkpoint", required=True,
+                    help="save_fitted() .npz holding a (Fitted)CausalForest")
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument("--stdio", action="store_true",
+                      help="serve one peer over stdin/stdout")
+    mode.add_argument("--port", type=int, default=None,
+                      help="TCP port (0 = ephemeral; default without --stdio)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--buckets", default=None,
+                    help="comma-separated batch buckets "
+                         "(default $ATE_TPU_SERVE_BUCKETS or 1,8,64,256)")
+    ap.add_argument("--window-ms", type=float, default=None,
+                    help="coalescing deadline window")
+    ap.add_argument("--depth", type=int, default=None,
+                    help="admission queue depth")
+    ap.add_argument("--row-backend", default=None,
+                    choices=("pallas", "pallas_interpret", "matmul"),
+                    help="predict row-kernel backend (default: auto)")
+    args = ap.parse_args(argv)
+
+    from ate_replication_causalml_tpu.serving.coalescer import BucketPlan
+    from ate_replication_causalml_tpu.serving.daemon import (
+        CateServer,
+        ServeConfig,
+        serve_socket,
+        serve_stdio,
+    )
+
+    overrides: dict = {}
+    if args.buckets is not None:
+        overrides["buckets"] = BucketPlan.parse(args.buckets)
+    if args.window_ms is not None:
+        overrides["window_s"] = args.window_ms / 1e3
+    if args.depth is not None:
+        overrides["max_depth"] = args.depth
+    if args.row_backend is not None:
+        overrides["row_backend"] = args.row_backend
+    config = ServeConfig.from_env(args.checkpoint, **overrides)
+
+    server = CateServer(config)
+    phases = server.startup()
+    print(
+        "# startup: " + " ".join(
+            f"{k}={v:.2f}s" for k, v in phases.items()
+        ) + f" buckets={list(config.buckets.sizes)}",
+        file=sys.stderr, flush=True,
+    )
+    if args.stdio:
+        serve_stdio(server)
+    else:
+        serve_socket(server, args.host, 0 if args.port is None else args.port)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -115,11 +115,39 @@ def test_sweep_quick_record_schema_stubbed(monkeypatch):
         bench.bench_sweep_quick(n_obs=7)
 
 
+def test_serving_quick_record_schema_stubbed(monkeypatch):
+    """The `serving_quick` record schema (ISSUE 6), pinned WITHOUT a
+    real fit/daemon (tier-1 budget): _serving_measurements is stubbed
+    to canned numbers. The executable end-to-end proof lives in
+    tests/test_serving.py (in-process window) and the @slow default
+    bench smoke below."""
+    import bench
+
+    canned = {
+        "rows": 400, "requests": 120, "buckets": [1, 8, 32],
+        "cold_predict_s": 1.5, "startup_load_s": 0.01,
+        "startup_aot_s": 4.2, "startup_warm_s": 0.02,
+        "p50_s": 0.003, "p99_s": 0.012, "batch_fill_mean": 0.8,
+        "zero_compile": True,
+    }
+    monkeypatch.setattr(bench, "_serving_measurements", lambda n: canned)
+    rec = bench.bench_serving_quick(n=400)
+    for field in ("metric", "value", "unit", "vs_baseline", "p50_ms",
+                  "p99_ms", "startup_load_s", "startup_aot_s",
+                  "startup_warm_s", "cold_predict_s", "batch_fill_mean",
+                  "requests", "buckets", "rows", "zero_compile"):
+        assert field in rec, field
+    assert rec["metric"] == "serving_quick" and rec["unit"] == "ms"
+    assert rec["value"] == rec["p50_ms"] == 3.0
+    assert rec["vs_baseline"] == 500.0  # 1.5 s cold tail / 3 ms served
+    assert rec["zero_compile"] is True
+
+
 @pytest.mark.slow
-def test_default_bench_emits_four_records_cpu_smoke():
+def test_default_bench_emits_five_records_cpu_smoke():
     """`python bench.py` must print one JSON record per metric (quick
-    sweep, AIPW, cached predict+variance, forest fit), forest fit LAST
-    (the driver's single-line parse lands on the flagship).
+    sweep, serving, AIPW, cached predict+variance, forest fit), forest
+    fit LAST (the driver's single-line parse lands on the flagship).
     Run on the CPU backend at smoke scale. @slow since ISSUE 4: the
     three quick-sweep legs pushed this past the tier-1 budget (memory:
     the 870 s single-process run was already near its ceiling); the
@@ -134,6 +162,7 @@ def test_default_bench_emits_four_records_cpu_smoke():
         JAX_PLATFORMS="cpu",
         ATE_BENCH_FOREST_ROWS="1500",
         ATE_BENCH_SWEEP_ROWS="500",
+        ATE_BENCH_SERVE_ROWS="200",
         ATE_NO_COMPILE_CACHE="1",
         # No virtual-device mesh in the child, but keep the suite's
         # compile-time opt level (the child is ~90% XLA compile too —
@@ -154,21 +183,25 @@ def test_default_bench_emits_four_records_cpu_smoke():
     assert out.returncode == 0, out.stderr[-2000:]
     lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
     records = [json.loads(l) for l in lines]
-    assert len(records) == 4, lines
+    assert len(records) == 5, lines
     metrics = [r["metric"] for r in records]
     assert metrics[0] == "sweep_wall_clock_quick"
-    assert metrics[1] == "aipw_bootstrap_se_10k_replicates_1m_rows"
-    assert metrics[2] == "causal_forest_predict_var_sec_per_1m_rows"
+    assert metrics[1] == "serving_quick"
+    assert metrics[2] == "aipw_bootstrap_se_10k_replicates_1m_rows"
+    assert metrics[3] == "causal_forest_predict_var_sec_per_1m_rows"
     # Flagship fit metric LAST — the driver's single-line parse.
-    assert metrics[3] == "causal_forest_2000_trees_sec_per_1m_rows"
+    assert metrics[4] == "causal_forest_2000_trees_sec_per_1m_rows"
     for r in records:
         for field in ("metric", "value", "unit", "vs_baseline"):
             assert field in r, (field, r)
-    for r in records[1:]:
+    for r in records[2:]:
         assert "samples_s" in r, r
     for field in ("sequential_s", "concurrent_s", "workers", "rows"):
         assert field in records[0], field
+    for field in ("startup_aot_s", "p99_ms", "zero_compile"):
+        assert field in records[1], field
+    assert records[1]["zero_compile"] is True
     for field in ("rows", "analytic_tflops", "mfu_bf16_pct"):
-        assert field in records[3], field
+        assert field in records[4], field
     for field in ("rows", "leaf_index_s"):
-        assert field in records[2], field
+        assert field in records[3], field

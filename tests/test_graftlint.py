@@ -521,6 +521,38 @@ class Checkpoint:
 """
 
 
+JGL008_SERVING_BAD = """\
+import threading
+
+class Server:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._executables: dict = {}
+        self._pending = []
+
+    def install(self, bucket, compiled):
+        self._executables[bucket] = compiled   # line 10: unlocked store
+        self._pending.append(bucket)           # line 11: unlocked append
+
+    def swap(self, bucket, compiled):
+        with self._lock:
+            self._executables[bucket] = compiled
+"""
+
+JGL008_SERVING_GOOD = """\
+import threading
+
+class Coalescer:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._pending: list = []
+
+    def submit(self, req):
+        with self._cond:
+            self._pending.append(req)
+"""
+
+
 def test_jgl008_fires_in_scheduler_and_pipeline_scope_only():
     # Annotated container assignments (`self._ready: list = []`) count
     # as shared state; threading.Condition counts as the lock.
@@ -534,8 +566,29 @@ def test_jgl008_fires_in_scheduler_and_pipeline_scope_only():
     assert _lines(JGL008_BAD, "JGL006", relpath="pkg/scheduler/engine.py") == []
 
 
+def test_jgl008_covers_serving_scope():
+    """ISSUE 6: the daemon is the most thread-shared code in the tree —
+    per-connection readers, the dispatcher and the reload thread all
+    touch the executable table / queues, so serving/ joins the JGL008
+    scope (and stays out of JGL006's)."""
+    assert _lines(
+        JGL008_SERVING_BAD, "JGL008", relpath="pkg/serving/daemon.py"
+    ) == [10, 11]
+    assert _lines(
+        JGL008_SERVING_BAD, "JGL008", relpath="pkg/serving/coalescer.py"
+    ) == [10, 11]
+    # Same fixture out of scope: quiet.
+    assert _lines(JGL008_SERVING_BAD, "JGL008", relpath="pkg/ops/mod.py") == []
+    assert _lines(
+        JGL008_SERVING_BAD, "JGL006", relpath="pkg/serving/daemon.py"
+    ) == []
+
+
 def test_jgl008_quiet_on_locked_checkpoint_class():
     assert _lines(JGL008_GOOD, "JGL008", relpath="pkg/pipeline.py") == []
+    assert _lines(
+        JGL008_SERVING_GOOD, "JGL008", relpath="pkg/serving/coalescer.py"
+    ) == []
 
 
 # --------------------------------------------------------------- JGL007

@@ -62,6 +62,72 @@ def test_counter_gauge_histogram_semantics():
     assert reg.counter("hits") is c
 
 
+def test_bucket_histogram_quantiles_and_snapshot():
+    """ISSUE 6: fixed-ladder histogram with snapshot-time p50/p95/p99 —
+    the quantile is the bucket's upper bound (Prometheus-style,
+    conservative), clamped to the observed max, and the snapshot passes
+    the schema checker's internal-consistency rules."""
+    reg = MetricsRegistry()
+    h = reg.bucket_histogram("lat", "t", bounds=(0.001, 0.01, 0.1, 1.0))
+    for v in (0.0005, 0.002, 0.003, 0.05, 0.5, 3.0):
+        h.observe(v, status="ok")
+    snap = reg.snapshot()
+    s = snap["bucket_histograms"]["lat"]["status=ok"]
+    assert s["count"] == 6 and s["min"] == 0.0005 and s["max"] == 3.0
+    assert s["buckets"] == [1, 2, 1, 1, 1]  # +1 overflow slot
+    assert s["bounds"] == [0.001, 0.01, 0.1, 1.0]
+    assert s["p50"] == 0.01          # 3rd of 6 falls in the <=0.01 bucket
+    assert s["p95"] == s["p99"] == 3.0  # overflow clamps to max
+    assert cms._check_bucket_sample("lat", "status=ok", s) == []
+    # A single observation reports itself at every quantile.
+    h.observe(0.02, status="one")
+    s1 = reg.snapshot()["bucket_histograms"]["lat"]["status=one"]
+    assert s1["p50"] == s1["p99"] == 0.02
+    # Default ladder is the shared log-spaced one.
+    assert reg.bucket_histogram("other").bounds == obs.DEFAULT_LATENCY_BUCKETS
+    # Kind conflicts and ladder conflicts are refused.
+    with pytest.raises(TypeError):
+        reg.histogram("lat")
+    with pytest.raises(ValueError):
+        reg.bucket_histogram("lat", bounds=(1.0, 2.0))
+    with pytest.raises(ValueError):
+        reg.bucket_histogram("bad", bounds=(2.0, 1.0))
+    # peek() exposes the sum like the summary histogram.
+    assert reg.peek("lat")["status=ok"] == pytest.approx(3.5555)
+
+
+def test_bucket_histogram_prom_export_is_cumulative():
+    from ate_replication_causalml_tpu.observability.promtext import (
+        render_prom_from_snapshot,
+    )
+
+    reg = MetricsRegistry()
+    h = reg.bucket_histogram("lat", "t", bounds=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v, op="x")
+    text = render_prom_from_snapshot(reg.snapshot())
+    assert "# TYPE ate_tpu_lat histogram" in text
+    assert 'ate_tpu_lat_bucket{op="x",le="0.1"} 1' in text
+    assert 'ate_tpu_lat_bucket{op="x",le="1.0"} 2' in text
+    assert 'ate_tpu_lat_bucket{op="x",le="+Inf"} 3' in text
+    assert 'ate_tpu_lat_count{op="x"} 3' in text
+
+
+def test_schema_checker_rejects_inconsistent_bucket_sample():
+    good = {"count": 2, "sum": 1.0, "min": 0.1, "max": 0.9,
+            "buckets": [1, 1, 0], "bounds": [0.5, 1.0],
+            "p50": 0.5, "p95": 0.9, "p99": 0.9}
+    assert cms._check_bucket_sample("f", "", good) == []
+    bad_sum = dict(good, buckets=[1, 0, 0])
+    assert any("sum to" in e for e in cms._check_bucket_sample("f", "", bad_sum))
+    bad_len = dict(good, buckets=[1, 1])
+    assert any("len(bounds)+1" in e for e in cms._check_bucket_sample("f", "", bad_len))
+    bad_q = dict(good, p50=0.95)
+    assert any("quantiles" in e for e in cms._check_bucket_sample("f", "", bad_q))
+    missing = {"count": 1}
+    assert cms._check_bucket_sample("f", "", missing)
+
+
 def test_collector_runs_at_snapshot_and_is_crash_proof():
     reg = MetricsRegistry()
     reg.add_collector(lambda: reg.gauge("scanned").set(42))

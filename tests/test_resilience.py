@@ -56,16 +56,19 @@ def _event_names():
 def test_chaos_grammar_parses_scopes_flags_and_values():
     cfg = chaos.parse_chaos("shard:p=0.25,seed=7,times=2,pool=forest;"
                             "fs:torn_write,corrupt_npz,times=3;"
-                            "device:drop=2;stage:fail=Belloni et.al")
+                            "device:drop=2;stage:fail=Belloni et.al;"
+                            "serve:p=0.3,seed=5,times=2")
     assert cfg.scope("shard") == {"p": 0.25, "seed": 7, "times": 2, "pool": "forest"}
     assert cfg.scope("fs") == {"torn_write": True, "corrupt_npz": True, "times": 3}
     assert cfg.scope("device") == {"drop": 2, "times": 0}
     assert cfg.scope("stage")["fail"] == "Belloni et.al"  # spaces/dots survive
+    assert cfg.scope("serve") == {"p": 0.3, "seed": 5, "times": 2}
     assert cfg.scope("nonexistent") is None
 
 
 @pytest.mark.parametrize("bad", [
     "bogus:p=1", "shard:nope=1", "shard:p=abc", "fs:torn_write=x,p=1",
+    "serve:fail=x", "serve:p=oops",
     "shard",  # scope with no ':' and no defaults armed is fine? -> shard alone
 ])
 def test_chaos_grammar_rejects_malformed_specs(bad):
@@ -121,6 +124,54 @@ def test_shard_chaos_pool_filter():
     inj = chaos.ChaosInjector(chaos.parse_chaos("shard:p=1.0,pool=forest"))
     assert not inj.shard_should_fail("lasso_folds", 0, 1)
     assert inj.shard_should_fail("forest_classifier", 0, 1)
+
+
+# ── serve scope (ISSUE 6) ───────────────────────────────────────────────
+
+
+def test_serve_chaos_selection_is_seed_deterministic():
+    """Selection is the pure (seed, "serve", id) hash — two injectors
+    over the same spec plan the same reject set, in any call order,
+    and the seed actually matters."""
+    ids = [f"r{i}" for i in range(60)]
+
+    def planned(seed, order):
+        inj = chaos.ChaosInjector(
+            chaos.parse_chaos(f"serve:p=0.3,seed={seed}")
+        )
+        return sorted(r for r in order if inj.take_serve_fault(r))
+
+    a = planned(4, ids)
+    b = planned(4, list(reversed(ids)))  # arrival order is irrelevant
+    c = planned(5, ids)
+    assert a == b
+    assert a != c
+    assert 6 < len(a) < 34  # p=0.3 behaves like a probability
+
+
+def test_serve_chaos_per_id_attempt_budget():
+    """A selected id faults on its first `times` attempts then serves —
+    the convergence contract a retrying client relies on; unselected
+    ids never fault and consume no budget."""
+    inj = chaos.ChaosInjector(chaos.parse_chaos("serve:p=1.0,times=2"))
+    assert inj.take_serve_fault("req9")        # attempt 1 faults
+    assert inj.take_serve_fault("req9")        # attempt 2 faults
+    assert not inj.take_serve_fault("req9")    # attempt 3 serves
+    assert not inj.take_serve_fault("req9")    # and stays served
+    # Budgets are per id, not global.
+    assert inj.take_serve_fault("other")
+    # p=0: scope armed but selecting nothing.
+    quiet = chaos.ChaosInjector(chaos.parse_chaos("serve:p=0.0"))
+    assert not quiet.take_serve_fault("req9")
+
+
+def test_serve_chaos_records_injections():
+    with chaos.override("serve:p=1.0,seed=1"):
+        inj = chaos.active()
+        assert inj.take_serve_fault("reqA")
+    snap = obs.REGISTRY.snapshot()["counters"]
+    assert snap["chaos_injections_total"]["scope=serve"] >= 1.0
+    assert "chaos_inject" in _event_names()
 
 
 def test_exhausted_chaos_budget_degrades_not_raises():

@@ -1,0 +1,600 @@
+"""CATE serving subsystem tests (ISSUE 6).
+
+Three layers, matched to the tier-1 budget:
+
+* the no-jax serving core — protocol framing (incl. torn frames),
+  coalescer deadline/bucket math, admission reject ordering, the
+  lifecycle + checkpoint-reload state machine — pure-host, ~ms each;
+* ONE module-scoped in-process daemon over a synthetic micro forest
+  (no fit — serving doesn't care how the forest was trained), proving
+  the acceptance criteria: a ≥100-request window across ≥2 buckets with
+  ZERO jax compile events and served values bit-identical to offline
+  ``predict_cate``, then degraded-mode chaos serving (planned faults
+  exactly, recovery reloads, bit-identical to the fault-free stream);
+* the subprocess stdio round-trip (@slow — process startup + its own
+  AOT compiles are redundant with the in-process window).
+
+The offline reference is computed BEFORE the daemon starts: the
+no-compile window term is process-global by design (a real daemon
+process runs nothing else), so the reference trace must not pollute it.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ate_replication_causalml_tpu.resilience import chaos
+from ate_replication_causalml_tpu.serving import protocol
+from ate_replication_causalml_tpu.serving.admission import (
+    AdmissionController,
+    InvalidTransition,
+    ReloadSupervisor,
+    ServingLifecycle,
+)
+from ate_replication_causalml_tpu.serving.coalescer import (
+    BucketPlan,
+    Coalescer,
+    PendingRequest,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ── protocol framing ────────────────────────────────────────────────────
+
+
+def test_frame_roundtrip_with_arrays():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    i = np.array([1, 2, 3], dtype=np.int64)
+    buf = protocol.encode_frame({"op": "predict", "id": "r1"},
+                                {"x": x, "idx": i})
+    header, arrays = protocol.read_frame(io.BytesIO(buf))
+    assert header == {"op": "predict", "id": "r1"}
+    assert np.array_equal(arrays["x"], x) and arrays["x"].dtype == x.dtype
+    assert np.array_equal(arrays["idx"], i)
+
+
+def test_frame_roundtrip_header_only_and_clean_eof():
+    buf = protocol.encode_frame({"ok": True})
+    stream = io.BytesIO(buf + protocol.encode_frame({"second": 2}))
+    assert protocol.read_frame(stream) == ({"ok": True}, {})
+    assert protocol.read_frame(stream) == ({"second": 2}, {})
+    assert protocol.read_frame(stream) is None  # EOF at a boundary
+    assert protocol.read_frame(io.BytesIO(b"")) is None
+
+
+@pytest.mark.parametrize("cut", [1, 3, 4, 7, -5, -1])
+def test_torn_frames_raise(cut):
+    """EOF anywhere inside a frame — in the length prefix, the header,
+    or the array payload — is a typed ProtocolError, never a hang or a
+    partial decode."""
+    buf = protocol.encode_frame(
+        {"op": "predict"}, {"x": np.ones((4, 3), np.float32)}
+    )
+    torn = buf[:cut] if cut > 0 else buf[:len(buf) + cut]
+    with pytest.raises(protocol.ProtocolError, match="torn|truncated"):
+        protocol.read_frame(io.BytesIO(torn))
+
+
+def test_frame_rejects_garbage_and_oversize():
+    with pytest.raises(protocol.ProtocolError, match="header length"):
+        protocol.decode_frame(b"\x00\x00\x00\x0a{}")  # hlen > body
+    with pytest.raises(protocol.ProtocolError, match="JSON"):
+        protocol.decode_frame(b"\x00\x00\x00\x02xy")
+    with pytest.raises(protocol.ProtocolError, match="trailing"):
+        protocol.decode_frame(protocol.encode_frame({"a": 1})[4:] + b"zz")
+    # A hostile length prefix must be refused before allocation.
+    huge = (protocol.MAX_FRAME_BYTES + 1).to_bytes(4, "big")
+    with pytest.raises(protocol.ProtocolError, match="MAX_FRAME_BYTES"):
+        protocol.read_frame(io.BytesIO(huge))
+    # Declared array bigger than the frame.
+    bad = protocol.encode_frame({"arrays": {"x": {
+        "dtype": "float32", "shape": [1000, 1000]}}})
+    with pytest.raises(protocol.ProtocolError, match="truncated"):
+        protocol.read_frame(io.BytesIO(bad))
+    # Non-numeric dtypes have no raw-buffer wire form; np.frombuffer on
+    # dtype "O" raises a PLAIN ValueError, which must be wrapped typed
+    # (a bare ValueError escapes serve_stream and kills the connection
+    # replyless).
+    for dt in ("O", "U4", "M8[ns]"):
+        evil = protocol.encode_frame({"arrays": {"x": {
+            "dtype": dt, "shape": [1]}}})
+        with pytest.raises(protocol.ProtocolError, match="non-numeric"):
+            protocol.read_frame(io.BytesIO(evil))
+
+
+# ── bucket plan + coalescer ─────────────────────────────────────────────
+
+
+def test_bucket_plan_parse_and_lookup():
+    plan = BucketPlan.parse("64,1,8,8")
+    assert plan.sizes == (1, 8, 64)
+    assert plan.bucket_for(1) == 1
+    assert plan.bucket_for(2) == 8
+    assert plan.bucket_for(8) == 8
+    assert plan.bucket_for(9) == 64
+    assert plan.bucket_for(64) == 64
+    assert plan.bucket_for(65) is None
+    with pytest.raises(ValueError):
+        plan.bucket_for(0)
+    for bad in ("", "0,4", "-1,4", "a,b"):
+        with pytest.raises(ValueError):
+            BucketPlan.parse(bad)
+
+
+class _FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _req(rid, rows, clock):
+    return PendingRequest(rid, None, rows, clock())
+
+
+def test_coalescer_flushes_when_full():
+    """A burst that exactly fills the largest bucket dispatches at once
+    — no window wait — and rides that bucket at fill 1.0."""
+    clock = _FakeClock()
+    co = Coalescer(BucketPlan.parse("4,16"), window_s=10.0, clock=clock)
+    for i in range(4):
+        co.submit(_req(f"r{i}", 4, clock))
+    batch = co.next_batch(timeout=0)
+    assert batch is not None
+    assert [r.request_id for r in batch.requests] == ["r0", "r1", "r2", "r3"]
+    assert batch.rows == 16 and batch.bucket == 16 and batch.fill == 1.0
+    assert co.next_batch(timeout=0) is None  # drained
+
+
+def test_coalescer_flushes_when_next_would_overflow():
+    """Head-of-line blocking is refused: when the next waiter cannot
+    fit, the packed prefix flushes immediately and the big request
+    leads the next batch."""
+    clock = _FakeClock()
+    co = Coalescer(BucketPlan.parse("4,16"), window_s=10.0, clock=clock)
+    co.submit(_req("small", 6, clock))
+    co.submit(_req("big", 14, clock))
+    first = co.next_batch(timeout=0)
+    assert [r.request_id for r in first.requests] == ["small"]
+    assert first.bucket == 16 and first.rows == 6
+    # The big request is now alone — not full, so it waits out its OWN
+    # window rather than flushing on the heels of the first batch.
+    assert co.next_batch(timeout=0) is None
+    clock.t += 10.0
+    second = co.next_batch(timeout=0)
+    assert [r.request_id for r in second.requests] == ["big"]
+
+
+def test_coalescer_window_deadline_flushes_partial():
+    """A lone request waits only until the OLDEST waiter's window
+    expires, then flushes padded to the smallest fitting bucket."""
+    clock = _FakeClock()
+    co = Coalescer(BucketPlan.parse("4,16"), window_s=0.5, clock=clock)
+    co.submit(_req("r0", 3, clock))
+    assert co.next_batch(timeout=0) is None  # window not expired
+    clock.t += 0.49
+    assert co.next_batch(timeout=0) is None
+    clock.t += 0.02  # oldest is now past its window
+    batch = co.next_batch(timeout=0)
+    assert batch is not None
+    assert batch.rows == 3 and batch.bucket == 4 and batch.fill == 0.75
+
+
+def test_coalescer_window_is_oldest_waiter_not_newest():
+    clock = _FakeClock()
+    co = Coalescer(BucketPlan.parse("16",), window_s=1.0, clock=clock)
+    co.submit(_req("r0", 2, clock))
+    clock.t += 0.9
+    co.submit(_req("r1", 2, clock))  # newer arrival must not reset r0
+    clock.t += 0.2
+    batch = co.next_batch(timeout=0)
+    assert batch is not None and batch.rows == 4
+
+
+def test_coalescer_oversize_and_close_semantics():
+    clock = _FakeClock()
+    co = Coalescer(BucketPlan.parse("4"), window_s=10.0, clock=clock)
+    with pytest.raises(ValueError, match="exceeds the largest bucket"):
+        co.submit(_req("big", 5, clock))
+    co.submit(_req("r0", 1, clock))
+    co.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        co.submit(_req("r1", 1, clock))
+    # Close drains immediately (no window wait), then None forever.
+    batch = co.next_batch(timeout=0)
+    assert batch is not None and batch.rows == 1
+    assert co.next_batch(timeout=0) is None
+
+
+# ── admission + lifecycle + reload state machine ───────────────────────
+
+
+def test_admission_reject_ordering():
+    adm = AdmissionController(max_depth=2)
+    assert adm.try_admit() and adm.try_admit()
+    assert not adm.try_admit()  # full: typed reject, never queue
+    adm.release()
+    assert adm.try_admit()      # freed slot admits the NEXT arrival
+    assert not adm.try_admit()
+    adm.release()
+    adm.release()
+    assert adm.depth == 0
+    with pytest.raises(RuntimeError, match="without a matching admit"):
+        adm.release()
+    with pytest.raises(ValueError):
+        AdmissionController(0)
+
+
+def test_lifecycle_legal_path_and_invalid_transitions():
+    lc = ServingLifecycle()
+    assert lc.state == "starting" and not lc.can_serve()
+    with pytest.raises(InvalidTransition):
+        lc.mark_recovered()          # not degraded yet
+    assert not lc.mark_fault("early")  # faults before ready don't own recovery
+    lc.mark_ready()
+    assert lc.can_serve()
+    with pytest.raises(InvalidTransition):
+        lc.mark_ready()              # double-ready
+    assert lc.mark_fault("boom")     # first reporter owns recovery
+    assert lc.state == "degraded"
+    assert not lc.mark_fault("boom2")  # concurrent reporters coalesce
+    lc.mark_recovered()
+    assert lc.can_serve() and lc.reload_count == 1 and lc.fault_count == 3
+    lc.mark_stopped()
+    lc.mark_stopped()                # idempotent
+    assert lc.state == "stopped"
+
+
+def test_reload_supervisor_state_machine():
+    """The checkpoint-reload state machine without jax: a failed reload
+    STAYS degraded (a corrupt checkpoint never rotates into service);
+    an explicit retry that verifies goes back to serving; the installed
+    model is exactly the reloaded object."""
+    lc = ServingLifecycle()
+    lc.mark_ready()
+    attempts = []
+    installed = []
+
+    def flaky_reload():
+        attempts.append(1)
+        if len(attempts) == 1:
+            raise RuntimeError("digest mismatch")
+        return {"model": len(attempts)}
+
+    sup = ReloadSupervisor(lc, flaky_reload, installed.append, inline=True)
+    assert sup.report_fault("chaos")       # owns recovery; reload FAILS
+    assert lc.state == "degraded" and installed == []
+    assert not sup.report_fault("again")   # degraded: coalesced, no own
+    assert lc.state == "degraded"
+    assert sup.retry()                     # second attempt verifies
+    assert lc.state == "serving"
+    assert installed == [{"model": 2}]
+    assert not sup.retry()                 # nothing to do while serving
+
+
+def test_reload_supervisor_background_thread():
+    lc = ServingLifecycle()
+    lc.mark_ready()
+    gate = threading.Event()
+    installed = []
+
+    def slow_reload():
+        gate.wait(5)
+        return "m2"
+
+    sup = ReloadSupervisor(lc, slow_reload, installed.append)
+    assert sup.report_fault("x")
+    assert lc.state == "degraded"  # recovery in flight, requests reject
+    gate.set()
+    sup.join(5)
+    assert lc.state == "serving" and installed == ["m2"]
+
+
+# ── the in-process daemon (micro synthetic forest, shared fixture) ─────
+
+
+N_REQUESTS = 120
+_SIZES = (1, 3, 4, 9, 16)  # cycles across both buckets of "4,16"
+
+
+def _synthetic_forest(rng):
+    """A structurally valid CausalForest from random arrays — serving
+    doesn't care how the forest was trained, and skipping the fit keeps
+    the fixture seconds, not minutes."""
+    import jax.numpy as jnp
+
+    from ate_replication_causalml_tpu.models.causal_forest import CausalForest
+
+    T, D, n, p, nb = 8, 3, 50, 4, 8
+    return CausalForest(
+        split_feat=jnp.asarray(
+            rng.integers(0, p, size=(T, D, 1 << D)).astype(np.int32)
+        ),
+        split_bin=jnp.asarray(
+            rng.integers(0, nb - 1, size=(T, D, 1 << D)).astype(np.int32)
+        ),
+        leaf_stats=jnp.asarray(
+            (np.abs(rng.normal(size=(T, 1 << D, 5))) + 0.5).astype(np.float32)
+        ),
+        in_sample=jnp.asarray(rng.uniform(size=(T, n)) < 0.5),
+        bin_edges=jnp.asarray(
+            np.sort(rng.normal(size=(p, nb - 1)), axis=1).astype(np.float32)
+        ),
+        ci_group_size=2,
+    )
+
+
+@pytest.fixture(scope="module")
+def serving_rig(tmp_path_factory):
+    """Checkpoint + offline reference + ONE running daemon. The offline
+    predict_cate reference is traced BEFORE startup so the daemon's
+    no-compile window stays clean (the window term is process-global)."""
+    import jax.numpy as jnp
+
+    from ate_replication_causalml_tpu.models.causal_forest import predict_cate
+    from ate_replication_causalml_tpu.serving.daemon import (
+        CateServer,
+        ServeConfig,
+    )
+    from ate_replication_causalml_tpu.utils.checkpoint import save_fitted
+
+    rng = np.random.default_rng(0)
+    forest = _synthetic_forest(rng)
+    ckpt = str(tmp_path_factory.mktemp("serve") / "forest.npz")
+    save_fitted(ckpt, forest)
+
+    xs = [
+        rng.normal(size=(_SIZES[i % len(_SIZES)], 4)).astype(np.float32)
+        for i in range(N_REQUESTS)
+    ]
+    off = predict_cate(
+        forest, jnp.asarray(np.concatenate(xs)), oob=False,
+        row_backend="matmul",
+    )
+    offline = (np.asarray(off.cate), np.asarray(off.variance))
+
+    server = CateServer(ServeConfig(
+        checkpoint=ckpt,
+        buckets=BucketPlan.parse("4,16"),
+        window_s=0.002,
+        max_depth=16,
+        retry_after_s=0.005,
+    ))
+    phases = server.startup()
+    yield dict(server=server, forest=forest, ckpt=ckpt, xs=xs,
+               offline=offline, phases=phases)
+    # stop() ENFORCES the zero-compile window over everything every
+    # test in this module did — including the chaos reloads.
+    server.stop()
+
+
+def _submit_retry(server, rid, x, on_fault=None):
+    from ate_replication_causalml_tpu.serving.daemon import RejectedRequest
+
+    for _ in range(300):
+        try:
+            return server.submit(rid, x)
+        except RejectedRequest as rej:
+            if rej.code == "serve_fault" and on_fault is not None:
+                on_fault(rid)
+            elif rej.code not in ("overloaded", "degraded", "serve_fault"):
+                raise
+            time.sleep(rej.retry_after_s or 0.002)
+    raise AssertionError(f"no progress on {rid}")
+
+
+def test_serving_window_zero_compile_and_bit_identity(serving_rig):
+    """THE acceptance criterion: ≥100 requests across ≥2 buckets, zero
+    jax compile/trace events in the registry during the window, served
+    values bit-identical to offline predict_cate on the same rows."""
+    server = serving_rig["server"]
+    xs = serving_rig["xs"]
+    offc, offv = serving_rig["offline"]
+    mark = server.compile_events_in_window()
+
+    # First few requests SEQUENTIALLY (each coalesces alone, waits out
+    # the window, rides the small bucket + the serve_one span path) ...
+    n_seq = 5
+    results = []
+    for i in range(n_seq):
+        results.append(server.serve_one(f"r{i}", xs[i]))
+    # ... then the rest as a pipelined burst (admission-retried like a
+    # real client), which packs the large bucket.
+    reqs = [
+        _submit_retry(server, f"r{i}", xs[i])
+        for i in range(n_seq, N_REQUESTS)
+    ]
+    for r in reqs:
+        assert r.wait(30), f"request {r.request_id} never served"
+        assert r.error is None, r.error
+        results.append(r.result)
+
+    off = 0
+    for i, (cate, var) in enumerate(results):
+        rows = xs[i].shape[0]
+        assert np.array_equal(cate, offc[off:off + rows])
+        assert np.array_equal(var, offv[off:off + rows])
+        off += rows
+
+    # Zero-compile proof, from the registry (not timings).
+    assert server.compile_events_in_window() == mark == 0.0
+    # ≥2 buckets actually used.
+    from ate_replication_causalml_tpu import observability as obs
+
+    batches = obs.REGISTRY.peek("serving_batches_total")
+    used = {k for k, v in batches.items() if v > 0 and k}
+    assert {"bucket=4", "bucket=16"} <= used
+    # The startup phases were recorded and exported as gauges.
+    assert set(serving_rig["phases"]) == {"load", "aot", "warm"}
+    assert all(v >= 0 for v in serving_rig["phases"].values())
+
+
+def test_degraded_mode_chaos_serving(serving_rig):
+    """Acceptance criterion 2: under a seeded serve: spec the daemon
+    faults EXACTLY the planned requests (selection is the pure hash of
+    the client ids), recovers by re-verifying + reloading the
+    checkpoint, never crashes, and the retried stream's answers are
+    bit-identical to the fault-free offline reference."""
+    server = serving_rig["server"]
+    xs = serving_rig["xs"]
+    offc, offv = serving_rig["offline"]
+    ids = [f"r{i}" for i in range(N_REQUESTS)]
+
+    faulted: list[str] = []
+    results: dict[str, tuple] = {}
+    with chaos.override("serve:p=0.25,seed=11"):
+        for i, rid in enumerate(ids):
+            req = _submit_retry(server, rid, xs[i], on_fault=faulted.append)
+            assert req.wait(30) and req.error is None
+            results[rid] = req.result
+
+    expected = [
+        rid for rid in ids if chaos._unit(11, "serve", rid) < 0.25
+    ]
+    assert faulted == expected and len(expected) > 0
+    # The daemon recovered (reload count advanced, state is serving).
+    assert server.lifecycle.state == "serving"
+    assert server.lifecycle.reload_count >= 1
+    # Chaos stream == fault-free reference, bit for bit.
+    off = 0
+    for i, rid in enumerate(ids):
+        cate, var = results[rid]
+        rows = xs[i].shape[0]
+        assert np.array_equal(cate, offc[off:off + rows])
+        assert np.array_equal(var, offv[off:off + rows])
+        off += rows
+    # A faulted id consumed its budget: replaying it chaos-free-attempt
+    # 2+ serves (already proven by the retry loop converging).
+
+
+def test_serving_rejects_are_typed(serving_rig):
+    from ate_replication_causalml_tpu.serving.daemon import RejectedRequest
+
+    server = serving_rig["server"]
+    with pytest.raises(RejectedRequest, match="bad_request"):
+        server.serve_one("bad1", np.ones((3,), np.float32))  # 1-D
+    with pytest.raises(RejectedRequest, match="features"):
+        server.serve_one("bad2", np.ones((2, 9), np.float32))
+    with pytest.raises(RejectedRequest, match="rows"):
+        server.serve_one("bad3", np.ones((17, 4), np.float32))  # > max bucket
+    # Unconvertible query payloads (strings etc.) are a typed reject at
+    # the submit layer, not a connection-killing ValueError.
+    with pytest.raises(RejectedRequest, match="float32"):
+        server.serve_one("bad4", np.array([["a", "b", "c", "d"]]))
+
+
+def test_stream_roundtrip_over_socketpair(serving_rig):
+    """The wire layer against the live daemon — a real socket, the real
+    client, no subprocess: predict + ping + stats round-trip, and a
+    torn frame kills only the connection."""
+    import socket as socketlib
+
+    from ate_replication_causalml_tpu.serving.client import CateClient
+    from ate_replication_causalml_tpu.serving.daemon import serve_stream
+
+    server = serving_rig["server"]
+    xs = serving_rig["xs"]
+    offc, offv = serving_rig["offline"]
+
+    a, b = socketlib.socketpair()
+    rw = b.makefile("rwb")
+    t = threading.Thread(target=serve_stream, args=(server, rw, rw),
+                         daemon=True)
+    t.start()
+    with CateClient(a.makefile("rb"), a.makefile("wb"), sock=a) as client:
+        assert client.ping()["state"] == "serving"
+        cate, var = client.predict(xs[0], request_id="wire0")
+        assert np.array_equal(cate, offc[:xs[0].shape[0]])
+        assert np.array_equal(var, offv[:xs[0].shape[0]])
+        stats = client.stats()
+        assert stats["compile_events_in_window"] == 0
+        assert stats["state"] == "serving"
+    t.join(5)
+    assert not t.is_alive()
+
+    # Torn frame: connection dies typed, the daemon keeps serving.
+    a2, b2 = socketlib.socketpair()
+    rw2 = b2.makefile("rwb")
+    t2 = threading.Thread(target=serve_stream, args=(server, rw2, rw2),
+                          daemon=True)
+    t2.start()
+    frame = protocol.encode_frame({"op": "ping"})
+    a2.sendall(frame[:len(frame) - 2])
+    a2.close()
+    t2.join(5)
+    assert not t2.is_alive()
+    assert server.lifecycle.state == "serving"
+
+
+def test_startup_refuses_corrupt_checkpoint(tmp_path):
+    """A torn/tampered checkpoint fails startup typed — the daemon must
+    refuse to serve, not serve wrong numbers."""
+    from ate_replication_causalml_tpu.resilience.errors import (
+        CheckpointCorrupt,
+    )
+    from ate_replication_causalml_tpu.serving.daemon import (
+        CateServer,
+        ServeConfig,
+    )
+    from ate_replication_causalml_tpu.utils.checkpoint import save_fitted
+
+    rng = np.random.default_rng(3)
+    ckpt = str(tmp_path / "forest.npz")
+    save_fitted(ckpt, _synthetic_forest(rng))
+    with open(ckpt, "r+b") as f:
+        f.truncate(os.path.getsize(ckpt) * 2 // 3)
+    server = CateServer(ServeConfig(checkpoint=ckpt))
+    with pytest.raises(CheckpointCorrupt):
+        server.startup()
+    server.stop()  # stop before startup completed: clean, no window
+
+
+# ── subprocess round-trip (@slow: redundant AOT + process startup) ─────
+
+
+@pytest.mark.slow
+def test_subprocess_stdio_daemon_roundtrip(serving_rig):
+    """scripts/serve.py --stdio end to end: spawn, predict a few mixed
+    batches, read stats (zero-compile window), shutdown, exit 0.
+
+    Reuses the rig's checkpoint and PRE-STARTUP offline reference: the
+    parent process must do no jax tracing here, or the still-running
+    rig server's strict stop() would (correctly) flag the parent-side
+    compiles at module teardown."""
+    from ate_replication_causalml_tpu.serving.client import CateClient
+
+    xs = serving_rig["xs"]
+    offc, _ = serving_rig["offline"]
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_backend_optimization_level=1")
+    client = CateClient.spawn_stdio(
+        [sys.executable, os.path.join(_REPO, "scripts", "serve.py"),
+         "--checkpoint", serving_rig["ckpt"], "--stdio",
+         "--buckets", "4,16", "--window-ms", "1"],
+        env=env, cwd=_REPO,
+    )
+    try:
+        assert client.ping()["state"] == "serving"
+        offp = 0
+        for i in range(4):
+            cate, _ = client.predict(xs[i], request_id=f"sub{i}")
+            assert np.array_equal(cate, offc[offp:offp + xs[i].shape[0]])
+            offp += xs[i].shape[0]
+        stats = client.stats()
+        assert stats["compile_events_in_window"] == 0
+        assert stats["state"] == "serving"
+        assert set(stats["startup_seconds"]) == {"load", "aot", "warm"}
+        client.shutdown()
+    finally:
+        client.close()
+    assert client._proc.returncode == 0
