@@ -699,7 +699,12 @@ class UnlockedSharedState(Rule):
     _context = "registry/event-log shared state"
 
     def _in_scope(self, relpath: str) -> bool:
-        return "observability/" in relpath
+        # observability/slo.py belongs to the SERVING plane's shared-
+        # state rule (JGL008) — one rule per file, or every finding
+        # there would be reported twice.
+        return "observability/" in relpath and not relpath.endswith(
+            "observability/slo.py"
+        )
 
     def check(self, module: ModuleInfo) -> Iterator[Finding]:
         if not self._in_scope(module.relpath):
@@ -877,13 +882,18 @@ class UnlockedSchedulerState(UnlockedSharedState):
     ``serving/`` joined with ISSUE 6: the daemon is the most
     thread-shared code in the tree — per-connection reader threads, the
     coalescer's dispatcher, and the degraded-mode reload thread all
-    touch the same model/executable/queue state."""
+    touch the same model/executable/queue state. ISSUE 7 added the
+    observability plane: ``observability/slo.py`` (the SLO engine's
+    snapshot history is ticked from the dispatcher and read from admin
+    probe threads) and the ``serving/admin.py`` endpoint — both serve
+    concurrent readers over state the daemon mutates."""
 
     id = "JGL008"
     name = "unlocked-scheduler-state"
     description = (
-        "scheduler/, serving/ or pipeline checkpoint class mutates "
-        "lock-guarded shared state outside the sanctioned instance lock"
+        "scheduler/, serving/, observability/slo.py or pipeline "
+        "checkpoint class mutates lock-guarded shared state outside "
+        "the sanctioned instance lock"
     )
     _context = "scheduler/serving/checkpoint shared state"
 
@@ -892,8 +902,11 @@ class UnlockedSchedulerState(UnlockedSharedState):
         # _Checkpoint; a bare endswith would also rope in
         # data/pipeline.py and any future nested pipeline.py.
         parts = relpath.replace("\\", "/").split("/")
-        return "scheduler/" in relpath or "serving/" in relpath or (
-            parts[-1] == "pipeline.py" and len(parts) <= 2
+        return (
+            "scheduler/" in relpath
+            or "serving/" in relpath
+            or relpath.endswith("observability/slo.py")
+            or (parts[-1] == "pipeline.py" and len(parts) <= 2)
         )
 
 

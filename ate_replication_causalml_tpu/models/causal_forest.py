@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import warnings
 from typing import NamedTuple
 
 import jax
@@ -1157,17 +1158,46 @@ def lower_predict_cate(
 
     ``donate=None`` donates the query buffer only on TPU — the CPU
     backend ignores donation with a warning per call, which a daemon
-    would emit thousands of times."""
+    would emit thousands of times. An EXPLICIT ``donate=True`` on a
+    backend that does not implement donation is gated the same way
+    (ISSUE 7 satellite): one Python warning here, at startup/lower
+    time, and the non-donated executable — never jax's per-dispatch
+    warning stream out of a serving loop."""
     if row_backend is None:
         row_backend = "pallas" if jax.default_backend() == "tpu" else "matmul"
+    backend = jax.default_backend()
     if donate is None:
-        donate = jax.default_backend() == "tpu"
+        donate = backend == "tpu"
+    elif donate and backend != "tpu":
+        _warn_donation_unsupported(backend)
+        donate = False
     p = forest.bin_edges.shape[0]
     x_spec = jax.ShapeDtypeStruct((int(batch), p), jnp.float32)
     fn = _predict_cate_donated if donate else _predict_cate_traced
     return fn.lower(
         forest, x_spec, oob, tree_chunk, row_chunk, None, row_backend,
         variance_compat,
+    )
+
+
+_donation_warned = False
+
+
+def _warn_donation_unsupported(backend: str) -> None:
+    """One process-wide warning for donate=True on a backend that
+    ignores donation (jax 0.4.37 warns per CALL otherwise — a serving
+    daemon would emit it once per dispatched batch, thousands of times
+    an hour). Startup-time, then silence."""
+    global _donation_warned
+    if _donation_warned:
+        return
+    _donation_warned = True
+    warnings.warn(
+        f"lower_predict_cate: buffer donation is not implemented on the "
+        f"{backend!r} backend; compiling the non-donated executable "
+        "(warned once per process)",
+        RuntimeWarning,
+        stacklevel=3,
     )
 
 

@@ -10,6 +10,11 @@ One import surface for the three parts:
   textfile, all atomic) — observability.export / observability.promtext
 * device & compile capture (cost analysis, memory stats, compile-cache
   listeners) — observability.device
+* trace timeline + analyzers (Perfetto export, critical path/overlap,
+  the serving report) — observability.trace / .critical_path /
+  .serving_report
+* SLO engine (declared objectives, multi-window burn rates) —
+  observability.slo
 
 Master switch: ``ATE_TPU_TELEMETRY=0`` disables everything at a cached
 bool check per hook. All instrumentation is host-side, outside jitted

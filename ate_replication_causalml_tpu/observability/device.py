@@ -112,6 +112,17 @@ def install_jax_monitoring() -> bool:
             "jax trace/lower/backend-compile events by kind").inc(0)
     bucket_histogram("serving_request_seconds",
                      "served request latency (enqueue to reply)")
+    # Serving lifecycle decomposition families (ISSUE 7): the per-phase
+    # seconds counter and the batch close-reason counter are contract
+    # families ("no batch ever closed" is a recorded 0); the per-phase
+    # bucket-histogram ladder is fixed here once so every emitter
+    # shares comparable buckets.
+    counter("serving_phase_seconds_total",
+            "summed per-request lifecycle phase seconds").inc(0)
+    counter("serving_batch_close_total",
+            "micro-batch close reasons").inc(0)
+    bucket_histogram("serving_phase_seconds",
+                     "per-request lifecycle phase durations")
     if _installed:
         return True
     try:

@@ -238,6 +238,30 @@ class BucketHistogram:
             out[key] = self._quantile(s, q)
         return out
 
+    def peek_counts(self) -> dict[str, dict]:
+        """Lock-held copy of the raw samples (count/sum/min/max/buckets
+        per label set) — the cheap read the SLO engine and the serving
+        ``stats`` op take; no quantile derivation, no collector scan."""
+        with self._lock:
+            return {
+                k: dict(s, buckets=list(s["buckets"]))
+                for k, s in self.samples.items()
+            }
+
+    def good_total_le(self, threshold: float) -> tuple[int, int]:
+        """``(good, total)`` observation counts summed across all label
+        sets, where *good* means the observation landed in a bucket
+        whose upper bound is ≤ ``threshold`` — the conservative
+        (Prometheus-style) reading the latency SLOs use: a value inside
+        the first bucket straddling the threshold counts as bad."""
+        k = bisect.bisect_right(self.bounds, float(threshold))
+        good = total = 0
+        with self._lock:
+            for s in self.samples.values():
+                total += s["count"]
+                good += sum(s["buckets"][:k])
+        return good, total
+
 
 class MetricsRegistry:
     """Thread-safe named-metric store with collector hooks.
@@ -310,6 +334,14 @@ class MetricsRegistry:
         with self._lock:
             if fn not in self._collectors:
                 self._collectors.append(fn)
+
+    def family(self, name: str):
+        """The metric object registered under ``name`` (or None) — the
+        read-only accessor derived readers (the SLO engine, the serving
+        ``stats`` op) use to reach bucket counts without growing the
+        registry a new family as :meth:`counter`/:meth:`gauge` would."""
+        with self._lock:
+            return self._metrics.get(name)
 
     def metrics(self) -> Iterator[Counter | Gauge | Histogram]:
         with self._lock:

@@ -1,0 +1,250 @@
+"""SLO engine: declared objectives over rolling windows (ISSUE 7).
+
+The serving daemon's metrics say what *happened*; this module says
+whether that is *acceptable*. An :class:`SLO` declares an objective —
+"99.9% of requests succeed", "99% of requests finish under 250 ms" —
+and the :class:`SLOEngine` evaluates it over multiple rolling windows
+as a **burn rate**: the rate the error budget is being spent, where
+1.0 means "exactly on budget" and N means "the budget for the whole
+window is gone in 1/N of it" (the multi-window burn-rate alerting shape
+from the SRE workbook). Short windows catch fast regressions, long
+windows catch slow leaks; the schema checker pins the windows ladder
+ascending so a report is always readable smallest-to-largest.
+
+Sources are the existing registry families — no new instrumentation:
+
+* ``latency`` SLOs read a :class:`~.registry.BucketHistogram` (good =
+  observations in buckets whose upper bound is ≤ the threshold, the
+  conservative Prometheus-style reading);
+* ``availability`` SLOs read a labeled counter (good = the samples
+  matching ``good_match``, total = all samples).
+
+Determinism: the engine never free-runs. Every window figure is a
+difference between two explicit :meth:`SLOEngine.tick` snapshots taken
+from an injectable clock, so a test can replay a hand-built histogram
+sequence and assert exact burn rates — and two evaluations over the
+same snapshots produce bit-identical reports. jax-free by construction
+(this module imports only the registry); it must be importable on
+hosts that will never initialize a backend.
+
+Consumers: the admin endpoint's ``/healthz``, the daemon's ``stats``
+op, and the ``slo_report.json`` written beside ``metrics.json`` at
+:meth:`CateServer.stop`/``dump`` (validated by
+``scripts/check_metrics_schema.py``).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Callable
+
+from ate_replication_causalml_tpu.observability import registry as _registry
+
+#: slo_report.json layout version.
+SLO_SCHEMA_VERSION = 1
+
+#: Default multi-window ladder (ascending — enforced): 1 min for fast
+#: burns, 5 min for sustained ones, 30 min for slow leaks.
+DEFAULT_WINDOWS: tuple[float, ...] = (60.0, 300.0, 1800.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class SLO:
+    """One declared objective over one registry family."""
+
+    name: str
+    #: "latency" (bucket histogram + threshold) or "availability"
+    #: (labeled counter + good_match).
+    kind: str
+    #: target good fraction in (0, 1) — e.g. 0.999 ⇒ a 0.1% budget.
+    objective: float
+    #: source metric family name in the registry.
+    metric: str
+    #: rolling windows, seconds, strictly ascending.
+    windows_s: tuple[float, ...] = DEFAULT_WINDOWS
+    #: latency only: observations ≤ this are good.
+    threshold_s: float | None = None
+    #: availability only: the ``k=v`` label pair that marks a sample
+    #: good (matched against the registry's canonical label key).
+    good_match: str = "status=ok"
+
+    def __post_init__(self):
+        if self.kind not in ("latency", "availability"):
+            raise ValueError(f"SLO {self.name}: unknown kind {self.kind!r}")
+        if not 0.0 < self.objective < 1.0:
+            raise ValueError(
+                f"SLO {self.name}: objective must be in (0, 1), "
+                f"got {self.objective}"
+            )
+        windows = tuple(float(w) for w in self.windows_s)
+        if not windows or any(w <= 0 for w in windows) or any(
+            b <= a for a, b in zip(windows, windows[1:])
+        ):
+            raise ValueError(
+                f"SLO {self.name}: windows must be positive and strictly "
+                f"ascending, got {self.windows_s!r}"
+            )
+        object.__setattr__(self, "windows_s", windows)
+        if self.kind == "latency" and self.threshold_s is None:
+            raise ValueError(f"SLO {self.name}: latency SLOs need threshold_s")
+
+
+def default_serving_slos(
+    latency_threshold_s: float = 0.25,
+    windows_s: tuple[float, ...] = DEFAULT_WINDOWS,
+) -> tuple[SLO, ...]:
+    """The daemon's stock objectives: 99.9% of requests reach a
+    terminal ``ok`` (rejects and errors spend the budget), 99% of
+    served requests complete under the latency threshold."""
+    return (
+        SLO(name="availability", kind="availability", objective=0.999,
+            metric="serving_requests_total", windows_s=windows_s),
+        SLO(name="latency", kind="latency", objective=0.99,
+            metric="serving_request_seconds", windows_s=windows_s,
+            threshold_s=latency_threshold_s),
+    )
+
+
+class SLOEngine:
+    """Rolling-window burn-rate evaluation over registry snapshots.
+
+    :meth:`tick` records the current cumulative (good, total) per SLO;
+    :meth:`evaluate` ticks once more and differences the history, so a
+    window's figures are always "what happened between two explicit
+    clock readings" — injectable-clock deterministic. History is
+    bounded by the longest declared window (plus slack), so a
+    week-long daemon cannot grow it unbounded.
+    """
+
+    def __init__(
+        self,
+        slos: tuple[SLO, ...] | list[SLO] | None = None,
+        registry: _registry.MetricsRegistry | None = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.slos = tuple(slos) if slos is not None else default_serving_slos()
+        names = [s.name for s in self.slos]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate SLO names: {names}")
+        self._registry = registry if registry is not None else _registry.REGISTRY
+        self._clock = clock
+        self._lock = threading.Lock()
+        #: (tick_mono, {slo_name: (good, total)}), oldest first.
+        self._history: collections.deque = collections.deque()
+        longest = max(
+            (w for s in self.slos for w in s.windows_s), default=60.0
+        )
+        self._retention_s = longest * 1.25 + 1.0
+
+    # ── snapshot side ────────────────────────────────────────────────
+
+    def _totals(self, slo: SLO) -> tuple[float, float]:
+        """Current cumulative ``(good, total)`` for one SLO."""
+        m = self._registry.family(slo.metric)
+        if m is None:
+            return 0.0, 0.0
+        if slo.kind == "latency":
+            if not isinstance(m, _registry.BucketHistogram):
+                raise TypeError(
+                    f"SLO {slo.name}: metric {slo.metric!r} is {m.kind}, "
+                    "latency SLOs need a bucket_histogram"
+                )
+            good, total = m.good_total_le(slo.threshold_s)
+            return float(good), float(total)
+        samples = self._registry.peek(slo.metric) or {}
+        total = float(sum(samples.values()))
+        good = float(sum(
+            v for k, v in samples.items() if slo.good_match in k.split(",")
+        ))
+        return good, total
+
+    def tick(self) -> float:
+        """Record one snapshot; returns its clock reading. The daemon
+        ticks after every dispatched batch (cheap: one dict copy per
+        family under the registry lock) and the admin/stats/report
+        paths tick implicitly via :meth:`evaluate`."""
+        now = self._clock()
+        totals = {slo.name: self._totals(slo) for slo in self.slos}
+        with self._lock:
+            self._history.append((now, totals))
+            while self._history and (
+                now - self._history[0][0] > self._retention_s
+            ):
+                self._history.popleft()
+        return now
+
+    # ── evaluation side ──────────────────────────────────────────────
+
+    @staticmethod
+    def _baseline(hist, now: float, window_s: float):
+        """The snapshot a window differences against: the NEWEST tick
+        at or before ``now - window_s``, or the oldest tick while the
+        window is not yet filled (reported via ``actual_s``)."""
+        base = hist[0]
+        for t, totals in hist:
+            if t <= now - window_s:
+                base = (t, totals)
+            else:
+                break
+        return base
+
+    def evaluate(self) -> dict:
+        """Tick, then render the full ``slo_report.json`` payload."""
+        now = self.tick()
+        with self._lock:
+            hist = list(self._history)
+        slos_out = []
+        for slo in self.slos:
+            cur_good, cur_total = hist[-1][1][slo.name]
+            budget = 1.0 - slo.objective
+            windows = []
+            worst = 0.0
+            for w in slo.windows_s:
+                bt, btotals = self._baseline(hist, now, w)
+                base_good, base_total = btotals[slo.name]
+                d_good = cur_good - base_good
+                d_total = cur_total - base_total
+                err = (
+                    max(0.0, 1.0 - d_good / d_total) if d_total > 0 else 0.0
+                )
+                burn = err / budget
+                worst = max(worst, burn)
+                windows.append({
+                    "window_s": w,
+                    "actual_s": round(now - bt, 6),
+                    "good": d_good,
+                    "total": d_total,
+                    "error_rate": round(err, 6),
+                    "burn_rate": round(burn, 4),
+                })
+            slos_out.append({
+                "name": slo.name,
+                "kind": slo.kind,
+                "objective": slo.objective,
+                "threshold_s": slo.threshold_s,
+                "metric": slo.metric,
+                "windows": windows,
+                "worst_burn_rate": round(worst, 4),
+                # burning = the budget is being spent faster than it
+                # accrues in at least one window.
+                "burning": worst > 1.0,
+            })
+        return {"schema_version": SLO_SCHEMA_VERSION, "slos": slos_out}
+
+    def health(self) -> dict:
+        """The compact form ``/healthz`` and the ``stats`` op embed:
+        per-SLO worst burn rate + the overall burning flag."""
+        report = self.evaluate()
+        return {
+            "burning": any(s["burning"] for s in report["slos"]),
+            "slos": {
+                s["name"]: {
+                    "worst_burn_rate": s["worst_burn_rate"],
+                    "burning": s["burning"],
+                }
+                for s in report["slos"]
+            },
+        }

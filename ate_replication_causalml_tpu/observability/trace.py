@@ -56,19 +56,25 @@ _TRACE_ENV = "ATE_TPU_TRACE"
 #: contract: ``node`` slices are the scheduler's execution intervals,
 #: ``lane`` slices are their duplicated lane-occupancy view (never
 #: counted as busy time twice), ``commit`` and ``prefetch`` feed the
-#: serialization-blame section.
+#: serialization-blame section; ``request``/``batch`` are the serving
+#: daemon's lifecycle slices (observability/serving_report.py's parse
+#: contract, ISSUE 7).
 _CATEGORIES = {
     "scheduler_node": "node",
     "commit": "commit",
     "prefetch_compile": "prefetch",
+    "serving_request": "request",
+    "serving_batch": "batch",
 }
 
 _PID = 1
 
-#: track-category sort order in the Perfetto UI: workers first, then
-#: lanes, the prefetch lane, the committer, counters last.
-_SORT = {"worker": 0, "lane": 100, "prefetch": 200, "committer": 300,
-         "counter": 400}
+#: track-category sort order in the Perfetto UI: serving connections
+#: first (one track per connection thread), then the dispatcher/device
+#: track, workers, lanes, the prefetch lane, the committer, counters
+#: last.
+_SORT = {"conn": 0, "dispatch": 50, "worker": 60, "lane": 100,
+         "prefetch": 200, "committer": 300, "counter": 400}
 
 
 def trace_enabled() -> bool:
@@ -91,6 +97,13 @@ def _track_of(rec: dict) -> tuple[str, str]:
     if track:
         return ("worker", str(track))
     name = rec.get("thread_name") or f"thread-{rec.get('thread', '?')}"
+    # Serving track semantics (ISSUE 7): request spans render one track
+    # per connection (producer) thread; batch spans render on the
+    # dispatcher/device track — the thread that owns the device.
+    if rec.get("name") == "serving_request":
+        return ("conn", str(name))
+    if rec.get("name") == "serving_batch":
+        return ("dispatch", str(name))
     return ("worker", str(name))
 
 
@@ -148,6 +161,8 @@ def build_trace(records: list[dict] | None = None,
     flow_id = 0
     artifact_slices: dict[str, dict] = {}
     stage_slices: list[dict] = []
+    request_slices: list[dict] = []
+    batch_by_seq: dict[int, dict] = {}
     counter_series: set[str] = set()
 
     for rec in sorted(records, key=lambda r: (r["start_mono_s"], r["span_id"])):
@@ -200,6 +215,10 @@ def build_trace(records: list[dict] | None = None,
                 artifact_slices[str(attrs.get("node"))] = slice_ev
             elif attrs.get("needs"):
                 stage_slices.append(slice_ev)
+        elif cat == "request" and attrs.get("batch_seq") is not None:
+            request_slices.append(slice_ev)
+        elif cat == "batch" and attrs.get("seq") is not None:
+            batch_by_seq[int(attrs["seq"])] = slice_ev
 
     # ── flow arrows: artifact fit -> each consuming stage ─────────────
     for stage_ev in stage_slices:
@@ -214,6 +233,25 @@ def build_trace(records: list[dict] | None = None,
                                ts=src["ts"] + src["dur"]))
             events.append(dict(common, ph="f", bp="e", tid=stage_ev["tid"],
                                ts=stage_ev["ts"]))
+
+    # ── serving flow arrows: request → batch → reply (ISSUE 7) ────────
+    # One three-point chain per coalesced request: start at the request
+    # slice's enqueue, step through the micro-batch it rode on the
+    # dispatcher track, finish back on the connection track at reply —
+    # Perfetto draws the coalescer's fan-in/fan-out on the timeline.
+    for req_ev in request_slices:
+        batch_ev = batch_by_seq.get(int(req_ev["args"]["batch_seq"]))
+        if batch_ev is None:
+            continue  # batch span missing (ring-evicted): no arrow
+        flow_id += 1
+        common = {"cat": "req", "name": "request",
+                  "id": flow_id, "pid": _PID}
+        events.append(dict(common, ph="s", tid=req_ev["tid"],
+                           ts=req_ev["ts"]))
+        events.append(dict(common, ph="t", tid=batch_ev["tid"],
+                           ts=batch_ev["ts"]))
+        events.append(dict(common, ph="f", bp="e", tid=req_ev["tid"],
+                           ts=req_ev["ts"] + req_ev["dur"]))
 
     # ── metadata: names + deterministic sort order ────────────────────
     meta_events = [{
@@ -323,6 +361,16 @@ class MetricSampler:
         "shard_backoff_seconds_total",
         "device_memory_bytes",
         "scheduler_prefetch_total",
+    )
+
+    #: The families the serving daemon samples instead (ISSUE 7): the
+    #: live queue depth and the request/reject/batch counters become
+    #: Perfetto counter tracks over the serving window.
+    SERVING_METRICS = (
+        "serving_requests_total",
+        "serving_rejected_total",
+        "serving_queue_depth",
+        "serving_batches_total",
     )
 
     def __init__(self, metrics: tuple[str, ...] | None = None,

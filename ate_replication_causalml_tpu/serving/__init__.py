@@ -4,16 +4,22 @@ The subsystem splits along the jax boundary:
 
 * no-jax core (importable anywhere, unit-tested in tier-1):
   :mod:`.protocol` (length-prefixed framing), :mod:`.coalescer`
-  (deadline-window micro-batching onto compiled buckets),
-  :mod:`.admission` (bounded-depth admission control + the
-  lifecycle/reload state machine), :mod:`.client`;
+  (deadline-window micro-batching onto compiled buckets, plus the
+  per-request lifecycle marks), :mod:`.admission` (bounded-depth
+  admission control + the lifecycle/reload state machine),
+  :mod:`.client`, :mod:`.admin` (the read-only HTTP endpoint) and
+  :mod:`.loadgen` (the seeded open-loop load-replay harness);
 * the daemon itself (:mod:`.daemon`): verified checkpoint load, one
   AOT-compiled predict executable per declared batch bucket, a
-  dispatcher whose steady state provably never compiles, and
-  degraded-mode serving under the ``serve:`` chaos scope.
+  dispatcher whose steady state provably never compiles, degraded-mode
+  serving under the ``serve:`` chaos scope, and the observability
+  plane (ISSUE 7): per-request phase decomposition, serving trace +
+  ``serving_report.json`` + ``slo_report.json`` export, live admin
+  endpoint.
 
 Entry points: ``scripts/serve.py`` (daemon CLI),
-``scripts/serve_client.py`` (load-gen/demo client), ``bench.py
+``scripts/serve_client.py`` (load-gen/demo client),
+``scripts/loadgen.py`` (deterministic load replay), ``bench.py
 --serving`` (the ``serving_quick`` record).
 """
 

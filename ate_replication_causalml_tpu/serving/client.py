@@ -55,6 +55,11 @@ class CateClient:
         self._proc = proc
         self._sock = sock
         self._seq = itertools.count(1)
+        #: retryable rejects absorbed by predict(), by wire code — the
+        #: backpressure this connection actually saw (loadgen folds it
+        #: into its record; an operator reading reject_retries == {}
+        #: must be able to trust it).
+        self.retry_counts: dict[str, int] = {}
 
     @classmethod
     def connect(cls, host: str, port: int, timeout: float = 10.0
@@ -117,6 +122,7 @@ class CateClient:
                         code, header.get("message", ""), attempt
                     )
                 raise ServingError(code, header.get("message", ""))
+            self.retry_counts[code] = self.retry_counts.get(code, 0) + 1
             time.sleep(float(header.get("retry_after_s", 0.05)))
         raise AssertionError("unreachable")
 
@@ -130,6 +136,20 @@ class CateClient:
             raise ServingError(header.get("error", "error"),
                                header.get("message", ""))
         return header["stats"]
+
+    def dump(self, outdir: str | None = None) -> list[str]:
+        """Ask the daemon to export its observability artifacts
+        (trace.json / serving_report.json / slo_report.json + the
+        metrics triple) without stopping. ``outdir`` defaults to the
+        daemon's ``$ATE_TPU_METRICS_DIR``. Returns the paths written."""
+        header_out: dict = {"op": "dump"}
+        if outdir is not None:
+            header_out["dir"] = outdir
+        header, _ = self._roundtrip(header_out)
+        if not header.get("ok"):
+            raise ServingError(header.get("error", "error"),
+                               header.get("message", ""))
+        return list(header.get("paths", ()))
 
     def shutdown(self) -> None:
         """Ask the daemon to exit (acknowledged before it stops)."""

@@ -19,15 +19,36 @@ that are simply never sliced back — the bit-identity tests pin this).
 All timing is injectable (``clock=``) so the deadline math is testable
 without sleeping, and monotonic — wall-clock jumps must not flush or
 starve batches (graftlint JGL009).
+
+Observability (ISSUE 7): every closed batch carries its close *reason*
+(``bucket_full`` / ``next_wont_fit`` / ``window_expired`` / ``drain``),
+the clock reading at close, and a monotonically increasing sequence
+number — the marks the per-request lifecycle decomposition and the
+serving trace's request→batch flow arrows are built from. The request
+itself accumulates the remaining marks (picked up by the dispatcher,
+device entry/exit, resolved) as it travels; :meth:`PendingRequest.
+phase_seconds` telescopes them into the canonical phase breakdown whose
+sum IS the end-to-end latency.
 """
 
 from __future__ import annotations
 
 import bisect
 import dataclasses
+import itertools
 import threading
 import time
 from typing import Callable, NamedTuple
+
+#: The per-request lifecycle phases, in timeline order. Durations are
+#: differences of consecutive monotonic marks, so they telescope:
+#: their sum equals ``resolved_mono - enqueued_mono`` exactly (up to
+#: float rounding — the acceptance tests allow ±1 µs).
+PHASES = ("coalesce_wait", "queue_wait", "dispatch", "device", "reply")
+
+#: The batch close reasons the coalescer can report (precedence order:
+#: a batch that is both full and expired closed because it was full).
+CLOSE_REASONS = ("bucket_full", "next_wont_fit", "window_expired", "drain")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -77,10 +98,16 @@ class PendingRequest:
     """One admitted request travelling through the coalescer. The
     producer blocks on :meth:`wait`; the dispatcher fills exactly one of
     ``result`` / ``error`` and fires the event. Timing marks are
-    monotonic and used for the latency histogram."""
+    monotonic; the lifecycle marks (batch close, dispatcher pickup,
+    device entry/exit) are stamped as the request travels and feed the
+    per-phase latency decomposition (ISSUE 7). All marks are written
+    before the done-event publication and only read after it — the
+    event is the memory barrier, so the marks need no lock."""
 
     __slots__ = (
         "request_id", "x", "rows", "enqueued_mono", "resolved_mono",
+        "batch_closed_mono", "picked_mono", "device_start_mono",
+        "device_end_mono", "batch_seq", "batch_bucket", "batch_fill",
         "result", "error", "_done",
     )
 
@@ -90,6 +117,13 @@ class PendingRequest:
         self.rows = rows
         self.enqueued_mono = enqueued_mono
         self.resolved_mono: float | None = None
+        self.batch_closed_mono: float | None = None
+        self.picked_mono: float | None = None
+        self.device_start_mono: float | None = None
+        self.device_end_mono: float | None = None
+        self.batch_seq: int | None = None
+        self.batch_bucket: int | None = None
+        self.batch_fill: float | None = None
         self.result = None
         self.error: BaseException | None = None
         self._done = threading.Event()
@@ -107,15 +141,41 @@ class PendingRequest:
     def wait(self, timeout: float | None = None) -> bool:
         return self._done.wait(timeout)
 
+    def phase_seconds(self) -> dict[str, float] | None:
+        """The lifecycle decomposition for a SERVED request, or None
+        while unresolved / failed before full mark coverage. Phases are
+        consecutive mark differences (:data:`PHASES` order), so::
+
+            sum(phase_seconds().values()) == resolved_mono - enqueued_mono
+
+        exactly up to float rounding — the property the acceptance
+        criteria pin at ±1 µs."""
+        marks = (
+            self.enqueued_mono, self.batch_closed_mono, self.picked_mono,
+            self.device_start_mono, self.device_end_mono,
+            self.resolved_mono,
+        )
+        if any(m is None for m in marks):
+            return None
+        return {
+            phase: marks[i + 1] - marks[i]
+            for i, phase in enumerate(PHASES)
+        }
+
 
 class Batch(NamedTuple):
     """A closed batch: the requests, their real row total, the compiled
-    bucket it rides, and the fill ratio the metrics report."""
+    bucket it rides, the fill ratio the metrics report, plus the close
+    bookkeeping (reason, clock reading, sequence number) the lifecycle
+    decomposition and the serving trace are built from."""
 
     requests: tuple[PendingRequest, ...]
     rows: int
     bucket: int
     fill: float
+    close_reason: str = "bucket_full"
+    closed_mono: float = 0.0
+    seq: int = 0
 
 
 class Coalescer:
@@ -140,6 +200,7 @@ class Coalescer:
         self._cond = threading.Condition()
         self._pending: list[PendingRequest] = []
         self._closed = False
+        self._seq = itertools.count(1)
 
     def submit(self, req: PendingRequest) -> None:
         """Enqueue an admitted request (rows already validated against
@@ -177,7 +238,9 @@ class Coalescer:
         beats head-of-line blocking), (c) the oldest waiter's window
         expired, or (d) the coalescer is draining. Re-acquires the
         condition (an RLock underneath), so it is safe both from
-        :meth:`next_batch` and standalone in tests."""
+        :meth:`next_batch` and standalone in tests. The close reason is
+        recorded in precedence order (a batch that is both full and
+        expired closed because it was full)."""
         with self._cond:
             take: list[PendingRequest] = []
             total = 0
@@ -188,16 +251,28 @@ class Coalescer:
                 total += req.rows
             if not take:
                 return None
-            full = (
-                total == self.plan.max_rows
-                or len(take) < len(self._pending)
-            )
             expired = now - take[0].enqueued_mono >= self.window_s
-            if not (full or expired or self._closed):
+            if total == self.plan.max_rows:
+                reason = "bucket_full"
+            elif len(take) < len(self._pending):
+                reason = "next_wont_fit"
+            elif expired:
+                reason = "window_expired"
+            elif self._closed:
+                reason = "drain"
+            else:
                 return None
             del self._pending[: len(take)]
             bucket = self.plan.bucket_for(total)
-            return Batch(tuple(take), total, bucket, total / bucket)
+            batch = Batch(tuple(take), total, bucket, total / bucket,
+                          close_reason=reason, closed_mono=now,
+                          seq=next(self._seq))
+            for req in take:
+                req.batch_closed_mono = now
+                req.batch_seq = batch.seq
+                req.batch_bucket = bucket
+                req.batch_fill = batch.fill
+            return batch
 
     def next_batch(self, timeout: float | None = None) -> Batch | None:
         """Dispatcher entry: block until a batch closes, the coalescer

@@ -36,6 +36,26 @@ Every protocol request gets a ``serving_request`` span; latencies ride
 the ``serving_request_seconds`` bucket histogram, queue depth and batch
 fill the registry, and everything exports through the same atomic
 ``metrics.json`` path as the sweep.
+
+The observability plane (ISSUE 7) rides the same machinery:
+
+* every request carries monotonic lifecycle marks (admission →
+  coalescer close → dispatcher pickup → device entry/exit → reply), so
+  its latency decomposes into ``coalesce_wait / queue_wait / dispatch /
+  device / reply`` — per-phase bucket histograms + span attrs whose sum
+  IS the end-to-end latency;
+* ``stop()`` (and the ``dump`` op) export the serving window's
+  ``trace.json`` (one track per connection, a dispatcher/device track,
+  request→batch→reply flow arrows) plus ``serving_report.json`` (a pure
+  function of the trace — ``scripts/analyze_trace.py`` recomputes it
+  bit-for-bit) and ``slo_report.json`` (multi-window burn rates from
+  ``observability/slo.py``);
+* an optional read-only admin endpoint (``serving/admin.py``,
+  ``ATE_TPU_SERVE_ADMIN_PORT``) serves ``/metrics`` / ``/healthz`` /
+  ``/readyz`` / ``/varz`` live — degraded serving is a 503 on readyz.
+
+None of it traces or compiles jax — the zero-compile window assertion
+in :meth:`CateServer.stop` holds with the whole plane active.
 """
 
 from __future__ import annotations
@@ -49,6 +69,11 @@ import time
 import numpy as np
 
 from ate_replication_causalml_tpu import observability as obs
+from ate_replication_causalml_tpu.observability.slo import (
+    DEFAULT_WINDOWS,
+    SLOEngine,
+    default_serving_slos,
+)
 from ate_replication_causalml_tpu.resilience import chaos
 from ate_replication_causalml_tpu.serving import protocol
 from ate_replication_causalml_tpu.serving.admission import (
@@ -67,11 +92,14 @@ ENV_BUCKETS = "ATE_TPU_SERVE_BUCKETS"
 ENV_WINDOW_MS = "ATE_TPU_SERVE_WINDOW_MS"
 ENV_DEPTH = "ATE_TPU_SERVE_DEPTH"
 ENV_RETRY_AFTER_MS = "ATE_TPU_SERVE_RETRY_AFTER_MS"
+ENV_ADMIN_PORT = "ATE_TPU_SERVE_ADMIN_PORT"
+ENV_SLO_MS = "ATE_TPU_SERVE_SLO_MS"
 
 DEFAULT_BUCKETS = "1,8,64,256"
 DEFAULT_WINDOW_MS = 2.0
 DEFAULT_DEPTH = 64
 DEFAULT_RETRY_AFTER_MS = 50.0
+DEFAULT_SLO_LATENCY_MS = 250.0
 
 
 class RejectedRequest(RuntimeError):
@@ -106,6 +134,13 @@ class ServeConfig:
     #: stop() raises if the serving window recorded any compile event;
     #: the enforcement knob exists for diagnostics, not for production.
     strict_no_compile: bool = True
+    #: admin endpoint (ISSUE 7): None = off (the default); an int binds
+    #: that TCP port on startup (0 = ephemeral, for tests).
+    admin_port: int | None = None
+    #: latency-SLO threshold: requests over this spend the error budget.
+    slo_latency_s: float = DEFAULT_SLO_LATENCY_MS / 1e3
+    #: multi-window burn-rate ladder (ascending; see observability/slo).
+    slo_windows_s: tuple[float, ...] = DEFAULT_WINDOWS
 
     @classmethod
     def from_env(cls, checkpoint: str, **overrides) -> "ServeConfig":
@@ -117,7 +152,12 @@ class ServeConfig:
             retry_after_s=float(
                 env.get(ENV_RETRY_AFTER_MS, DEFAULT_RETRY_AFTER_MS)
             ) / 1e3,
+            slo_latency_s=float(
+                env.get(ENV_SLO_MS, DEFAULT_SLO_LATENCY_MS)
+            ) / 1e3,
         )
+        if env.get(ENV_ADMIN_PORT):
+            base["admin_port"] = int(env[ENV_ADMIN_PORT])
         base.update(overrides)
         return cls(checkpoint=checkpoint, **base)
 
@@ -148,9 +188,19 @@ class CateServer:
         self._compile_mark: float | None = None
         self._startup_s: dict[str, float] = {}
         self._dispatcher: threading.Thread | None = None
+        # Everything the serving trace exports is filtered to records
+        # at/after this mark — the event log is a process-global ring
+        # shared with whatever ran before the daemon.
+        self._born_mono = time.monotonic()
         self._reloader = ReloadSupervisor(
             self.lifecycle, self._load_checkpoint, self._install_model
         )
+        self.slo = SLOEngine(default_serving_slos(
+            latency_threshold_s=config.slo_latency_s,
+            windows_s=config.slo_windows_s,
+        ))
+        self._admin = None
+        self._sampler: obs.MetricSampler | None = None
         self._requests = obs.counter(
             "serving_requests_total", "CATE serving requests by terminal status"
         )
@@ -166,6 +216,26 @@ class CateServer:
         self._fill = obs.bucket_histogram(
             "serving_batch_fill",
             "micro-batch fill ratio (real rows / bucket rows)",
+            bounds=(0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
+        )
+        # Lifecycle decomposition (ISSUE 7): one bucket-histogram family
+        # labeled by phase (quantiles) plus a counter mirror (the
+        # schema-contract family — "no phase was ever recorded" must be
+        # an explicit 0 in metrics.json) and the batch close reasons.
+        self._phase_hist = obs.bucket_histogram(
+            "serving_phase_seconds",
+            "per-request lifecycle phase durations",
+        )
+        self._phase_total = obs.counter(
+            "serving_phase_seconds_total",
+            "summed per-request lifecycle phase seconds",
+        )
+        self._close_reasons = obs.counter(
+            "serving_batch_close_total", "micro-batch close reasons"
+        )
+        self._pad = obs.bucket_histogram(
+            "serving_pad_fraction",
+            "padded fraction of dispatched bucket rows (1 - fill)",
             bounds=(0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
         )
 
@@ -259,12 +329,45 @@ class CateServer:
         )
         for phase, secs in phases.items():
             g.set(secs, phase=phase)
+        self._start_observability_plane()
         with self._lock:
             self._startup_s = dict(phases)
             self._compile_mark = obs.compile_event_count()
         self.lifecycle.mark_ready()
         self._start_dispatcher()
         return phases
+
+    def _start_observability_plane(self) -> None:
+        """The ISSUE 7 plane: background counter sampling for the
+        serving trace, and the optional admin endpoint. Both are
+        jax-free — starting them inside the no-compile window is the
+        point (the window assertion proves they stay that way)."""
+        if obs.enabled() and obs.trace_enabled():
+            sampler = obs.MetricSampler(
+                metrics=obs.MetricSampler.SERVING_METRICS
+            )
+            sampler.start()
+            with self._lock:
+                self._sampler = sampler
+        if self.config.admin_port is not None:
+            from ate_replication_causalml_tpu.serving.admin import AdminServer
+
+            admin = AdminServer(self)
+            try:
+                bound = admin.start(self.config.admin_port)
+            except BaseException:
+                # A failed admin bind (port taken, privileged) aborts
+                # startup — but must not leak the sampler thread into a
+                # process that will never call stop().
+                with self._lock:
+                    sampler, self._sampler = self._sampler, None
+                if sampler is not None:
+                    sampler.stop()
+                raise
+            with self._lock:
+                self._admin = admin
+            obs.gauge("serving_admin_port", "bound admin HTTP port").set(bound)
+            obs.emit("serving_admin_started", status="ok", port=bound)
 
     def _start_dispatcher(self) -> None:
         with self._lock:
@@ -278,9 +381,16 @@ class CateServer:
     # ── request path (producers) ─────────────────────────────────────
 
     def _reject(self, code: str, message: str,
-                retry_after_s: float | None = None) -> RejectedRequest:
+                retry_after_s: float | None = None,
+                request_id: str = "") -> RejectedRequest:
         self._rejects.inc(1, reason=code)
         self._requests.inc(1, status=f"rejected_{code}")
+        # The reject timeline (ISSUE 7): one instant per refusal, so
+        # the serving trace/report show WHEN admission pushed back, not
+        # just how often. Covers every entry path — serve_one spans and
+        # raw submit() callers alike.
+        obs.emit("serving_reject", status="error", reason=code,
+                 request_id=str(request_id))
         return RejectedRequest(code, message, retry_after_s)
 
     def submit(self, request_id: str, x: np.ndarray) -> PendingRequest:
@@ -294,15 +404,18 @@ class CateServer:
             # String/object/datetime queries must become a typed reject,
             # not a connection-killing exception.
             raise self._reject(
-                "bad_request", f"x does not convert to float32 ({e})"
+                "bad_request", f"x does not convert to float32 ({e})",
+                request_id=request_id,
             ) from e
         if x.ndim != 2:
-            raise self._reject("bad_request", f"x must be 2-D, got {x.shape}")
+            raise self._reject("bad_request", f"x must be 2-D, got {x.shape}",
+                               request_id=request_id)
         with self._lock:
             p = self._n_features
         if p is not None and x.shape[1] != p:
             raise self._reject(
-                "bad_request", f"x has {x.shape[1]} features, model wants {p}"
+                "bad_request", f"x has {x.shape[1]} features, model wants {p}",
+                request_id=request_id,
             )
         rows = x.shape[0]
         if rows < 1 or rows > self.config.buckets.max_rows:
@@ -310,6 +423,7 @@ class CateServer:
                 "bad_request",
                 f"rows must be in [1, {self.config.buckets.max_rows}], "
                 f"got {rows} (chunk larger queries client-side)",
+                request_id=request_id,
             )
         inj = chaos.active()
         if inj is not None and inj.take_serve_fault(request_id):
@@ -320,20 +434,20 @@ class CateServer:
             raise self._reject(
                 "serve_fault",
                 "injected serving fault; degraded-mode recovery running",
-                self.config.retry_after_s,
+                self.config.retry_after_s, request_id=request_id,
             )
         if not self.lifecycle.can_serve():
             state = self.lifecycle.state
             raise self._reject(
                 "degraded" if state == "degraded" else state,
                 f"daemon is {state}",
-                self.config.retry_after_s,
+                self.config.retry_after_s, request_id=request_id,
             )
         if not self.admission.try_admit():
             raise self._reject(
                 "overloaded",
                 f"admission queue at max depth {self.config.max_depth}",
-                self.config.retry_after_s,
+                self.config.retry_after_s, request_id=request_id,
             )
         req = PendingRequest(
             str(request_id), x, rows, time.monotonic()
@@ -379,6 +493,21 @@ class CateServer:
             self._latency.observe(
                 req.resolved_mono - req.enqueued_mono, status="ok"
             )
+            # Lifecycle decomposition on the span (ISSUE 7): the phase
+            # attrs whose sum is the end-to-end latency, plus the batch
+            # linkage the trace exporter turns into request→batch→reply
+            # flow arrows and serving_report.json aggregates.
+            ph = req.phase_seconds()
+            if ph is not None:
+                for phase, secs in ph.items():
+                    sp.set_attr(f"{phase}_s", round(secs, 9))
+                sp.set_attr(
+                    "e2e_s",
+                    round(req.resolved_mono - req.enqueued_mono, 9),
+                )
+                sp.set_attr("batch_seq", req.batch_seq)
+                sp.set_attr("bucket", req.batch_bucket)
+                sp.set_attr("pad_fraction", round(1.0 - req.batch_fill, 6))
             return req.result
 
     # ── dispatch (the single device-owning thread) ───────────────────
@@ -395,27 +524,34 @@ class CateServer:
     def _dispatch(self, batch: Batch) -> None:
         import jax
 
+        picked = time.monotonic()
         with self._lock:
             model = self._model
             compiled = self._executables[batch.bucket]
             p = self._n_features
         now = time.monotonic
         with obs.span("serving_batch", bucket=batch.bucket,
-                      rows=batch.rows, requests=len(batch.requests)):
+                      rows=batch.rows, requests=len(batch.requests),
+                      seq=batch.seq, close_reason=batch.close_reason,
+                      fill=round(batch.fill, 6)):
             try:
                 padded = np.zeros((batch.bucket, p), np.float32)
                 off = 0
                 for req in batch.requests:
                     padded[off:off + req.rows] = req.x
                     off += req.rows
-                out = compiled(model, jax.device_put(padded), None)
+                x_dev = jax.device_put(padded)
+                device_start = now()
+                out = compiled(model, x_dev, None)
                 cate = np.asarray(out.cate)
                 var = np.asarray(out.variance)
+                device_end = now()
             except Exception as e:
                 # A dispatch failure fails THIS batch's requests typed
                 # and walks degraded recovery; the daemon itself
                 # survives (never-crash is the serving contract).
                 for req in batch.requests:
+                    req.picked_mono = picked
                     req.fail(e, now())
                     self.admission.release()
                 self._reloader.report_fault(
@@ -424,6 +560,9 @@ class CateServer:
                 return
             off = 0
             for req in batch.requests:
+                req.picked_mono = picked
+                req.device_start_mono = device_start
+                req.device_end_mono = device_end
                 req.resolve(
                     (cate[off:off + req.rows].copy(),
                      var[off:off + req.rows].copy()),
@@ -433,6 +572,18 @@ class CateServer:
                 self.admission.release()
         self._batches.inc(1, bucket=batch.bucket)
         self._fill.observe(batch.fill, bucket=batch.bucket)
+        self._close_reasons.inc(1, reason=batch.close_reason)
+        self._pad.observe(1.0 - batch.fill, bucket=batch.bucket)
+        for req in batch.requests:
+            ph = req.phase_seconds()
+            if ph is None:
+                continue
+            for phase, secs in ph.items():
+                self._phase_hist.observe(secs, phase=phase)
+                self._phase_total.inc(max(0.0, secs), phase=phase)
+        # One SLO snapshot per dispatched batch: cheap (a dict copy per
+        # family) and exactly as fresh as the data it judges.
+        self.slo.tick()
 
     # ── proof + shutdown ─────────────────────────────────────────────
 
@@ -450,9 +601,63 @@ class CateServer:
         with self._lock:
             return dict(self._startup_s)
 
+    @staticmethod
+    def _label_value(key: str, label: str) -> str | None:
+        """One label's value out of the registry's canonical label-key
+        string (``k=v,k2=v2``) — the single parser both decomposition
+        readers below share."""
+        return dict(
+            pair.split("=", 1) for pair in key.split(",") if "=" in pair
+        ).get(label)
+
+    def phase_stats(self) -> dict:
+        """p50/p99/count per lifecycle phase from the registry's bucket
+        histograms — the decomposition the ``stats`` op, loadgen and
+        ``bench.py --serving`` report. Empty before any batch served."""
+        m = obs.REGISTRY.family("serving_phase_seconds")
+        if m is None:
+            return {}
+        out: dict = {}
+        for key, s in sorted(m.peek_counts().items()):
+            phase = self._label_value(key, "phase")
+            if phase is None:
+                continue
+            snap = m.snapshot_sample(s)
+            out[phase] = {
+                "count": snap["count"],
+                "mean_s": snap["sum"] / snap["count"] if snap["count"] else 0.0,
+                "p50_s": snap["p50"],
+                "p99_s": snap["p99"],
+                "max_s": snap["max"],
+            }
+        return out
+
+    def close_reason_counts(self) -> dict[str, int]:
+        """Batches by close reason (window expiry vs bucket fill vs
+        next-wouldn't-fit vs drain) — the coalescer-policy blame."""
+        samples = obs.REGISTRY.peek("serving_batch_close_total") or {}
+        out: dict[str, int] = {}
+        for key, v in sorted(samples.items()):
+            reason = self._label_value(key, "reason")
+            if reason is not None and v:
+                out[reason] = int(v)
+        return out
+
+    def pad_fraction_mean(self) -> float:
+        """Mean padded fraction across all dispatched batches."""
+        m = obs.REGISTRY.family("serving_pad_fraction")
+        if m is None:
+            return 0.0
+        counts = m.peek_counts()
+        n = sum(s["count"] for s in counts.values())
+        return sum(s["sum"] for s in counts.values()) / n if n else 0.0
+
     def stats(self) -> dict:
-        """The ``stats`` op payload: state, depth, startup phases, and
-        the no-compile window term."""
+        """The ``stats`` op payload: state, depth, startup phases, the
+        no-compile window term, the per-phase latency decomposition and
+        the SLO burn-rate summary."""
+        with self._lock:
+            admin = self._admin
         return {
             "state": self.lifecycle.state,
             "queue_depth": self.admission.depth,
@@ -462,21 +667,68 @@ class CateServer:
             "compile_events_in_window": self.compile_events_in_window(),
             "faults": self.lifecycle.fault_count,
             "reloads": self.lifecycle.reload_count,
+            "phases": self.phase_stats(),
+            "close_reasons": self.close_reason_counts(),
+            "pad_fraction_mean": self.pad_fraction_mean(),
+            "admin_port": admin.port if admin is not None else None,
+            "slo": self.slo.health(),
         }
 
+    def dump_artifacts(self, outdir: str) -> list[str]:
+        """Export the serving window's full artifact set into
+        ``outdir``: metrics.json / events.jsonl / metrics.prom, the
+        serving ``trace.json`` + ``serving_report.json`` pair, and
+        ``slo_report.json``. Live-safe (the ``dump`` op calls this on a
+        serving daemon) and called by :meth:`stop` when
+        ``$ATE_TPU_METRICS_DIR`` is set. Returns the paths written."""
+        from ate_replication_causalml_tpu.observability import (
+            serving_report as _sreport,
+        )
+        from ate_replication_causalml_tpu.observability import trace as _trace
+
+        if not obs.enabled():
+            return []
+        os.makedirs(outdir, exist_ok=True)
+        paths = obs.write_run_artifacts(outdir)
+        if obs.trace_enabled():
+            # The event log is a process-global ring: keep only this
+            # daemon's window (same filter run_sweep applies).
+            records = [
+                r for r in obs.EVENTS.records()
+                if r.get("start_mono_s", 0.0) >= self._born_mono - 1e-6
+            ]
+            tr = _trace.build_trace(records, meta=_trace.run_meta(
+                tool="serving",
+                checkpoint=self.config.checkpoint,
+                buckets=",".join(str(b) for b in self.config.buckets.sizes),
+            ))
+            paths += _sreport.write_serving_artifacts(outdir, tr)
+        spath = os.path.join(outdir, _sreport.SLO_REPORT_BASENAME)
+        obs.atomic_write_json(spath, self.slo.evaluate())
+        paths.append(spath)
+        return paths
+
     def stop(self, timeout: float = 10.0) -> None:
-        """Drain, stop the dispatcher, export telemetry (when
-        ``$ATE_TPU_METRICS_DIR`` is set) and ENFORCE the no-compile
-        guarantee: any compile event inside the serving window raises
-        (``strict_no_compile=False`` downgrades to an error event for
-        diagnostics runs)."""
+        """Drain, stop the dispatcher and the observability plane,
+        export telemetry (when ``$ATE_TPU_METRICS_DIR`` is set) and
+        ENFORCE the no-compile guarantee: any compile event inside the
+        serving window raises (``strict_no_compile=False`` downgrades
+        to an error event for diagnostics runs)."""
         self._reloader.join(timeout)
         self.coalescer.close()
         self.lifecycle.mark_stopped()
         with self._lock:
             t = self._dispatcher
+            sampler = self._sampler
+            admin = self._admin
+            self._sampler = None
+            self._admin = None
         if t is not None:
             t.join(timeout)
+        if sampler is not None:
+            sampler.stop()
+        if admin is not None:
+            admin.stop()
         leaked = self.compile_events_in_window()
         obs.gauge(
             "serving_compile_events_in_window",
@@ -485,7 +737,7 @@ class CateServer:
         outdir = os.environ.get("ATE_TPU_METRICS_DIR")
         if outdir:
             try:
-                obs.write_run_artifacts(outdir)
+                self.dump_artifacts(outdir)
             except Exception as e:
                 # Telemetry export must never mask the serving outcome.
                 obs.emit("serving_export_failed", status="error",
@@ -539,6 +791,22 @@ def _handle_op(server: CateServer, header: dict, arrays: dict):
                 "state": server.lifecycle.state}, {}, False
     if op == "stats":
         return {"ok": True, "op": "stats", "stats": server.stats()}, {}, False
+    if op == "dump":
+        # Live artifact export (ISSUE 7): trace.json + serving_report
+        # + slo_report + metrics triple, without stopping the daemon.
+        outdir = header.get("dir") or os.environ.get("ATE_TPU_METRICS_DIR")
+        if not outdir:
+            return {"ok": False, "id": rid, "error": "bad_request",
+                    "message": "dump needs a 'dir' header field or "
+                               "$ATE_TPU_METRICS_DIR"}, {}, False
+        try:
+            paths = server.dump_artifacts(outdir)
+        except Exception as e:
+            obs.emit("serving_dump_failed", status="error",
+                     error=f"{type(e).__name__}: {e}")
+            return {"ok": False, "id": rid, "error": "error",
+                    "message": f"{type(e).__name__}: {e}"}, {}, False
+        return {"ok": True, "op": "dump", "paths": paths}, {}, False
     if op == "shutdown":
         return {"ok": True, "op": "shutdown"}, {}, True
     return {"ok": False, "error": "bad_request",
@@ -600,6 +868,7 @@ def serve_socket(server: CateServer, host: str = "127.0.0.1",
                     rw.close()
 
         threads: list[threading.Thread] = []
+        conn_seq = 0
         while not stop_evt.is_set():
             # Prune finished connections each pass — a long-lived daemon
             # accepts millions of short connections and must not retain
@@ -609,7 +878,11 @@ def serve_socket(server: CateServer, host: str = "127.0.0.1",
                 conn, _ = srv.accept()
             except socket.timeout:
                 continue
-            t = threading.Thread(target=_conn, args=(conn,), daemon=True)
+            conn_seq += 1
+            # The thread name IS the trace track: every connection gets
+            # its own timeline row in the exported serving trace.
+            t = threading.Thread(target=_conn, args=(conn,), daemon=True,
+                                 name=f"conn-{conn_seq}")
             t.start()
             threads.append(t)
         for t in threads:

@@ -1,0 +1,295 @@
+"""Deterministic load-replay harness for the CATE daemon (ISSUE 7).
+
+Open-loop traffic generation with a fully seeded schedule: the arrival
+process (exponential inter-arrival gaps — a Poisson process at the
+offered rate), the bucket mix (weighted row-count draws) and the query
+payloads are all pure functions of the seed, so the same seed replays
+the *identical* request stream — ids, timing, bytes — against any
+daemon. That buys two things:
+
+* **regression comparison** — two daemon builds measured under the
+  same seed saw the same offered load, so their latency records are
+  comparable;
+* **chaos coordination** — the ``serve:`` chaos scope selects faults by
+  a pure hash of the request id, and the schedule's ids are
+  deterministic (``{prefix}{index}``), so a chaos replay faults the
+  same requests every run and a retrying generator converges to
+  bit-identical answers.
+
+Open-loop means requests are *submitted at their scheduled time*, not
+when the previous reply lands — the arrival process never adapts to
+server latency, which is what makes overload visible as queue growth
+and admission rejects instead of silently throttled offered load.
+
+The schedule/record core is jax-free and numpy-only (tier-1 unit
+tests); :func:`run_inprocess` drives a live in-process
+:class:`~.daemon.CateServer` (what ``bench.py --serving`` uses) and
+:func:`run_wire` drives a TCP/stdio daemon through
+:class:`~.client.CateClient` pools (what ``scripts/loadgen.py`` uses).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Sequence
+
+import numpy as np
+
+#: default offered rate — fast enough to exercise coalescing at micro
+#: scale without turning the bench into a sleep festival.
+DEFAULT_RATE_HZ = 2000.0
+DEFAULT_MIX = "1:4,8:2,32:1"
+
+
+@dataclasses.dataclass(frozen=True)
+class ScheduledRequest:
+    """One planned request: when it is offered, under what id, with how
+    many rows."""
+
+    index: int
+    request_id: str
+    t_s: float
+    rows: int
+
+
+def parse_mix(spec: str) -> tuple[tuple[int, float], ...]:
+    """Parse a bucket-mix spec: ``"1:4,8:2,32:1"`` (rows:weight) or
+    ``"1,8,32"`` (equal weights). Weights need not normalize."""
+    out: list[tuple[int, float]] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        rows_s, _, weight_s = part.partition(":")
+        try:
+            rows = int(rows_s)
+            weight = float(weight_s) if weight_s else 1.0
+        except ValueError as e:
+            raise ValueError(f"bad mix entry {part!r} in {spec!r}") from e
+        if rows < 1 or weight <= 0:
+            raise ValueError(f"bad mix entry {part!r} in {spec!r}")
+        out.append((rows, weight))
+    if not out:
+        raise ValueError(f"empty mix spec {spec!r}")
+    return tuple(out)
+
+
+def build_schedule(
+    seed: int,
+    requests: int,
+    rate_hz: float = DEFAULT_RATE_HZ,
+    mix: str | Sequence[tuple[int, float]] = DEFAULT_MIX,
+    id_prefix: str = "r",
+) -> list[ScheduledRequest]:
+    """The deterministic open-loop schedule: same seed ⇒ identical
+    ``(id, t_s, rows)`` triples (pinned by a tier-1 test). Draw order
+    is fixed — all gaps first, then all row counts — so adding a new
+    randomized field later cannot silently reshuffle existing ones."""
+    if requests < 1:
+        raise ValueError(f"requests must be >= 1, got {requests}")
+    if rate_hz <= 0:
+        raise ValueError(f"rate_hz must be > 0, got {rate_hz}")
+    entries = parse_mix(mix) if isinstance(mix, str) else tuple(mix)
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / rate_hz, size=requests)
+    arrivals = np.cumsum(gaps)
+    weights = np.asarray([w for _, w in entries], dtype=np.float64)
+    rows = rng.choice(
+        np.asarray([r for r, _ in entries], dtype=np.int64),
+        size=requests, p=weights / weights.sum(),
+    )
+    return [
+        ScheduledRequest(
+            index=i,
+            request_id=f"{id_prefix}{i}",
+            t_s=float(arrivals[i]),
+            rows=int(rows[i]),
+        )
+        for i in range(requests)
+    ]
+
+
+def build_queries(
+    seed: int, schedule: Sequence[ScheduledRequest], features: int
+) -> list[np.ndarray]:
+    """Deterministic float32 query payloads matching the schedule's row
+    counts. A separate derived seed keeps payload bytes independent of
+    schedule-shape draws (changing the mix does not change row
+    values row-for-row)."""
+    rng = np.random.default_rng(np.random.SeedSequence((seed, 0x9E3779B9)))
+    return [
+        rng.normal(size=(s.rows, features)).astype(np.float32)
+        for s in schedule
+    ]
+
+
+def _percentiles(latencies_s: list[float]) -> dict:
+    from ate_replication_causalml_tpu.observability.serving_report import (
+        index_quantile,
+    )
+
+    s = sorted(latencies_s)
+    return {
+        "p50_s": index_quantile(s, 0.50),
+        "p90_s": index_quantile(s, 0.90),
+        "p99_s": index_quantile(s, 0.99),
+        "max_s": s[-1],
+        "mean_s": sum(s) / len(s),
+    }
+
+
+def _record(
+    schedule: Sequence[ScheduledRequest],
+    latencies_s: list[float],
+    duration_s: float,
+    retries: dict[str, int],
+    rate_hz: float,
+) -> dict:
+    out = {
+        "requests": len(schedule),
+        "served": len(latencies_s),
+        "rows_offered": int(sum(s.rows for s in schedule)),
+        "offered_rate_hz": rate_hz,
+        "duration_s": round(duration_s, 6),
+        "achieved_rate_hz": (
+            round(len(latencies_s) / duration_s, 3) if duration_s > 0 else 0.0
+        ),
+        "reject_retries": {k: retries[k] for k in sorted(retries)},
+    }
+    if latencies_s:
+        out.update({
+            k: round(v, 9) for k, v in _percentiles(latencies_s).items()
+        })
+    return out
+
+
+def run_inprocess(
+    server,
+    schedule: Sequence[ScheduledRequest],
+    queries: Sequence[np.ndarray],
+    timeout_s: float = 60.0,
+    max_attempts: int = 500,
+    clock: Callable[[], float] = time.monotonic,
+    sleep: Callable[[float], None] = time.sleep,
+) -> dict:
+    """Replay ``schedule`` open-loop against an in-process
+    :class:`~.daemon.CateServer` via :meth:`submit` — submissions are
+    paced by the schedule, never by replies. Typed retryable rejects
+    (overload backpressure, chaos faults, degraded windows) are retried
+    under the SAME id after the server's hint, exactly like a polite
+    production client; ``bad_request`` raises (a schedule that offends
+    the daemon's contract is a harness bug, not load)."""
+    from ate_replication_causalml_tpu.serving.daemon import RejectedRequest
+
+    t0 = clock()
+    pending = []
+    retries: dict[str, int] = {}
+    for sched, q in zip(schedule, queries):
+        delay = t0 + sched.t_s - clock()
+        if delay > 0:
+            sleep(delay)
+        for _ in range(max_attempts):
+            try:
+                pending.append(server.submit(sched.request_id, q))
+                break
+            except RejectedRequest as rej:
+                if rej.code == "bad_request":
+                    raise
+                retries[rej.code] = retries.get(rej.code, 0) + 1
+                sleep(rej.retry_after_s or 0.002)
+        else:
+            raise RuntimeError(
+                f"no progress on {sched.request_id} after "
+                f"{max_attempts} attempts"
+            )
+    latencies: list[float] = []
+    for req in pending:
+        if not req.wait(timeout_s):
+            raise TimeoutError(f"request {req.request_id} never served")
+        if req.error is not None:
+            raise req.error
+        latencies.append(req.resolved_mono - req.enqueued_mono)
+    duration = clock() - t0
+    offered = len(schedule) / schedule[-1].t_s if schedule[-1].t_s > 0 else 0.0
+    return _record(schedule, latencies, duration, retries, round(offered, 3))
+
+
+def run_wire(
+    client_factory: Callable[[], object],
+    schedule: Sequence[ScheduledRequest],
+    queries: Sequence[np.ndarray],
+    concurrency: int = 8,
+    max_retries: int = 64,
+    close_clients: bool = True,
+) -> dict:
+    """Replay ``schedule`` against a live daemon over the wire.
+    ``concurrency`` connections (one :class:`CateClient` each — the
+    client is not thread-safe) pull due requests from the shared
+    schedule; each blocks on its own round-trip, so pacing holds as
+    long as in-flight requests stay under ``concurrency`` (reported
+    offered-vs-achieved rate shows when it did not). Pass
+    ``close_clients=False`` when the factory hands out a borrowed
+    client the caller still needs (the stdio transport's single
+    pipe)."""
+    lock = threading.Lock()
+    next_idx = [0]
+    latencies: list[float] = []
+    errors: list[BaseException] = []
+    retries: dict[str, int] = {}
+    t0 = time.monotonic()
+
+    def worker() -> None:
+        client = client_factory()
+        try:
+            while True:
+                with lock:
+                    i = next_idx[0]
+                    if i >= len(schedule):
+                        return
+                    next_idx[0] = i + 1
+                sched = schedule[i]
+                delay = t0 + sched.t_s - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                sent = time.monotonic()
+                try:
+                    client.predict(
+                        queries[i], request_id=sched.request_id,
+                        max_retries=max_retries,
+                    )
+                except BaseException as e:
+                    with lock:
+                        errors.append(e)
+                    return
+                lat = time.monotonic() - sent
+                with lock:
+                    latencies.append(lat)
+        finally:
+            # Fold this connection's absorbed retryable rejects into
+            # the run record — reject_retries == {} must MEAN no
+            # backpressure, not "the wire path doesn't count".
+            counts = getattr(client, "retry_counts", {})
+            with lock:
+                for code, n in counts.items():
+                    retries[code] = retries.get(code, 0) + n
+            if close_clients:
+                try:
+                    client.close()
+                except OSError:
+                    pass
+
+    threads = [
+        threading.Thread(target=worker, name=f"loadgen-{i}", daemon=True)
+        for i in range(max(1, min(concurrency, len(schedule))))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    duration = time.monotonic() - t0
+    offered = len(schedule) / schedule[-1].t_s if schedule[-1].t_s > 0 else 0.0
+    return _record(schedule, latencies, duration, retries, round(offered, 3))

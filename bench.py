@@ -673,16 +673,26 @@ SERVE_BENCH_ROWS = int(os.environ.get("ATE_BENCH_SERVE_ROWS", 400))
 SERVE_BENCH_REQUESTS = 120
 
 
+#: the seeded loadgen replay behind the record — same seed ⇒ identical
+#: request stream, so serving records are comparable round to round.
+SERVE_BENCH_SEED = 0
+SERVE_BENCH_RATE_HZ = 2000.0
+
+
 def _serving_measurements(n=SERVE_BENCH_ROWS):
     """All the jax work behind the ``serving_quick`` record: fit a
     micro causal forest, round-trip it through a verified checkpoint,
     time the COLD offline predict (``jax.clear_caches()`` first — the
     fresh-process trace+compile tail NEXT.md §3 describes, measured
     BEFORE the daemon starts so its no-compile window stays clean),
-    then run the daemon startup phases and a pipelined ~120-request
-    window across the declared buckets. ``server.stop()`` enforces the
-    zero-compile assertion — a compile in the window fails the bench,
-    it does not footnote it."""
+    then run the daemon startup phases and a ~120-request deterministic
+    open-loop replay (``serving/loadgen.py``, ISSUE 7 — seeded Poisson
+    arrivals over the declared buckets). The record carries the full
+    per-phase latency decomposition (queue wait / coalesce wait /
+    dispatch / device / reply) and the coalescer's close-reason split,
+    read back from the daemon's own registry. ``server.stop()``
+    enforces the zero-compile assertion — a compile in the window
+    fails the bench, it does not footnote it."""
     import tempfile
 
     import numpy as np
@@ -692,15 +702,14 @@ def _serving_measurements(n=SERVE_BENCH_ROWS):
         fit_causal_forest,
         predict_cate,
     )
+    from ate_replication_causalml_tpu.serving import loadgen
     from ate_replication_causalml_tpu.serving.coalescer import BucketPlan
     from ate_replication_causalml_tpu.serving.daemon import (
         CateServer,
-        RejectedRequest,
         ServeConfig,
     )
     from ate_replication_causalml_tpu.utils.checkpoint import save_fitted
 
-    rng = np.random.default_rng(0)
     kx, kw, ky = jax.random.split(jax.random.key(0), 3)
     x = jax.random.normal(kx, (n, 6), dtype=jnp.float32)
     w = (jax.random.uniform(kw, (n,)) < 0.5).astype(jnp.float32)
@@ -715,17 +724,21 @@ def _serving_measurements(n=SERVE_BENCH_ROWS):
     save_fitted(ckpt, fitted.forest)
 
     buckets = BucketPlan.parse("1,8,32")
-    sizes = (1, 2, 8, 5, 32)
-    queries = [
-        rng.normal(size=(sizes[i % len(sizes)], 6)).astype(np.float32)
-        for i in range(SERVE_BENCH_REQUESTS)
-    ]
+    schedule = loadgen.build_schedule(
+        SERVE_BENCH_SEED, SERVE_BENCH_REQUESTS,
+        rate_hz=SERVE_BENCH_RATE_HZ, mix="1:2,2:1,5:1,8:1,32:1",
+        id_prefix="b",
+    )
+    queries = loadgen.build_queries(SERVE_BENCH_SEED, schedule, 6)
 
     # The cold baseline: what ONE fresh-process predict costs before any
     # daemon exists (trace + compile + dispatch at the largest bucket).
+    cold_q = np.random.default_rng(SERVE_BENCH_SEED).normal(
+        size=(32, 6)
+    ).astype(np.float32)
     jax.clear_caches()
     cold_s, _ = _timed(lambda: np.asarray(predict_cate(
-        fitted.forest, jnp.asarray(queries[4]), oob=False
+        fitted.forest, jnp.asarray(cold_q), oob=False
     ).cate))
 
     server = CateServer(ServeConfig(
@@ -734,27 +747,7 @@ def _serving_measurements(n=SERVE_BENCH_ROWS):
     ))
     phases = server.startup()
 
-    reqs = []
-    for i, q in enumerate(queries):
-        for _ in range(500):
-            try:
-                reqs.append(server.submit(f"b{i}", q))
-                break
-            except RejectedRequest as rej:
-                if rej.code != "overloaded":
-                    raise
-                time.sleep(rej.retry_after_s or 0.002)
-        else:
-            raise RuntimeError("serving bench made no progress")
-    lat = []
-    for r in reqs:
-        if not r.wait(60):
-            raise RuntimeError(f"request {r.request_id} never served")
-        if r.error is not None:
-            raise r.error
-        lat.append(r.resolved_mono - r.enqueued_mono)
-    lat.sort()
-    pct = lambda q: lat[min(len(lat) - 1, int(q * len(lat)))]
+    replay = loadgen.run_inprocess(server, schedule, queries, timeout_s=60.0)
 
     fill = obs.REGISTRY.bucket_histogram("serving_batch_fill").samples
     fill_count = sum(s["count"] for s in fill.values())
@@ -762,33 +755,51 @@ def _serving_measurements(n=SERVE_BENCH_ROWS):
         sum(s["sum"] for s in fill.values()) / fill_count
         if fill_count else float("nan")
     )
+    phase_stats = server.phase_stats()
+    close_reasons = server.close_reason_counts()
+    pad_mean = server.pad_fraction_mean()
     leaked = server.compile_events_in_window()
     server.stop()  # raises on any compile event in the window
     return {
         "rows": n,
-        "requests": len(reqs),
+        "requests": replay["served"],
         "buckets": list(buckets.sizes),
+        "seed": SERVE_BENCH_SEED,
+        "offered_rate_hz": replay["offered_rate_hz"],
+        "achieved_rate_hz": replay["achieved_rate_hz"],
         "cold_predict_s": cold_s,
         "startup_load_s": phases["load"],
         "startup_aot_s": phases["aot"],
         "startup_warm_s": phases["warm"],
-        "p50_s": pct(0.50),
-        "p99_s": pct(0.99),
+        "p50_s": replay["p50_s"],
+        "p99_s": replay["p99_s"],
         "batch_fill_mean": fill_mean,
+        "phase_stats": phase_stats,
+        "close_reasons": close_reasons,
+        "mean_pad_fraction": pad_mean,
         "zero_compile": leaked == 0.0,
     }
 
 
+def _phase_ms(phase_stats, phase, key):
+    """One phase quantile from the daemon's decomposition, in ms (0.0
+    when the phase never recorded — e.g. a stubbed run)."""
+    return round(phase_stats.get(phase, {}).get(key, 0.0) * 1e3, 3)
+
+
 def bench_serving_quick(n=SERVE_BENCH_ROWS):
-    """``serving_quick`` (ISSUE 6): the daemon's startup-phase
-    decomposition (verified load / AOT / warm), steady served p50/p99,
-    mean batch fill, and the zero-compile assertion. ``vs_baseline`` is
-    cold_predict_s / p50 — how many times cheaper a served request is
-    than the fresh-process trace+compile+dispatch it replaces, i.e. the
-    cold-start tail converted into a one-time startup cost."""
+    """``serving_quick`` (ISSUE 6 + 7): the daemon's startup-phase
+    decomposition (verified load / AOT / warm), steady served p50/p99
+    with the full per-phase lifecycle split (queue wait / coalesce wait
+    / pad overhead / device time — the observability plane's answer to
+    "WHY was p99 slow"), coalescer close-reason counts, and the
+    zero-compile assertion. ``vs_baseline`` is cold_predict_s / p50 —
+    how many times cheaper a served request is than the fresh-process
+    trace+compile+dispatch it replaces."""
     m = _serving_measurements(n)
     p50_ms = m["p50_s"] * 1e3
     p99_ms = m["p99_s"] * 1e3
+    ph = m["phase_stats"]
     print(
         f"# serving rows={m['rows']} requests={m['requests']} "
         f"buckets={m['buckets']} startup="
@@ -796,6 +807,10 @@ def bench_serving_quick(n=SERVE_BENCH_ROWS):
         f"{m['startup_warm_s']:.2f}s (load/aot/warm) "
         f"cold_predict={m['cold_predict_s']:.2f}s p50={p50_ms:.2f}ms "
         f"p99={p99_ms:.2f}ms fill={m['batch_fill_mean']:.2f} "
+        f"queue_p99={_phase_ms(ph, 'queue_wait', 'p99_s')}ms "
+        f"coalesce_p99={_phase_ms(ph, 'coalesce_wait', 'p99_s')}ms "
+        f"device_p99={_phase_ms(ph, 'device', 'p99_s')}ms "
+        f"close={m['close_reasons']} "
         f"zero_compile={m['zero_compile']}",
         file=sys.stderr,
     )
@@ -812,6 +827,17 @@ def bench_serving_quick(n=SERVE_BENCH_ROWS):
         startup_warm_s=round(m["startup_warm_s"], 3),
         cold_predict_s=round(m["cold_predict_s"], 3),
         batch_fill_mean=round(m["batch_fill_mean"], 3),
+        # ISSUE 7: the lifecycle decomposition, from the daemon's own
+        # per-phase bucket histograms (serving/loadgen replay).
+        queue_wait_p50_ms=_phase_ms(ph, "queue_wait", "p50_s"),
+        queue_wait_p99_ms=_phase_ms(ph, "queue_wait", "p99_s"),
+        coalesce_wait_p50_ms=_phase_ms(ph, "coalesce_wait", "p50_s"),
+        coalesce_wait_p99_ms=_phase_ms(ph, "coalesce_wait", "p99_s"),
+        mean_pad_fraction=round(m["mean_pad_fraction"], 4),
+        close_reasons=m["close_reasons"],
+        offered_rate_hz=m["offered_rate_hz"],
+        achieved_rate_hz=m["achieved_rate_hz"],
+        seed=m["seed"],
         requests=m["requests"],
         buckets=m["buckets"],
         rows=m["rows"],

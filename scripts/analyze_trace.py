@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Critical-path / overlap analysis of an exported sweep trace.
+"""Critical-path / overlap / serving analysis of an exported trace.
 
 Usage::
 
@@ -7,13 +7,20 @@ Usage::
     python scripts/analyze_trace.py results/          # finds trace.json
     python scripts/analyze_trace.py results/trace.json --out report.json
 
-Reads the catapult ``trace.json`` the sweep driver (or bench) exports,
-recomputes the overlap report — critical path through the scheduler's
-node intervals, per-lane busy/wait, overlap efficiency, serialization
-blame — writes it as ``overlap_report.json`` next to the trace (or to
-``--out``) and prints a human summary. A pure function of the trace:
-re-running on the same file reproduces the same report, so the analyzer
-can be applied to any saved run without the code that produced it.
+Reads the catapult ``trace.json`` the sweep driver (or bench, or the
+serving daemon) exports, recomputes the overlap report — critical path
+through the scheduler's node intervals, per-lane busy/wait, overlap
+efficiency, serialization blame — writes it as ``overlap_report.json``
+next to the trace (or to ``--out``) and prints a human summary. When
+the trace carries a serving session (``cat="request"``/``"batch"``
+slices, ISSUE 7), the serving report — per-phase latency decomposition,
+batch fill/close-reason split, reject timeline — is recomputed and
+written as ``serving_report.json`` too, byte-identical to the one the
+daemon's own ``stop()``/``dump`` exported: both run the same pure
+function of the trace. A pure function of the trace either way:
+re-running on the same file reproduces the same reports, so the
+analyzer can be applied to any saved run without the code that
+produced it.
 
 Pure stdlib, no JAX — importable on a laptop against a trace captured
 on a TPU host.
@@ -42,6 +49,9 @@ if "ate_replication_causalml_tpu" not in sys.modules:
 
 from ate_replication_causalml_tpu.observability import (  # noqa: E402
     critical_path as cp,
+)
+from ate_replication_causalml_tpu.observability import (  # noqa: E402
+    serving_report as sreport,
 )
 from ate_replication_causalml_tpu.observability.export import (  # noqa: E402
     atomic_write_json,
@@ -111,6 +121,10 @@ def main(argv: list[str] | None = None) -> int:
         return 2
     try:
         report = cp.overlap_report(trace)
+        serving = (
+            sreport.serving_report(trace)
+            if sreport.has_serving_slices(trace) else None
+        )
     except (KeyError, TypeError, ValueError, AttributeError) as e:
         # Hand-edited/truncated traces (valid JSON, wrong shape) get a
         # clean diagnosis + exit 2, not a traceback — the same contract
@@ -127,6 +141,15 @@ def main(argv: list[str] | None = None) -> int:
     else:
         print(render_summary(report))
     print(f"# wrote {out}", file=sys.stderr)
+    if serving is not None:
+        sout = os.path.join(os.path.dirname(tpath) or ".",
+                            sreport.SERVING_REPORT_BASENAME)
+        atomic_write_json(sout, serving)
+        if args.json:
+            print(json.dumps(serving, indent=1))
+        else:
+            print(sreport.render_summary(serving))
+        print(f"# wrote {sout}", file=sys.stderr)
     return 0
 
 

@@ -21,7 +21,12 @@ phases, complete events with non-negative durations, flow ends binding
 to a start, every used track named by metadata) and
 ``overlap_report.json`` (required keys plus internal consistency —
 Σ busy ≤ wall × workers, critical path ≥ the longest node) are
-validated too when present (ISSUE 5).
+validated too when present (ISSUE 5), as are the serving plane's
+artifacts (ISSUE 7): ``serving_report.json`` (phase stats internally
+consistent and equal-count across phases, Σ close-reasons == batches,
+fill/pad complementary) and ``slo_report.json`` (burn-rate windows
+strictly ascending, error rates in [0, 1], good ≤ total, the worst
+burn rate actually the max).
 
 Importable: the telemetry integration test drives :func:`validate_pair`
 directly. Pure stdlib — runnable on any saved ``results/`` directory
@@ -56,6 +61,13 @@ REQUIRED_COUNTERS = (
     "serving_requests_total",
     "serving_rejected_total",
     "jax_compiles_total",
+    # Serving lifecycle decomposition (ISSUE 7): the per-phase seconds
+    # mirror and the coalescer's close-reason counter joined the
+    # contract with the observability plane — "no phase was recorded"
+    # and "no batch closed" are explicit zeros on every instrumented
+    # run.
+    "serving_phase_seconds_total",
+    "serving_batch_close_total",
 )
 
 _EVENT_FIELDS = (
@@ -300,9 +312,120 @@ def validate_overlap(report: dict, tol: float = 1e-6) -> list[str]:
     return errors
 
 
+_SERVING_PHASES = ("coalesce_wait", "queue_wait", "dispatch", "device",
+                   "reply")
+
+_PHASE_STAT_KEYS = {"count", "sum_s", "p50_s", "p99_s", "max_s"}
+
+
+def validate_serving_report(report: dict, tol: float = 1e-9) -> list[str]:
+    """Key and internal-consistency checks on ``serving_report.json``
+    (ISSUE 7). The quantities are derived data — a hand-edited report
+    must FAIL here, not mislead a reader."""
+    errors: list[str] = []
+    for key in ("schema_version", "window_s", "requests", "batches",
+                "rejects"):
+        if key not in report:
+            errors.append(f"serving: missing key {key!r}")
+    if errors:
+        return errors
+    req = report["requests"]
+    phases = req.get("phases")
+    if not isinstance(phases, dict) or set(phases) != set(_SERVING_PHASES):
+        errors.append(
+            f"serving: requests.phases must cover {_SERVING_PHASES}"
+        )
+        return errors
+    counts = set()
+    for name, st in phases.items():
+        if not (isinstance(st, dict) and _PHASE_STAT_KEYS <= set(st)):
+            errors.append(f"serving: phase {name} lacks {_PHASE_STAT_KEYS}")
+            continue
+        if not (st["p50_s"] <= st["p99_s"] <= st["max_s"] + tol):
+            errors.append(f"serving: phase {name} quantiles out of order")
+        if st["count"] < 0 or st["sum_s"] < -tol:
+            errors.append(f"serving: phase {name} negative count/sum")
+        counts.add(st["count"])
+    # Every decomposed request contributes every phase exactly once —
+    # unequal counts mean the histograms tore.
+    if len(counts) > 1:
+        errors.append(
+            f"serving: phase counts differ across phases ({sorted(counts)})"
+        )
+    elif counts and counts != {req.get("with_phases")}:
+        errors.append(
+            f"serving: phase count {sorted(counts)} != with_phases "
+            f"{req.get('with_phases')!r}"
+        )
+    bat = report["batches"]
+    closes = bat.get("close_reasons", {})
+    if sum(closes.values()) != bat.get("count"):
+        errors.append(
+            f"serving: close reasons sum to {sum(closes.values())} != "
+            f"batches {bat.get('count')}"
+        )
+    fill, pad = bat.get("fill_mean", 0.0), bat.get("pad_fraction_mean", 0.0)
+    if not (0.0 <= fill <= 1.0 + tol) or not (0.0 <= pad <= 1.0 + tol):
+        errors.append(f"serving: fill/pad out of [0,1] ({fill}, {pad})")
+    elif bat.get("count") and abs(fill + pad - 1.0) > 1e-5:
+        errors.append(
+            f"serving: fill_mean {fill} + pad_fraction_mean {pad} != 1"
+        )
+    rej = report["rejects"]
+    if sum(rej.get("by_reason", {}).values()) != rej.get("count"):
+        errors.append("serving: reject by_reason does not sum to count")
+    if len(rej.get("timeline", ())) + rej.get("timeline_truncated", 0) != \
+            rej.get("count"):
+        errors.append("serving: reject timeline + truncated != count")
+    return errors
+
+
+def validate_slo_report(report: dict, tol: float = 1e-9) -> list[str]:
+    """Internal-consistency checks on ``slo_report.json`` (ISSUE 7):
+    burn-rate windows strictly ascending (monotone), rates in range,
+    good ≤ total, the worst burn rate actually the max."""
+    errors: list[str] = []
+    slos = report.get("slos")
+    if report.get("schema_version") is None or not isinstance(slos, list):
+        return ["slo: missing schema_version or slos list"]
+    for s in slos:
+        name = s.get("name", "?")
+        if not 0.0 < s.get("objective", -1.0) < 1.0:
+            errors.append(f"slo: {name} objective out of (0,1)")
+        windows = s.get("windows")
+        if not isinstance(windows, list) or not windows:
+            errors.append(f"slo: {name} has no windows")
+            continue
+        spans = [w.get("window_s") for w in windows]
+        if any(not isinstance(x, (int, float)) for x in spans) or any(
+            b <= a for a, b in zip(spans, spans[1:])
+        ):
+            errors.append(f"slo: {name} windows not strictly ascending")
+        burns = []
+        for w in windows:
+            if not (0.0 <= w.get("error_rate", -1.0) <= 1.0 + tol):
+                errors.append(f"slo: {name} error_rate out of [0,1]")
+            if w.get("burn_rate", -1.0) < -tol:
+                errors.append(f"slo: {name} negative burn_rate")
+            if w.get("good", 0) > w.get("total", 0) + tol:
+                errors.append(f"slo: {name} good exceeds total")
+            if w.get("actual_s", -1.0) < -tol:
+                errors.append(f"slo: {name} negative actual_s")
+            burns.append(w.get("burn_rate", 0.0))
+        if burns and abs(s.get("worst_burn_rate", 0.0) - max(burns)) > 1e-6:
+            errors.append(
+                f"slo: {name} worst_burn_rate {s.get('worst_burn_rate')} "
+                f"!= max window burn {max(burns)}"
+            )
+        if bool(s.get("burning")) != (s.get("worst_burn_rate", 0.0) > 1.0):
+            errors.append(f"slo: {name} burning flag inconsistent")
+    return errors
+
+
 def validate_trace_files(outdir: str) -> list[str]:
-    """Validate trace.json / overlap_report.json in ``outdir`` when
-    present (tracing is optional; absence is not an error)."""
+    """Validate trace.json / overlap_report.json / serving_report.json
+    / slo_report.json in ``outdir`` when present (tracing and serving
+    are optional; absence is not an error)."""
     errors: list[str] = []
     tpath = os.path.join(outdir, "trace.json")
     if os.path.exists(tpath):
@@ -318,6 +441,20 @@ def validate_trace_files(outdir: str) -> list[str]:
                 errors += validate_overlap(json.load(f))
         except (OSError, json.JSONDecodeError) as e:
             errors.append(f"overlap: cannot read {opath}: {e}")
+    spath = os.path.join(outdir, "serving_report.json")
+    if os.path.exists(spath):
+        try:
+            with open(spath) as f:
+                errors += validate_serving_report(json.load(f))
+        except (OSError, json.JSONDecodeError) as e:
+            errors.append(f"serving: cannot read {spath}: {e}")
+    lpath = os.path.join(outdir, "slo_report.json")
+    if os.path.exists(lpath):
+        try:
+            with open(lpath) as f:
+                errors += validate_slo_report(json.load(f))
+        except (OSError, json.JSONDecodeError) as e:
+            errors.append(f"slo: cannot read {lpath}: {e}")
     return errors
 
 

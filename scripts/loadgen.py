@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Deterministic load replay against a CATE serving daemon (ISSUE 7).
+
+Usage::
+
+    # against a live TCP daemon (scripts/serve.py --port 7777)
+    python scripts/loadgen.py --connect 127.0.0.1:7777 --features 6 \
+        --requests 200 --rate 500 --seed 7
+
+    # spawn a stdio daemon, replay, shut it down
+    python scripts/loadgen.py --spawn --checkpoint forest.npz \
+        --features 6 --requests 120 --seed 7 --buckets 1,8,32
+
+Builds a seeded open-loop schedule (Poisson arrivals at ``--rate``,
+weighted bucket mix ``--mix``, ids ``{prefix}{i}`` — the same ids a
+``serve:`` chaos spec selects on, so chaos replays are coordinated),
+replays it through one or more client connections, then prints ONE
+JSON record: offered vs achieved rate, client-side p50/p90/p99, and —
+fetched from the daemon's ``stats`` op — the server-side per-phase
+latency decomposition, close-reason counts and pad fraction. The same
+schedule/replay core backs ``bench.py --serving``, so a loadgen run
+and a bench record are directly comparable.
+
+The client side is jax-free; only the spawned daemon (if any) touches
+an accelerator stack.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ate_replication_causalml_tpu.serving import loadgen  # noqa: E402
+from ate_replication_causalml_tpu.serving.client import CateClient  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    target = ap.add_mutually_exclusive_group(required=True)
+    target.add_argument("--connect", metavar="HOST:PORT",
+                        help="replay against a live TCP daemon")
+    target.add_argument("--spawn", action="store_true",
+                        help="spawn a stdio daemon (needs --checkpoint), "
+                             "replay, shut it down")
+    ap.add_argument("--checkpoint", default=None,
+                    help="forest checkpoint for --spawn")
+    ap.add_argument("--features", type=int, required=True,
+                    help="query feature count p (must match the model)")
+    ap.add_argument("--requests", type=int, default=120)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--rate", type=float, default=loadgen.DEFAULT_RATE_HZ,
+                    help="offered arrival rate, Hz (open loop)")
+    ap.add_argument("--mix", default=loadgen.DEFAULT_MIX,
+                    help="rows:weight bucket mix, e.g. 1:4,8:2,32:1")
+    ap.add_argument("--id-prefix", default="r",
+                    help="request-id prefix (chaos specs select on ids)")
+    ap.add_argument("--concurrency", type=int, default=8,
+                    help="TCP connections for --connect (stdio is one pipe)")
+    ap.add_argument("--buckets", default=None,
+                    help="--spawn daemon bucket plan override")
+    ap.add_argument("--window-ms", type=float, default=None,
+                    help="--spawn daemon coalescing window override")
+    ap.add_argument("--dump-dir", default=None,
+                    help="ask the daemon to dump its observability "
+                         "artifacts here after the replay")
+    args = ap.parse_args(argv)
+
+    schedule = loadgen.build_schedule(
+        args.seed, args.requests, rate_hz=args.rate, mix=args.mix,
+        id_prefix=args.id_prefix,
+    )
+    queries = loadgen.build_queries(args.seed, schedule, args.features)
+
+    if args.spawn:
+        if not args.checkpoint:
+            ap.error("--spawn needs --checkpoint")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        cmd = [sys.executable, os.path.join(repo, "scripts", "serve.py"),
+               "--checkpoint", args.checkpoint, "--stdio"]
+        if args.buckets:
+            cmd += ["--buckets", args.buckets]
+        if args.window_ms is not None:
+            cmd += ["--window-ms", str(args.window_ms)]
+        client = CateClient.spawn_stdio(cmd)
+        try:
+            record = loadgen.run_wire(
+                lambda: client, schedule, queries, concurrency=1,
+                close_clients=False,
+            )
+            record["transport"] = "stdio"
+            _attach_server_stats(client, record, args.dump_dir)
+            client.shutdown()
+        finally:
+            client.close()
+    else:
+        host, _, port_s = args.connect.rpartition(":")
+        if not host or not port_s.isdigit():
+            ap.error(f"--connect wants HOST:PORT, got {args.connect!r}")
+
+        def factory() -> CateClient:
+            return CateClient.connect(host, int(port_s))
+
+        record = loadgen.run_wire(
+            factory, schedule, queries, concurrency=args.concurrency,
+        )
+        record["transport"] = "tcp"
+        stats_client = factory()
+        try:
+            _attach_server_stats(stats_client, record, args.dump_dir)
+        finally:
+            stats_client.close()
+
+    record["seed"] = args.seed
+    record["mix"] = args.mix
+    print(json.dumps(record))
+    return 0
+
+
+def _attach_server_stats(client: CateClient, record: dict,
+                         dump_dir: str | None) -> None:
+    """Fold the daemon's phase decomposition into the client record —
+    the full queue/coalesce/dispatch/device/reply split only the server
+    can see — and optionally trigger a live artifact dump."""
+    stats = client.stats()
+    record["server"] = {
+        "phases": stats.get("phases", {}),
+        "close_reasons": stats.get("close_reasons", {}),
+        "pad_fraction_mean": stats.get("pad_fraction_mean", 0.0),
+        "compile_events_in_window": stats.get("compile_events_in_window"),
+        "slo": stats.get("slo", {}),
+    }
+    if dump_dir:
+        record["dumped"] = client.dump(dump_dir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

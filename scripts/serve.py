@@ -44,6 +44,13 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--row-backend", default=None,
                     choices=("pallas", "pallas_interpret", "matmul"),
                     help="predict row-kernel backend (default: auto)")
+    ap.add_argument("--admin-port", type=int, default=None,
+                    help="read-only admin HTTP port (/metrics /healthz "
+                         "/readyz /varz; 0 = ephemeral; default "
+                         "$ATE_TPU_SERVE_ADMIN_PORT or off)")
+    ap.add_argument("--slo-ms", type=float, default=None,
+                    help="latency-SLO threshold in ms (default "
+                         "$ATE_TPU_SERVE_SLO_MS or 250)")
     args = ap.parse_args(argv)
 
     from ate_replication_causalml_tpu.serving.coalescer import BucketPlan
@@ -63,6 +70,10 @@ def main(argv: list[str] | None = None) -> int:
         overrides["max_depth"] = args.depth
     if args.row_backend is not None:
         overrides["row_backend"] = args.row_backend
+    if args.admin_port is not None:
+        overrides["admin_port"] = args.admin_port
+    if args.slo_ms is not None:
+        overrides["slo_latency_s"] = args.slo_ms / 1e3
     config = ServeConfig.from_env(args.checkpoint, **overrides)
 
     server = CateServer(config)
@@ -73,6 +84,11 @@ def main(argv: list[str] | None = None) -> int:
         ) + f" buckets={list(config.buckets.sizes)}",
         file=sys.stderr, flush=True,
     )
+    admin_port = server.stats().get("admin_port")
+    if admin_port is not None:
+        print(f"# admin endpoint on 127.0.0.1:{admin_port} "
+              "(/metrics /healthz /readyz /varz)",
+              file=sys.stderr, flush=True)
     if args.stdio:
         serve_stdio(server)
     else:

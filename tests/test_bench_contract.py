@@ -123,11 +123,21 @@ def test_serving_quick_record_schema_stubbed(monkeypatch):
     bench smoke below."""
     import bench
 
+    phase_stats = {
+        phase: {"count": 120, "mean_s": 0.001, "p50_s": 0.001,
+                "p99_s": 0.004, "max_s": 0.005}
+        for phase in ("coalesce_wait", "queue_wait", "dispatch",
+                      "device", "reply")
+    }
     canned = {
-        "rows": 400, "requests": 120, "buckets": [1, 8, 32],
+        "rows": 400, "requests": 120, "buckets": [1, 8, 32], "seed": 0,
+        "offered_rate_hz": 2000.0, "achieved_rate_hz": 1800.0,
         "cold_predict_s": 1.5, "startup_load_s": 0.01,
         "startup_aot_s": 4.2, "startup_warm_s": 0.02,
         "p50_s": 0.003, "p99_s": 0.012, "batch_fill_mean": 0.8,
+        "phase_stats": phase_stats,
+        "close_reasons": {"bucket_full": 10, "window_expired": 25},
+        "mean_pad_fraction": 0.2,
         "zero_compile": True,
     }
     monkeypatch.setattr(bench, "_serving_measurements", lambda n: canned)
@@ -135,12 +145,22 @@ def test_serving_quick_record_schema_stubbed(monkeypatch):
     for field in ("metric", "value", "unit", "vs_baseline", "p50_ms",
                   "p99_ms", "startup_load_s", "startup_aot_s",
                   "startup_warm_s", "cold_predict_s", "batch_fill_mean",
+                  # ISSUE 7: the lifecycle decomposition joined the
+                  # record contract.
+                  "queue_wait_p50_ms", "queue_wait_p99_ms",
+                  "coalesce_wait_p50_ms", "coalesce_wait_p99_ms",
+                  "mean_pad_fraction", "close_reasons",
+                  "offered_rate_hz", "achieved_rate_hz", "seed",
                   "requests", "buckets", "rows", "zero_compile"):
         assert field in rec, field
     assert rec["metric"] == "serving_quick" and rec["unit"] == "ms"
     assert rec["value"] == rec["p50_ms"] == 3.0
     assert rec["vs_baseline"] == 500.0  # 1.5 s cold tail / 3 ms served
     assert rec["zero_compile"] is True
+    assert rec["queue_wait_p99_ms"] == 4.0
+    assert rec["coalesce_wait_p50_ms"] == 1.0
+    assert rec["mean_pad_fraction"] == 0.2
+    assert rec["close_reasons"] == {"bucket_full": 10, "window_expired": 25}
 
 
 @pytest.mark.slow

@@ -268,3 +268,67 @@ def test_deep_trees_supported():
     assert fitted.forest.leaf_stats.shape[1] == 1 << 10
     eff = average_treatment_effect(fitted)
     assert abs(float(eff.estimate) - ate_true) < 0.8
+
+
+def test_lower_predict_cate_gates_cpu_donation_warning(monkeypatch):
+    """ISSUE 7 satellite: an explicit donate=True on a backend without
+    donation support (this CPU image) warns ONCE at lower/startup time
+    and compiles the NON-donated executable — never jax's per-dispatch
+    warning stream out of a serving loop. The executable proves it:
+    the same input buffer survives two calls (a donated one would be
+    invalidated after the first)."""
+    import warnings
+
+    from ate_replication_causalml_tpu.models import causal_forest as cf
+
+    if jax.default_backend() == "tpu":
+        pytest.skip("donation is supported on TPU; the gate is a no-op")
+    monkeypatch.setattr(cf, "_donation_warned", False)
+
+    rng = np.random.default_rng(5)
+    T, D, n, p, nb = 4, 3, 20, 4, 8
+    forest = cf.CausalForest(
+        split_feat=jnp.asarray(
+            rng.integers(0, p, size=(T, D, 1 << D)).astype(np.int32)),
+        split_bin=jnp.asarray(
+            rng.integers(0, nb - 1, size=(T, D, 1 << D)).astype(np.int32)),
+        leaf_stats=jnp.asarray(
+            (np.abs(rng.normal(size=(T, 1 << D, 5))) + 0.5
+             ).astype(np.float32)),
+        in_sample=jnp.asarray(rng.uniform(size=(T, n)) < 0.5),
+        bin_edges=jnp.asarray(
+            np.sort(rng.normal(size=(p, nb - 1)), axis=1).astype(np.float32)),
+        ci_group_size=2,
+    )
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        lowered = cf.lower_predict_cate(forest, 4, donate=True)
+    gate = [w for w in caught if "donation" in str(w.message)]
+    assert len(gate) == 1 and gate[0].category is RuntimeWarning
+
+    # Second lower: the warning already fired this process — silence.
+    with warnings.catch_warnings(record=True) as caught2:
+        warnings.simplefilter("always")
+        cf.lower_predict_cate(forest, 4, donate=True)
+    assert [w for w in caught2 if "donation" in str(w.message)] == []
+
+    # The gate fell back to the NON-donated executable: the query
+    # buffer survives a dispatch and can be reused (and no jax
+    # "donation not implemented" warning fires per call).
+    compiled = lowered.compile()
+    x = jax.device_put(np.zeros((4, p), np.float32))
+    with warnings.catch_warnings(record=True) as caught3:
+        warnings.simplefilter("always")
+        first = np.asarray(compiled(forest, x, None).cate)
+        second = np.asarray(compiled(forest, x, None).cate)
+    assert np.array_equal(first, second)
+    assert [w for w in caught3 if "donat" in str(w.message).lower()] == []
+
+    # donate=None (the default) never warns on CPU — it resolves to the
+    # non-donated path by design.
+    monkeypatch.setattr(cf, "_donation_warned", False)
+    with warnings.catch_warnings(record=True) as caught4:
+        warnings.simplefilter("always")
+        cf.lower_predict_cate(forest, 4)
+    assert [w for w in caught4 if "donation" in str(w.message)] == []

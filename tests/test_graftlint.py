@@ -591,6 +591,79 @@ def test_jgl008_quiet_on_locked_checkpoint_class():
     ) == []
 
 
+JGL008_SLO_BAD = """\
+import collections
+import threading
+
+class SLOEngine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._history = collections.deque()
+
+    def tick(self, now, totals):
+        self._history.append((now, totals))    # line 10: unlocked append
+
+    def prune(self):
+        with self._lock:
+            self._history.popleft()
+"""
+
+JGL008_SLO_GOOD = """\
+import collections
+import threading
+
+class SLOEngine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._history = collections.deque()
+
+    def tick(self, now, totals):
+        with self._lock:
+            self._history.append((now, totals))
+"""
+
+JGL008_ADMIN_BAD = """\
+import threading
+
+class AdminServer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._probes: dict = {}
+
+    def record(self, path):
+        self._probes[path] = 1                 # line 9: unlocked store
+"""
+
+
+def test_jgl008_covers_slo_and_admin_scope():
+    """ISSUE 7: the observability plane's shared state — the SLO
+    engine's snapshot history (ticked by the dispatcher, read by admin
+    probe threads) and the admin endpoint module — is JGL008 territory;
+    observability/slo.py moves OUT of JGL006 so each finding has
+    exactly one rule."""
+    assert _lines(
+        JGL008_SLO_BAD, "JGL008", relpath="pkg/observability/slo.py"
+    ) == [10]
+    assert _lines(
+        JGL008_ADMIN_BAD, "JGL008", relpath="pkg/serving/admin.py"
+    ) == [9]
+    # One rule per file: JGL006 cedes slo.py to JGL008 ...
+    assert _lines(
+        JGL008_SLO_BAD, "JGL006", relpath="pkg/observability/slo.py"
+    ) == []
+    # ... but keeps the rest of observability/ exactly as before.
+    assert _lines(
+        JGL008_SLO_BAD, "JGL006", relpath="pkg/observability/registry.py"
+    ) == [10]
+    assert _lines(
+        JGL008_SLO_BAD, "JGL008", relpath="pkg/observability/registry.py"
+    ) == []
+    # Known-good twins stay quiet in scope.
+    assert _lines(
+        JGL008_SLO_GOOD, "JGL008", relpath="pkg/observability/slo.py"
+    ) == []
+
+
 # --------------------------------------------------------------- JGL007
 
 

@@ -1,0 +1,275 @@
+"""SLO engine unit tests (ISSUE 7): burn-rate math on hand-built
+histogram/counter sequences with KNOWN answers, declared-objective
+validation, window-baseline selection, and the slo_report /
+serving_report schema validators.
+
+Entirely jax-free and clock-injected — every figure here is asserted
+exactly, no sleeps, no daemon."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import pytest
+
+from ate_replication_causalml_tpu.observability.registry import (
+    MetricsRegistry,
+)
+from ate_replication_causalml_tpu.observability.slo import (
+    SLO,
+    SLOEngine,
+    default_serving_slos,
+)
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+))
+import check_metrics_schema as cms  # noqa: E402
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_slo_declaration_validation():
+    ok = dict(name="x", kind="latency", objective=0.9,
+              metric="m", windows_s=(1.0, 10.0), threshold_s=0.1)
+    SLO(**ok)
+    with pytest.raises(ValueError, match="kind"):
+        SLO(**{**ok, "kind": "vibes"})
+    for bad in (0.0, 1.0, -1.0, 2.0):
+        with pytest.raises(ValueError, match="objective"):
+            SLO(**{**ok, "objective": bad})
+    for bad_w in ((), (10.0, 1.0), (1.0, 1.0), (-1.0, 2.0)):
+        with pytest.raises(ValueError, match="windows"):
+            SLO(**{**ok, "windows_s": bad_w})
+    with pytest.raises(ValueError, match="threshold_s"):
+        SLO(**{**ok, "threshold_s": None})
+    with pytest.raises(ValueError, match="duplicate"):
+        SLOEngine((SLO(**ok), SLO(**ok)), registry=MetricsRegistry())
+
+
+def test_latency_burn_rate_known_answers():
+    """The core math, end to end: 8 good + 2 bad in a 10 s window
+    against a 90% objective is error 0.2 / budget 0.1 = burn 2.0
+    (burning); a clean follow-up decade drops the short window to 0
+    while the long window still shows the historical 2% = burn 0.2."""
+    reg = MetricsRegistry()
+    h = reg.bucket_histogram("lat", bounds=(0.1, 0.2, 0.4))
+    clock = _Clock(0.0)
+    slo = SLO(name="lat", kind="latency", objective=0.9, metric="lat",
+              windows_s=(10.0, 100.0), threshold_s=0.2)
+    eng = SLOEngine((slo,), registry=reg, clock=clock)
+    eng.tick()  # baseline at t=0: (0, 0)
+
+    for _ in range(8):
+        h.observe(0.05)   # good (≤ threshold bucket)
+    for _ in range(2):
+        h.observe(0.35)   # bad (lands past the 0.2 bound)
+    clock.t = 10.0
+    rep = eng.evaluate()
+    (s,) = rep["slos"]
+    w10, w100 = s["windows"]
+    assert (w10["good"], w10["total"]) == (8.0, 10.0)
+    assert w10["error_rate"] == pytest.approx(0.2)
+    assert w10["burn_rate"] == pytest.approx(2.0)
+    assert s["burning"] is True and s["worst_burn_rate"] == pytest.approx(2.0)
+
+    for _ in range(90):
+        h.observe(0.05)
+    clock.t = 100.0
+    rep = eng.evaluate()
+    (s,) = rep["slos"]
+    w10, w100 = s["windows"]
+    # Short window (baseline = the t=10 tick): 90 good / 90 → clean.
+    assert (w10["good"], w10["total"]) == (90.0, 90.0)
+    assert w10["burn_rate"] == 0.0
+    # Long window (baseline = the t=0 tick): 98/100 → 2% = 0.2 burn.
+    assert w100["error_rate"] == pytest.approx(0.02)
+    assert w100["burn_rate"] == pytest.approx(0.2)
+    assert s["burning"] is False
+    assert s["worst_burn_rate"] == pytest.approx(0.2)
+    # The report passes its own schema validator.
+    assert cms.validate_slo_report(rep) == []
+
+
+def test_latency_threshold_is_conservative_bucket_edge():
+    """An observation in the bucket STRADDLING the threshold counts
+    bad (Prometheus-style conservative reading): threshold 0.15 over
+    bounds (0.1, 0.2) credits only the ≤0.1 bucket."""
+    reg = MetricsRegistry()
+    h = reg.bucket_histogram("lat", bounds=(0.1, 0.2))
+    clock = _Clock(0.0)
+    eng = SLOEngine(
+        (SLO(name="l", kind="latency", objective=0.5, metric="lat",
+             windows_s=(10.0,), threshold_s=0.15),),
+        registry=reg, clock=clock,
+    )
+    eng.tick()
+    h.observe(0.05)   # ≤ 0.1: good
+    h.observe(0.12)   # in the 0.2 bucket: conservatively BAD
+    clock.t = 5.0
+    (s,) = eng.evaluate()["slos"]
+    assert (s["windows"][0]["good"], s["windows"][0]["total"]) == (1.0, 2.0)
+
+
+def test_availability_good_match_and_labels():
+    """Availability counts the good_match label pair against ALL
+    samples — ok vs rejected/error/timeout — and an empty window is
+    zero burn, not a divide-by-zero."""
+    reg = MetricsRegistry()
+    clock = _Clock(0.0)
+    eng = SLOEngine(
+        (SLO(name="avail", kind="availability", objective=0.5,
+             metric="reqs", windows_s=(10.0,)),),
+        registry=reg, clock=clock,
+    )
+    rep = eng.evaluate()  # family does not even exist yet
+    assert rep["slos"][0]["windows"][0]["burn_rate"] == 0.0
+
+    c = reg.counter("reqs")
+    c.inc(3, status="ok")
+    c.inc(2, status="rejected_overloaded")
+    c.inc(1, status="error")
+    clock.t = 5.0
+    (s,) = eng.evaluate()["slos"]
+    w = s["windows"][0]
+    assert (w["good"], w["total"]) == (3.0, 6.0)
+    assert w["error_rate"] == pytest.approx(0.5)
+    assert w["burn_rate"] == pytest.approx(1.0)  # budget 0.5
+    assert s["burning"] is False  # exactly on budget, not over
+
+
+def test_window_baseline_selection_and_actual_s():
+    """A window picks the NEWEST tick at or before its start; while
+    history is shorter than the window it differences against the
+    oldest tick and reports the truth in actual_s."""
+    reg = MetricsRegistry()
+    h = reg.bucket_histogram("lat", bounds=(1.0,))
+    clock = _Clock(0.0)
+    eng = SLOEngine(
+        (SLO(name="l", kind="latency", objective=0.9, metric="lat",
+             windows_s=(100.0,), threshold_s=1.0),),
+        registry=reg, clock=clock,
+    )
+    eng.tick()           # t=0
+    h.observe(0.5)
+    clock.t = 5.0
+    (s,) = eng.evaluate()["slos"]
+    w = s["windows"][0]
+    assert w["actual_s"] == pytest.approx(5.0)  # window not yet filled
+    assert (w["good"], w["total"]) == (1.0, 1.0)
+
+
+def test_history_retention_is_bounded():
+    reg = MetricsRegistry()
+    reg.bucket_histogram("lat", bounds=(1.0,))
+    clock = _Clock(0.0)
+    eng = SLOEngine(
+        (SLO(name="l", kind="latency", objective=0.9, metric="lat",
+             windows_s=(10.0,), threshold_s=1.0),),
+        registry=reg, clock=clock,
+    )
+    for i in range(1000):
+        clock.t = float(i)
+        eng.tick()
+    # retention = 10 * 1.25 + 1 = 13.5 s of ticks, not 1000.
+    assert len(eng._history) <= 16
+
+
+def test_default_serving_slos_shape():
+    slos = default_serving_slos(latency_threshold_s=0.1)
+    assert [s.name for s in slos] == ["availability", "latency"]
+    assert slos[1].threshold_s == 0.1
+    assert all(s.windows_s == slos[0].windows_s for s in slos)
+
+
+def test_kind_mismatch_raises():
+    """A latency SLO pointed at a counter family is a config bug and
+    must raise, not silently report zero."""
+    reg = MetricsRegistry()
+    reg.counter("reqs").inc(1, status="ok")
+    eng = SLOEngine(
+        (SLO(name="l", kind="latency", objective=0.9, metric="reqs",
+             windows_s=(10.0,), threshold_s=1.0),),
+        registry=reg, clock=_Clock(0.0),
+    )
+    with pytest.raises(TypeError, match="bucket_histogram"):
+        eng.tick()
+
+
+# ── the report validators reject corrupted artifacts ───────────────────
+
+
+def test_slo_report_validator_rejects_corruption():
+    reg = MetricsRegistry()
+    reg.bucket_histogram("lat", bounds=(1.0,))
+    eng = SLOEngine(
+        (SLO(name="l", kind="latency", objective=0.9, metric="lat",
+             windows_s=(10.0, 60.0), threshold_s=1.0),),
+        registry=reg, clock=_Clock(0.0),
+    )
+    good = eng.evaluate()
+    assert cms.validate_slo_report(good) == []
+    # Windows out of order (the "burn-rate windows monotone" gate).
+    bad = {**good, "slos": [dict(good["slos"][0])]}
+    bad["slos"][0]["windows"] = list(reversed(bad["slos"][0]["windows"]))
+    assert any("ascending" in e for e in cms.validate_slo_report(bad))
+    # Hand-edited worst burn.
+    bad2 = {**good, "slos": [dict(good["slos"][0],
+                                  worst_burn_rate=99.0)]}
+    assert any("worst_burn_rate" in e for e in cms.validate_slo_report(bad2))
+    # good > total must fail.
+    bad3 = {**good, "slos": [dict(good["slos"][0])]}
+    bad3["slos"][0]["windows"] = [
+        dict(bad3["slos"][0]["windows"][0], good=5.0, total=1.0)
+    ]
+    assert any("exceeds total" in e for e in cms.validate_slo_report(bad3))
+
+
+def test_serving_report_validator_rejects_corruption():
+    phases = {
+        k: {"count": 2, "sum_s": 0.2, "p50_s": 0.1, "p99_s": 0.1,
+            "max_s": 0.1}
+        for k in ("coalesce_wait", "queue_wait", "dispatch", "device",
+                  "reply")
+    }
+    good = {
+        "schema_version": 1,
+        "window_s": 1.0,
+        "requests": {"count": 2, "status": {"ok": 2}, "with_phases": 2,
+                     "e2e": {"count": 2, "sum_s": 1.0, "p50_s": 0.5,
+                             "p99_s": 0.5, "max_s": 0.5},
+                     "phases": phases},
+        "batches": {"count": 2, "rows": 4, "by_bucket": {"4": 2},
+                    "fill_mean": 0.5, "pad_fraction_mean": 0.5,
+                    "close_reasons": {"bucket_full": 1,
+                                      "window_expired": 1}},
+        "rejects": {"count": 1, "by_reason": {"overloaded": 1},
+                    "timeline": [{"ts_s": 0.1, "reason": "overloaded",
+                                  "request_id": "r1"}],
+                    "timeline_truncated": 0},
+    }
+    assert cms.validate_serving_report(good) == []
+    # Σ close-reasons must equal the batch count.
+    bad = {**good, "batches": dict(good["batches"],
+                                   close_reasons={"bucket_full": 1})}
+    assert any("close reasons" in e
+               for e in cms.validate_serving_report(bad))
+    # Torn phase histograms (unequal counts across phases) must fail.
+    torn = {k: dict(v) for k, v in phases.items()}
+    torn["device"] = dict(torn["device"], count=1)
+    bad2 = {**good, "requests": dict(good["requests"], phases=torn)}
+    assert any("differ across phases" in e
+               for e in cms.validate_serving_report(bad2))
+    # Quantiles out of order.
+    bad3 = {**good, "requests": dict(
+        good["requests"],
+        phases={**phases, "reply": dict(phases["reply"], p50_s=9.0)},
+    )}
+    assert any("out of order" in e for e in cms.validate_serving_report(bad3))
