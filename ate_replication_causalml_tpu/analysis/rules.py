@@ -1,4 +1,4 @@
-"""The graftlint rule set (JGL001–JGL009).
+"""The graftlint rule set (JGL001–JGL010).
 
 Each rule targets a failure class that has actually bitten (or nearly
 bitten) this codebase on TPU — see ADVICE.md and the rule docstrings.
@@ -1068,3 +1068,59 @@ class WallClockDuration(Rule):
                         "time.perf_counter() (observability/ owns the "
                         "wall-clock anchor)",
                     )
+
+
+# ---------------------------------------------------------------- JGL010
+
+#: the host-materialization calls the artifact plane owns: a bare
+#: ``np.asarray`` on a jax array is a device_get (a per-shard fetch and
+#: host assemble), and both escape the transfer metering.
+_HOST_MATERIALIZE_CALLS = {"numpy.asarray", "jax.device_get"}
+
+
+@register
+class UnmeteredHostMaterialization(Rule):
+    """ISSUE 8's boundary contract: every byte a nuisance artifact
+    moves between host and device goes through ``parallel/shardio.py``,
+    which meters it into ``artifact_transfer_bytes_total`` and applies
+    the mesh-lane discipline to the collective paths. A bare
+    ``np.asarray``/``jax.device_get`` in the scheduler or the sweep
+    driver is exactly the PR-4 ``materialized()`` host bounce this PR
+    removed — unmetered host bandwidth, invisible to the mesh-scaling
+    byte accounting, and (for sharded inputs) a device sync outside the
+    sanctioned gather path."""
+
+    id = "JGL010"
+    name = "unmetered-host-materialization"
+    description = (
+        "np.asarray/jax.device_get in scheduler/ or pipeline.py outside "
+        "the metered parallel/shardio.py artifact plane"
+    )
+
+    def _in_scope(self, relpath: str) -> bool:
+        # Same scope shape as JGL008: the scheduler package plus the
+        # top-level driver only — data/pipeline.py and any nested
+        # pipeline.py do host I/O legitimately.
+        parts = relpath.replace("\\", "/").split("/")
+        return (
+            "scheduler/" in relpath
+            or (parts[-1] == "pipeline.py" and len(parts) <= 2)
+        )
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if not self._in_scope(module.relpath):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = module.resolve(node.func)
+            if name in _HOST_MATERIALIZE_CALLS:
+                yield self.finding(
+                    module,
+                    node,
+                    f"{name} host-materializes outside the metered "
+                    "artifact plane — route the transfer through "
+                    "parallel/shardio.py (gather_host/commit) so the "
+                    "bytes are counted and the mesh-lane discipline "
+                    "holds",
+                )

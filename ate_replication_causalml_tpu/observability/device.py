@@ -100,6 +100,17 @@ def install_jax_monitoring() -> bool:
             ).inc(0)
     counter("scheduler_prefetch_total",
             "compile-prefetch lane outcomes by stage and status").inc(0)
+    # Artifact-plane families (ISSUE 8): every byte an artifact moves
+    # across a layout boundary is metered (parallel/shardio.py) — "no
+    # artifact crossed the host" is a recorded 0, and a nonzero
+    # host_bounce path on a scheduled sweep is a regression.
+    counter("artifact_transfer_bytes_total",
+            "artifact-plane bytes moved by path (host_upload / "
+            "device_reshard / device_handoff / host_gather / host_bounce)"
+            ).inc(0)
+    counter("artifact_reshard_total",
+            "artifact-plane shard/gather/reshard calls by compile status"
+            ).inc(0)
     # Serving families (ISSUE 6): the daemon's request/reject counters
     # and the compile-event bridge are contract families too — a bench
     # that never serves exports explicit zeros, and the bucket-histogram

@@ -1,0 +1,293 @@
+"""Device-resident sharded artifact I/O — the metered host/device
+boundary of the nuisance-artifact plane (ISSUE 8).
+
+PR 4's scheduler deliberately HOST-materialized every mesh-lane
+artifact before releasing the lane (``pipeline.materialized()``: a
+``np.asarray`` → ``jnp.asarray`` double copy per artifact). That was
+correct — a sharded array consumed by an unlaned stage would compile
+its ops into collectives outside the lane — but it makes every
+producer→consumer handoff pay host bandwidth twice and caps the
+cross-fitting data axis at what one host can stream. This module is
+the replacement: artifacts live on device as ``NamedSharding``
+-annotated arrays, and every byte that crosses a layout boundary moves
+through one of the functions below, which
+
+* compile each shard/gather/reshard path ONCE per (pytree-structure,
+  sharding) pair — a process-global cache of ``jax.jit`` identities in
+  the style of SNIPPETS [1] (``make_shard_and_gather_fns``) and [3]
+  (paired in/out shardings on compiled fns) — and
+* meter every call into ``artifact_transfer_bytes_total{artifact,path}``
+  (bytes moved, by path) and ``artifact_reshard_total{artifact,status}``
+  (calls, by compile status), the two counter families
+  ``scripts/check_metrics_schema.py`` requires on every instrumented
+  run.
+
+Byte paths (``path=`` label values):
+
+* ``host_upload``   — host → device commit of a host-resident leaf
+  (``jax.device_put`` onto the declared sharding; no XLA program).
+* ``device_reshard`` — device → device layout change (compiled
+  identity with ``out_shardings``; a COLLECTIVE program — callers that
+  own a mesh lane run it inside ``lane_lock``, see scheduler/cache.py).
+* ``device_handoff`` — a consumer took the device-resident form as-is:
+  bytes that stayed on device, the zero-host-byte laned→laned edge.
+* ``host_gather``   — device → host: compiled all-gather to replicated
+  (collective, lane discipline as above) then ONE ``device_get``. The
+  single host crossing an unlaned consumer pays.
+* ``host_bounce``   — the LEGACY materialized() double copy (full host
+  materialization immediately re-uploaded), kept only as the metered
+  "before" number for ``bench.py --mesh-scaling``; the sweep itself
+  must never hit this path (regression-tested).
+
+Lane discipline is the CALLER's job (``scheduler/cache.py`` wraps the
+collective paths in ``lane_lock``); this module is policy-free data
+movement. Everything here is synchronous: :func:`commit` blocks until
+the transfer/collective has drained, preserving ``materialized()``'s
+second job — a mesh lane is released only after the artifact's device
+work completed, not merely enqueued.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ate_replication_causalml_tpu import observability as obs
+from ate_replication_causalml_tpu.parallel.mesh import DATA_AXIS
+
+BYTES_FAMILY = "artifact_transfer_bytes_total"
+CALLS_FAMILY = "artifact_reshard_total"
+
+PATH_UPLOAD = "host_upload"
+PATH_RESHARD = "device_reshard"
+PATH_HANDOFF = "device_handoff"
+PATH_GATHER = "host_gather"
+PATH_BOUNCE = "host_bounce"
+
+#: compiled identity per target sharding + signatures already compiled,
+#: so each reshard path compiles once (status=compiled vs cached).
+_JITS: dict[Any, Any] = {}
+_SEEN: set[tuple] = set()
+_LOCK = threading.Lock()
+
+
+def _bytes_counter():
+    return obs.counter(
+        BYTES_FAMILY,
+        "artifact-plane bytes moved by path (host_upload / device_reshard"
+        " / device_handoff / host_gather / host_bounce)",
+    )
+
+
+def _calls_counter():
+    return obs.counter(
+        CALLS_FAMILY,
+        "artifact-plane shard/gather/reshard calls by compile status",
+    )
+
+
+def leaf_nbytes(leaf) -> int:
+    """Payload bytes of one array-like leaf without touching device
+    memory (``np.asarray`` on a jax array would be a device_get)."""
+    size = getattr(leaf, "size", None)
+    dtype = getattr(leaf, "dtype", None)
+    if size is None or dtype is None:
+        arr = np.asarray(leaf)
+        size, dtype = arr.size, arr.dtype
+    return int(size) * np.dtype(dtype).itemsize
+
+
+def tree_nbytes(tree) -> int:
+    """Total payload bytes of a pytree of arrays."""
+    return sum(leaf_nbytes(l) for l in jax.tree_util.tree_leaves(tree))
+
+
+def row_sharding(mesh, n: int, axis: str = DATA_AXIS) -> NamedSharding:
+    """Shard dim 0 of an n-row array over ``axis`` — falling back to
+    replicated when ``n`` does not divide the axis size: this image's
+    jax (0.4.37) rejects uneven shards at the ``device_put`` /
+    ``out_shardings`` API level, and a replicated declaration is still
+    device-resident (the lane discipline and zero-host-byte handoffs
+    are unchanged; only the per-device memory footprint differs)."""
+    if n % mesh.shape[axis] == 0:
+        return NamedSharding(mesh, P(axis))
+    return NamedSharding(mesh, P())
+
+
+def _spec_tree(tree, sharding):
+    """Broadcast a single Sharding over the value's pytree; a matching
+    pytree of shardings passes through."""
+    if isinstance(sharding, jax.sharding.Sharding):
+        return jax.tree_util.tree_map(lambda _: sharding, tree)
+    return sharding
+
+
+def _jit_to(dst):
+    with _LOCK:
+        fn = _JITS.get(dst)
+        if fn is None:
+            fn = _JITS[dst] = jax.jit(lambda a: a, out_shardings=dst)
+        return fn
+
+
+def _block(tree):
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "block_until_ready"):
+            leaf.block_until_ready()
+    return tree
+
+
+def _move_leaf(leaf, dst, artifact: str, calls, moved: list) -> Any:
+    """One leaf onto sharding ``dst`` via the compiled identity for that
+    path; ``moved`` accumulates bytes that actually changed layout."""
+    if getattr(leaf, "sharding", None) == dst:
+        calls.inc(1, artifact=artifact, status="noop")
+        return leaf
+    sig = (
+        tuple(getattr(leaf, "shape", ())),
+        str(getattr(leaf, "dtype", "")),
+        getattr(leaf, "sharding", None),
+        dst,
+    )
+    with _LOCK:
+        seen = sig in _SEEN
+        _SEEN.add(sig)
+    out = _jit_to(dst)(leaf)
+    calls.inc(1, artifact=artifact, status="cached" if seen else "compiled")
+    moved.append(leaf_nbytes(leaf))
+    return out
+
+
+def commit(tree, sharding, artifact: str = "") -> Any:
+    """Commit a fit's output onto its DECLARED device-resident sharding
+    and block until the transfer/collective drained (the lane-release
+    discipline). Host leaves upload via ``device_put`` (metered
+    ``host_upload``); device leaves reshard through the compiled path
+    (metered ``device_reshard``); leaves already in layout are noops."""
+    specs = _spec_tree(tree, sharding)
+    b, c = _bytes_counter(), _calls_counter()
+    moved: list[int] = []
+    uploaded: list[int] = []
+
+    def per_leaf(leaf, dst):
+        if not isinstance(leaf, jax.Array):
+            out = jax.device_put(np.asarray(leaf), dst)
+            c.inc(1, artifact=artifact, status="upload")
+            uploaded.append(leaf_nbytes(leaf))
+            return out
+        return _move_leaf(leaf, dst, artifact, c, moved)
+
+    out = _block(jax.tree_util.tree_map(per_leaf, tree, specs))
+    if uploaded:
+        b.inc(sum(uploaded), artifact=artifact, path=PATH_UPLOAD)
+    if moved:
+        b.inc(sum(moved), artifact=artifact, path=PATH_RESHARD)
+    return out
+
+
+def reshard(tree, sharding, artifact: str = "") -> Any:
+    """Device → device layout change onto ``sharding`` (a collective —
+    lane-owning callers run it inside ``lane_lock``)."""
+    specs = _spec_tree(tree, sharding)
+    b, c = _bytes_counter(), _calls_counter()
+    moved: list[int] = []
+    out = _block(jax.tree_util.tree_map(
+        lambda leaf, dst: _move_leaf(leaf, dst, artifact, c, moved),
+        tree, specs,
+    ))
+    if moved:
+        b.inc(sum(moved), artifact=artifact, path=PATH_RESHARD)
+    return out
+
+
+def handoff(tree, artifact: str = "") -> Any:
+    """Meter a zero-host-byte device-resident handoff: the consumer
+    declared the stored layout, so the bytes recorded under
+    ``device_handoff`` are bytes that did NOT cross the host bus — the
+    laned→laned edge the mesh-scaling record pins at zero host bytes."""
+    _bytes_counter().inc(tree_nbytes(tree), artifact=artifact,
+                         path=PATH_HANDOFF)
+    return tree
+
+
+def _replicated_like(leaf) -> NamedSharding | None:
+    sh = getattr(leaf, "sharding", None)
+    if isinstance(sh, NamedSharding) and not sh.is_fully_replicated:
+        return NamedSharding(sh.mesh, P())
+    return None
+
+
+def gather_host(tree, artifact: str = "") -> Any:
+    """Device → host: all-gather each sharded leaf to replicated
+    through the compiled path (a collective — lane discipline applies),
+    then ONE ``device_get``. Returns a host (numpy) pytree: the single
+    metered host crossing an unlaned consumer pays, replacing the
+    legacy double copy."""
+    b, c = _bytes_counter(), _calls_counter()
+    moved: list[int] = []
+
+    def per_leaf(leaf):
+        if not isinstance(leaf, jax.Array):
+            return leaf
+        rep = _replicated_like(leaf)
+        if rep is not None:
+            leaf = _move_leaf(leaf, rep, artifact, c, moved)
+        host = np.asarray(jax.device_get(leaf))
+        # Read-only: the host form is CACHED and shared by every host
+        # consumer (scheduler/cache.py) — an in-place write in one stage
+        # body must fail loudly, not corrupt the others' inputs.
+        host.flags.writeable = False
+        b.inc(host.nbytes, artifact=artifact, path=PATH_GATHER)
+        return host
+
+    out = jax.tree_util.tree_map(per_leaf, tree)
+    if moved:
+        # The all-gather's own device traffic: every byte the plane
+        # moves is metered, including the collective feeding a gather.
+        b.inc(sum(moved), artifact=artifact, path=PATH_RESHARD)
+    return out
+
+
+def host_bounce(tree, artifact: str = "") -> Any:
+    """The LEGACY ``materialized()`` path, kept only as the metered
+    before-number for ``bench.py --mesh-scaling``: full host
+    materialization (``np.asarray`` — a per-shard fetch and host
+    assemble) immediately re-uploaded via ``jnp.asarray``. Pays host
+    bandwidth TWICE per call (metered ``host_bounce`` = 2×payload).
+    The sweep must never reach this path — tests assert its counter
+    stays zero on every scheduled run."""
+    import jax.numpy as jnp
+
+    b = _bytes_counter()
+
+    def per_leaf(leaf):
+        host = np.asarray(leaf)
+        b.inc(2 * host.nbytes, artifact=artifact, path=PATH_BOUNCE)
+        return jnp.asarray(host)
+
+    return _block(jax.tree_util.tree_map(per_leaf, tree))
+
+
+def edge_byte_plan(nbytes: int, producer_lane: str | None,
+                   consumer_lane: str | None) -> dict:
+    """Deterministic per-edge host/device byte accounting — the
+    quantity that IS the multi-chip bandwidth win when devices are
+    physical, pinned by ``tests/test_mesh_scaling.py`` without running
+    a backend (the dispatch-plan pattern of ``plan_tree_dispatch``).
+
+    A laned→laned edge (producer and consumer share a mesh lane, the
+    consumer declared the device layout) hands the artifact off fully
+    on-device: zero host bytes. Any other edge pays exactly one
+    device→host gather. The legacy PR-4 ``materialized()`` path paid
+    ``2×nbytes`` host bytes on EVERY edge — the before column."""
+    laned_to_laned = producer_lane is not None and producer_lane == consumer_lane
+    return {
+        "host_bytes": 0 if laned_to_laned else nbytes,
+        "device_bytes": nbytes if laned_to_laned else 0,
+        "legacy_host_bytes": 2 * nbytes,
+    }

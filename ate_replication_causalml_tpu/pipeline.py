@@ -806,18 +806,27 @@ def _run_sweep_impl(
     # have no collectives and no lane.
     mesh_lane = "mesh" if mesh_devices > 1 else None
 
-    def materialized(fit):
-        """Wrap a mesh-lane artifact fit so its value leaves the lane as
-        a HOST-materialized, unsharded array. Two jobs: (1) a consumer
-        stage outside the lane must never hold a device-sharded input —
-        jitted ops on one compile to cross-device collectives, exactly
-        the launches the lane exists to serialize; (2) np.asarray is a
-        device sync, so the lane is released only after the artifact's
-        collective work has fully drained, not merely been enqueued."""
-        def wrapped(c):
-            return jax.numpy.asarray(np.asarray(fit(c)))
+    # Device-resident artifact plane (ISSUE 8): mesh-lane artifacts
+    # declare a sharding instead of the old materialized() host bounce
+    # (np.asarray → jnp.asarray, host bandwidth paid twice per
+    # handoff). The cache commits the declared layout inside the lane,
+    # blocked until drained (same release discipline), stores the
+    # device-resident form, and hands unlaned consumers ONE metered
+    # host gather (parallel/shardio.py); a laned consumer declaring
+    # consumes_sharding="device" would take the handoff with zero host
+    # bytes. Row-sharded over the data axis when the row count divides
+    # the mesh, replicated otherwise (this jax rejects uneven shards).
+    artifact_sharding = None
+    if mesh_devices > 1:
+        from ate_replication_causalml_tpu.parallel.mesh import (
+            DATA_AXIS,
+            make_mesh as _make_mesh,
+        )
+        from ate_replication_causalml_tpu.parallel.shardio import row_sharding
 
-        return wrapped
+        artifact_sharding = row_sharding(
+            _make_mesh((DATA_AXIS,)), df_mod.n
+        )
 
     artifacts = [
         # In-sample logistic propensity (Rmd:164-168) — consumed by both
@@ -858,21 +867,23 @@ def _run_sweep_impl(
         # 133-146) — consumes its fold masks, feeds the IPW stage.
         ArtifactSpec(
             "lasso_ps",
-            fit=materialized(lambda c: with_folds(lambda: prop_score_lasso(
+            fit=lambda c: with_folds(lambda: prop_score_lasso(
                 df_mod, foldid=c.get("folds:ps_lasso"),
-                fold_axis=fold_axis))),
+                fold_axis=fold_axis)),
             needs=("folds:ps_lasso",),
             key=(fingerprint,),
             exclusive=mesh_lane,
+            sharding=artifact_sharding,
         ),
         # RF OOB vote-fraction propensity (ate_functions.R:169-174).
         ArtifactSpec(
             "rf_oob_propensity",
-            fit=materialized(lambda c: rf_oob_propensity(
+            fit=lambda c: rf_oob_propensity(
                 df_mod, key=key_for("dr_rf_prop"), n_trees=config.dr_trees,
-                depth=config.forest_depth, mesh=tree_mesh)),
+                depth=config.forest_depth, mesh=tree_mesh),
             key=(fingerprint, config.dr_trees, config.forest_depth),
             exclusive=mesh_lane,
+            sharding=artifact_sharding,
         ),
     ]
 

@@ -1,4 +1,5 @@
-"""Fit-once nuisance artifact cache (ISSUE 4, tentpole part 2).
+"""Fit-once nuisance artifact cache (ISSUE 4, tentpole part 2; ISSUE 8
+device-resident artifact plane).
 
 Replaces the driver's ad-hoc ``_p_log`` lazy list: every shared
 nuisance (logistic propensity, LASSO PS path, fold masks, RF OOB
@@ -16,9 +17,31 @@ memoized: the sequential sweep refits a failed shared nuisance on the
 next consumer (each consumer stage degrades independently), and the
 concurrent sweep must behave identically.
 
+Device residency (ISSUE 8): an artifact whose spec declares a
+``sharding`` is stored in its device-resident form — the fit's output
+is committed onto the declared layout (``parallel/shardio.commit``,
+blocked until drained) INSIDE the artifact's lane, replacing PR 4's
+host-materialization bounce. Consumers receive the layout their spec's
+``consumes_sharding`` declares through :meth:`get`'s ``layout``
+argument (the engine binds stage bodies to a :class:`_LayoutView`):
+
+* ``"device"`` — the stored sharded form, a zero-host-byte handoff;
+* a sharding object — a compiled device→device reshard;
+* ``"host"`` / undeclared — the SAFE default: one compiled all-gather
+  + ``device_get`` (a single host crossing), cached per entry so N
+  host consumers pay one gather total.
+
+The PR-4 lane rule is preserved structurally: every path that can
+launch a collective (the commit, a reshard, the gather) runs inside
+``lane_lock(spec.exclusive)``, so a sharded artifact consumed by an
+unlaned stage never launches its all-gather concurrently with a
+mesh-lane node, and an unlaned consumer only ever holds host data.
+
 Hit/miss traffic lands in the ``nuisance_cache_requests_total`` counter
-(labels ``artifact=``, ``status=hit|miss``) and each fit is a
-``nuisance_fit`` span — the metrics families
+(labels ``artifact=``, ``status=hit|miss``), each fit is a
+``nuisance_fit`` span, and every byte the plane moves is metered into
+``artifact_transfer_bytes_total`` / ``artifact_reshard_total``
+(parallel/shardio.py) — the families
 ``scripts/check_metrics_schema.py`` validates.
 """
 
@@ -31,6 +54,43 @@ from typing import Iterable
 from ate_replication_causalml_tpu import observability as obs
 from ate_replication_causalml_tpu.scheduler.dag import ArtifactSpec, DagError
 
+#: lazily imported so this module (and the no-jax scheduler tests) can
+#: load without initializing a backend; tests monkeypatch this to drive
+#: the layout paths with a fake plane.
+_SHARDIO = None
+
+
+def _shardio():
+    global _SHARDIO
+    if _SHARDIO is None:
+        from ate_replication_causalml_tpu.parallel import shardio
+
+        _SHARDIO = shardio
+    return _SHARDIO
+
+
+class _LayoutView:
+    """Consumer-facing resolver bound to one spec's ``consumes_sharding``
+    declaration: ``get(name)`` yields the declared layout, undeclared
+    names fall back to the cache's safe default (host form for sharded
+    artifacts). Bodies keep calling plain ``c.get(...)`` — the layout
+    contract lives in the declaration, not the call site."""
+
+    __slots__ = ("_cache", "_consumes")
+
+    def __init__(self, cache: "NuisanceCache", consumes: dict):
+        self._cache = cache
+        self._consumes = dict(consumes)
+
+    def get(self, name: str):
+        return self._cache.get(name, layout=self._consumes.get(name))
+
+    def spec(self, name: str) -> ArtifactSpec:
+        return self._cache.spec(name)
+
+    def stats(self):
+        return self._cache.stats()
+
 
 class NuisanceCache:
     """Thread-safe fit-once store over a set of artifact specs."""
@@ -39,6 +99,7 @@ class NuisanceCache:
         self._lock = threading.Lock()
         self._specs: dict[str, ArtifactSpec] = {}
         self._values: dict[tuple, object] = {}
+        self._host_forms: dict[tuple, object] = {}
         self._entry_locks: dict[tuple, threading.Lock] = {}
         self._lane_locks: dict[str, threading.RLock] = {}
         self._hits: dict[str, int] = {}
@@ -56,6 +117,15 @@ class NuisanceCache:
         with self._lock:
             return self._specs[name]
 
+    def view_for(self, spec) -> object:
+        """The resolver a node body receives: the cache itself when the
+        spec declares no consume layouts (zero overhead, today's object
+        identity), else a :class:`_LayoutView` bound to them."""
+        consumes = getattr(spec, "consumes_sharding", None)
+        if not consumes:
+            return self
+        return _LayoutView(self, consumes)
+
     def _entry_lock(self, key: tuple) -> threading.Lock:
         with self._lock:
             lk = self._entry_locks.get(key)
@@ -70,26 +140,45 @@ class NuisanceCache:
         overlapping, but a failed laned artifact is refit by whichever
         consumer stage requests it next — possibly an unlaned stage body
         on another worker thread. Both the engine (around a laned node's
-        execution) and :meth:`get` (around a laned artifact's fit) hold
-        this lock, so that refit can never launch its collective
-        concurrently with a laned node. Re-entrant because the engine's
-        own artifact node reaches the fit through :meth:`get` on the
-        same thread; always acquired BEFORE the per-entry lock so the
-        two orderings cannot deadlock."""
+        execution) and :meth:`get` (around a laned artifact's fit, and
+        around every collective the artifact plane launches on its
+        behalf — commit, reshard, gather) hold this lock, so none of
+        those can ever launch a collective concurrently with a laned
+        node. Re-entrant because the engine's own artifact node reaches
+        the fit through :meth:`get` on the same thread; always acquired
+        BEFORE the per-entry lock so the two orderings cannot
+        deadlock."""
         with self._lock:
             lk = self._lane_locks.get(lane)
             if lk is None:
                 lk = self._lane_locks[lane] = threading.RLock()
             return lk
 
-    def get(self, name: str) -> object:
-        """The artifact's value, fitting it on first request.
+    def _lane_guard(self, spec: ArtifactSpec):
+        if spec.exclusive is not None:
+            return self.lane_lock(spec.exclusive)
+        return contextlib.nullcontext()
+
+    def get(self, name: str, *, layout: object = None) -> object:
+        """The artifact's value, fitting it on first request, in the
+        consumer's declared ``layout`` (see module docstring; ``None``
+        is the safe default — the host form for sharded artifacts,
+        the plain value otherwise).
 
         Counted as a hit when the value already exists (including when
         this thread blocked on another thread's in-flight fit), a miss
         when this call runs the fit. An exception from the fit
         propagates to THIS caller and leaves no entry behind.
         """
+        spec = self.spec(name)
+        value = self.ensure(name)
+        return self._deliver(spec, (name, spec.key), value, layout)
+
+    def ensure(self, name: str) -> object:
+        """Fit-if-needed and return the STORED form — device-resident
+        for sharded artifacts — with no layout delivery and no handoff
+        metering. The engine's artifact nodes call this: they PRODUCE
+        the artifact; only consumer edges move or meter bytes."""
         spec = self.spec(name)
         key = (name, spec.key)
         c = obs.counter(
@@ -102,12 +191,7 @@ class NuisanceCache:
                 value = self._values[key]
                 c.inc(1, artifact=name, status="hit")
                 return value
-        guard = (
-            self.lane_lock(spec.exclusive)
-            if spec.exclusive is not None
-            else contextlib.nullcontext()
-        )
-        with guard:
+        with self._lane_guard(spec):
             with self._entry_lock(key):
                 # Double-check: the thread we waited on may have fit it.
                 with self._lock:
@@ -118,11 +202,51 @@ class NuisanceCache:
                         return value
                 c.inc(1, artifact=name, status="miss")
                 with obs.span("nuisance_fit", artifact=name):
-                    value = spec.fit(self)
+                    value = spec.fit(self.view_for(spec))
+                    if spec.sharding is not None:
+                        # Commit the declared device-resident layout
+                        # INSIDE the lane, blocked until drained — the
+                        # lane releases only after the artifact's
+                        # device work completed, exactly the
+                        # materialized() discipline, minus the host
+                        # bounce.
+                        value = _shardio().commit(
+                            value, spec.sharding, artifact=name
+                        )
                 with self._lock:
                     self._misses[name] = self._misses.get(name, 0) + 1
                     self._values[key] = value
                 return value
+
+    # ── layout delivery (ISSUE 8) ─────────────────────────────────────
+
+    def _deliver(self, spec: ArtifactSpec, key: tuple, value: object,
+                 layout: object) -> object:
+        """Resolve the stored value into the consumer's declared layout.
+        Collective-launching paths (reshard, gather) run inside the
+        artifact's lane; the host form is cached per entry so repeated
+        host consumers pay one gather total."""
+        if spec.sharding is None:
+            return value
+        if layout == "device" or (
+            layout is not None and layout == spec.sharding
+        ):
+            return _shardio().handoff(value, artifact=spec.name)
+        if layout is not None and layout != "host":
+            with self._lane_guard(spec):
+                return _shardio().reshard(value, layout, artifact=spec.name)
+        with self._lock:
+            if key in self._host_forms:
+                return self._host_forms[key]
+        with self._lane_guard(spec):
+            with self._entry_lock(("host",) + key):
+                with self._lock:
+                    if key in self._host_forms:
+                        return self._host_forms[key]
+                host = _shardio().gather_host(value, artifact=spec.name)
+                with self._lock:
+                    self._host_forms[key] = host
+                return host
 
     def stats(self) -> dict[str, dict[str, int]]:
         """``{"hits": {...}, "misses": {...}}`` by artifact name (tests

@@ -55,6 +55,23 @@ class ArtifactSpec:
     #: device CPU backend), so collectives keep a single global launch
     #: order while non-collective stages overlap freely.
     exclusive: str | None = None
+    #: declared device-resident layout (ISSUE 8): opaque to this
+    #: jax-free module — a ``jax.sharding.Sharding`` in practice. When
+    #: set, the cache commits the fit's output onto it (inside the
+    #: artifact's lane, blocked until drained — parallel/shardio.py)
+    #: and stores the device-resident form; consumers receive the
+    #: layout they declared via ``consumes_sharding``, defaulting to
+    #: the safe host-gathered form (a sharded array held by an unlaned
+    #: stage would compile its ops into collectives outside the lane —
+    #: the PR-4 rule). None = plain host value, pre-ISSUE-8 semantics.
+    sharding: object | None = None
+    #: artifact name → layout this fit consumes its ``needs`` inputs
+    #: in: ``"device"`` (the stored device-resident form, zero host
+    #: bytes), ``"host"`` (explicit host gather), or a sharding object
+    #: (reshard to that layout, inside the producer's lane). Keys must
+    #: be a subset of ``needs`` and may only name sharded artifacts —
+    #: :func:`validate` rejects anything else at build time.
+    consumes_sharding: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -73,6 +90,10 @@ class StageSpec:
     warm: Callable[[], object] | None = None
     #: see ArtifactSpec.exclusive.
     exclusive: str | None = None
+    #: see ArtifactSpec.consumes_sharding — the engine binds each stage
+    #: body to a cache view resolving ``get(name)`` in the declared
+    #: layout; undeclared sharded artifacts arrive host-gathered.
+    consumes_sharding: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -134,6 +155,25 @@ def validate(
         for dep in s.needs:
             if dep not in arts:
                 raise DagError(f"stage {s.name!r} needs unknown artifact {dep!r}")
+    # Layout declarations (ISSUE 8) must bind to a consumed, SHARDED
+    # artifact: a consumes_sharding key that is not in needs is a typo
+    # that would silently fall back to the host form, and a layout for
+    # an unsharded artifact has no device-resident form to resolve.
+    for kind, spec in (
+        [("artifact", a) for a in arts.values()]
+        + [("stage", s) for s in stage_list]
+    ):
+        for dep in spec.consumes_sharding:
+            if dep not in spec.needs:
+                raise DagError(
+                    f"{kind} {spec.name!r} declares consumes_sharding for "
+                    f"{dep!r} it does not consume"
+                )
+            if arts[dep].sharding is None:
+                raise DagError(
+                    f"{kind} {spec.name!r} declares a consume layout for "
+                    f"unsharded artifact {dep!r}"
+                )
 
     # Artifact depth by DFS; a cycle surfaces as revisiting the active
     # path. Iterative (the sweep DAG is tiny, but a declaration bug

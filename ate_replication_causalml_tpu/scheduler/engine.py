@@ -148,7 +148,10 @@ class SweepEngine:
             nodes[name] = _Node(
                 "artifact", name, prio,
                 tuple(d for d in spec.needs if d in needed),
-                (lambda nm=name: self.cache.get(nm)),
+                # ensure(), not get(): the artifact node PRODUCES the
+                # stored (device-resident) form — layout delivery and
+                # its byte metering belong to consumer edges only.
+                (lambda nm=name: self.cache.ensure(nm)),
                 dag.first_consumer[name],
                 exclusive=spec.exclusive,
             )
@@ -156,7 +159,11 @@ class SweepEngine:
             nodes[spec.name] = _Node(
                 "stage", spec.name, (i, 1, 0, 0),
                 tuple(d for d in spec.needs if d in needed),
-                (lambda sp=spec: sp.run(self.cache)),
+                # Stage bodies resolve artifacts through a cache view
+                # bound to their consumes_sharding declaration (ISSUE
+                # 8): laned consumers take device-resident handoffs,
+                # everyone else gets the safe host form.
+                (lambda sp=spec: sp.run(self.cache.view_for(sp))),
                 i,
                 exclusive=spec.exclusive,
             )

@@ -55,6 +55,11 @@ REQUIRED_COUNTERS = (
     "compile_cache_misses_total",
     "nuisance_cache_requests_total",
     "scheduler_prefetch_total",
+    # Artifact-plane families (ISSUE 8): every byte a nuisance artifact
+    # moves across a layout boundary is metered — "nothing crossed the
+    # host" is a recorded 0 on every instrumented run.
+    "artifact_transfer_bytes_total",
+    "artifact_reshard_total",
     # Serving families (ISSUE 6): "nothing was served" and "jax never
     # compiled" are recorded zeros, not missing keys — the latter is
     # the daemon's steady-state no-compile proof instrument.
@@ -422,6 +427,107 @@ def validate_slo_report(report: dict, tol: float = 1e-9) -> list[str]:
     return errors
 
 
+_PLANE_EDGE_KEYS = {"edge", "producer_lane", "consumer_lane",
+                    "host_bytes", "device_bytes", "legacy_host_bytes"}
+
+
+def validate_mesh_scaling(record: dict) -> list[str]:
+    """Internal-consistency checks on ``MESH_SCALING.json``'s artifact
+    plane section (ISSUE 8). The byte columns are the record's claim —
+    a hand-edited file must FAIL here, not mislead a reader:
+
+    * per-device column arrays line up with the ``devices`` axis;
+    * every edge carries the full byte-accounting triple, non-negative,
+      with the legacy before-number equal to 2× the payload (the
+      materialized() double copy) and exactly one of host/device bytes
+      carrying the payload;
+    * laned→laned edges (producer and consumer share a lane) report
+      ZERO host bytes — the acceptance claim;
+    * the measured counter totals for the plane leg carry no
+      ``host_bounce`` bytes (the legacy path must be unreachable from
+      the scheduled plane).
+    """
+    errors: list[str] = []
+    devices = record.get("devices")
+    if not isinstance(devices, list) or not devices:
+        return ["mesh_scaling: missing devices axis"]
+    plane = record.get("artifact_plane")
+    if not isinstance(plane, dict):
+        return ["mesh_scaling: missing artifact_plane section"]
+    for key in ("rows", "wall_s", "legacy_wall_s", "edges",
+                "measured_bytes", "legacy_measured_bytes"):
+        if key not in plane:
+            errors.append(f"mesh_scaling: artifact_plane lacks {key!r}")
+    if errors:
+        return errors
+    for key in ("wall_s", "legacy_wall_s"):
+        col = plane[key]
+        if not isinstance(col, list) or len(col) != len(devices):
+            errors.append(
+                f"mesh_scaling: {key} does not line up with devices"
+            )
+        elif any(not isinstance(v, (int, float)) or v < 0 for v in col):
+            errors.append(f"mesh_scaling: {key} has non-numeric/negative entries")
+    edges = plane["edges"]
+    if not isinstance(edges, list) or not edges:
+        errors.append("mesh_scaling: artifact_plane.edges empty")
+        return errors
+    for e in edges:
+        if not (isinstance(e, dict) and _PLANE_EDGE_KEYS <= set(e)):
+            errors.append(f"mesh_scaling: malformed edge {e!r}")
+            continue
+        hb, db, lb = e["host_bytes"], e["device_bytes"], e["legacy_host_bytes"]
+        # Type-guard before arithmetic: a hand-edited record must FAIL,
+        # not TypeError out of the validator.
+        if any(isinstance(v, bool) or not isinstance(v, (int, float))
+               for v in (hb, db, lb)):
+            errors.append(
+                f"mesh_scaling: edge {e.get('edge')!r} non-numeric bytes"
+            )
+            continue
+        if min(hb, db, lb) < 0:
+            errors.append(f"mesh_scaling: edge {e['edge']} negative bytes")
+        if hb and db:
+            errors.append(
+                f"mesh_scaling: edge {e['edge']} pays both host and device "
+                "bytes — an edge crosses exactly one boundary"
+            )
+        if lb != 2 * (hb + db):
+            errors.append(
+                f"mesh_scaling: edge {e['edge']} legacy_host_bytes {lb} != "
+                f"2x payload {2 * (hb + db)}"
+            )
+        laned = (
+            e["producer_lane"] is not None
+            and e["producer_lane"] == e["consumer_lane"]
+        )
+        if laned and hb != 0:
+            errors.append(
+                f"mesh_scaling: laned->laned edge {e['edge']} reports "
+                f"{hb} host bytes (must be 0)"
+            )
+        if not laned and db != 0:
+            errors.append(
+                f"mesh_scaling: cross-lane edge {e['edge']} claims "
+                "device-resident bytes"
+            )
+    for key, bounce_ok in (("measured_bytes", False),
+                           ("legacy_measured_bytes", True)):
+        mb = plane[key]
+        if not isinstance(mb, dict):
+            errors.append(f"mesh_scaling: {key} not a mapping")
+            continue
+        if any(v < 0 for v in mb.values() if isinstance(v, (int, float))):
+            errors.append(f"mesh_scaling: {key} negative byte totals")
+        if not bounce_ok and mb.get("host_bounce", 0):
+            errors.append(
+                "mesh_scaling: plane leg measured host_bounce bytes — the "
+                "legacy double copy must be unreachable from the artifact "
+                "plane"
+            )
+    return errors
+
+
 def validate_trace_files(outdir: str) -> list[str]:
     """Validate trace.json / overlap_report.json / serving_report.json
     / slo_report.json in ``outdir`` when present (tracing and serving
@@ -485,6 +591,22 @@ def main(argv: list[str] | None = None) -> int:
                          "sweep_stage_total")
     args = ap.parse_args(argv)
     trace_dir = None
+    if len(args.paths) == 1 and os.path.basename(
+        args.paths[0]
+    ).startswith("MESH_SCALING"):
+        # Scaling-evidence mode (ISSUE 8): validate the byte-accounting
+        # record bench.py --mesh-scaling writes at the repo root.
+        try:
+            with open(args.paths[0]) as f:
+                errors = validate_mesh_scaling(json.load(f))
+        except (OSError, json.JSONDecodeError) as e:
+            errors = [f"mesh_scaling: cannot read {args.paths[0]}: {e}"]
+        for e in errors:
+            print(f"FAIL {e}", file=sys.stderr)
+        if errors:
+            return 1
+        print(f"OK {args.paths[0]}")
+        return 0
     if len(args.paths) == 1 and os.path.isdir(args.paths[0]):
         trace_dir = args.paths[0]
         metrics_path = os.path.join(args.paths[0], "metrics.json")

@@ -803,6 +803,54 @@ def test_jgl009_exempts_observability_and_honors_suppressions():
     assert [f.line for f in res.suppressed] == [6]
 
 
+# --------------------------------------------------------------- JGL010
+
+
+JGL010_BAD = """\
+import numpy as np
+import jax
+
+def leak(artifact):
+    host = np.asarray(artifact)          # line 5: unmetered device_get
+    pulled = jax.device_get(artifact)    # line 6: unmetered device_get
+    return host, pulled
+"""
+
+JGL010_GOOD = """\
+import numpy as np
+from ate_replication_causalml_tpu.parallel import shardio
+
+def ok(artifact, ate):
+    host = shardio.gather_host(artifact, artifact="p")  # metered plane
+    finite = np.isfinite(ate)            # non-materializing numpy: fine
+    return host, finite
+"""
+
+
+def test_jgl010_fires_in_scheduler_and_pipeline_scope_only():
+    """ISSUE 8: artifact bytes cross the host boundary only through the
+    metered parallel/shardio.py plane — a bare np.asarray/device_get in
+    the scheduler or driver is the materialized() bounce coming back."""
+    assert _lines(JGL010_BAD, "JGL010", relpath="pkg/scheduler/cache.py") == [5, 6]
+    assert _lines(JGL010_BAD, "JGL010", relpath="pkg/pipeline.py") == [5, 6]
+    # The sanctioned plane itself, nested pipelines and everything else
+    # host-materialize legitimately.
+    assert _lines(JGL010_BAD, "JGL010", relpath="pkg/parallel/shardio.py") == []
+    assert _lines(JGL010_BAD, "JGL010", relpath="pkg/data/pipeline.py") == []
+    assert _lines(JGL010_BAD, "JGL010", relpath="pkg/ops/mod.py") == []
+
+
+def test_jgl010_quiet_on_plane_calls_and_honors_suppressions():
+    assert _lines(JGL010_GOOD, "JGL010", relpath="pkg/scheduler/cache.py") == []
+    src = JGL010_BAD.replace(
+        "    host = np.asarray(artifact)          # line 5: unmetered device_get",
+        "    host = np.asarray(artifact)  # graftlint: disable=JGL010",
+    )
+    res = lint_source(src, relpath="pkg/scheduler/cache.py", select=["JGL010"])
+    assert [f.line for f in res.findings] == [6]
+    assert [f.line for f in res.suppressed] == [5]
+
+
 # ----------------------------------------------------- suppressions etc.
 
 

@@ -1,14 +1,26 @@
-"""Dispatch-plan scaling contracts (VERDICT r4 #5).
+"""Dispatch-plan and byte-accounting scaling contracts (VERDICT r4 #5,
+ISSUE 8).
 
 The virtual-device mesh cannot demonstrate wall-clock speedup on a
-1-core host, so the testable multi-chip claim is the DETERMINISTIC
-dispatch plan: per-device work divides as 1/d along each mesh axis and
-the dispatch count shrinks with it. ``bench.py --mesh-scaling``
-measures the same curves with wall-clock and writes MESH_SCALING.json;
-this test pins the plan math without any backend.
+1-core host, so the testable multi-chip claims are DETERMINISTIC: the
+dispatch plan (per-device work divides as 1/d along each mesh axis and
+the dispatch count shrinks with it) and the artifact-plane transfer
+plan (laned→laned handoffs move ZERO host bytes; the legacy
+``materialized()`` bounce paid 2× payload per edge). ``bench.py
+--mesh-scaling`` measures the same curves with wall-clock and writes
+MESH_SCALING.json; this module pins the plan math without running a
+backend and holds the committed record to it.
 """
 
+import json
+import os
+import sys
+
 from ate_replication_causalml_tpu.models.forest import plan_tree_dispatch
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "scripts"))
+import check_metrics_schema as cms  # noqa: E402
 
 
 def _curve(n_rows, depth, total, trees_per_unit=1, streaming=False,
@@ -93,3 +105,62 @@ def test_sharded_fit_plan_matches_resolved_backend(monkeypatch):
     assert sharded_fit_plan(1_000_000, 9, 500) == plan_tree_dispatch(
         1_000_000, 9, 500, streaming=True, hist_floor=_HIST_M_FLOOR
     )
+
+
+# ── artifact-plane byte accounting (ISSUE 8) ──────────────────────────
+
+
+def test_edge_byte_plan_curve():
+    """The transfer-plan analogue of the dispatch curves: at every axis
+    size and payload, a laned→laned artifact edge hands off fully
+    on-device (zero host bytes) while the legacy PR-4 host bounce paid
+    2× payload — the quantity that IS the multi-chip bandwidth win when
+    devices are physical."""
+    from ate_replication_causalml_tpu.parallel import shardio
+
+    for nbytes in (4 << 10, 4 << 20, 4 << 30):
+        laned = shardio.edge_byte_plan(nbytes, "mesh", "mesh")
+        assert laned["host_bytes"] == 0
+        assert laned["device_bytes"] == nbytes
+        crossed = shardio.edge_byte_plan(nbytes, "mesh", None)
+        assert crossed["host_bytes"] == nbytes
+        assert crossed["device_bytes"] == 0
+        for plan in (laned, crossed):
+            assert plan["legacy_host_bytes"] == 2 * nbytes
+
+
+def test_committed_record_byte_accounting():
+    """MESH_SCALING.json (regenerated by ``bench.py --mesh-scaling``)
+    must carry the flagship sharded-panel leg with per-edge transfer
+    bytes: zero host bytes on every laned→laned edge, the legacy bounce
+    as the 2×-payload before-number, and a measured plane leg that
+    never touched the host_bounce path."""
+    with open(os.path.join(_REPO, "MESH_SCALING.json")) as f:
+        record = json.load(f)
+    assert cms.validate_mesh_scaling(record) == []
+    plane = record["artifact_plane"]
+    # Flagship scale: ≥1M rows sharded over the data axis, cross-fit
+    # folds mapped onto it.
+    assert plane["rows"] >= 1_000_000 and plane["folds"] >= 2
+    assert len(plane["wall_s"]) == len(record["devices"])
+    laned = [e for e in plane["edges"]
+             if e["producer_lane"] == e["consumer_lane"] == "mesh"]
+    crossed = [e for e in plane["edges"]
+               if e["producer_lane"] != e["consumer_lane"]]
+    assert laned and crossed, "both edge classes must be measured"
+    assert all(e["host_bytes"] == 0 for e in laned)
+    assert all(e["legacy_host_bytes"] == 2 * (e["host_bytes"] + e["device_bytes"])
+               for e in plane["edges"])
+    assert plane["measured_bytes"].get("host_bounce", 0) == 0
+    assert plane["legacy_measured_bytes"]["host_bounce"] > 0
+    assert plane["tau_bit_equal_vs_legacy"] is True
+
+
+def test_validator_fails_cleanly_on_hand_edited_records():
+    """A corrupted record produces FAIL diagnostics, never a
+    TypeError out of the validator (its stated contract)."""
+    with open(os.path.join(_REPO, "MESH_SCALING.json")) as f:
+        record = json.load(f)
+    record["artifact_plane"]["edges"][0]["host_bytes"] = "0"
+    errors = cms.validate_mesh_scaling(record)
+    assert any("non-numeric bytes" in e for e in errors)

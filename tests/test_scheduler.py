@@ -386,6 +386,139 @@ def test_exclusive_lane_serializes():
     assert active["max"] >= 2, "no concurrency at all without a lane"
 
 
+# ── device-resident artifact plane (ISSUE 8) ──────────────────────────
+
+from ate_replication_causalml_tpu.scheduler import cache as cache_mod
+
+
+class _FakePlane:
+    """Stands in for parallel/shardio.py so the layout/lane semantics
+    run without jax: values are tagged so tests can see which path
+    delivered them, and calls are counted."""
+
+    def __init__(self):
+        self.calls = []
+
+    def commit(self, value, sharding, artifact=""):
+        self.calls.append(("commit", artifact))
+        return ("dev", value)
+
+    def handoff(self, value, artifact=""):
+        self.calls.append(("handoff", artifact))
+        return value
+
+    def reshard(self, value, sharding, artifact=""):
+        self.calls.append(("reshard", artifact))
+        return ("reshard", sharding, value)
+
+    def gather_host(self, value, artifact=""):
+        self.calls.append(("gather", artifact))
+        return ("host", value[1])
+
+
+def test_validate_rejects_bad_layout_declarations():
+    sharded = ArtifactSpec("a", fit=lambda c: 1, sharding=object())
+    plain = ArtifactSpec("b", fit=lambda c: 1)
+    with pytest.raises(DagError, match="does not consume"):
+        validate([sharded], [StageSpec(
+            "s", run=lambda c: 1, consumes_sharding={"a": "device"})])
+    with pytest.raises(DagError, match="unsharded artifact"):
+        validate([plain], [StageSpec(
+            "s", run=lambda c: 1, needs=("b",),
+            consumes_sharding={"b": "device"})])
+    with pytest.raises(DagError, match="does not consume"):
+        validate(
+            [sharded, ArtifactSpec("d", fit=lambda c: 1,
+                                   consumes_sharding={"a": "device"})],
+            [],
+        )
+
+
+def test_layout_view_delivers_declared_forms(monkeypatch):
+    fake = _FakePlane()
+    monkeypatch.setattr(cache_mod, "_SHARDIO", fake)
+    got = {}
+    arts = [ArtifactSpec("p", fit=lambda c: 7, key=("k",),
+                         sharding="rowspec")]
+    stages = [
+        StageSpec("dev", run=lambda c: got.setdefault("dev", c.get("p")),
+                  needs=("p",), consumes_sharding={"p": "device"}),
+        StageSpec("spec", run=lambda c: got.setdefault("spec", c.get("p")),
+                  needs=("p",), consumes_sharding={"p": "otherspec"}),
+        StageSpec("host1", run=lambda c: got.setdefault("h1", c.get("p")),
+                  needs=("p",)),
+        StageSpec("host2", run=lambda c: got.setdefault("h2", c.get("p")),
+                  needs=("p",)),
+    ]
+    SweepEngine(arts, stages, workers=1, prefetch=False).run()
+    # The fit's output was committed onto the declared sharding once and
+    # stored device-resident.
+    assert fake.calls.count(("commit", "p")) == 1
+    # Declared-device consumer takes the stored form (zero-copy handoff);
+    # an explicit sharding reshards; undeclared consumers get the host
+    # form, gathered exactly ONCE for both (cached per entry).
+    assert got["dev"] == ("dev", 7)
+    assert got["spec"] == ("reshard", "otherspec", ("dev", 7))
+    assert got["h1"] == ("host", 7) and got["h2"] is got["h1"]
+    assert fake.calls.count(("gather", "p")) == 1
+
+
+def test_sharded_gather_for_unlaned_consumer_stays_in_lane(monkeypatch):
+    # The ISSUE 8 lane-safety regression, on the PR-4 gated-body
+    # adversarial-ordering harness: an UNLANED stage consuming a
+    # mesh-lane sharded artifact triggers the device→host gather — a
+    # collective launch — which must hold the mesh lane, so it can
+    # never overlap a laned node that becomes ready mid-gather.
+    active = {"n": 0, "max": 0}
+    mu = threading.Lock()
+    gather_started = threading.Event()
+
+    def enter():
+        with mu:
+            active["n"] += 1
+            active["max"] = max(active["max"], active["n"])
+
+    def leave():
+        with mu:
+            active["n"] -= 1
+
+    class GatingPlane(_FakePlane):
+        def gather_host(self, value, artifact=""):
+            gather_started.set()
+            enter()
+            time.sleep(0.2)
+            leave()
+            return ("host", value[1])
+
+    monkeypatch.setattr(cache_mod, "_SHARDIO", GatingPlane())
+
+    def laned_body(c):
+        enter()
+        time.sleep(0.05)
+        leave()
+        return 1
+
+    arts = [
+        ArtifactSpec("a", fit=lambda c: 42, key=(), exclusive="mesh",
+                     sharding="rowspec"),
+        # Ready-gate: s_laned becomes schedulable only once the gather
+        # is mid-flight on the unlaned consumer's worker.
+        ArtifactSpec("b", fit=lambda c: gather_started.wait(timeout=30),
+                     key=()),
+    ]
+    stages = [
+        StageSpec("s_unlaned", run=lambda c: c.get("a"), needs=("a",)),
+        StageSpec("s_laned", run=laned_body, needs=("b",),
+                  exclusive="mesh"),
+    ]
+    res = SweepEngine(arts, stages, workers=2, prefetch=False).run()
+    assert res["s_unlaned"] == ("host", 42)
+    assert active["max"] == 1, (
+        "a sharded artifact's gather for an unlaned consumer overlapped "
+        "a mesh-lane node — collective launched outside the lane"
+    )
+
+
 # ── prefetch lane ─────────────────────────────────────────────────────
 
 def test_prefetcher_warms_skips_and_swallows_errors():

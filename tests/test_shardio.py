@@ -1,0 +1,168 @@
+"""Device-resident artifact plane (ISSUE 8): reshard round-trip
+bit-identity across 1/2/4/8 virtual devices for every declared artifact
+value shape, compile-once caching of the shard/gather/reshard paths,
+byte metering, and the one-host-round-trip regression replacing the
+PR-4 ``materialized()`` double copy.
+
+Cheap by design: every program here is a compiled identity over tiny
+arrays — no estimator compute (tier-1 budget note in CHANGES.md)."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ate_replication_causalml_tpu import observability as obs
+from ate_replication_causalml_tpu.parallel import shardio
+from ate_replication_causalml_tpu.parallel.mesh import DATA_AXIS
+from ate_replication_causalml_tpu.scheduler import (
+    ArtifactSpec,
+    StageSpec,
+    SweepEngine,
+)
+
+N = 1024  # divides every tested axis size
+
+
+def _mesh(d):
+    return Mesh(np.asarray(jax.devices()[:d]), (DATA_AXIS,))
+
+
+def _artifact_values():
+    """The value shapes the sweep/bench declare as sharded artifacts:
+    a propensity vector (lasso_ps / rf_oob_propensity / p_fold), a 2-D
+    design matrix (the panel), and the (mu0, mu1) pytree."""
+    rng = np.random.default_rng(3)
+    vec = rng.standard_normal(N).astype(np.float32)
+    mat = rng.standard_normal((N, 5)).astype(np.float32)
+    return {
+        "vec": vec,
+        "mat": mat,
+        "mu_pair": (vec + 1.0, (vec - 1.0).astype(np.float64)),
+    }
+
+
+def _host_leaves(tree):
+    return [np.asarray(l) for l in jax.tree_util.tree_leaves(tree)]
+
+
+@pytest.mark.parametrize("d", [1, 2, 4, 8])
+def test_roundtrip_bit_identity_across_device_counts(d):
+    mesh = _mesh(d)
+    rs = shardio.row_sharding(mesh, N)
+    rep = NamedSharding(mesh, P())
+    for name, val in _artifact_values().items():
+        tag = f"rt_{name}"
+        dev = shardio.commit(val, rs, artifact=tag)
+        for leaf in jax.tree_util.tree_leaves(dev):
+            assert leaf.sharding == rs
+        # host round trip is bit-identical, dtype included
+        for a, b in zip(_host_leaves(val),
+                        _host_leaves(shardio.gather_host(dev, artifact=tag))):
+            assert a.dtype == b.dtype
+            assert np.array_equal(a, b)
+        # reshard away and back: still bit-identical
+        back = shardio.reshard(
+            shardio.reshard(dev, rep, artifact=tag), rs, artifact=tag
+        )
+        for a, b in zip(_host_leaves(val),
+                        _host_leaves(shardio.gather_host(back, artifact=tag))):
+            assert np.array_equal(a, b)
+
+
+def test_row_sharding_uneven_rows_fall_back_replicated():
+    mesh = _mesh(8)
+    assert shardio.row_sharding(mesh, 1001).is_fully_replicated
+    assert shardio.row_sharding(mesh, N) == NamedSharding(mesh, P(DATA_AXIS))
+
+
+def _delta(family, before):
+    after = obs.REGISTRY.peek(family) or {}
+    return {k: v - before.get(k, 0.0) for k, v in after.items()
+            if v != before.get(k, 0.0)}
+
+
+def test_reshard_path_compiles_once_and_meters():
+    mesh = _mesh(4)
+    # Unique shape so earlier tests cannot have pre-seeded this path.
+    v = np.arange(4 * 37, dtype=np.float32).reshape(4, 37)
+    rs = NamedSharding(mesh, P(DATA_AXIS))
+    rep = NamedSharding(mesh, P())
+    before = dict(obs.REGISTRY.peek(shardio.CALLS_FAMILY) or {})
+    dev = shardio.commit(v, rs, artifact="once")         # host upload
+    r1 = shardio.reshard(dev, rep, artifact="once")      # compiles
+    shardio.reshard(dev, rep, artifact="once")           # cached fn
+    shardio.reshard(r1, rep, artifact="once")            # already there
+    calls = _delta(shardio.CALLS_FAMILY, before)
+    assert calls.get("artifact=once,status=upload") == 1
+    assert calls.get("artifact=once,status=compiled") == 1
+    assert calls.get("artifact=once,status=cached") == 1
+    assert calls.get("artifact=once,status=noop") == 1
+
+
+def test_byte_paths_metered_exactly():
+    mesh = _mesh(8)
+    v = np.arange(2048, dtype=np.float32)
+    rs = shardio.row_sharding(mesh, v.size)
+    before = dict(obs.REGISTRY.peek(shardio.BYTES_FAMILY) or {})
+    dev = shardio.commit(v, rs, artifact="bytes_t")
+    shardio.handoff(dev, artifact="bytes_t")
+    host = shardio.gather_host(dev, artifact="bytes_t")
+    bounced = shardio.host_bounce(dev, artifact="bytes_t")
+    moved = _delta(shardio.BYTES_FAMILY, before)
+    assert moved.get("artifact=bytes_t,path=host_upload") == v.nbytes
+    assert moved.get("artifact=bytes_t,path=device_handoff") == v.nbytes
+    assert moved.get("artifact=bytes_t,path=host_gather") == v.nbytes
+    # the gather's internal all-gather is device traffic and is metered
+    assert moved.get("artifact=bytes_t,path=device_reshard") == v.nbytes
+    # the legacy double copy records BOTH crossings — the before-number
+    assert moved.get("artifact=bytes_t,path=host_bounce") == 2 * v.nbytes
+    assert np.array_equal(host, v)
+    assert np.array_equal(np.asarray(bounced), v)
+    # The host form is shared by every consumer: read-only, so an
+    # in-place write fails loudly instead of corrupting the cache.
+    assert host.flags.writeable is False
+    with pytest.raises(ValueError):
+        host[0] = 0.0
+
+
+def test_unlaned_consumers_pay_one_host_round_trip():
+    """The materialized() regression (ISSUE 8 satellite): a mesh-lane
+    sharded artifact consumed by unlaned stages crosses the host ONCE —
+    one metered gather shared by every host consumer — never the legacy
+    np.asarray→jnp.asarray double copy (host_bounce must stay zero on
+    any scheduled run)."""
+    raw = np.arange(4096, dtype=np.float32)
+    mesh = _mesh(8)
+    rs = shardio.row_sharding(mesh, raw.size)
+    got = {}
+    arts = [ArtifactSpec("reg_p", fit=lambda c: jax.numpy.asarray(raw),
+                         key=("k",), exclusive="mesh", sharding=rs)]
+    stages = [
+        StageSpec("u1", run=lambda c: got.setdefault("u1", c.get("reg_p")),
+                  needs=("reg_p",)),
+        StageSpec("u2", run=lambda c: got.setdefault("u2", c.get("reg_p")),
+                  needs=("reg_p",)),
+    ]
+    before = dict(obs.REGISTRY.peek(shardio.BYTES_FAMILY) or {})
+    SweepEngine(arts, stages, workers=2, prefetch=False).run()
+    moved = _delta(shardio.BYTES_FAMILY, before)
+    assert moved.get("artifact=reg_p,path=host_gather") == raw.nbytes
+    assert not any("path=host_bounce" in k for k in moved)
+    assert isinstance(got["u1"], np.ndarray)
+    assert np.array_equal(got["u1"], raw)
+    assert got["u2"] is got["u1"]
+    assert got["u1"].flags.writeable is False
+
+
+def test_edge_byte_plan():
+    for nb in (1, 4096, 1 << 22):
+        assert shardio.edge_byte_plan(nb, "mesh", "mesh") == {
+            "host_bytes": 0, "device_bytes": nb, "legacy_host_bytes": 2 * nb,
+        }
+        for producer, consumer in (("mesh", None), (None, None),
+                                   ("mesh", "other")):
+            plan = shardio.edge_byte_plan(nb, producer, consumer)
+            assert plan["host_bytes"] == nb and plan["device_bytes"] == 0
+            assert plan["legacy_host_bytes"] == 2 * nb
