@@ -57,6 +57,7 @@ from jax import lax
 
 from ate_replication_causalml_tpu.data.frame import CausalFrame
 from ate_replication_causalml_tpu.models.forest import (
+    _meter_hist_dispatches,
     apply_trees_chunked,
     auto_tree_chunk,
     bin_onehot,
@@ -65,6 +66,7 @@ from ate_replication_causalml_tpu.models.forest import (
     exact_subsample_mask,
     fit_forest_regressor,
     forest_oob_mean,
+    hist_partition_active,
     plan_host_dispatch,
     plan_tree_dispatch,
     quantile_bins,
@@ -76,7 +78,9 @@ from ate_replication_causalml_tpu.models.forest import (
 from ate_replication_causalml_tpu.ops.hist_pallas import (
     bin_histogram,
     bin_histogram_shared,
+    mode_for_width,
     node_sums_shared,
+    resolve_hist_mode,
 )
 from ate_replication_causalml_tpu.ops.linalg import _PREC
 from ate_replication_causalml_tpu.ops.tree_pallas import (
@@ -178,6 +182,7 @@ def grow_causal_forest(
     honesty: bool = True,
     group_chunk: int | None = None,
     hist_backend: str = "auto",
+    hist_mode: str | None = None,
 ) -> CausalForest:
     """Grow the causal forest on *centered* treatment/outcome residuals.
 
@@ -185,6 +190,10 @@ def grow_causal_forest(
     group of trees shares one without-replacement half-sample
     (``sample_fraction`` of rows), and every tree splits its sample into
     honest I (grow) / J (estimate) halves.
+
+    ``hist_mode`` (ISSUE 10): dense | partition | auto kernel
+    formulation per level width; defaults to the ``ATE_TPU_HIST_MODE``
+    policy, resolved here at config time.
     """
     n, p = x.shape
     if mtry is None:
@@ -199,6 +208,7 @@ def grow_causal_forest(
     # so the input rounding buys nothing. Explicit "pallas_bf16" remains
     # available.
     hist_backend = resolve_hist_backend(hist_backend, n_rows=n, n_bins=n_bins)
+    hist_mode = resolve_hist_mode(hist_mode)
     edges = quantile_bins(x, n_bins)
     codes = binarize(x, edges)
     xb_onehot = bin_onehot(codes, n_bins) if hist_backend == "onehot" else None
@@ -219,6 +229,8 @@ def grow_causal_forest(
         chunk_rows, depth, cap=16, trees_per_unit=k,
         leaf_onehot=not streaming, streaming=streaming, p=p, n_bins=n_bins,
         kernel_weights=5, hist_floor=1,
+        hist_partition=streaming
+        and hist_partition_active(hist_mode, depth, 1, 5, p, n_bins),
     )
     group_chunk = auto_chunk if group_chunk is None else min(group_chunk, auto_chunk)
     # Superchunking (see forest.py::_DISPATCH_CHUNK_TARGET): several
@@ -238,6 +250,13 @@ def grow_causal_forest(
     # transient device failure re-runs only that dispatch (keys are
     # explicit, so the retry is bit-identical — parallel/retry.py).
     def chunk_shard(i: int):
+        # One collapsed tree-batched kernel call per (level × vmapped
+        # chunk) — the nested group×tree vmaps flatten through the
+        # custom_vmap rule; metered per issued dispatch.
+        _meter_hist_dispatches(
+            "causal", hist_backend, hist_mode, depth, 1,
+            super_, 5, p, n_bins,
+        )
         kk = group_keys[
             i * super_ * group_chunk : (i + 1) * super_ * group_chunk
         ].reshape(super_, group_chunk)
@@ -246,6 +265,7 @@ def grow_causal_forest(
             codes, wt, yt, mom_stack, xb_onehot,
             depth=depth, mtry=mtry, n_bins=n_bins, min_node=min_node,
             s=s, k=k, honesty=honesty, hist_backend=hist_backend,
+            hist_mode=hist_mode,
         )
 
     chunks = require_all(
@@ -285,6 +305,7 @@ def grow_causal_forest_sharded(
     axis_name: str = "tree",
     group_chunk: int | None = None,
     hist_backend: str = "auto",
+    hist_mode: str | None = None,
 ) -> CausalForest:
     """Mesh-parallel causal-forest grow: little-bag groups shard over the
     mesh's tree axis (SURVEY.md §2.4 — the expert-parallel analogue of
@@ -318,6 +339,7 @@ def grow_causal_forest_sharded(
     hist_backend = resolve_hist_backend(
         hist_backend, allow_onehot=False, n_rows=n, n_bins=n_bins
     )
+    hist_mode = resolve_hist_mode(hist_mode)
     axis_size = mesh.shape[axis_name]
     per_dev_groups = -(-n_groups // axis_size)
     streaming = hist_backend.startswith("pallas")
@@ -326,6 +348,8 @@ def grow_causal_forest_sharded(
         plan_rows, depth, per_dev_groups, cap=16, trees_per_unit=k,
         leaf_onehot=not streaming, streaming=streaming, p=p, n_bins=n_bins,
         kernel_weights=5, hist_floor=1,
+        hist_partition=streaming
+        and hist_partition_active(hist_mode, depth, 1, 5, p, n_bins),
     )
     if group_chunk is not None and group_chunk < auto_chunk:
         # An explicit (smaller) chunk re-plans the dispatch split so the
@@ -349,10 +373,17 @@ def grow_causal_forest_sharded(
         mesh, axis_name, chunks_per_disp, group_chunk,
         depth=depth, mtry=mtry, n_bins=n_bins, min_node=min_node,
         s=s, k=k, honesty=honesty, hist_backend=hist_backend,
+        hist_mode=hist_mode,
     )
     key_sharding = NamedSharding(mesh, P(axis_name))
 
     def dispatch(i: int):
+        # Every device runs its own per-device chunks — the meter
+        # counts kernel calls across the mesh, per issued dispatch.
+        _meter_hist_dispatches(
+            "causal", hist_backend, hist_mode, depth, 1,
+            chunks_per_disp * axis_size, 5, p, n_bins,
+        )
         return grow(
             jax.device_put(group_keys[i], key_sharding), codes, wt, yt, mom_stack
         )
@@ -380,7 +411,7 @@ def grow_causal_forest_sharded(
 @functools.lru_cache(maxsize=64)
 def _sharded_cf_grow_fn(mesh, axis_name, chunks_per_disp, group_chunk, *,
                         depth, mtry, n_bins, min_node, s, k, honesty,
-                        hist_backend):
+                        hist_backend, hist_mode="dense"):
     """The jitted shard_map causal-grow executable, cached on (mesh,
     plan, statics) — same reason as forest.py::_sharded_grow_fn: a
     per-call `jax.jit(shard_map(local_lambda))` re-traced and
@@ -393,6 +424,7 @@ def _sharded_cf_grow_fn(mesh, axis_name, chunks_per_disp, group_chunk, *,
             codes, wt, yt, mom_stack, None,
             depth=depth, mtry=mtry, n_bins=n_bins, min_node=min_node,
             s=s, k=k, honesty=honesty, hist_backend=hist_backend,
+            hist_mode=hist_mode,
         )
 
     return jax.jit(_shard_map(
@@ -406,10 +438,11 @@ def _sharded_cf_grow_fn(mesh, axis_name, chunks_per_disp, group_chunk, *,
 @functools.partial(
     jax.jit,
     static_argnames=("depth", "mtry", "n_bins", "min_node", "s", "k",
-                     "honesty", "hist_backend"),
+                     "honesty", "hist_backend", "hist_mode"),
 )
 def _grow_cf_chunk(group_keys, codes, wt, yt, mom_stack, xb_onehot, *,
-                   depth, mtry, n_bins, min_node, s, k, honesty, hist_backend):
+                   depth, mtry, n_bins, min_node, s, k, honesty, hist_backend,
+                   hist_mode="dense"):
     """One compiled dispatch of little-bag groups, k trees per group
     sharing a half-sample. ``group_keys`` is (gc,) for one vmapped
     chunk or (S, gc) for a superchunk (S chunks sequentially under
@@ -500,9 +533,13 @@ def _grow_cf_chunk(group_keys, codes, wt, yt, mom_stack, xb_onehot, *,
 
         feats, bins, node_int = streaming_level_loop(
             codes_g, depth, n_bins,
+            # Per-WIDTH kernel mode (ISSUE 10): hist_mode is a config-
+            # time-resolved jit static; each width compiles in exactly
+            # one mode, reusing the existing instantiation set.
             hist_fn=lambda ids, m: bin_histogram_shared(
                 codes_g, jnp.where(grow_mask, ids, -1), mom5,
                 max_nodes=m, n_bins=n_bins, backend=hist_backend,
+                mode=mode_for_width(hist_mode, m, 5, p, n_bins),
             ),
             tables_fn=tables_fn,
             route_fn=lambda ids, bf, bb: route_bits(
@@ -699,6 +736,7 @@ def fit_causal_forest(
     nuisance_trees: int = 500,
     nuisance_depth: int = 9,
     hist_backend: str = "auto",
+    hist_mode: str | None = None,
     mesh=None,
     axis_name: str = "tree",
     **grow_kwargs,
@@ -729,12 +767,12 @@ def fit_causal_forest(
         fit_reg = functools.partial(
             fit_forest_regressor_sharded, mesh=mesh, axis_name=axis_name,
             n_trees=nuisance_trees, depth=nuisance_depth,
-            hist_backend=hist_backend,
+            hist_backend=hist_backend, hist_mode=hist_mode,
         )
     else:
         fit_reg = functools.partial(
             fit_forest_regressor, n_trees=nuisance_trees, depth=nuisance_depth,
-            hist_backend=hist_backend,
+            hist_backend=hist_backend, hist_mode=hist_mode,
         )
     fy = fit_reg(x, y, ky)
     y_hat = forest_oob_mean(fy, x)
@@ -748,12 +786,13 @@ def fit_causal_forest(
     if mesh is not None:
         forest = grow_causal_forest_sharded(
             x, w - w_hat, y - y_hat, kc, mesh, n_trees=n_trees, depth=depth,
-            axis_name=axis_name, hist_backend=hist_backend, **grow_kwargs,
+            axis_name=axis_name, hist_backend=hist_backend,
+            hist_mode=hist_mode, **grow_kwargs,
         )
     else:
         forest = grow_causal_forest(
             x, w - w_hat, y - y_hat, kc, n_trees=n_trees, depth=depth,
-            hist_backend=hist_backend, **grow_kwargs,
+            hist_backend=hist_backend, hist_mode=hist_mode, **grow_kwargs,
         )
     return FittedCausalForest(forest=forest, y_hat=y_hat, w_hat=w_hat, x=x, y=y, w=w)
 
@@ -824,6 +863,16 @@ def compute_leaf_index(
     )
 
 
+def _grf_df_flag(variance_compat: str) -> jnp.float32:
+    """Validate ``variance_compat`` on the host and map it to the
+    traced 0/1 df-selector operand of :func:`_predict_cate_impl`."""
+    if variance_compat not in ("unbiased", "grf"):
+        raise ValueError(
+            f"variance_compat must be 'unbiased' or 'grf', got {variance_compat!r}"
+        )
+    return jnp.float32(variance_compat == "grf")
+
+
 def _tau_from_sums(S, M):
     """α-weighted residual-on-residual regression from accumulated
     normalized moments S (…, 5) over M valid trees: the 2×2 local
@@ -888,15 +937,18 @@ def predict_cate(
             "row_backend must be 'pallas', 'pallas_interpret' or 'matmul', "
             f"got {row_backend!r}"
         )
+    # The compat flag enters as a traced 0/1 OPERAND (PR 10): both df
+    # conventions dispatch the SAME executable, so their shared
+    # between-variance numerator is bit-identical — the documented
+    # exact (gn−1)/gn ratio holds on every row (validated at config
+    # time here, never at trace time).
     return _predict_cate_traced(
         forest, x, oob, tree_chunk, row_chunk, leaf_index, row_backend,
-        variance_compat,
+        _grf_df_flag(variance_compat),
     )
 
 
-_PREDICT_CATE_STATICS = (
-    "oob", "tree_chunk", "row_chunk", "row_backend", "variance_compat"
-)
+_PREDICT_CATE_STATICS = ("oob", "tree_chunk", "row_chunk", "row_backend")
 
 
 def _predict_cate_impl(
@@ -907,12 +959,16 @@ def _predict_cate_impl(
     row_chunk: int,
     leaf_index: jax.Array | None,
     row_backend: str,
-    variance_compat: str,
+    grf_df: jax.Array,
 ) -> CatePredictions:
-    """:func:`predict_cate`'s traceable body (``row_backend`` concrete).
-    Jitted twice below: :data:`_predict_cate_traced` (the dispatcher's
-    body) and :data:`_predict_cate_donated` (the serving variant that
-    donates the query buffer — see :func:`lower_predict_cate`)."""
+    """:func:`predict_cate`'s traceable body (``row_backend`` concrete;
+    ``grf_df`` a traced f32 0/1 scalar selecting the between-group df —
+    an OPERAND, not a static, so both variance_compat modes share one
+    executable and their truncated between-variance is bit-identical;
+    see the df comment below). Jitted twice: :data:`_predict_cate_traced`
+    (the dispatcher's body) and :func:`_predict_cate_aot_fn` (the
+    serving wrapper — flag closed over, optional buffer donation; see
+    :func:`lower_predict_cate`)."""
     if oob and x.shape[0] != forest.in_sample.shape[1]:
         raise ValueError(
             "oob=True is only valid for the training matrix: forest was "
@@ -1098,13 +1154,20 @@ def _predict_cate_impl(
     # noise). grf's half-sample "Bayes debiasing" correction is skipped
     # by both sides (grf only applies it when ci_group_size > 1
     # subsampling leaves it well-defined).
-    if variance_compat not in ("unbiased", "grf"):
-        raise ValueError(
-            f"variance_compat must be 'unbiased' or 'grf', got {variance_compat!r}"
-        )
+    #
+    # ``grf_df`` is a TRACED 0/1 scalar, not a jit static (PR 10): as a
+    # static, the two compat modes compiled SEPARATE executables, and
+    # XLA was free to associate the f32 cancellation ``SP2 − gn·ψ̄²``
+    # differently in each — on rows where the true between-variance is
+    # ≈ 0 the two executables' truncation residue disagreed at ulp
+    # level and the documented exact (gn−1)/gn ratio did not hold
+    # (the known-red test_variance_compat_grf_df_ratio). One shared
+    # executable makes the numerator bit-identical by construction; the
+    # where() selects between the exact same df values the old static
+    # branches produced.
     ngr = jnp.maximum(gn, 1.0)
     mean_psi = SP / ngr
-    between_df = ngr if variance_compat == "grf" else jnp.maximum(gn - 1.0, 1.0)
+    between_df = jnp.where(grf_df > 0, ngr, jnp.maximum(gn - 1.0, 1.0))
     v_between = jnp.maximum(SP2 - gn * mean_psi * mean_psi, 0.0) / between_df
     v_within = ssw / jnp.maximum(gn * (k - 1.0), 1.0)
     var_psi = jnp.maximum(v_between - v_within / k, 0.0)
@@ -1118,20 +1181,36 @@ _predict_cate_traced = functools.partial(
     jax.jit, static_argnames=_PREDICT_CATE_STATICS
 )(_predict_cate_impl)
 
-# Serving variant (ISSUE 6): identical computation, but the query
-# buffer is DONATED — the daemon pads every micro-batch into a fresh
-# device array, so XLA may reuse that buffer for outputs instead of
-# holding both live per in-flight batch. Split from the dispatcher's
-# jit because donation is part of the executable's calling convention:
-# offline callers (tests, notebook predict) must keep their inputs.
-_predict_cate_donated = functools.partial(
-    jax.jit, static_argnames=_PREDICT_CATE_STATICS, donate_argnums=(1,)
-)(_predict_cate_impl)
+# The serving (donated-buffer) variant lives in _predict_cate_aot_fn
+# below: donation is part of the executable's calling convention
+# (offline callers must keep their inputs), and the AOT wrapper also
+# closes over the df flag so the compiled serving signature stays
+# ``compiled(forest, x, None)``.
 
 
 # The dispatcher keeps the jitted body's cache controls (tests rebuild
 # traces with monkeypatched internals via predict_cate.clear_cache()).
 predict_cate.clear_cache = _predict_cate_traced.clear_cache
+
+
+@functools.lru_cache(maxsize=None)
+def _predict_cate_aot_fn(grf: bool, donate: bool):
+    """The AOT (serving) jit wrapper with the df-selector flag CLOSED
+    OVER as a constant: keeps the compiled signature at
+    ``compiled(forest, x, None)`` while the offline dispatcher threads
+    the flag as a runtime operand (one executable for both compat
+    modes). Cached so repeated lowers reuse one function identity."""
+
+    def body(forest, x, oob, tree_chunk, row_chunk, leaf_index, row_backend):
+        return _predict_cate_impl(
+            forest, x, oob, tree_chunk, row_chunk, leaf_index, row_backend,
+            jnp.float32(grf),
+        )
+
+    kw: dict = dict(static_argnames=_PREDICT_CATE_STATICS)
+    if donate:
+        kw["donate_argnums"] = (1,)
+    return jax.jit(body, **kw)
 
 
 def lower_predict_cate(
@@ -1171,12 +1250,16 @@ def lower_predict_cate(
     elif donate and backend != "tpu":
         _warn_donation_unsupported(backend)
         donate = False
+    _grf_df_flag(variance_compat)  # validate at config time
     p = forest.bin_edges.shape[0]
     x_spec = jax.ShapeDtypeStruct((int(batch), p), jnp.float32)
-    fn = _predict_cate_donated if donate else _predict_cate_traced
+    # The AOT path closes over the df flag as a trace-time CONSTANT so
+    # the compiled call signature stays ``compiled(forest, x, None)``
+    # (the serving daemon's documented contract). Serving never needs
+    # cross-compat bit-identity — each daemon compiles one convention.
+    fn = _predict_cate_aot_fn(variance_compat == "grf", donate)
     return fn.lower(
-        forest, x_spec, oob, tree_chunk, row_chunk, None, row_backend,
-        variance_compat,
+        forest, x_spec, oob, tree_chunk, row_chunk, None, row_backend
     )
 
 

@@ -45,8 +45,10 @@ from ate_replication_causalml_tpu.data.frame import CausalFrame
 from ate_replication_causalml_tpu.ops.bootstrap import _poisson1_counts
 from ate_replication_causalml_tpu.ops.hist_pallas import (
     bin_histogram,
+    mode_for_width,
     node_sums,
     resolve_hist_backend,
+    resolve_hist_mode,
 )
 from ate_replication_causalml_tpu.ops.linalg import _PREC
 from ate_replication_causalml_tpu.ops.tree_pallas import (
@@ -317,6 +319,60 @@ def bitrev_perm(level: int) -> tuple[int, ...]:
 # causal grower passes floor 1 (no padding) and the classifier 16/32.
 _HIST_M_FLOOR = 16
 _ROUTE_M_FLOOR = 32
+
+
+def streaming_hist_widths(depth: int, hist_floor: int = 1) -> tuple[int, ...]:
+    """The kernel widths (padded node counts) the streaming level loop
+    actually requests, one per level: level 0 runs at the floor; level
+    l ≥ 1 computes LEFT children only (sibling subtraction), so its
+    kernel covers max(2^(l−1), floor) nodes. The per-width kernel-mode
+    decision (ISSUE 10) and the dispatch meter both key on these."""
+    if depth < 1:
+        return ()
+    return tuple(
+        max(1, hist_floor) if level == 0
+        else max(1 << (level - 1), hist_floor)
+        for level in range(depth)
+    )
+
+
+def hist_partition_active(hist_mode: str, depth: int, hist_floor: int,
+                          kernel_weights: int, p: int, n_bins: int) -> bool:
+    """Whether ANY level of a streaming grow resolves to the partition
+    kernel under ``hist_mode`` — the chunk planners use this to charge
+    the partition kernel's fixed VMEM transients
+    (ops/hist_pallas.py::batched_tree_cap(partition=True))."""
+    return any(
+        mode_for_width(hist_mode, w, kernel_weights, p, n_bins) == "partition"
+        for w in streaming_hist_widths(depth, hist_floor)
+    )
+
+
+def _meter_hist_dispatches(engine: str, hist_backend: str, hist_mode: str,
+                           depth: int, hist_floor: int, n_chunks: int,
+                           kernel_weights: int, p: int, n_bins: int) -> None:
+    """Host-side meter of the streaming growers' histogram-kernel
+    calls: ``hist_kernel_dispatch_total{mode, engine}`` counts one per
+    (grow level × vmapped chunk) — each level of each chunk collapses
+    to exactly ONE tree-batched kernel call through the custom_vmap
+    rule. Called from INSIDE each host dispatch function (the kernel
+    itself runs inside a trace where counting is impossible), so a
+    retried dispatch counts its re-issued kernel calls and an aborted
+    fit counts only the dispatches that actually ran — the counter
+    reflects calls ISSUED, not a plan. Pre-created at zero by
+    install_jax_monitoring so every instrumented run carries the
+    family."""
+    if not (hist_backend.startswith("pallas") and n_chunks > 0):
+        return
+    per_mode: dict[str, int] = {}
+    for w in streaming_hist_widths(depth, hist_floor):
+        m = mode_for_width(hist_mode, w, kernel_weights, p, n_bins)
+        per_mode[m] = per_mode.get(m, 0) + 1
+    for m, levels in per_mode.items():
+        obs.counter(
+            "hist_kernel_dispatch_total",
+            "streaming histogram kernel calls by kernel mode and engine",
+        ).inc(levels * n_chunks, mode=m, engine=engine)
 
 
 def streaming_level_loop(codes, depth, n_bins, hist_fn, tables_fn,
@@ -706,6 +762,7 @@ def plan_tree_dispatch(
     n_bins: int = 64,
     kernel_weights: int = 2,
     hist_floor: int = _HIST_M_FLOOR,
+    hist_partition: bool = False,
 ) -> tuple[int, int, int]:
     """Dispatch plan for a per-device tree workload: (chunk,
     chunks_per_disp, n_disp). ``chunk`` units vmap together within the
@@ -725,7 +782,7 @@ def plan_tree_dispatch(
         n_rows, depth, cap=cap, trees_per_unit=trees_per_unit,
         leaf_onehot=leaf_onehot, streaming=streaming,
         p=p, n_bins=n_bins, kernel_weights=kernel_weights,
-        hist_floor=hist_floor,
+        hist_floor=hist_floor, hist_partition=hist_partition,
     )
     return plan_host_dispatch(
         per_dev_total, budget,
@@ -744,6 +801,7 @@ def auto_tree_chunk(
     n_bins: int = 64,
     kernel_weights: int = 2,
     hist_floor: int = _HIST_M_FLOOR,
+    hist_partition: bool = False,
 ) -> int:
     """Trees to grow per compiled chunk: as many as fit the HBM budget,
     capped at ``cap``. The dominant operand is the deepest level's
@@ -788,7 +846,8 @@ def auto_tree_chunk(
         chunk = min(
             chunk,
             max(1, batched_tree_cap(kernel_nodes, kernel_weights, p=p,
-                                    n_bins=n_bins) // trees_per_unit),
+                                    n_bins=n_bins, partition=hist_partition,
+                                    ) // trees_per_unit),
         )
     return chunk
 
@@ -826,6 +885,7 @@ def fit_forest_classifier(
     n_bins: int = 64,
     tree_chunk: int | None = None,
     hist_backend: str = "auto",
+    hist_mode: str | None = None,
 ) -> Forest:
     """Fit a classification forest of ``n_trees`` depth-``depth`` trees.
 
@@ -839,6 +899,13 @@ def fit_forest_classifier(
     and memory, chunk-level progress/retry points (parallel/retry.py),
     identical numbers to a monolithic run since every chunk owns its
     fold-in keys.
+
+    ``hist_mode`` (ISSUE 10): "dense" | "partition" | "auto" — the
+    streaming histogram kernel's per-width formulation; defaults to the
+    ``ATE_TPU_HIST_MODE`` environment policy ("auto" when unset —
+    dense at shallow widths, partition past the measured FLOP
+    crossover). Resolved HERE at config time (never at trace time) and
+    baked into the chunk executable as a jit static.
     """
     n, p = x.shape
     if mtry is None:
@@ -847,6 +914,8 @@ def fit_forest_classifier(
     hist_backend = resolve_hist_backend(
         hist_backend, n_rows=n, n_bins=n_bins, integer_weights=y01
     )
+    hist_mode = resolve_hist_mode(hist_mode)
+    hist_floor = 1 if hist_backend == "pallas_interpret" else _HIST_M_FLOOR
     # (n_bins ≤ 256 is enforced at the binarize() chokepoint.)
     # Explicit chunks are clamped too: the per-level routing one-hot is
     # (rows, 2^(depth−1)) per vmapped tree — or one row block of it on
@@ -858,7 +927,9 @@ def fit_forest_classifier(
         # Mirrors the grower's floor choice (interpret mode pads
         # nothing) so the planned chunk matches what the kernels
         # actually allocate.
-        hist_floor=1 if hist_backend == "pallas_interpret" else _HIST_M_FLOOR,
+        hist_floor=hist_floor,
+        hist_partition=hist_backend.startswith("pallas")
+        and hist_partition_active(hist_mode, depth, hist_floor, 2, p, n_bins),
     )
     tree_chunk = auto_chunk if tree_chunk is None else min(tree_chunk, auto_chunk)
     edges = quantile_bins(x, n_bins)
@@ -878,12 +949,17 @@ def fit_forest_classifier(
     tree_keys = jax.random.split(key, n_disp * super_ * tree_chunk)
 
     def chunk_shard(i: int):
+        _meter_hist_dispatches(
+            "classifier", hist_backend, hist_mode, depth, hist_floor,
+            super_, 2, p, n_bins,
+        )
         kk = tree_keys[
             i * super_ * tree_chunk : (i + 1) * super_ * tree_chunk
         ].reshape(super_, tree_chunk)
         return _grow_chunk(
             kk, codes, yf, xb_onehot, jnp.float32(not y01),
             depth=depth, mtry=mtry, n_bins=n_bins, hist_backend=hist_backend,
+            hist_mode=hist_mode,
         )
 
     # Elastic host loop (parallel/retry.py, classified retry): a
@@ -912,10 +988,11 @@ def fit_forest_classifier(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("depth", "mtry", "n_bins", "hist_backend")
+    jax.jit,
+    static_argnames=("depth", "mtry", "n_bins", "hist_backend", "hist_mode"),
 )
 def _grow_chunk(tree_keys, codes, yf, xb_onehot, center, *, depth, mtry, n_bins,
-                hist_backend):
+                hist_backend, hist_mode="dense"):
     """One compiled dispatch of trees. ``tree_keys`` is either (tc,) —
     one vmapped chunk — or (S, tc) — a superchunk: S vmapped chunks run
     sequentially under lax.map (memory of one chunk, one dispatch).
@@ -1006,9 +1083,17 @@ def _grow_chunk(tree_keys, codes, yf, xb_onehot, center, *, depth, mtry, n_bins,
             weights2 = jnp.stack([counts, counts * yt])
             feats, bins, node_of_row = streaming_level_loop(
                 codes, depth, n_bins,
+                # Kernel mode per WIDTH (ISSUE 10): ``hist_mode`` is a
+                # jit static resolved at config time; mode_for_width is
+                # a pure function of static shapes, so this dispatch is
+                # fixed at trace time and each kernel width compiles in
+                # exactly ONE mode — the partition kernel reuses the
+                # uniform-width instantiation set instead of
+                # multiplying it.
                 hist_fn=lambda ids, m: bin_histogram(
                     codes, ids, weights2, max_nodes=m, n_bins=n_bins,
                     backend=hist_backend,
+                    mode=mode_for_width(hist_mode, m, 2, p, n_bins),
                 ),
                 tables_fn=lambda hist, level, perm: split_tables(
                     hist, level_keys[level], 1 << level, perm=perm
@@ -1346,6 +1431,7 @@ def fit_forest_sharded(
     n_bins: int = 64,
     axis_name: str = "tree",
     hist_backend: str = "auto",
+    hist_mode: str | None = None,
 ) -> Forest:
     """Tree-parallel forest fit over a mesh axis (SURVEY.md §2.4: trees
     are the expert-parallel analogue).
@@ -1382,12 +1468,16 @@ def fit_forest_sharded(
         hist_backend, allow_onehot=False, n_rows=n, n_bins=n_bins,
         integer_weights=y01,
     )
+    hist_mode = resolve_hist_mode(hist_mode)
+    hist_floor = 1 if hist_backend == "pallas_interpret" else _HIST_M_FLOOR
     axis_size = mesh.shape[axis_name]
     per_dev_total = -(-n_trees // axis_size)
     tree_chunk, chunks_per_disp, n_disp = plan_tree_dispatch(
         n, depth, per_dev_total, streaming=hist_backend.startswith("pallas"),
         p=p, n_bins=n_bins,
-        hist_floor=1 if hist_backend == "pallas_interpret" else _HIST_M_FLOOR,
+        hist_floor=hist_floor,
+        hist_partition=hist_backend.startswith("pallas")
+        and hist_partition_active(hist_mode, depth, hist_floor, 2, p, n_bins),
     )
     per_disp_dev = chunks_per_disp * tree_chunk
 
@@ -1401,11 +1491,18 @@ def fit_forest_sharded(
     grow = _sharded_grow_fn(
         mesh, axis_name, chunks_per_disp, tree_chunk,
         depth=depth, mtry=mtry, n_bins=n_bins, hist_backend=hist_backend,
+        hist_mode=hist_mode,
     )
     key_sharding = NamedSharding(mesh, P(axis_name))
     center = jnp.float32(not y01)
 
     def dispatch(i: int):
+        # Every device runs its own per-device chunks — the meter
+        # counts kernel calls across the mesh, per issued dispatch.
+        _meter_hist_dispatches(
+            "classifier", hist_backend, hist_mode, depth, hist_floor,
+            chunks_per_disp * axis_size, 2, p, n_bins,
+        )
         return grow(jax.device_put(tree_keys[i], key_sharding), codes, yf, center)
 
     parts = require_all(
@@ -1429,7 +1526,7 @@ def fit_forest_sharded(
 
 @functools.lru_cache(maxsize=64)
 def _sharded_grow_fn(mesh, axis_name, chunks_per_disp, tree_chunk, *,
-                     depth, mtry, n_bins, hist_backend):
+                     depth, mtry, n_bins, hist_backend, hist_mode="dense"):
     """The jitted shard_map grow executable, cached on (mesh, plan,
     statics). Building `jax.jit(shard_map(local_lambda))` inside
     :func:`fit_forest_sharded` gave every CALL a fresh function
@@ -1444,6 +1541,7 @@ def _sharded_grow_fn(mesh, axis_name, chunks_per_disp, tree_chunk, *,
         return _grow_chunk(
             keys.reshape(chunks_per_disp, tree_chunk), codes, yf, None, center,
             depth=depth, mtry=mtry, n_bins=n_bins, hist_backend=hist_backend,
+            hist_mode=hist_mode,
         )
 
     return jax.jit(_shard_map(
@@ -1461,6 +1559,7 @@ def sharded_fit_plan(
     hist_backend: str = "auto",
     n_bins: int = 64,
     p: int = 21,
+    hist_mode: str | None = None,
 ) -> tuple[int, int, int]:
     """The (chunk, chunks_per_disp, n_disp) plan :func:`fit_forest_sharded`
     will actually use, after backend resolution — for callers recording
@@ -1470,10 +1569,14 @@ def sharded_fit_plan(
     resolved = resolve_hist_backend(
         hist_backend, allow_onehot=False, n_rows=n_rows, n_bins=n_bins,
     )
+    mode = resolve_hist_mode(hist_mode)
+    floor = 1 if resolved == "pallas_interpret" else _HIST_M_FLOOR
     return plan_tree_dispatch(
         n_rows, depth, per_dev_total,
         streaming=resolved.startswith("pallas"), p=p, n_bins=n_bins,
-        hist_floor=1 if resolved == "pallas_interpret" else _HIST_M_FLOOR,
+        hist_floor=floor,
+        hist_partition=resolved.startswith("pallas")
+        and hist_partition_active(mode, depth, floor, 2, p, n_bins),
     )
 
 
@@ -1506,6 +1609,7 @@ def fit_forest_regressor(
     n_bins: int = 64,
     tree_chunk: int | None = None,
     hist_backend: str = "auto",
+    hist_mode: str | None = None,
 ) -> Forest:
     """Regression forest — same engine as the classifier (the split
     score is SSE-reduction, see ``level_step``), leaf values are
@@ -1521,6 +1625,7 @@ def fit_forest_regressor(
     return fit_forest_classifier(
         x, y, key, n_trees=n_trees, depth=depth, mtry=mtry,
         n_bins=n_bins, tree_chunk=tree_chunk, hist_backend=hist_backend,
+        hist_mode=hist_mode,
     )
 
 

@@ -100,6 +100,14 @@ def install_jax_monitoring() -> bool:
             ).inc(0)
     counter("scheduler_prefetch_total",
             "compile-prefetch lane outcomes by stage and status").inc(0)
+    # Histogram-kernel mode family (ISSUE 10): every streaming grow
+    # meters its per-level kernel-call plan by {mode, engine} — "the
+    # partition kernel never ran" is a recorded 0 on every instrumented
+    # run, and a dense-only flagship fit under ATE_TPU_HIST_MODE=auto
+    # is visible as such.
+    counter("hist_kernel_dispatch_total",
+            "streaming histogram kernel calls by kernel mode and engine"
+            ).inc(0)
     # Artifact-plane families (ISSUE 8): every byte an artifact moves
     # across a layout boundary is metered (parallel/shardio.py) — "no
     # artifact crossed the host" is a recorded 0, and a nonzero

@@ -29,11 +29,26 @@ Layout notes (pallas_guide.md):
 CPU tests run the same kernel with ``interpret=True`` (tests/conftest.py
 forces the CPU backend); ``backend="auto"`` picks the compiled kernel on
 TPU and the chunked-XLA fallback elsewhere.
+
+Round 10 (ISSUE 10) adds a second kernel FORMULATION orthogonal to the
+backend: the **in-kernel stable-bin partition** mode
+(:func:`_hist_kernel_batched_partition`). The dense contraction pays
+every node for every row (useful-FLOP fraction ~1/2^d at depth d); the
+partition mode regroups each tile's rows by node id in VMEM (stable —
+row order preserved within a node, which preserves f32 accumulation
+order) and contracts node-pure 8-row blocks, making FLOPs proportional
+to rows with a depth-independent useful fraction. The per-width choice
+is the ``ATE_TPU_HIST_MODE`` policy (:func:`resolve_hist_mode` /
+:func:`mode_for_width`): dense below the modeled crossover (width 32
+for the K=2 classifier, 16 for the K=5 causal engine), partition past
+it. ``bench.py --hist-ab`` regenerates the committed per-level
+A/B + FLOP-model record (HIST_AB.json).
 """
 
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -57,9 +72,10 @@ def _round_up(x: int, m: int) -> int:
 
 
 # Row count above which the streaming Pallas kernel beats the XLA
-# contraction on TPU. Re-measured after the TREE-BATCHED kernel landed
-# (round-3 second pass; within-ONE-window, `bench.py --hist-ab`: whole
-# classifier-tree ms/tree, p=21, 64 bins, depth 9):
+# contraction on TPU. Measured round-3 second pass (within-ONE-window,
+# `bench.py --hist-ab`: whole classifier-tree ms/tree, p=21, 64 bins,
+# depth 9, TPU v5e — the DENSE kernel mode; partition-mode TPU
+# wall-clock is still TPU-blocked, see below):
 #
 #   rows    9k   15k   30k   60k   100k   200k    1M
 #   xla     5.3  4.9   6.1   8.4   23.3   64.1   ~800 (pre-batching)
@@ -76,6 +92,22 @@ def _round_up(x: int, m: int) -> int:
 # gathered). The XLA path's scatter-built bin
 # one-hot still degrades superlinearly with rows, so the kernel's edge
 # grows with n (2.3× at 100k, 3.4× at 200k, ~10× at 1M).
+#
+# Round 10 (`bench.py --hist-ab`, regenerated — HIST_AB.json is the
+# committed record): the harness now also A/Bs the KERNEL MODE per
+# level with the analytic FLOP model. At the K=2 classifier shape
+# (p=21, 64 bins) the modeled dense:partition total-FLOP ratio by
+# kernel width is
+#
+#   width     1     2     4     8    16    32    64   128
+#   ratio  0.05  0.11  0.21  0.42  0.81  1.54  2.77  4.61
+#
+# — the auto crossover (partition_crossover_width) sits at 32 for K=2
+# and 16 for the K=5 causal engine; dense's useful-FLOP fraction decays
+# like 1/2^d while partition's is depth-independent. On this CPU image
+# the mode wall-times are interpreter-dominated (the record says so in
+# its `backend` field); the MXU wall-clock consequence is TPU-blocked
+# and belongs to the next hardware round.
 _PALLAS_ROWS_THRESHOLD = 8_192
 
 
@@ -141,22 +173,23 @@ def resolve_hist_backend(
     return backend
 
 
-def _build_bin_oh(codes_ref, bw, f_pb, n_bins, in_dtype):
-    """Tile-local bin one-hot, (TILE, bw·LANES): one 128-lane block per
-    ``f_pb`` features, concatenated along lanes. Each feature is
-    compared only against its own block's 128 lanes — ~10× less VPU
-    compare work at the GGL shape than full-width compares. The kernel
-    wrappers pre-offset the codes (code + (f mod f_pb)·n_bins, one
-    fused XLA add per kernel call) so the per-step work is exactly one
-    compare + accumulate per feature. Shared by both kernels (they must stay
-    bit-identical; tests assert it)."""
-    tile = codes_ref.shape[1]
-    lane_iota = lax.broadcasted_iota(jnp.int32, (tile, _LANES), 1)
+def _build_bin_oh(codes, bw, f_pb, n_bins, in_dtype):
+    """Tile-local bin one-hot, (rows, bw·LANES) from a (rows, bw·f_pb)
+    code array: one 128-lane block per ``f_pb`` features, concatenated
+    along lanes. Each feature is compared only against its own block's
+    128 lanes — ~10× less VPU compare work at the GGL shape than
+    full-width compares. The kernel wrappers pre-offset the codes
+    (code + (f mod f_pb)·n_bins, one fused XLA add per kernel call) so
+    the per-step work is exactly one compare + accumulate per feature.
+    Shared by every kernel (dense and partition — they must stay
+    bit-identical per row; tests assert it)."""
+    rows = codes.shape[0]
+    lane_iota = lax.broadcasted_iota(jnp.int32, (rows, _LANES), 1)
     pieces = []
     for g in range(bw):
-        oh_g = jnp.zeros((tile, _LANES), in_dtype)
+        oh_g = jnp.zeros((rows, _LANES), in_dtype)
         for f in range(f_pb):  # static unroll — f_pb = LANES // n_bins
-            flat = codes_ref[0, :, g * f_pb + f : g * f_pb + f + 1]
+            flat = codes[:, g * f_pb + f : g * f_pb + f + 1]
             oh_g = oh_g + (lane_iota == flat).astype(in_dtype)
         pieces.append(oh_g)
     return pieces[0] if bw == 1 else jnp.concatenate(pieces, axis=1)
@@ -183,7 +216,7 @@ def _hist_kernel(codes_ref, node_ref, w_ref, out_ref, *, n_weights, max_nodes,
     def _zero():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    bin_oh = _build_bin_oh(codes_ref, bw, f_pb, n_bins, in_dtype)
+    bin_oh = _build_bin_oh(codes_ref[0], bw, f_pb, n_bins, in_dtype)
 
     # Node one-hot: (TILE, max_nodes). Padded rows carry node=-1 → all 0,
     # which also kills the padded rows' garbage bin one-hot.
@@ -246,7 +279,7 @@ def _hist_kernel_batched(codes_ref, node_ref, w_ref, out_ref, *, n_weights,
         out_ref[:] = jnp.zeros_like(out_ref)
 
     tile = codes_ref.shape[1]
-    bin_oh = _build_bin_oh(codes_ref, bw, f_pb, n_bins, in_dtype)
+    bin_oh = _build_bin_oh(codes_ref[0], bw, f_pb, n_bins, in_dtype)
 
     # TRANSPOSED lhs build: the weighted node one-hots live (nodes, TILE)
     # — rows on the LANE axis — so each tree's node-id strip and each
@@ -256,24 +289,175 @@ def _hist_kernel_batched(codes_ref, node_ref, w_ref, out_ref, *, n_weights,
     # measured as the dominant dtype-insensitive kernel cost at 1M rows).
     # The dot contracts lhsᵀ's lane axis against bin_oh's sublane axis —
     # the natural A·B MXU form.
+    #
+    # ONE DOT PER TREE (PR 10): the pre-PR-10 kernel concatenated
+    # every tree into a single (T·K·M, TILE) lhs, so the dot's shape —
+    # and with it the f32 reduction association XLA/Eigen picks on the
+    # interpret (CPU) backend — depended on the BATCH SIZE T. That made
+    # "vmap collapse is bit-identical to per-slice calls" false at ulp
+    # level for float weight stacks (the known-red
+    # test_shared_custom_vmap_collapses). Per-tree (K·M, TILE) dots make
+    # every tree's numbers independent of which batch/chunk it rides in:
+    # identical inputs through an identical dot shape, whatever T is.
+    # Same total MXU work; the MXU's fixed-order accumulation makes the
+    # two layouts bit-equal on hardware anyway.
     node_iota_t = lax.broadcasted_iota(jnp.int32, (max_nodes, tile), 0)
-    lhs_parts = []
+    km = n_weights * max_nodes
     for t in range(n_trees):  # static unroll — T is a chunk-sized constant
         node_row = node_ref[t : t + 1, :]                       # (1, TILE)
         node_oh_t = (node_row == node_iota_t).astype(in_dtype)  # (M, TILE)
+        lhs_parts = []
         for k in range(n_weights):
             w_base = k if shared_weights else t * n_weights + k
             w_row = w_ref[w_base : w_base + 1, :]
             lhs_parts.append(node_oh_t * w_row.astype(in_dtype))
-    lhs_t = (
-        lhs_parts[0] if len(lhs_parts) == 1 else jnp.concatenate(lhs_parts, axis=0)
-    )  # (T·K·max_nodes, TILE)
-    out_ref[0] += lax.dot_general(
-        lhs_t,
-        bin_oh,
-        dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
+        lhs_t = (
+            lhs_parts[0] if len(lhs_parts) == 1
+            else jnp.concatenate(lhs_parts, axis=0)
+        )  # (K·max_nodes, TILE)
+        out_ref[0, t * km : (t + 1) * km, :] += lax.dot_general(
+            lhs_t,
+            bin_oh,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+
+# Row-block granularity of the partition kernel's per-node contraction:
+# after the stable in-tile partition, every B-row block is node-PURE, so
+# one (K, B) @ (B, lanes) dot per block lands on exactly one node's
+# output rows. 8 = one f32 sublane group.
+_PART_BLOCK = 8
+
+
+def _hist_kernel_batched_partition(codes_ref, node_ref, w_ref, out_ref, *,
+                                   n_weights, n_trees, max_nodes, bw, f_pb,
+                                   n_bins, in_dtype, shared_weights=False):
+    """Partition-mode grid step (ISSUE 10): same contract and
+    layouts as :func:`_hist_kernel_batched`, different FLOP structure.
+
+    The dense kernel's MXU contraction multiplies every row against the
+    one-hot of EVERY node, so at a level with M live nodes only 1/M of
+    its FLOPs touch a (row, its-own-node) pair — the useful fraction
+    decays like 1/2^d with depth. This kernel instead STABLY partitions
+    each row tile by node id in-kernel and then contracts each node's
+    rows once:
+
+      1. per-node counts over the (TILE,) node stream → block-aligned
+         region offsets (cumulative counts; regions padded to
+         ``_PART_BLOCK`` rows, dropped rows — id −1 / out of range — go
+         to a trailing trash region);
+      2. every row's destination = its region offset + its stable rank
+         (count of EARLIER tile rows with the same id — the partition
+         preserves row order within a node, which is what preserves the
+         f32 accumulation order of each cell);
+      3. rows regroup in VMEM through a one-hot permutation matmul (the
+         repo's standard gather-free idiom — per-row gathers serialize
+         on TPU): codes and weights permute EXACTLY (each output row
+         has one unit product; codes < 2^13 are exact in f32);
+      4. the bin one-hot is built ONCE from the partitioned codes —
+         the shared codes stream never re-gathers from HBM — and a
+         ``fori_loop`` over node-pure B-row blocks runs one small
+         (K, B) @ (B, lanes) dot per block, accumulated into that
+         block's node rows.
+
+    FLOPs are proportional to ROWS (permutation matmuls: TILE·TP·(C+K);
+    block dots: TP·K·lanes), with NO M factor in any matmul — the
+    useful-FLOP fraction is depth-independent (see
+    :func:`hist_level_flops`).
+
+    Bit-identity vs dense mode: per cell both modes sum the same member
+    products in the same row order. On the MXU (fixed sequential-in-K
+    accumulation) that makes the two modes bit-identical — asserted by
+    the compiled ``@pytest.mark.tpu`` A/B variants. On the CPU interpret
+    backend XLA/Eigen folds a long gemm's K axis in 256-wide panels
+    (measured, PR 10), so float-weight cells can differ at ulp level
+    between the panel fold and the per-block fold; INTEGER-valued weight
+    stacks (the classifier engine's counts / counts·y, every f32 sum
+    exact below 2^24) are bit-identical in any association and the
+    tier-1 A/B matrix asserts them with ``array_equal``.
+    """
+    @pl.when(pl.program_id(1) == 0)
+    def _zero():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    tile = codes_ref.shape[1]
+    b = _PART_BLOCK
+    m1 = max_nodes + 1                       # + trailing trash region
+    tp = tile + m1 * b                       # partition buffer rows
+    nb = tp // b
+    lanes = bw * _LANES
+    km = n_weights * max_nodes
+    codes_f = codes_ref[0].astype(jnp.float32)          # (TILE, C)
+
+    sub_iota = lax.broadcasted_iota(jnp.int32, (m1, tile), 0)
+    tp_iota = lax.broadcasted_iota(jnp.int32, (tp, tile), 0)
+    blk_start = lax.broadcasted_iota(jnp.int32, (nb, m1), 0) * b
+
+    for t in range(n_trees):  # static unroll — T is a chunk-sized constant
+        node_row = node_ref[t : t + 1, :]                # (1, TILE)
+        in_range = (node_row >= 0) & (node_row < max_nodes)
+        node_x = jnp.where(in_range, node_row, max_nodes)
+        ohx = (node_x == sub_iota).astype(jnp.int32)     # (M+1, TILE)
+        cnt = jnp.sum(ohx, axis=1, keepdims=True)        # (M+1, 1)
+        reg = -(-cnt // b) * b                           # block-aligned sizes
+        end = jnp.cumsum(reg, axis=0)                    # inclusive ends
+        off = end - reg                                  # exclusive starts
+        csum = jnp.cumsum(ohx, axis=1)                   # stable ranks + 1
+        rank = jnp.sum(ohx * csum, axis=0, keepdims=True) - 1
+        base = jnp.sum(ohx * off, axis=0, keepdims=True)
+        dst = base + rank                                # (1, TILE) in [0, TP)
+        # Gather-free regroup: one-hot permutation matmuls (exact —
+        # every output row receives exactly one unit product).
+        perm = (tp_iota == dst).astype(jnp.float32)      # (TP, TILE)
+        codes_part = lax.dot_general(
+            perm, codes_f,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(jnp.int32)                              # (TP, C)
+        if shared_weights:
+            w_rows = w_ref[...]                          # (K, TILE)
+        else:
+            w_rows = w_ref[t * n_weights : (t + 1) * n_weights, :]
+        w_part = lax.dot_general(
+            w_rows.astype(jnp.float32), perm,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(in_dtype)                               # (K, TP)
+        # ONE shared bin one-hot per tile from the partitioned codes —
+        # pad/trash rows decode to lane 0 of block 0, killed by their
+        # exactly-zero permuted weights.
+        bin_oh_part = _build_bin_oh(codes_part, bw, f_pb, n_bins, in_dtype)
+        # Block → node map: block start past region m's end ⇒ a later
+        # region. Trash blocks get M, slack blocks M+1 — both masked.
+        blk_node = jnp.sum(
+            (blk_start >= end.reshape(1, m1)).astype(jnp.int32), axis=1
+        )                                                # (nb,)
+        blk_ok = (blk_node < max_nodes).astype(jnp.float32)
+        blk_safe = jnp.where(blk_node < max_nodes, blk_node, 0)
+
+        def body(i, acc):
+            wb = lax.dynamic_slice(w_part, (0, i * b), (n_weights, b))
+            ob = lax.dynamic_slice(bin_oh_part, (i * b, 0), (b, lanes))
+            pb = lax.dot_general(
+                wb, ob,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                            # (K, lanes)
+            # Invalid (trash/slack) blocks add an exact ±0 to node 0 —
+            # the f32 identity everywhere a real sum exists.
+            pb = pb * lax.dynamic_index_in_dim(blk_ok, i, keepdims=False)
+            row = lax.dynamic_index_in_dim(blk_safe, i, keepdims=False)
+            for k in range(n_weights):
+                at = (k * max_nodes + row, 0)
+                cur = lax.dynamic_slice(acc, at, (1, lanes))
+                acc = lax.dynamic_update_slice(acc, cur + pb[k : k + 1], at)
+            return acc
+
+        acc = lax.fori_loop(
+            0, nb, body, jnp.zeros((km, lanes), jnp.float32)
+        )
+        out_ref[0, t * km : (t + 1) * km, :] += acc
 
 
 _VMEM_BUDGET = 100 * 1024 * 1024  # raise Mosaic's 16 MB scoped default
@@ -421,7 +605,8 @@ def _batched_unlayout(out, n_trees, k_w, max_nodes, p_groups, bw, f_pb,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("max_nodes", "n_bins", "tile", "bw", "interpret", "bf16"),
+    static_argnames=("max_nodes", "n_bins", "tile", "bw", "interpret", "bf16",
+                     "partition"),
 )
 def bin_histogram_pallas_batched(
     codes: jax.Array,
@@ -434,6 +619,7 @@ def bin_histogram_pallas_batched(
     bw: int | None = None,
     interpret: bool = False,
     bf16: bool = False,
+    partition: bool = False,
 ) -> jax.Array:
     """Tree-batched histograms: T trees sharing one ``codes`` stream.
 
@@ -442,6 +628,14 @@ def bin_histogram_pallas_batched(
       node_of_row: (T, n) int32 per-tree node ids; ids outside
         [0, max_nodes) contribute nothing.
       weights: (T, K, n) f32 per-tree weight vectors.
+      partition: run the in-kernel stable-bin-partition formulation
+        (:func:`_hist_kernel_batched_partition`) instead of the dense
+        every-node-per-row contraction. Same contract; FLOPs ∝ rows
+        instead of rows × nodes. Bit-identical to dense for
+        integer-valued weight stacks everywhere and for all stacks on
+        the MXU's fixed accumulation order; ulp-level on the CPU
+        interpret backend for float stacks (gemm panel fold — see the
+        kernel docstring).
 
     Returns:
       (T, K, max_nodes, p, n_bins) f32 — bit-identical to T separate
@@ -473,10 +667,13 @@ def bin_histogram_pallas_batched(
         ((0, 0), (0, n_pad - n)),
     )
 
+    kernel_body = (
+        _hist_kernel_batched_partition if partition else _hist_kernel_batched
+    )
     grid = (p_groups, n_pad // tile)
     out = pl.pallas_call(
         functools.partial(
-            _hist_kernel_batched, n_weights=k_w, n_trees=n_trees,
+            kernel_body, n_weights=k_w, n_trees=n_trees,
             max_nodes=max_nodes, bw=bw, f_pb=f_pb, n_bins=n_bins,
             in_dtype=jnp.bfloat16 if bf16 else jnp.float32,
         ),
@@ -502,7 +699,8 @@ def bin_histogram_pallas_batched(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("max_nodes", "n_bins", "tile", "bw", "interpret", "bf16"),
+    static_argnames=("max_nodes", "n_bins", "tile", "bw", "interpret", "bf16",
+                     "partition"),
 )
 def bin_histogram_pallas_batched_shared(
     codes: jax.Array,
@@ -515,6 +713,7 @@ def bin_histogram_pallas_batched_shared(
     bw: int | None = None,
     interpret: bool = False,
     bf16: bool = False,
+    partition: bool = False,
 ) -> jax.Array:
     """:func:`bin_histogram_pallas_batched` with ONE weight stack
     shared by every tree: ``weights`` is (K, n), not (T, K, n).
@@ -527,6 +726,15 @@ def bin_histogram_pallas_batched_shared(
     in the id stream (-1 drops a row), so the five ρ channels are the
     raw per-row moment stack, invariant across trees
     (models/causal_forest.py::grow_one_streaming).
+
+    ``partition=True`` note: a non-member row is a MASKED ID here but a
+    zero WEIGHT in the per-tree layout, so the two layouts partition a
+    tile differently (masked ids go to the trash region; zero-weight
+    rows stay inside their node's region). The shared-vs-per-tree
+    bit-identity therefore holds unconditionally for integer-valued
+    stacks (exact sums) and on the MXU's ordered accumulation, but is
+    ulp-level on the CPU interpret backend for float stacks — the same
+    split as the dense-vs-partition contract.
     """
     n, p = codes.shape
     n_trees = node_of_row.shape[0]
@@ -542,10 +750,13 @@ def bin_histogram_pallas_batched_shared(
     )
     w_kn = jnp.pad(weights.astype(jnp.float32), ((0, 0), (0, n_pad - n)))
 
+    kernel_body = (
+        _hist_kernel_batched_partition if partition else _hist_kernel_batched
+    )
     grid = (p_groups, n_pad // tile)
     out = pl.pallas_call(
         functools.partial(
-            _hist_kernel_batched, n_weights=k_w, n_trees=n_trees,
+            kernel_body, n_weights=k_w, n_trees=n_trees,
             max_nodes=max_nodes, bw=bw, f_pb=f_pb, n_bins=n_bins,
             in_dtype=jnp.bfloat16 if bf16 else jnp.float32,
             shared_weights=True,
@@ -579,7 +790,8 @@ def kernel_lanes(p: int, n_bins: int) -> int:
 
 
 def batched_tree_cap(max_nodes: int, n_weights: int, tile: int = 2048,
-                     p: int = 21, n_bins: int = 64) -> int:
+                     p: int = 21, n_bins: int = 64,
+                     partition: bool = False) -> int:
     """Largest tree batch T whose kernel working set fits the scoped-VMEM
     budget: out block (T·K·M, lanes) f32 + lhs (tile, T·K·M) f32 + bin
     one-hot and codes temps. ``p`` and ``n_bins`` size the lane axis —
@@ -593,16 +805,146 @@ def batched_tree_cap(max_nodes: int, n_weights: int, tile: int = 2048,
     fits. The same A/B measured the deep-level MARGINAL cost flat in T
     (~4.7 ms/tree) while the ~4.7 ms per-call fixed work (bin one-hot
     build + codes DMA + grid overhead, level-invariant) divides by T —
-    a bigger batch is pure fixed-cost amortization."""
+    a bigger batch is pure fixed-cost amortization. The 0.9 factor is
+    LOAD-BEARING at the flagship scale (round-5 close measured 1.15
+    OOMing the chip's HBM via the bigger chunks' (T, n) streams) —
+    partition mode keeps it and instead enlarges the FIXED term.
+
+    ``partition=True`` accounts the partition kernel's per-tree
+    sequential transients (ISSUE 10): the (TP, TILE) permutation
+    one-hot, the partitioned (TP, lanes) bin one-hot and (K, TP)
+    weights, where TP = TILE + (M+1)·8. These do NOT scale with T
+    (trees unroll sequentially and Mosaic reuses the buffers) so they
+    join the fixed term — the cap shrinks, the budget factor stays."""
     lanes = kernel_lanes(p, n_bins)
     per_tree = 4 * n_weights * max_nodes * (lanes + tile)
     fixed = 2 * 4 * tile * lanes
+    if partition:
+        tp = tile + (max_nodes + 1) * _PART_BLOCK
+        fixed += 4 * (tp * tile + tp * lanes + n_weights * tp)
     return max(1, (int(_VMEM_BUDGET * 0.9) - fixed) // max(per_tree, 1))
+
+
+# ---------------------------------------------------------------------------
+# Kernel-mode policy (ISSUE 10): dense vs in-kernel stable-bin partition.
+#
+# The policy is split exactly like the backend policy PR 2 fixed twice
+# (JGL001/JGL003): resolve_hist_mode reads the ENVIRONMENT on the host in
+# un-jitted config code and returns a concrete policy string; the pure
+# functions below (mode_for_width / the FLOP model) run at trace time on
+# STATIC shapes only — no ambient state ever reaches a traced body.
+# ---------------------------------------------------------------------------
+
+_HIST_MODE_ENV = "ATE_TPU_HIST_MODE"
+HIST_MODES = ("dense", "partition", "auto")
+
+
+def resolve_hist_mode(mode: str | None = None) -> str:
+    """The single CONFIG-TIME entry for the kernel-mode policy.
+
+    ``mode`` (a fitter's ``hist_mode=`` argument) wins when given;
+    otherwise ``ATE_TPU_HIST_MODE`` (case-insensitive), defaulting to
+    "auto" — dense below :func:`partition_crossover_width`, partition at
+    and past it. A bad value raises HERE, at config time, never at
+    trace time. Deliberately un-jitted (graftlint JGL001): the result is
+    passed into the growers as a jit STATIC, so a cached trace can never
+    serve a mode chosen under a different environment."""
+    raw = mode if mode is not None else os.environ.get(_HIST_MODE_ENV, "auto")
+    val = str(raw).strip().lower()
+    if val not in HIST_MODES:
+        raise ValueError(
+            f"{_HIST_MODE_ENV}/hist_mode must be one of {HIST_MODES} "
+            f"(case-insensitive), got {raw!r}"
+        )
+    return val
+
+
+def hist_level_flops(mode: str, n_rows: int, max_nodes: int, n_weights: int,
+                     p: int = 21, n_bins: int = 64, tile: int = 2048) -> dict:
+    """Analytic MXU-FLOP model of ONE tree's level histogram (the
+    ``bench.py --hist-ab`` record's per-level fields; also what the
+    auto-mode crossover is derived from).
+
+    Counts matmul FLOPs only (2 per MAC), mirroring the kernels' real
+    layouts (:func:`_batched_layout`): padded rows, feature-blocked
+    lanes ``L = ceil(p/f_pb)·128``, code columns ``C = ceil(p/f_pb)·f_pb``.
+
+    ``useful`` is mode-INDEPENDENT by construction — the FLOPs that had
+    to happen: every real row × its own node × the live (p·n_bins)
+    cells × K channels. Dense total is ``rows_pad·K·M·L`` (every node
+    pays every row → useful fraction ~1/M, decaying like 1/2^d with
+    depth); partition total is the permutation matmuls + the node-pure
+    block dots, ``rows_pad·(TP/tile)·(C + K) + TP_rows·K·L`` — NO M
+    factor in any term, so its useful fraction is depth-independent
+    (asserted in tests and schema-validated in the bench record)."""
+    if mode not in ("dense", "partition"):
+        raise ValueError(f"flop model mode must be dense|partition, got {mode!r}")
+    f_pb = max(1, _LANES // n_bins)
+    p_blocks = -(-p // f_pb)
+    lanes = p_blocks * _LANES
+    c_cols = p_blocks * f_pb
+    n_tiles = max(1, -(-n_rows // tile))
+    rows_pad = n_tiles * tile
+    useful = 2.0 * n_rows * n_weights * p * n_bins
+    if mode == "dense":
+        total = 2.0 * rows_pad * n_weights * max_nodes * lanes
+    else:
+        tp = tile + (max_nodes + 1) * _PART_BLOCK
+        per_tile = (
+            tp * tile * c_cols          # codes permutation matmul
+            + n_weights * tile * tp     # weight permutation matmul
+            + tp * n_weights * lanes    # node-pure block dots
+        )
+        total = 2.0 * n_tiles * per_tile
+    # Deliberately UNclamped: useful ≤ total is a property of a correct
+    # model, and validate_hist_ab_record exists to catch a broken one —
+    # a max() here would hide exactly the bug the validator checks for.
+    return {"useful": useful, "total": total}
+
+
+@functools.lru_cache(maxsize=None)
+def partition_crossover_width(n_weights: int, p: int = 21, n_bins: int = 64,
+                              tile: int = 2048) -> int:
+    """Smallest kernel width (padded node count, a power of two ≤ 128)
+    at which the partition kernel's modeled total FLOPs beat dense's —
+    the auto-mode depth crossover. Pure function of static shapes;
+    unit-tested with known answers in tests/test_hist_pallas.py. Returns
+    256 (an unreachable width) when dense wins everywhere ≤ 128."""
+    for width in (1, 2, 4, 8, 16, 32, 64, 128):
+        dense = hist_level_flops("dense", tile, width, n_weights, p, n_bins,
+                                 tile)
+        part = hist_level_flops("partition", tile, width, n_weights, p,
+                                n_bins, tile)
+        if part["total"] < dense["total"]:
+            return width
+    return 256
+
+
+def mode_for_width(mode: str, width: int, n_weights: int, p: int = 21,
+                   n_bins: int = 64) -> str:
+    """Resolve a config-time policy ("dense" | "partition" | "auto") to
+    the concrete kernel mode for ONE kernel width. Pure — callable at
+    trace time on jit statics.
+
+    The decision is keyed on the KERNEL width (the padded node count the
+    kernel actually allocates), not the grow level: the uniform-width
+    floors map several shallow levels onto one width, and deciding per
+    width means each width compiles in exactly ONE mode — the partition
+    kernel reuses the existing instantiation set instead of multiplying
+    it (executable count is a first-class cost, NEXT.md hardware
+    lessons)."""
+    if mode in ("dense", "partition"):
+        return mode
+    if mode != "auto":
+        raise ValueError(f"unknown histogram mode {mode!r}")
+    if width >= partition_crossover_width(n_weights, p, n_bins):
+        return "partition"
+    return "dense"
 
 
 @functools.lru_cache(maxsize=None)
 def _pallas_batched_vmappable(max_nodes: int, n_bins: int, bf16: bool,
-                              interpret: bool):
+                              interpret: bool, partition: bool = False):
     """The tree-batched kernel as a `custom_vmap` callable.
 
     The forest growers call :func:`bin_histogram` per tree under
@@ -625,13 +967,14 @@ def _pallas_batched_vmappable(max_nodes: int, n_bins: int, bf16: bool,
     def impl(codes, node, weights):
         t = node.shape[0]
         cap = batched_tree_cap(
-            max_nodes, weights.shape[1], p=codes.shape[1], n_bins=n_bins
+            max_nodes, weights.shape[1], p=codes.shape[1], n_bins=n_bins,
+            partition=partition,
         )
         outs = [
             bin_histogram_pallas_batched(
                 codes, node[s : s + cap], weights[s : s + cap],
                 max_nodes=max_nodes, n_bins=n_bins, bf16=bf16,
-                interpret=interpret,
+                interpret=interpret, partition=partition,
             )
             for s in range(0, t, cap)
         ]
@@ -671,7 +1014,8 @@ def _pallas_batched_vmappable(max_nodes: int, n_bins: int, bf16: bool,
 
 @functools.lru_cache(maxsize=None)
 def _pallas_batched_shared_vmappable(max_nodes: int, n_bins: int, bf16: bool,
-                                     interpret: bool):
+                                     interpret: bool,
+                                     partition: bool = False):
     """The shared-weights tree-batched kernel as a `custom_vmap`
     callable: g(codes (n, p), node (T, n), weights (K, n)).
 
@@ -688,13 +1032,14 @@ def _pallas_batched_shared_vmappable(max_nodes: int, n_bins: int, bf16: bool,
     def impl(codes, node, weights):
         t = node.shape[0]
         cap = batched_tree_cap(
-            max_nodes, weights.shape[0], p=codes.shape[1], n_bins=n_bins
+            max_nodes, weights.shape[0], p=codes.shape[1], n_bins=n_bins,
+            partition=partition,
         )
         outs = [
             bin_histogram_pallas_batched_shared(
                 codes, node[s : s + cap], weights,
                 max_nodes=max_nodes, n_bins=n_bins, bf16=bf16,
-                interpret=interpret,
+                interpret=interpret, partition=partition,
             )
             for s in range(0, t, cap)
         ]
@@ -731,6 +1076,24 @@ def _pallas_batched_shared_vmappable(max_nodes: int, n_bins: int, bf16: bool,
     return g
 
 
+def _check_mode(mode: str, backend: str) -> bool:
+    """Validate a RESOLVED kernel mode against a RESOLVED backend and
+    return whether the partition kernels should run. 'auto' is not
+    accepted here — callers resolve it per kernel width with
+    :func:`mode_for_width` at config/trace time (a dispatcher seeing
+    'auto' means a caller skipped the heuristic)."""
+    if mode not in ("dense", "partition"):
+        raise ValueError(
+            f"histogram kernel mode must be 'dense' or 'partition' at "
+            f"dispatch (resolve 'auto' via mode_for_width), got {mode!r}"
+        )
+    if mode == "partition" and not backend.startswith("pallas"):
+        raise ValueError(
+            f"mode='partition' requires a pallas backend, got {backend!r}"
+        )
+    return mode == "partition"
+
+
 def bin_histogram_shared(
     codes: jax.Array,
     node_of_row: jax.Array,
@@ -739,6 +1102,7 @@ def bin_histogram_shared(
     max_nodes: int,
     n_bins: int,
     backend: str = "auto",
+    mode: str = "dense",
 ) -> jax.Array:
     """:func:`bin_histogram` whose weight stack is SHARED across any
     vmapped tree axes: node_of_row (n,) per tree, weights (K, n) common.
@@ -753,10 +1117,11 @@ def bin_histogram_shared(
     backend = resolve_hist_backend(
         backend, allow_onehot=False, n_rows=codes.shape[0], n_bins=n_bins
     )
+    partition = _check_mode(mode, backend)
     if backend in ("pallas", "pallas_bf16", "pallas_interpret"):
         g = _pallas_batched_shared_vmappable(
             max_nodes, n_bins, backend == "pallas_bf16",
-            backend == "pallas_interpret",
+            backend == "pallas_interpret", partition,
         )
         return g(codes, node_of_row[None], weights)[0]
     if backend == "xla":
@@ -774,6 +1139,7 @@ def bin_histogram_batched(
     max_nodes: int,
     n_bins: int,
     backend: str = "auto",
+    mode: str = "dense",
 ) -> jax.Array:
     """Tree-batched dispatch with the same contract as :func:`bin_histogram`
     lifted over a leading tree axis: node_of_row (T, n), weights
@@ -781,10 +1147,11 @@ def bin_histogram_batched(
     backend = resolve_hist_backend(
         backend, allow_onehot=False, n_rows=codes.shape[0], n_bins=n_bins
     )
+    partition = _check_mode(mode, backend)
     if backend in ("pallas", "pallas_bf16", "pallas_interpret"):
         g = _pallas_batched_vmappable(
             max_nodes, n_bins, backend == "pallas_bf16",
-            backend == "pallas_interpret",
+            backend == "pallas_interpret", partition,
         )
         return g(codes, node_of_row, weights)
     if backend == "xla":
@@ -906,6 +1273,7 @@ def bin_histogram(
     max_nodes: int,
     n_bins: int,
     backend: str = "auto",
+    mode: str = "dense",
 ) -> jax.Array:
     """Dispatch: compiled Pallas kernel on TPU, chunked XLA elsewhere.
 
@@ -914,8 +1282,14 @@ def bin_histogram(
     accumulation) — bit-exact only for integer-valued weights (see
     :func:`bin_histogram_pallas`); callers opt in per forest via their
     ``hist_backend`` argument.
+
+    ``mode``: "dense" | "partition" — the kernel FORMULATION (ISSUE 10;
+    pallas backends only). The growers resolve their per-level choice
+    with :func:`mode_for_width` from the config-time
+    :func:`resolve_hist_mode` policy.
     """
     backend = resolve_hist_backend(backend, allow_onehot=False)
+    partition = _check_mode(mode, backend)
     if backend in ("pallas", "pallas_bf16", "pallas_interpret"):
         # Through the custom_vmap wrapper: callers vmap this per tree
         # (nested vmaps in the causal grower), and the rule collapses
@@ -923,7 +1297,7 @@ def bin_histogram(
         # kernel call per grow level instead of a per-tree grid sweep.
         g = _pallas_batched_vmappable(
             max_nodes, n_bins, backend == "pallas_bf16",
-            backend == "pallas_interpret",
+            backend == "pallas_interpret", partition,
         )
         return g(codes, node_of_row[None], weights[None])[0]
     if backend == "xla":

@@ -6,6 +6,8 @@ Usage::
     python scripts/check_metrics_schema.py results/
     python scripts/check_metrics_schema.py metrics.json events.jsonl \
         [--require-stages "naive,oracle,..."]
+    python scripts/check_metrics_schema.py MESH_SCALING.json   # ISSUE 8
+    python scripts/check_metrics_schema.py HIST_AB.json        # ISSUE 10
 
 Checks ``metrics.json`` (schema version, section shapes, the counter
 families every instrumented run must carry — shard retry, compile
@@ -55,6 +57,10 @@ REQUIRED_COUNTERS = (
     "compile_cache_misses_total",
     "nuisance_cache_requests_total",
     "scheduler_prefetch_total",
+    # Histogram-kernel mode family (ISSUE 10): the streaming growers'
+    # per-level kernel-call plan by {mode, engine} — "partition mode
+    # never ran" is a recorded 0.
+    "hist_kernel_dispatch_total",
     # Artifact-plane families (ISSUE 8): every byte a nuisance artifact
     # moves across a layout boundary is metered — "nothing crossed the
     # host" is a recorded 0 on every instrumented run.
@@ -528,6 +534,112 @@ def validate_mesh_scaling(record: dict) -> list[str]:
     return errors
 
 
+def validate_hist_ab_record(record: dict, tol: float = 1e-9) -> list[str]:
+    """Internal-consistency checks on the ``bench.py --hist-ab``
+    dense-vs-partition record (ISSUE 10). The per-level FLOP model is
+    the record's transferable claim — a hand-edited or internally
+    inconsistent record must FAIL here:
+
+    * every level carries width, both mode timings (non-negative) and
+      both FLOP models with ``useful ≤ total``;
+    * ``useful`` is mode-INDEPENDENT: partition useful == dense useful
+      per level (the FLOPs that had to happen do not depend on the
+      kernel formulation);
+    * the dense total is exactly proportional to the kernel width
+      (every node pays every row → its useful fraction decays ~1/2^d);
+    * the partition useful-FLOP fraction is depth-independent: its
+      min/max ratio across levels stays within 2× while dense's spans
+      the width range (the acceptance curve of the partition kernel).
+    """
+    errors: list[str] = []
+    levels = record.get("levels")
+    if not isinstance(levels, list) or not levels:
+        return ["hist_ab: missing/empty levels section"]
+    if not isinstance(record.get("crossover_width"), int):
+        errors.append("hist_ab: missing integer crossover_width")
+    widths, dense_fracs, part_fracs, dense_totals = [], [], [], []
+    for i, lv in enumerate(levels):
+        if not isinstance(lv, dict):
+            errors.append(f"hist_ab: level {i} not a mapping")
+            continue
+        missing = {"width", "dense_ms", "partition_ms", "dense_flops",
+                   "partition_flops", "mode_auto"} - set(lv)
+        if missing:
+            errors.append(f"hist_ab: level {i} lacks {sorted(missing)}")
+            continue
+        w = lv["width"]
+        if not isinstance(w, int) or w < 1:
+            errors.append(f"hist_ab: level {i} bad width {w!r}")
+            continue
+        for key in ("dense_ms", "partition_ms"):
+            v = lv[key]
+            if not isinstance(v, (int, float)) or v < 0:
+                errors.append(f"hist_ab: level {i} {key} invalid: {v!r}")
+        models = {}
+        for key in ("dense_flops", "partition_flops"):
+            fm = lv[key]
+            if not (isinstance(fm, dict)
+                    and isinstance(fm.get("useful"), (int, float))
+                    and isinstance(fm.get("total"), (int, float))):
+                errors.append(f"hist_ab: level {i} {key} malformed")
+                continue
+            if fm["useful"] < 0 or fm["total"] <= 0:
+                errors.append(f"hist_ab: level {i} {key} non-positive")
+                continue
+            if fm["useful"] > fm["total"] * (1 + tol):
+                errors.append(
+                    f"hist_ab: level {i} {key} useful {fm['useful']} > "
+                    f"total {fm['total']}"
+                )
+                continue
+            models[key] = fm
+        if len(models) != 2:
+            continue
+        du, pu = models["dense_flops"]["useful"], models["partition_flops"]["useful"]
+        if abs(du - pu) > tol * max(du, 1.0):
+            errors.append(
+                f"hist_ab: level {i} useful FLOPs differ across modes "
+                f"({du} vs {pu}) — useful is mode-independent by definition"
+            )
+        if lv["mode_auto"] not in ("dense", "partition"):
+            errors.append(f"hist_ab: level {i} bad mode_auto {lv['mode_auto']!r}")
+        widths.append(w)
+        dense_totals.append(models["dense_flops"]["total"])
+        dense_fracs.append(du / models["dense_flops"]["total"])
+        part_fracs.append(pu / models["partition_flops"]["total"])
+    if errors or len(widths) < 2:
+        return errors
+    if any(widths[i] > widths[i + 1] for i in range(len(widths) - 1)):
+        errors.append("hist_ab: level widths not non-decreasing")
+    # Dense total ∝ width (exactly, per the model): every node pays
+    # every row.
+    for i in range(1, len(widths)):
+        want = dense_totals[0] * widths[i] / widths[0]
+        if abs(dense_totals[i] - want) > 1e-6 * want:
+            errors.append(
+                f"hist_ab: dense total at width {widths[i]} not "
+                f"proportional to width ({dense_totals[i]} vs {want})"
+            )
+            break
+    # The acceptance curves: partition's useful fraction is flat in
+    # depth (bounded drift from the (M+1)·B region padding); dense's
+    # spans the width range.
+    if min(part_fracs) > 0 and max(part_fracs) / min(part_fracs) > 2.0:
+        errors.append(
+            "hist_ab: partition useful-FLOP fraction varies more than 2x "
+            "across levels — the depth-independence claim fails"
+        )
+    if widths[-1] > widths[0]:
+        want_ratio = widths[-1] / widths[0]
+        got_ratio = dense_fracs[0] / max(dense_fracs[-1], 1e-30)
+        if abs(got_ratio - want_ratio) > 1e-3 * want_ratio:
+            errors.append(
+                "hist_ab: dense useful-FLOP fraction does not decay like "
+                f"1/width ({got_ratio} vs {want_ratio})"
+            )
+    return errors
+
+
 def validate_trace_files(outdir: str) -> list[str]:
     """Validate trace.json / overlap_report.json / serving_report.json
     / slo_report.json in ``outdir`` when present (tracing and serving
@@ -591,22 +703,31 @@ def main(argv: list[str] | None = None) -> int:
                          "sweep_stage_total")
     args = ap.parse_args(argv)
     trace_dir = None
-    if len(args.paths) == 1 and os.path.basename(
-        args.paths[0]
-    ).startswith("MESH_SCALING"):
-        # Scaling-evidence mode (ISSUE 8): validate the byte-accounting
-        # record bench.py --mesh-scaling writes at the repo root.
-        try:
-            with open(args.paths[0]) as f:
-                errors = validate_mesh_scaling(json.load(f))
-        except (OSError, json.JSONDecodeError) as e:
-            errors = [f"mesh_scaling: cannot read {args.paths[0]}: {e}"]
-        for e in errors:
-            print(f"FAIL {e}", file=sys.stderr)
-        if errors:
-            return 1
-        print(f"OK {args.paths[0]}")
-        return 0
+    # Committed bench-evidence records, validated by filename prefix:
+    # the byte-accounting record of --mesh-scaling (ISSUE 8) and the
+    # kernel-mode A/B + FLOP-model record of --hist-ab (ISSUE 10). One
+    # table-driven branch so the next evidence record adds a row, not a
+    # copied block.
+    _EVIDENCE_VALIDATORS = (
+        ("MESH_SCALING", "mesh_scaling", validate_mesh_scaling),
+        ("HIST_AB", "hist_ab", validate_hist_ab_record),
+    )
+    if len(args.paths) == 1:
+        base = os.path.basename(args.paths[0])
+        for prefix, tag, validator in _EVIDENCE_VALIDATORS:
+            if not base.startswith(prefix):
+                continue
+            try:
+                with open(args.paths[0]) as f:
+                    errors = validator(json.load(f))
+            except (OSError, json.JSONDecodeError) as e:
+                errors = [f"{tag}: cannot read {args.paths[0]}: {e}"]
+            for e in errors:
+                print(f"FAIL {e}", file=sys.stderr)
+            if errors:
+                return 1
+            print(f"OK {args.paths[0]}")
+            return 0
     if len(args.paths) == 1 and os.path.isdir(args.paths[0]):
         trace_dir = args.paths[0]
         metrics_path = os.path.join(args.paths[0], "metrics.json")

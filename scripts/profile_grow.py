@@ -20,7 +20,19 @@ on a timeline next to any other capture instead of only as stderr
 prints.
 
 Usage: python scripts/profile_grow.py [--rows 1000000] [--trees 8]
+                                      [--interpret] [--mode dense|partition]
                                       [--trace-out /tmp/profile_grow_trace.json]
+
+``--interpret`` (ISSUE 10) runs every kernel stage through the Pallas
+interpreter so the level-by-level grow decomposition — the instrument
+for validating the dense/partition depth crossover — runs on a plain
+CPU image (previously TPU-only: the compiled kernel has no CPU path).
+Interpret timings measure the interpreter, not the MXU — use them for
+SHAPE of the per-level curve and for exercising both kernel modes, not
+for absolute cost. Without an explicit ``--rows`` the interpret default
+drops to 65,536 (a 1M-row interpreted sweep prices in hours on one
+core). ``--mode`` selects the histogram kernel formulation per level
+(dense | partition | auto — ops/hist_pallas.py::mode_for_width).
 """
 
 import argparse
@@ -49,7 +61,8 @@ from ate_replication_causalml_tpu.models.forest import (  # noqa: E402
 )
 from ate_replication_causalml_tpu.ops.bootstrap import _poisson1_counts  # noqa: E402
 from ate_replication_causalml_tpu.ops.hist_pallas import (  # noqa: E402
-    bin_histogram_pallas,
+    bin_histogram,
+    mode_for_width,
 )
 
 R = 8  # repeats inside one dispatch
@@ -140,20 +153,39 @@ def grow_no_hist(args):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--rows", type=int, default=None,
+                    help="default 1,000,000 (65,536 under --interpret)")
     ap.add_argument("--depth", type=int, default=9)
     ap.add_argument("--trees", type=int, default=8)
     ap.add_argument("--bf16", action="store_true")
+    ap.add_argument("--interpret", action="store_true",
+                    help="run kernels through the Pallas interpreter "
+                         "(CPU-capable level decomposition)")
+    ap.add_argument("--mode", default="dense",
+                    choices=("dense", "partition", "auto"),
+                    help="histogram kernel formulation per level")
     ap.add_argument("--no-hist", action="store_true")
     ap.add_argument("--trace-out", default="/tmp/profile_grow_trace.json",
                     help="Perfetto trace path ('' disables)")
     args = ap.parse_args()
+    if args.rows is None:
+        args.rows = 65_536 if args.interpret else 1_000_000
+    if args.interpret and args.bf16:
+        ap.error("--bf16 measures the MXU dtype path; meaningless under "
+                 "--interpret")
+    if not args.interpret and jax.default_backend() != "tpu":
+        ap.error("the compiled Pallas kernels need a TPU; pass --interpret "
+                 "on CPU images")
     if args.no_hist:
         grow_no_hist(args)
         _export_trace(args)
         return
     n, p, n_bins = args.rows, 21, 64
     depth = args.depth
+    hist_backend = (
+        "pallas_interpret" if args.interpret
+        else ("pallas_bf16" if args.bf16 else "pallas")
+    )
 
     key = jax.random.key(0)
     kx, ky, kc = jax.random.split(key, 3)
@@ -191,16 +223,19 @@ def main():
     for l in range(depth):
         m = max(1, (1 << l) // 2) if l > 0 else 1
         ids = jnp.where(node_ids[l] % 2 == 0, node_ids[l] // 2, -1) if l else node_ids[l]
+        lvl_mode = mode_for_width(args.mode, m, weights.shape[0], p, n_bins)
 
         def body(eps, ids, w):
-            h = bin_histogram_pallas(
-                codes, ids, w + eps, max_nodes=m, n_bins=n_bins, bf16=args.bf16
+            h = bin_histogram(
+                codes, ids, w + eps, max_nodes=m, n_bins=n_bins,
+                backend=hist_backend, mode=lvl_mode,
             )
             return h.ravel()[0]
 
-        t = timed(rep(body), ids, weights, stage=f"hist_l{l}")
+        t = timed(rep(body), ids, weights, stage=f"hist_l{l}_{lvl_mode}")
         hist_ms.append(t * 1e3)
-        print(f"hist  level {l} (m={m:3d}): {t * 1e3:8.2f} ms", file=sys.stderr)
+        print(f"hist  level {l} (m={m:3d}, {lvl_mode}): {t * 1e3:8.2f} ms",
+              file=sys.stderr)
 
     # --- route per level
     route_ms = []
@@ -291,14 +326,13 @@ def main():
     from ate_replication_causalml_tpu.models.forest import auto_tree_chunk
 
     vw = min(args.trees, auto_tree_chunk(n, depth, cap=32))
-    tc = (args.trees // vw) * vw
+    tc = max(vw, (args.trees // vw) * vw)
     keys = jax.random.split(jax.random.key(7), tc).reshape(tc // vw, vw)
-    backend = "pallas_bf16" if args.bf16 else "pallas"
 
     def full():
         out = _grow_chunk(
             keys, codes, y, None, depth=depth, mtry=4, n_bins=n_bins,
-            hist_backend=backend, center=False,
+            hist_backend=hist_backend, hist_mode=args.mode, center=False,
         )
         return out
 

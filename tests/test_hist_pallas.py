@@ -435,15 +435,19 @@ def test_shared_weights_kernel_bit_identical(case):
     np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
 
 
-# @slow: known-failing on this image's jaxlib (f32 ulp accumulation
-# order under vmapped interpret mode — pre-existing, see CHANGES.md
-# PR 1) and several seconds of interpret-mode compute; the slow lane
-# keeps it visible without burning tier-1 budget on a documented F.
-@pytest.mark.slow
 def test_shared_custom_vmap_collapses(case):
     """bin_histogram_shared under nested vmaps (groups × trees) returns
     the same histograms as per-slice calls, with the weight stack never
-    batched."""
+    batched.
+
+    FIXED in PR 10 (was the known-red f32-ulp cell carried since
+    PR 1): the batched kernel used to concatenate every tree into ONE
+    (T·K·M, TILE) dot, so the reduction association XLA:CPU picked
+    depended on the batch size T and the collapsed call (T=6) drifted
+    at ulp level from the per-slice calls (T=3) for float weights. The
+    kernel now issues one (K·M, TILE) dot PER TREE — every tree's
+    numbers are independent of the batch it rides in, so this holds
+    with array_equal for float stacks too, on any backend."""
     from ate_replication_causalml_tpu.ops.hist_pallas import bin_histogram_shared
 
     codes, node, weights, max_nodes, n_bins = case

@@ -1,0 +1,435 @@
+"""ISSUE 10: the in-kernel stable-bin-partition histogram mode.
+
+Contracts pinned here:
+
+* **Dense/partition bit-identity** across the A/B matrix (rows ×
+  width × weight-stack mode) wherever the per-cell sums are
+  order-exact: every INTEGER-valued weight stack (the classifier
+  engine's counts / counts·y∈{0,1} — f32 sums below 2^24 are exact in
+  any association). Both kernels sum the same member products in the
+  same stable row order, so on the MXU's fixed sequential-in-K
+  accumulation the identity extends to FLOAT stacks too — asserted by
+  the compiled ``@pytest.mark.tpu`` variants. On the CPU interpret
+  backend XLA/Eigen folds a long gemm's K axis in 256-wide panels
+  (measured, PR 10 — see _hist_kernel_batched_partition's
+  docstring), so float stacks are pinned here at a few-ulp tolerance
+  with the association rationale, exactly like the batched-vs-single
+  comparison in test_hist_pallas.py.
+* **The mode policy**: env parsing at config time, the pure crossover
+  heuristic, the per-width decision (one mode per kernel width — the
+  instantiation set is reused, not multiplied), and the FLOP model's
+  internal consistency (useful ≤ total; useful is mode-independent;
+  dense's useful fraction decays like 1/width while partition's is
+  depth-independent).
+* **Grower integration**: binary-classifier fits are bit-identical
+  across modes end-to-end; the causal ρ-decomposed grower agrees at
+  the statistical contract; kernel dispatches are metered into
+  ``hist_kernel_dispatch_total{mode, engine}``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ate_replication_causalml_tpu.ops.hist_pallas import (
+    _check_mode,
+    bin_histogram_batched,
+    bin_histogram_pallas_batched,
+    bin_histogram_pallas_batched_shared,
+    bin_histogram_shared,
+    hist_level_flops,
+    mode_for_width,
+    partition_crossover_width,
+    resolve_hist_mode,
+)
+
+ON_TPU = jax.default_backend() == "tpu"
+
+
+def _numpy_hist(codes, node, weights, max_nodes, n_bins):
+    k_w, n = weights.shape
+    p = codes.shape[1]
+    out = np.zeros((k_w, max_nodes, p, n_bins), np.float64)
+    for i in range(n):
+        m = node[i]
+        if 0 <= m < max_nodes:
+            for f in range(p):
+                out[:, m, f, codes[i, f]] += weights[:, i]
+    return out
+
+
+def _case(n, width, k_w, trees=2, p=5, n_bins=16, integer=True, seed=0):
+    rng = np.random.default_rng(seed)
+    codes = jnp.asarray(rng.integers(0, n_bins, (n, p)), jnp.int32)
+    nodes = jnp.asarray(rng.integers(-1, width, (trees, n)), jnp.int32)
+    if integer:
+        w = rng.poisson(1.0, (trees, k_w, n)).astype(np.float32)
+        w[:, 1:] *= rng.integers(-2, 3, (trees, k_w - 1, n)).astype(np.float32)
+    else:
+        w = rng.uniform(-2, 2, (trees, k_w, n)).astype(np.float32)
+    return codes, nodes, jnp.asarray(w)
+
+
+def test_partition_matches_numpy_truth():
+    codes, nodes, w = _case(1000, 8, 2, integer=False, seed=1)
+    got = bin_histogram_pallas_batched(
+        codes, nodes, w, max_nodes=8, n_bins=16, tile=256, interpret=True,
+        partition=True,
+    )
+    for t in range(nodes.shape[0]):
+        truth = _numpy_hist(np.asarray(codes), np.asarray(nodes[t]),
+                            np.asarray(w[t]), 8, 16)
+        np.testing.assert_allclose(np.asarray(got[t]), truth, rtol=0, atol=1e-4)
+
+
+# The A/B matrix (acceptance): kernel widths through depth 9 — the
+# streaming growers' deepest level at depth 9 runs width 2^7 = 128.
+@pytest.mark.parametrize("width", [1, 2, 4, 8, 16, 32, 64, 128])
+def test_partition_bit_identical_integer_all_widths(width):
+    """Per-tree layout (the classifier engine's stack shape), integer
+    weights: dense and partition modes are BIT-identical at every
+    kernel width — exact sums are association-invariant, so this holds
+    on every backend and jaxlib."""
+    codes, nodes, w = _case(1000, width, 2, seed=width)
+    kw = dict(max_nodes=width, n_bins=16, tile=256, interpret=True)
+    dense = bin_histogram_pallas_batched(codes, nodes, w, **kw)
+    part = bin_histogram_pallas_batched(codes, nodes, w, partition=True, **kw)
+    assert jnp.array_equal(dense, part)
+
+
+@pytest.mark.parametrize("n,width", [(9216, 16), (9216, 128), (65536, 64)])
+def test_partition_bit_identical_integer_large_rows(n, width):
+    """The multi-tile regime (default 2048-row tiles): 9k rows (the
+    reference's own scale) and a 64k-row cell. Cross-tile accumulation
+    order is the SAME out_ref += per-tile fold in both modes."""
+    codes, nodes, w = _case(n, width, 2, trees=1, seed=n + width)
+    kw = dict(max_nodes=width, n_bins=16, interpret=True)
+    dense = bin_histogram_pallas_batched(codes, nodes, w, **kw)
+    part = bin_histogram_pallas_batched(codes, nodes, w, partition=True, **kw)
+    assert jnp.array_equal(dense, part)
+
+
+@pytest.mark.parametrize("width", [1, 8, 64, 128])
+def test_partition_shared_weights_bit_identical_integer(width):
+    """The causal grower's kernel shape: ONE shared (K=5, n) stack with
+    membership folded into the id stream. Integer-valued stacks are
+    bit-identical across modes; the 5-stream layout and the −1 masking
+    flow through the partition (masked rows land in the trash region
+    and contribute nothing)."""
+    rng = np.random.default_rng(width + 7)
+    n = 1000
+    codes = jnp.asarray(rng.integers(0, 16, (n, 5)), jnp.int32)
+    member = rng.integers(0, 2, (3, n)).astype(np.int32)
+    nodes = rng.integers(0, width, (3, n)).astype(np.int32)
+    ids = jnp.asarray(np.where(member > 0, nodes, -1).astype(np.int32))
+    shared = jnp.asarray(
+        rng.integers(-3, 4, (5, n)).astype(np.float32)
+    )
+    kw = dict(max_nodes=width, n_bins=16, tile=256, interpret=True)
+    dense = bin_histogram_pallas_batched_shared(codes, ids, shared, **kw)
+    part = bin_histogram_pallas_batched_shared(
+        codes, ids, shared, partition=True, **kw
+    )
+    assert jnp.array_equal(dense, part)
+
+
+def test_partition_float_ulp_on_cpu_interpret():
+    """The causal 5-stream FLOAT stack under interpret mode: the two
+    modes sum each cell's member products in the same row order, but
+    XLA:CPU reduces dense's long gemm in 256-wide K panels while the
+    partition kernel folds node-pure 8-row blocks — a pure f32
+    reassociation, bounded at a few ulp of the cell magnitudes
+    (measured 1e-6-scale on this image). On the MXU both modes
+    accumulate sequentially in K, and the @tpu variant below asserts
+    exact equality there. Bit-exactness for every INTEGER stack is the
+    unconditional contract (tests above)."""
+    rng = np.random.default_rng(11)
+    n = 2048
+    codes = jnp.asarray(rng.integers(0, 16, (n, 5)), jnp.int32)
+    ids = jnp.asarray(rng.integers(-1, 16, (2, n)), jnp.int32)
+    wt = rng.normal(size=n).astype(np.float32) * 0.5
+    yt = rng.normal(size=n).astype(np.float32)
+    mom5 = jnp.asarray(np.stack([np.ones_like(wt), wt, yt, wt * wt, wt * yt]))
+    kw = dict(max_nodes=16, n_bins=16, tile=256, interpret=True)
+    dense = bin_histogram_pallas_batched_shared(codes, ids, mom5, **kw)
+    part = bin_histogram_pallas_batched_shared(
+        codes, ids, mom5, partition=True, **kw
+    )
+    np.testing.assert_allclose(
+        np.asarray(part), np.asarray(dense), rtol=1e-5, atol=1e-5
+    )
+
+
+@pytest.mark.tpu
+@pytest.mark.skipif(not ON_TPU, reason="compiled Mosaic kernels need TPU")
+@pytest.mark.parametrize("width", [16, 64, 128])
+def test_partition_bit_identical_float_tpu_compiled(width):
+    """On real hardware the MXU accumulates every dot sequentially in
+    K, so the stable partition preserves each cell's f32 accumulation
+    order EXACTLY — dense and partition must be bit-identical for
+    float stacks too, through the COMPILED kernels."""
+    rng = np.random.default_rng(width)
+    n = 65536
+    codes = jnp.asarray(rng.integers(0, 64, (n, 21)), jnp.int32)
+    ids = jnp.asarray(rng.integers(-1, width, (4, n)), jnp.int32)
+    mom5 = jnp.asarray(rng.normal(size=(5, n)).astype(np.float32))
+    kw = dict(max_nodes=width, n_bins=64)
+    dense = bin_histogram_pallas_batched_shared(codes, ids, mom5, **kw)
+    part = bin_histogram_pallas_batched_shared(
+        codes, ids, mom5, partition=True, **kw
+    )
+    assert jnp.array_equal(dense, part)
+
+
+def test_partition_through_dispatch_and_vmap():
+    """The dispatcher + custom_vmap path: partition mode collapses
+    nested vmaps into tree-batched partition kernel calls exactly like
+    dense mode, and per-slice calls match the collapsed call (per-tree
+    numerics are batch-size-independent in BOTH modes since PR 10)."""
+    rng = np.random.default_rng(3)
+    n = 700
+    codes = jnp.asarray(rng.integers(0, 16, (n, 5)), jnp.int32)
+    nodes = jnp.asarray(rng.integers(0, 8, (4, n)), jnp.int32)
+    weights = jnp.asarray(rng.poisson(1.0, (4, 2, n)).astype(np.float32))
+
+    def one(nd, w):
+        return bin_histogram_batched(
+            codes, nd[None], w[None], max_nodes=8, n_bins=16,
+            backend="pallas_interpret", mode="partition",
+        )[0]
+
+    got = jax.vmap(one)(nodes, weights)
+    want = jnp.stack([one(nodes[t], weights[t]) for t in range(4)])
+    assert jnp.array_equal(got, want)
+
+
+def test_partition_floors_bit_identical():
+    """Width padding (the uniform-instantiation floors) cannot change a
+    partition-mode bit EVEN FOR FLOAT weights: the per-block dots never
+    see the padded width — node 0..m_live regions are laid out
+    identically and padded nodes own zero blocks. (Dense mode's floor
+    invariance rests on the M-independence of the kernel's dot
+    association — test_forest.py::test_grow_floors_bit_identical.)"""
+    rng = np.random.default_rng(9)
+    n = 1000
+    codes = jnp.asarray(rng.integers(0, 16, (n, 5)), jnp.int32)
+    ids = jnp.asarray(rng.integers(-1, 4, (2, n)), jnp.int32)
+    mom = jnp.asarray(rng.normal(size=(5, n)).astype(np.float32))
+    kw = dict(n_bins=16, tile=256, interpret=True, partition=True)
+    live = bin_histogram_pallas_batched_shared(codes, ids, mom, max_nodes=4, **kw)
+    padded = bin_histogram_pallas_batched_shared(
+        codes, ids, mom, max_nodes=16, **kw
+    )
+    assert jnp.array_equal(live, padded[:, :, :4])
+
+
+# --- mode policy -----------------------------------------------------------
+
+
+def test_resolve_hist_mode_env_and_arg(monkeypatch):
+    monkeypatch.delenv("ATE_TPU_HIST_MODE", raising=False)
+    assert resolve_hist_mode() == "auto"
+    assert resolve_hist_mode("DENSE") == "dense"
+    assert resolve_hist_mode(" Partition ") == "partition"
+    monkeypatch.setenv("ATE_TPU_HIST_MODE", "PARTITION")
+    assert resolve_hist_mode() == "partition"
+    monkeypatch.setenv("ATE_TPU_HIST_MODE", "auto")
+    assert resolve_hist_mode() == "auto"
+    # The explicit argument beats the environment.
+    assert resolve_hist_mode("dense") == "dense"
+
+
+def test_resolve_hist_mode_bad_value_raises_at_config_time(monkeypatch):
+    with pytest.raises(ValueError, match="ATE_TPU_HIST_MODE"):
+        resolve_hist_mode("bogus")
+    monkeypatch.setenv("ATE_TPU_HIST_MODE", "fastest")
+    with pytest.raises(ValueError, match="fastest"):
+        resolve_hist_mode()
+    # ... and a fitter surfaces it BEFORE any tracing/fitting happens.
+    from ate_replication_causalml_tpu.models.forest import fit_forest_classifier
+
+    x = jnp.zeros((8, 3), jnp.float32)
+    y = jnp.zeros((8,), jnp.float32)
+    with pytest.raises(ValueError, match="fastest"):
+        fit_forest_classifier(x, y, jax.random.key(0), n_trees=1, depth=2)
+
+
+def test_dispatch_rejects_unresolved_mode():
+    """'auto' must never reach a kernel dispatcher (the heuristic runs
+    in the growers), and partition mode has no XLA formulation."""
+    assert _check_mode("partition", "pallas") is True
+    assert _check_mode("dense", "xla") is False
+    with pytest.raises(ValueError, match="auto"):
+        _check_mode("auto", "pallas")
+    with pytest.raises(ValueError, match="pallas"):
+        _check_mode("partition", "xla")
+
+
+def test_crossover_known_answers():
+    """The measured-model crossovers at the production shapes: the K=2
+    classifier engine flips at width 32, the K=5 causal engine at 16 —
+    both engines' shallow levels stay dense, deep levels partition.
+    (These pin the MODEL; re-derive if the FLOP model changes.)"""
+    assert partition_crossover_width(2, p=21, n_bins=64) == 32
+    assert partition_crossover_width(5, p=21, n_bins=64) == 16
+    # More channels amortize the permutation cost over more useful work
+    # → the crossover can only move down (never up) with K.
+    widths = [partition_crossover_width(k, p=21, n_bins=64)
+              for k in (1, 2, 5, 8)]
+    assert widths == sorted(widths, reverse=True)
+
+
+def test_mode_for_width_policy():
+    for w in (1, 16, 32, 128):
+        assert mode_for_width("dense", w, 2) == "dense"
+        assert mode_for_width("partition", w, 2) == "partition"
+    cross = partition_crossover_width(2, p=21, n_bins=64)
+    assert mode_for_width("auto", cross - 1, 2, 21, 64) == "dense"
+    assert mode_for_width("auto", cross, 2, 21, 64) == "partition"
+    with pytest.raises(ValueError):
+        mode_for_width("bogus", 16, 2)
+
+
+def test_flop_model_consistency():
+    """useful ≤ total; useful is mode-independent; dense total ∝ width
+    (useful fraction ~1/2^d); partition fraction depth-independent."""
+    widths = [1, 2, 4, 8, 16, 32, 64, 128]
+    dense = [hist_level_flops("dense", 10_000, w, 5) for w in widths]
+    part = [hist_level_flops("partition", 10_000, w, 5) for w in widths]
+    for d, p_ in zip(dense, part):
+        assert d["useful"] <= d["total"]
+        assert p_["useful"] <= p_["total"]
+        assert d["useful"] == p_["useful"]
+    for i in range(1, len(widths)):
+        assert dense[i]["total"] == pytest.approx(
+            dense[0]["total"] * widths[i] / widths[0]
+        )
+    fracs = [p_["useful"] / p_["total"] for p_ in part]
+    assert max(fracs) / min(fracs) < 2.0
+    dfracs = [d["useful"] / d["total"] for d in dense]
+    assert dfracs[0] / dfracs[-1] == pytest.approx(128.0)
+
+
+def test_streaming_hist_widths():
+    from ate_replication_causalml_tpu.models.forest import (
+        hist_partition_active,
+        streaming_hist_widths,
+    )
+
+    assert streaming_hist_widths(9) == (1, 1, 2, 4, 8, 16, 32, 64, 128)
+    assert streaming_hist_widths(9, 16) == (
+        16, 16, 16, 16, 16, 16, 32, 64, 128
+    )
+    assert streaming_hist_widths(1) == (1,)
+    # The chunk planners' partition-transient flag.
+    assert hist_partition_active("partition", 3, 1, 2, 21, 64)
+    assert not hist_partition_active("dense", 9, 1, 2, 21, 64)
+    assert hist_partition_active("auto", 9, 1, 2, 21, 64)
+    assert not hist_partition_active("auto", 4, 1, 2, 21, 64)  # widths ≤ 4
+
+
+# --- grower integration ----------------------------------------------------
+
+
+def test_classifier_fit_bit_identical_across_modes():
+    """End-to-end: a binary-target classifier fit (integer weight
+    stacks) grows the SAME forest in both kernel modes — splits, bins,
+    leaves, recorded training leaves."""
+    from ate_replication_causalml_tpu.models.forest import fit_forest_classifier
+
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(300, 6)).astype(np.float32))
+    y = jnp.asarray((rng.uniform(size=300) < 0.4).astype(np.float32))
+    key = jax.random.key(11)
+    kw = dict(n_trees=2, depth=3, n_bins=8, tree_chunk=2,
+              hist_backend="pallas_interpret")
+    fd = fit_forest_classifier(x, y, key, hist_mode="dense", **kw)
+    fp = fit_forest_classifier(x, y, key, hist_mode="partition", **kw)
+    assert jnp.array_equal(fd.split_feat, fp.split_feat)
+    assert jnp.array_equal(fd.split_bin, fp.split_bin)
+    assert jnp.array_equal(fd.leaf_value, fp.leaf_value)
+    assert jnp.array_equal(fd.train_leaf, fp.train_leaf)
+
+
+def test_causal_grower_modes_agree():
+    """The ρ-decomposed causal grower across modes: float moment
+    channels mean ulp-level histogram drift can flip exact-tie splits
+    on CPU interpret (same contract as the cross-backend test) — near-
+    total split agreement and matching CATE is the bound; on TPU the
+    modes are bit-identical (kernel-level @tpu test)."""
+    from ate_replication_causalml_tpu.models.causal_forest import (
+        grow_causal_forest,
+        predict_cate,
+    )
+
+    rng = np.random.default_rng(4)
+    n = 250
+    x = jnp.asarray(rng.normal(size=(n, 5)).astype(np.float32))
+    wt = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    yt = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    key = jax.random.key(5)
+    kw = dict(n_trees=2, depth=3, n_bins=8, group_chunk=1,
+              hist_backend="pallas_interpret")
+    ref = grow_causal_forest(x, wt, yt, key, hist_mode="dense", **kw)
+    got = grow_causal_forest(x, wt, yt, key, hist_mode="partition", **kw)
+    agree = np.mean(
+        (np.asarray(got.split_feat) == np.asarray(ref.split_feat))
+        & (np.asarray(got.split_bin) == np.asarray(ref.split_bin))
+    )
+    assert agree >= 0.95, f"split agreement {agree:.3f}"
+    cate_ref = predict_cate(ref, x, oob=False).cate
+    cate_got = predict_cate(got, x, oob=False).cate
+    err = float(jnp.abs(cate_got - cate_ref).mean())
+    scale = float(jnp.abs(cate_ref).mean()) + 1e-6
+    assert err / scale < 0.05, (err, scale)
+
+
+def test_hist_dispatch_counter_metered():
+    """Every streaming fit meters its per-level kernel plan into
+    hist_kernel_dispatch_total{mode, engine} — one count per
+    (level × vmapped chunk), split by the per-width mode decision."""
+    from ate_replication_causalml_tpu import observability as obs
+    from ate_replication_causalml_tpu.models.forest import fit_forest_classifier
+
+    before = dict(obs.REGISTRY.peek("hist_kernel_dispatch_total") or {})
+    rng = np.random.default_rng(6)
+    x = jnp.asarray(rng.normal(size=(200, 4)).astype(np.float32))
+    y = jnp.asarray((rng.uniform(size=200) < 0.5).astype(np.float32))
+    fit_forest_classifier(
+        x, y, jax.random.key(1), n_trees=2, depth=3, n_bins=8,
+        tree_chunk=2, hist_backend="pallas_interpret", hist_mode="partition",
+    )
+    after = obs.REGISTRY.peek("hist_kernel_dispatch_total")
+    key = "engine=classifier,mode=partition"
+    # depth 3 → 3 level calls in ONE vmapped chunk.
+    assert after.get(key, 0.0) - before.get(key, 0.0) == 3.0
+
+
+def test_hist_ab_record_schema():
+    """bench.py --hist-ab's per-level FLOP-model record validates, and
+    the validator actually rejects inconsistency (useful > total /
+    mode-dependent useful)."""
+    import copy
+    import sys
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    sys.path.insert(0, os.path.join(repo, "scripts"))
+    import bench
+    from check_metrics_schema import validate_hist_ab_record
+
+    record = bench.hist_mode_ab_record(
+        2048, trees=1, depth=4, k_weights=2, p=5, n_bins=16, reps=1
+    )
+    assert validate_hist_ab_record(record) == []
+    bad = copy.deepcopy(record)
+    bad["levels"][1]["partition_flops"]["useful"] *= 2.0
+    errs = validate_hist_ab_record(bad)
+    assert any("useful" in e for e in errs)
+    bad2 = copy.deepcopy(record)
+    bad2["levels"][0]["dense_flops"]["useful"] = (
+        bad2["levels"][0]["dense_flops"]["total"] * 2
+    )
+    assert validate_hist_ab_record(bad2)

@@ -95,14 +95,29 @@ def test_sharded_fit_plan_matches_resolved_backend(monkeypatch):
         sharded_fit_plan,
     )
 
+    # The expectations below assume the DEFAULT auto kernel-mode policy
+    # — an exported ATE_TPU_HIST_MODE (a documented knob) must not leak
+    # into the plan comparison.
+    monkeypatch.delenv("ATE_TPU_HIST_MODE", raising=False)
+
     # CPU: 'auto' (allow_onehot=False) resolves to the non-streaming
     # XLA path at any size.
     assert sharded_fit_plan(4_000, 6, 64) == plan_tree_dispatch(
         4_000, 6, 64, streaming=False
     )
     # TPU at kernel scale: streaming pallas with the classifier floor.
+    # Under the default auto kernel-mode policy (ISSUE 10) the depth-9
+    # deep widths resolve to PARTITION mode, so the plan charges the
+    # partition kernel's fixed VMEM transients; a dense-pinned fit
+    # keeps the pre-partition plan.
     monkeypatch.setattr(hp.jax, "default_backend", lambda: "tpu")
     assert sharded_fit_plan(1_000_000, 9, 500) == plan_tree_dispatch(
+        1_000_000, 9, 500, streaming=True, hist_floor=_HIST_M_FLOOR,
+        hist_partition=True,
+    )
+    assert sharded_fit_plan(
+        1_000_000, 9, 500, hist_mode="dense"
+    ) == plan_tree_dispatch(
         1_000_000, 9, 500, streaming=True, hist_floor=_HIST_M_FLOOR
     )
 

@@ -177,14 +177,33 @@ def test_streaming_grower_unchanged_by_route_kernel():
 
 
 @pytest.mark.slow
-# slow: the (gn-1)/gn little-bags ratio pin was frozen on the original
-# image's jax; this image's jaxlib drifts 11/2500 rows past the rtol
-# after variance truncation (same class of drift as the frozen goldens).
+# slow only for runtime (an interpret-mode grow + two predicts); green
+# since PR 10 — see the shared-executable note below.
 def test_variance_compat_grf_df_ratio():
     """variance_compat="grf" divides the between-group variance by
     num_groups instead of gn−1. With ci_group_size=1 the within-group
-    correction vanishes (every group is one tree), so the final
-    variances differ by exactly (gn−1)/gn wherever they are positive."""
+    correction vanishes identically (every group is one tree: ψ_t −
+    ψ̄_group ≡ 0, an exact f32 subtraction of a value from itself), so
+    the final variances differ by exactly (gn−1)/gn wherever they are
+    positive.
+
+    FIXED in PR 10 (this was a known-red cell since PR 1). Root cause
+    of the historical 11/2500-row drift: ``gn`` — the number of groups
+    counted into the variance — is PER ROW (a group only counts where
+    every one of its trees produced a valid prediction), and a row that
+    routes to an EMPTY honest leaf in one tree has gn < n_trees with an
+    exactly different df ratio (gn−1)/gn. The old assertion hardcoded
+    gn = 6 for every row; which rows hit an empty leaf shifts with any
+    ulp-level change to the grown forest (jaxlib drift, suite x64/opt
+    flags perturbing the f64 quantile edges), so the test was red on
+    this image with 11 rows at exactly (5−1)/5. The assertion now
+    states the REAL contract: every row's ratio is exactly (g−1)/g for
+    its own integer g ≤ 6, with the full-forest value 5/6 on the vast
+    majority. (PR 10 also made the two compat modes share ONE
+    executable — the df selector is a traced 0/1 operand, not a jit
+    static — so the truncated between-variance numerator is
+    bit-identical across the two calls by construction, never just by
+    compiler accident.)"""
     from ate_replication_causalml_tpu.models.causal_forest import (
         grow_causal_forest,
         predict_cate,
@@ -208,5 +227,16 @@ def test_variance_compat_grf_df_ratio():
     vg = np.asarray(grf.variance)
     pos = vu > 0
     assert pos.any()
-    gn = 6  # every tree produced a prediction (oob=False, nonempty leaves)
-    np.testing.assert_allclose(vg[pos] / vu[pos], (gn - 1) / gn, rtol=1e-5)
+    ratio = vg[pos] / vu[pos]
+    # Exact per-row df semantics: ratio == (g−1)/g for that row's own
+    # valid-group count g ∈ {2..6} (g=1 makes both dfs 1 → ratio 1).
+    allowed = np.asarray([(g - 1) / g for g in range(2, 7)] + [1.0])
+    dist = np.abs(ratio[:, None] - allowed[None, :]).min(axis=1)
+    np.testing.assert_allclose(dist, 0.0, atol=2e-6)
+    # The full-forest ratio 5/6 must be the bulk — empty-leaf routing
+    # is a tail event at this shape.
+    frac_full = np.mean(np.abs(ratio - 5 / 6) < 1e-5)
+    assert frac_full > 0.95, frac_full
+    # Zero-variance rows agree exactly (same truncation, same
+    # executable).
+    np.testing.assert_array_equal(vg[~pos], vu[~pos])
