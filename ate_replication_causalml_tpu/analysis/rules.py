@@ -886,7 +886,11 @@ class UnlockedSchedulerState(UnlockedSharedState):
     observability plane: ``observability/slo.py`` (the SLO engine's
     snapshot history is ticked from the dispatcher and read from admin
     probe threads) and the ``serving/admin.py`` endpoint — both serve
-    concurrent readers over state the daemon mutates."""
+    concurrent readers over state the daemon mutates. ISSUE 11's fleet
+    layer (``serving/fleet.py``, ``serving/retrain.py``) is squarely in
+    scope: the model registry is swapped by rotation threads while the
+    dispatcher binds it, and the shedder's burn cache is written from
+    the dispatcher and read from every producer."""
 
     id = "JGL008"
     name = "unlocked-scheduler-state"

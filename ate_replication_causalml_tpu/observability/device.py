@@ -142,6 +142,20 @@ def install_jax_monitoring() -> bool:
             "micro-batch close reasons").inc(0)
     bucket_histogram("serving_phase_seconds",
                      "per-request lifecycle phase durations")
+    # Train-to-serve fleet families (ISSUE 11): "nothing ever rotated",
+    # "no fleet request was routed" and "no retrain ever retried" are
+    # reported facts on every instrumented run — and a nonzero
+    # rotations{status=refused} is how a refused corrupt candidate
+    # stays auditable after the fact.
+    counter("serving_rotations_total",
+            "checkpoint hot-swap rotations by model and status").inc(0)
+    counter("serving_fleet_requests_total",
+            "fleet-routed serving requests by model and terminal status"
+            ).inc(0)
+    counter("serving_retrain_total",
+            "retrain supervisor runs by model and terminal status").inc(0)
+    counter("serving_retrain_retries_total",
+            "retrain attempts retried after a transient failure").inc(0)
     if _installed:
         return True
     try:

@@ -23,8 +23,20 @@ Sections:
   vs next-wouldn't-fit vs drain) that blames the coalescer's policy;
 * **rejects** — the admission/chaos reject timeline (bounded; the
   counters carry exact totals).
+* **reconciliation** (present when a ``metrics.json`` snapshot is
+  supplied) — ``requests_in_metrics`` (the dispatch-side phase
+  histogram's count: EVERY request that completed the lifecycle,
+  including raw ``submit()`` callers) vs ``requests_in_trace`` (the
+  ``serve_one`` request slices the phase section is built from). The
+  difference is ``silent_drops``: requests that are real in the
+  metrics but invisible to the trace-derived phase stats — the PR 7
+  gotcha, now a reported number the schema checker cross-validates
+  instead of a footnote.
 
 Pure stdlib and jax-free, like the critical-path analyzer beside it.
+The report stays a pure function of its INPUTS — (trace, metrics
+snapshot) — so the analyzer CLI reproduces the daemon's bytes from the
+saved artifacts alone.
 """
 
 from __future__ import annotations
@@ -66,8 +78,45 @@ def index_quantile(sorted_vals: list[float], q: float) -> float:
     return sorted_vals[min(len(sorted_vals) - 1, int(q * len(sorted_vals)))]
 
 
-def serving_report(trace: dict) -> dict:
-    """The ``serving_report.json`` payload for one exported trace."""
+def phase_count_from_metrics(metrics: dict | None) -> int | None:
+    """Requests the METRICS side decomposed: the ``serving_phase_seconds``
+    bucket histogram's count for the ``device`` phase (every phase of a
+    decomposed request is recorded exactly once, so any phase's count
+    works; ``device`` is the least ambiguous). None only when no
+    snapshot was supplied at all; a snapshot without the family means
+    zero requests were decomposed — a reported 0, not a missing
+    section."""
+    if metrics is None:
+        return None
+    fam = metrics.get("bucket_histograms", {}).get(
+        "serving_phase_seconds"
+    )
+    if not isinstance(fam, dict):
+        return 0
+    return sum(
+        int(s.get("count", 0))
+        for key, s in fam.items()
+        if "phase=device" in key.split(",") and isinstance(s, dict)
+    )
+
+
+def phase_mark_from_trace(trace: dict) -> int:
+    """The daemon's startup phase-count baseline, stamped into the
+    trace's ``otherData`` — the quantity that windows the process-
+    global metrics count to THIS serving session. One extraction rule,
+    shared by the report builder and the schema validator."""
+    try:
+        return int(
+            (trace.get("otherData") or {}).get("serving_phase_mark", 0)
+        )
+    except (TypeError, ValueError):
+        return 0
+
+
+def serving_report(trace: dict, metrics: dict | None = None) -> dict:
+    """The ``serving_report.json`` payload for one exported trace,
+    optionally reconciled against the run's ``metrics.json`` snapshot
+    (the silent-drop accounting for raw ``submit()`` traffic)."""
     requests: list[dict] = []
     batches: list[dict] = []
     rejects: list[dict] = []
@@ -141,7 +190,7 @@ def serving_report(trace: dict) -> dict:
                 "request_id": str(ev.get("args", {}).get("request_id", "")),
             })
 
-    return {
+    out: dict = {
         "schema_version": SERVING_SCHEMA_VERSION,
         "window_s": round(window_s, 6),
         "requests": {
@@ -170,13 +219,34 @@ def serving_report(trace: dict) -> dict:
             "timeline_truncated": max(0, len(rejects) - len(timeline)),
         },
     }
+    in_metrics = phase_count_from_metrics(metrics)
+    if in_metrics is not None:
+        # The phase histogram is process-global; the daemon stamped its
+        # startup baseline into the trace's otherData so an earlier
+        # serving session in the same process is not misreported as
+        # this window's silent drops. The metrics snapshot is taken
+        # AFTER the trace is built (the daemon's dump order pins
+        # this), so the windowed metrics side can only see MORE
+        # decomposed requests, never fewer — silent_drops is the
+        # raw-submit() traffic the trace-derived phase section cannot
+        # see.
+        in_window = max(0, in_metrics - phase_mark_from_trace(trace))
+        out["reconciliation"] = {
+            "requests_in_metrics": in_window,
+            "requests_in_trace": len(e2e_vals),
+            "silent_drops": in_window - len(e2e_vals),
+        }
+    return out
 
 
-def write_serving_artifacts(outdir: str, trace: dict) -> list[str]:
+def write_serving_artifacts(outdir: str, trace: dict,
+                            metrics: dict | None = None) -> list[str]:
     """Write the ``trace.json`` + ``serving_report.json`` pair for a
     serving session — the one write recipe :meth:`CateServer.stop`, the
     ``dump`` op and the analyzer CLI share, so their bytes can only
-    agree. Returns the paths written ([] when tracing is disabled)."""
+    agree. ``metrics`` (the run's metrics.json payload) enables the
+    silent-drop reconciliation section. Returns the paths written
+    ([] when tracing is disabled)."""
     from ate_replication_causalml_tpu.observability.export import (
         atomic_write_json,
     )
@@ -191,7 +261,7 @@ def write_serving_artifacts(outdir: str, trace: dict) -> list[str]:
     tpath = os.path.join(outdir, TRACE_BASENAME)
     write_trace_json(tpath, trace=trace)
     spath = os.path.join(outdir, SERVING_REPORT_BASENAME)
-    atomic_write_json(spath, serving_report(trace))
+    atomic_write_json(spath, serving_report(trace, metrics=metrics))
     return [tpath, spath]
 
 
@@ -225,4 +295,11 @@ def render_summary(report: dict) -> str:
         )
     if rej["count"]:
         lines.append(f"rejects by reason: {rej['by_reason']}")
+    rec = report.get("reconciliation")
+    if rec is not None:
+        lines.append(
+            f"reconciliation: {rec['requests_in_metrics']} in metrics, "
+            f"{rec['requests_in_trace']} in trace "
+            f"({rec['silent_drops']} silent raw-submit drop(s))"
+        )
     return "\n".join(lines)

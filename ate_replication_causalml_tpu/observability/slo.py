@@ -67,9 +67,21 @@ class SLO:
     windows_s: tuple[float, ...] = DEFAULT_WINDOWS
     #: latency only: observations ≤ this are good.
     threshold_s: float | None = None
-    #: availability only: the ``k=v`` label pair that marks a sample
-    #: good (matched against the registry's canonical label key).
+    #: availability only: the ``k=v`` label pairs (comma-separated, ALL
+    #: must match) that mark a sample good, matched against the
+    #: registry's canonical label key.
     good_match: str = "status=ok"
+    #: availability only: pairs restricting which samples count at all —
+    #: the per-model scope (``model=tenantA``). Empty = every sample.
+    scope_match: str = ""
+    #: availability only: alternatives (separated by ``|``) of ``k=v``
+    #: pair groups DISQUALIFYING a sample from the totals — a sample
+    #: matching ANY alternative is excluded. The shedder's SLOs ignore
+    #: ``status=rejected_shed`` (shedding must not feed back into the
+    #: burn rate that triggered it) and the client-error rejects (a
+    #: malformed-request spammer must not burn a tenant's budget and
+    #: starve its healthy traffic).
+    ignore_match: str = ""
 
     def __post_init__(self):
         if self.kind not in ("latency", "availability"):
@@ -106,6 +118,44 @@ def default_serving_slos(
             metric="serving_request_seconds", windows_s=windows_s,
             threshold_s=latency_threshold_s),
     )
+
+
+def fleet_slos(
+    models: tuple[str, ...],
+    objective: float = 0.999,
+    windows_s: tuple[float, ...] = DEFAULT_WINDOWS,
+    metric: str = "serving_fleet_requests_total",
+) -> tuple[SLO, ...]:
+    """Per-model availability objectives over the fleet counter
+    (ISSUE 11) — one ``fleet:<model>`` SLO per served model, scoped to
+    that model's samples so one tenant's burn never spends another's
+    budget. Excluded from the totals: shed rejects (the *response* to
+    a burn, not part of it — the property that keeps SLO-burn-driven
+    shedding from latching) and client-error rejects (bad_request /
+    retired_model are the CALLER's fault — the 4xx convention; a
+    malformed-request spammer must not burn a tenant's budget until
+    the shedder starves its healthy traffic). Server-caused rejects
+    (serve_fault / degraded / model_degraded / overloaded) DO spend
+    the budget."""
+    return tuple(
+        SLO(name=f"fleet:{m}", kind="availability", objective=objective,
+            metric=metric, windows_s=windows_s,
+            scope_match=f"model={m}", good_match="status=ok",
+            ignore_match="status=rejected_shed"
+                         "|status=rejected_bad_request"
+                         "|status=rejected_retired_model")
+        for m in models
+    )
+
+
+def _pairs(spec: str) -> tuple[str, ...]:
+    return tuple(p for p in spec.split(",") if p)
+
+
+def _match(label_key: str, pairs: tuple[str, ...]) -> bool:
+    """Whether every ``k=v`` pair appears in the canonical label key."""
+    present = label_key.split(",")
+    return all(p in present for p in pairs)
 
 
 class SLOEngine:
@@ -155,9 +205,18 @@ class SLOEngine:
             good, total = m.good_total_le(slo.threshold_s)
             return float(good), float(total)
         samples = self._registry.peek(slo.metric) or {}
-        total = float(sum(samples.values()))
+        scope = _pairs(slo.scope_match)
+        ignore_alts = [
+            _pairs(alt) for alt in slo.ignore_match.split("|") if alt
+        ]
+        good_pairs = scope + _pairs(slo.good_match)
+        total = float(sum(
+            v for k, v in samples.items()
+            if _match(k, scope)
+            and not any(_match(k, alt) for alt in ignore_alts)
+        ))
         good = float(sum(
-            v for k, v in samples.items() if slo.good_match in k.split(",")
+            v for k, v in samples.items() if _match(k, good_pairs)
         ))
         return good, total
 

@@ -34,7 +34,6 @@ from __future__ import annotations
 
 import dataclasses
 import time
-import zlib
 from typing import Callable, Iterable, Sequence
 
 import jax
@@ -42,16 +41,15 @@ import jax.numpy as jnp
 
 from ate_replication_causalml_tpu import observability as obs
 from ate_replication_causalml_tpu.resilience import chaos
+from ate_replication_causalml_tpu.resilience.backoff import (
+    BACKOFF_CAP_MULT,
+    jittered_backoff_delay,
+)
 from ate_replication_causalml_tpu.resilience.errors import (
     ChaosFault,
     DeadlineExceeded,
     classify,
 )
-
-#: Backoff growth is capped at this multiple of the base delay — after
-#: a few doublings a longer sleep stops buying recovery probability and
-#: only burns the pool deadline.
-BACKOFF_CAP_MULT = 8.0
 
 
 def probe_devices(devices: Sequence | None = None) -> list:
@@ -94,12 +92,12 @@ def backoff_delay(pool: str, shard: int, attempt: int,
     ``BACKOFF_CAP_MULT × base_s``. The jitter is a pure function of
     ``(pool, shard, attempt)`` (crc32 → [0, 0.25)) — retries de-herd
     across shards without any nondeterminism, so tests can assert the
-    exact sleep schedule."""
-    if base_s <= 0.0:
-        return 0.0
-    raw = base_s * (2.0 ** (attempt - 1))
-    jitter = zlib.crc32(f"{pool}|{shard}|{attempt}".encode()) / 2.0**32
-    return min(raw * (1.0 + 0.25 * jitter), BACKOFF_CAP_MULT * base_s)
+    exact sleep schedule. The formula lives in
+    ``resilience/backoff.py``, shared with the serving client and the
+    retrain supervisor."""
+    return jittered_backoff_delay(
+        f"{pool}|{shard}|{attempt}", attempt, base_s
+    )
 
 
 def run_shards(

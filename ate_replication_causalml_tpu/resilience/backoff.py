@@ -1,0 +1,46 @@
+"""THE crc32-jittered exponential backoff (ISSUE 3's discipline, made
+single-source in ISSUE 11 — no jax).
+
+Three retry loops share the same schedule — the shard runner
+(``parallel/retry.backoff_delay``), the serving client's typed-reject
+retries (``serving/client.retry_backoff_delay``) and the retrain
+supervisor (``serving/retrain.retrain_backoff_delay``). Each keeps its
+own thin wrapper (the domain-specific jitter KEY is the contract their
+tests pin), but the formula lives here exactly once: exponential in
+the attempt, deterministic jitter in [0, 25%) from a crc32 of the
+key, capped at ``cap_mult × base_s`` (and optionally an absolute
+ceiling). A pure function of its arguments — retries de-herd across
+sites with zero nondeterminism, and tests can assert the exact sleep
+schedule.
+
+This module must stay importable without jax: the client and the
+retrain supervisor run on hosts that never initialize a backend
+(``parallel/retry.py`` imports jax at module level, which is why the
+formula cannot live there).
+"""
+
+from __future__ import annotations
+
+import zlib
+
+#: Backoff growth is capped at this multiple of the base delay — after
+#: a few doublings a longer sleep stops buying recovery probability
+#: and only burns the pool deadline / the client's patience.
+BACKOFF_CAP_MULT = 8.0
+
+
+def jittered_backoff_delay(
+    key: str,
+    attempt: int,
+    base_s: float,
+    cap_mult: float = BACKOFF_CAP_MULT,
+    cap_s: float | None = None,
+) -> float:
+    """Seconds to sleep before retry ``attempt`` (1-based) of the work
+    identified by ``key``."""
+    if base_s <= 0.0:
+        return 0.0
+    raw = base_s * (2.0 ** (attempt - 1))
+    jitter = zlib.crc32(key.encode()) / 2.0**32
+    delay = min(raw * (1.0 + 0.25 * jitter), cap_mult * base_s)
+    return delay if cap_s is None else min(delay, cap_s)

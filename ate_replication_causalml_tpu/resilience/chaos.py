@@ -32,6 +32,17 @@ item is ``key=value`` or a bare flag. Scopes and their keys:
   the CLIENT-supplied request id, so with a client that retries under
   the same id the planned reject set is identical run to run and a
   chaos-free rerun of the same stream is bit-identical.
+* ``rotate`` — the train-to-serve fleet's failure modes (ISSUE 11),
+  each a bare flag budgeted by ``times``: ``retrain`` (the retrain
+  supervisor's fit raises :class:`~.errors.ChaosRotateFault` —
+  transient, so the classified-retry discipline re-runs it),
+  ``corrupt`` (the next published checkpoint is truncated after its
+  digest was embedded — rotation's re-verify MUST refuse it and keep
+  the last good model), ``mid_swap`` (the installer raises between
+  verify and swap — the rotation must refuse atomically, never leave a
+  half-installed model), and ``verify_ms=<float>`` (the rotation's
+  verify step sleeps this long — serving and ``readyz`` must be
+  unaffected for the whole window).
 
 Injection decisions are pure functions of ``(seed, scope, site)`` —
 never of call order or a global RNG — so a chaos run is reproducible
@@ -71,6 +82,8 @@ _SCOPE_SCHEMA: dict[str, dict[str, type]] = {
     "device": {"drop": int, "times": int},
     "stage": {"fail": str, "times": int},
     "serve": {"p": float, "seed": int, "times": int},
+    "rotate": {"corrupt": bool, "mid_swap": bool, "retrain": bool,
+               "verify_ms": float, "times": int},
 }
 
 _SCOPE_DEFAULTS: dict[str, dict[str, object]] = {
@@ -79,6 +92,8 @@ _SCOPE_DEFAULTS: dict[str, dict[str, object]] = {
     "device": {"drop": 0, "times": 0},  # times=0: every probe
     "stage": {"fail": "", "times": 1},
     "serve": {"p": 0.0, "seed": 0, "times": 1},
+    "rotate": {"corrupt": False, "mid_swap": False, "retrain": False,
+               "verify_ms": 0.0, "times": 1},
 }
 
 
@@ -196,6 +211,14 @@ class ChaosInjector:
         stage = config.scope("stage")
         self._stage_left = int(stage["times"]) if stage else 0
         self._serve_attempts: dict[str, int] = {}
+        rot = config.scope("rotate") or _SCOPE_DEFAULTS["rotate"]
+        self._rotate_left = {
+            kind: (int(rot["times"]) if rot.get(kind) else 0)
+            for kind in ("corrupt", "mid_swap", "retrain")
+        }
+        self._rotate_verify_left = (
+            int(rot["times"]) if float(rot["verify_ms"]) > 0 else 0
+        )
 
     # ── bookkeeping ───────────────────────────────────────────────────
 
@@ -369,6 +392,36 @@ class ChaosInjector:
         self._record("serve", f"req/{rid}", request_id=rid,
                      attempt=attempt)
         return True
+
+    # ── rotate scope ──────────────────────────────────────────────────
+
+    def take_rotate_fault(self, kind: str, site: str) -> bool:
+        """Fleet-rotation injection point: whether this ``kind``
+        (``corrupt`` / ``mid_swap`` / ``retrain``) draws a fault,
+        consuming one unit of its ``times`` budget. The three kinds are
+        budgeted independently so one spec can stack failure modes
+        (``rotate:retrain,corrupt,times=2``)."""
+        with self._lock:
+            if self._rotate_left.get(kind, 0) <= 0:
+                return False
+            self._rotate_left[kind] -= 1
+        self._record("rotate", site, kind=kind)
+        return True
+
+    def rotate_verify_delay_s(self, site: str) -> float:
+        """Slow-verify injection point: seconds the rotation's verify
+        step must sleep (0.0 when the scope is off or the budget is
+        spent). Serving must be provably unaffected for the window."""
+        cfg = self.config.scope("rotate")
+        if cfg is None or float(cfg["verify_ms"]) <= 0:
+            return 0.0
+        with self._lock:
+            if self._rotate_verify_left <= 0:
+                return 0.0
+            self._rotate_verify_left -= 1
+        delay = float(cfg["verify_ms"]) / 1e3
+        self._record("rotate", site, kind="slow_verify", delay_s=delay)
+        return delay
 
     def maybe_fail_stage(self, method: str) -> None:
         """Sweep-stage injection point: raise for the first ``times``

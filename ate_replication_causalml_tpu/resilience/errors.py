@@ -50,6 +50,15 @@ class ChaosServeFault(ChaosFault):
     wrong value, just a bounded retry."""
 
 
+class ChaosRotateFault(ChaosFault):
+    """Injected along the train-to-serve rotation path (``rotate:``
+    scope): a retrain fit that dies, or a fault between a candidate
+    checkpoint's verify and its swap. Transient by the family contract —
+    the retrain supervisor's classified retry re-runs a dead fit, and a
+    mid-swap fault must become a typed rotation refusal (last good model
+    kept), never a half-installed one."""
+
+
 class ChaosSpecError(ValueError):
     """The ``ATE_TPU_CHAOS`` spec string does not parse. A ValueError —
     a malformed chaos config is a programming error, fatal-fast, never

@@ -40,6 +40,12 @@ from ate_replication_causalml_tpu.serving.coalescer import (
     Coalescer,
     PendingRequest,
 )
+from ate_replication_causalml_tpu.serving.fleet import (
+    BurnShedder,
+    ModelFleet,
+    ModelLifecycle,
+    parse_fleet_spec,
+)
 from ate_replication_causalml_tpu.serving.protocol import (
     ProtocolError,
     encode_frame,
@@ -49,11 +55,13 @@ from ate_replication_causalml_tpu.serving.protocol import (
 )
 
 __all__ = [
-    "AdmissionController", "Batch", "BucketPlan", "CateClient",
-    "CateServer", "Coalescer", "InvalidTransition", "PendingRequest",
-    "ProtocolError", "RejectedRequest", "ReloadSupervisor", "ServeConfig",
-    "ServingError", "ServingLifecycle", "ServingUnavailable",
-    "decode_frame", "encode_frame", "read_frame", "write_frame",
+    "AdmissionController", "Batch", "BucketPlan", "BurnShedder",
+    "CateClient", "CateServer", "Coalescer", "InvalidTransition",
+    "ModelFleet", "ModelLifecycle", "PendingRequest", "ProtocolError",
+    "RejectedRequest", "ReloadSupervisor", "RetrainSupervisor",
+    "ServeConfig", "ServingError", "ServingLifecycle",
+    "ServingUnavailable", "decode_frame", "encode_frame",
+    "parse_fleet_spec", "read_frame", "write_frame",
 ]
 
 
@@ -64,4 +72,8 @@ def __getattr__(name):
         from ate_replication_causalml_tpu.serving import daemon
 
         return getattr(daemon, name)
+    if name == "RetrainSupervisor":
+        from ate_replication_causalml_tpu.serving import retrain
+
+        return retrain.RetrainSupervisor
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

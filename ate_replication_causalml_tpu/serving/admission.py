@@ -153,8 +153,9 @@ class ServingLifecycle:
 
 
 class ReloadSupervisor:
-    """Owns degraded-mode recovery: one reload at a time, verified
-    before swap, failure stays degraded.
+    """Owns degraded-mode recovery AND zero-downtime rotation: one
+    reload/rotation at a time, verified before swap, failure stays on
+    the last good model.
 
     ``reload_fn`` re-loads AND re-verifies the model source (the
     daemon wires the SHA-256-verified ``load_fitted``); ``on_reloaded``
@@ -163,6 +164,13 @@ class ReloadSupervisor:
     (deterministic tests); the daemon uses a background thread so the
     request path only ever sees typed rejects, never a reload's
     latency.
+
+    :meth:`rotate` (ISSUE 11) shares the same single-flight claim —
+    a rotation can never race a degraded-mode reload into two
+    concurrent installs — but differs in failure semantics: a reload
+    failure STAYS degraded (the served model was already suspect); a
+    rotation refusal keeps SERVING on the last good model (the served
+    model was never the problem — only the candidate was).
     """
 
     def __init__(
@@ -186,6 +194,10 @@ class ReloadSupervisor:
         self._running = False
         self._counter = _registry.counter(
             "serving_reloads_total", "degraded-mode reload attempts by status"
+        )
+        self._rotations = _registry.counter(
+            "serving_rotations_total",
+            "checkpoint hot-swap rotations by model and status",
         )
 
     def _try_begin(self) -> bool:
@@ -229,6 +241,7 @@ class ReloadSupervisor:
             t.join(timeout)
 
     def _run(self, reason: str) -> None:
+        recovered = False
         try:
             with _events.span("serving_reload", reason=reason) as sp:
                 try:
@@ -249,9 +262,18 @@ class ReloadSupervisor:
                     return
                 self._counter.inc(1, status="reloaded")
                 self._lifecycle.mark_recovered()
+                recovered = True
         finally:
             with self._lock:
                 self._running = False
+            # A fault reported between mark_recovered and the claim
+            # release found the lifecycle SERVING (it owns recovery)
+            # but the claim still held (its launch coalesced into
+            # nothing) — pick that orphaned recovery up now. Only
+            # after a SUCCESSFUL run: a failed reload staying degraded
+            # without relaunching is the deliberate refusal contract.
+            if recovered and self._lifecycle.state == DEGRADED:
+                self.retry()
 
     def retry(self) -> bool:
         """Explicitly retry a failed recovery (an operator action or a
@@ -270,3 +292,71 @@ class ReloadSupervisor:
             return False
         self._launch("retry")
         return True
+
+    def rotate(
+        self,
+        loader: Callable[[], object],
+        installer: Callable[[object], None] | None = None,
+        reason: str = "rotate",
+        model: str = "",
+    ) -> str:
+        """Zero-downtime verified hot-swap (ISSUE 11). Runs on the
+        CALLING thread (rotation callers are the retrain supervisor or
+        an operator op — never the request path): ``loader`` loads and
+        re-verifies the candidate checkpoint, ``installer`` (default
+        ``on_reloaded``) swaps it in atomically. Returns a status
+        string:
+
+        * ``"rotated"`` — verified and installed; new admissions bind
+          the new model, in-flight batches complete against the old
+          reference, and the lifecycle never leaves SERVING (a
+          rotation that lands while DEGRADED doubles as recovery).
+        * ``"refused"`` — the candidate failed verification (corrupt
+          digest, changed geometry, a fault mid-swap): NOTHING was
+          installed and the last good model keeps serving. A corrupt
+          published checkpoint can never rotate into service.
+        * ``"busy"`` — another reload/rotation holds the single-flight
+          claim; the caller retries later. One reload, one verify.
+        """
+        install = installer if installer is not None else self._on_reloaded
+        if not self._try_begin():
+            self._rotations.inc(1, model=model, status="busy")
+            _events.emit("serving_rotation_busy", status="error",
+                         model=model, reason=reason)
+            return "busy"
+        try:
+            with _events.span("serving_rotation", reason=reason,
+                              model=model) as sp:
+                try:
+                    obj = loader()
+                    install(obj)
+                except Exception as e:
+                    # Typed refusal: last good model keeps serving, the
+                    # lifecycle is untouched (rotation is not a fault).
+                    sp.set_status("error")
+                    self._rotations.inc(1, model=model, status="refused")
+                    _events.emit(
+                        "serving_rotation_refused", status="error",
+                        model=model, reason=reason,
+                        error=f"{type(e).__name__}: {e}",
+                    )
+                    return "refused"
+                self._rotations.inc(1, model=model, status="rotated")
+                # The swap instant: rendered as an instant marker on
+                # the rotating thread's trace track.
+                _events.emit("serving_rotated", status="ok", model=model,
+                             reason=reason)
+                if self._lifecycle.state == DEGRADED:
+                    self._lifecycle.mark_recovered()
+                return "rotated"
+        finally:
+            with self._lock:
+                self._running = False
+            # A fault reported WHILE this rotation held the claim owned
+            # recovery but could not launch it (its report coalesced
+            # into the rotation, and a refused rotation does not
+            # recover anything). Orphaned-degraded here would otherwise
+            # persist until an operator retry — launch the reload now
+            # that the claim is free.
+            if self._lifecycle.state == DEGRADED:
+                self.retry()

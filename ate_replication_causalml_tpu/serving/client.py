@@ -7,11 +7,22 @@ Speaks the length-prefixed protocol over either transport:
 * subprocess stdio — :meth:`CateClient.spawn_stdio` (hermetic tests and
   one-shot tooling: the client owns the daemon's lifetime).
 
-Typed rejects (``overloaded`` / ``serve_fault`` / ``degraded``) are
-retried after the server's ``retry_after_s`` hint under the SAME
-request id — ids are the client's idempotency key: the chaos harness
-selects faults by id, so a retrying client converges deterministically
-and a chaos run's final answers are bit-identical to a fault-free run.
+Typed rejects (``overloaded`` / ``serve_fault`` / ``degraded`` /
+``model_degraded`` / ``shed``) are retried under the SAME request id —
+ids are the client's idempotency key: the chaos harness selects faults
+by id, so a retrying client converges deterministically and a chaos
+run's final answers are bit-identical to a fault-free run.
+
+Backoff honors the server's typed ``retry_after_s`` hint as the BASE of
+the PR 3 discipline rather than a fixed sleep: exponential in the
+attempt, deterministic crc32 jitter keyed on ``(request_id, code,
+attempt)`` (retries de-herd across clients with zero nondeterminism —
+tests assert the exact schedule), capped at
+:data:`BACKOFF_CAP_MULT` × hint and at the absolute
+:attr:`CateClient.max_backoff_s`. Every absorbed reject and every
+backoff second is metered on the client (``retry_counts`` /
+``backoff_s_total``) so ``reject_retries == {}`` in a loadgen record
+can be trusted.
 """
 
 from __future__ import annotations
@@ -23,7 +34,28 @@ import time
 
 import numpy as np
 
+from ate_replication_causalml_tpu.resilience.backoff import (
+    BACKOFF_CAP_MULT,
+    jittered_backoff_delay,
+)
 from ate_replication_causalml_tpu.serving import protocol
+
+__all__ = ["BACKOFF_CAP_MULT", "CateClient", "ServingError",
+           "ServingUnavailable", "retry_backoff_delay"]
+
+
+def retry_backoff_delay(request_id: str, code: str, attempt: int,
+                        hint_s: float, cap_s: float = 2.0) -> float:
+    """Deterministic client backoff before retry ``attempt`` of a typed
+    reject: ``hint_s`` grows exponentially per attempt with a crc32
+    jitter in [0, 25%), capped at ``BACKOFF_CAP_MULT × hint_s`` and at
+    ``cap_s`` absolute. A pure function of its arguments — the same
+    retrying request sleeps the same schedule every run. One formula,
+    shared with the shard runner and the retrain supervisor
+    (``resilience/backoff.py``)."""
+    return jittered_backoff_delay(
+        f"{request_id}|{code}|{attempt}", attempt, hint_s, cap_s=cap_s
+    )
 
 
 class ServingError(RuntimeError):
@@ -42,8 +74,12 @@ class ServingUnavailable(ServingError):
         self.attempts = attempts
 
 
-#: Reject codes worth retrying after the server's hint.
-RETRYABLE = ("overloaded", "serve_fault", "degraded", "starting")
+#: Reject codes worth retrying after the server's hint. The fleet
+#: codes (ISSUE 11): ``model_degraded`` is one tenant's recovery
+#: window, ``shed`` is SLO-burn backpressure — both clear; unknown or
+#: retired model ids are terminal and raise.
+RETRYABLE = ("overloaded", "serve_fault", "degraded", "starting",
+             "model_degraded", "shed")
 
 
 class CateClient:
@@ -60,6 +96,11 @@ class CateClient:
         #: into its record; an operator reading reject_retries == {}
         #: must be able to trust it).
         self.retry_counts: dict[str, int] = {}
+        #: seconds slept in typed-reject backoff (metered, like the
+        #: shard runner's backoff counter).
+        self.backoff_s_total: float = 0.0
+        #: absolute backoff ceiling per sleep.
+        self.max_backoff_s: float = 2.0
 
     @classmethod
     def connect(cls, host: str, port: int, timeout: float = 10.0
@@ -98,23 +139,30 @@ class CateClient:
             raise ServingError("closed", "server closed the connection")
         return frame
 
-    def predict(
+    def predict_full(
         self,
         x: np.ndarray,
         request_id: str | None = None,
         max_retries: int = 16,
-    ) -> tuple[np.ndarray, np.ndarray]:
-        """``(cate, variance)`` for the rows of ``x``. Retryable rejects
-        honor the server's retry-after under the same id; anything else
+        model: str | None = None,
+    ) -> tuple[np.ndarray, np.ndarray, dict]:
+        """``(cate, variance, reply_header)`` for the rows of ``x`` —
+        the header carries the ``model`` / ``model_version`` that
+        actually served the request (the bit-identity partition key
+        across a hot-swap). ``model`` routes to a fleet entry (None =
+        the daemon's default model). Retryable rejects back off on the
+        server's retry-after hint with deterministic crc32 jitter
+        (:func:`retry_backoff_delay`) under the same id; anything else
         raises :class:`ServingError` typed with the wire code."""
         rid = str(request_id) if request_id is not None else f"c{next(self._seq)}"
         x = np.ascontiguousarray(x, dtype=np.float32)
+        request: dict = {"op": "predict", "id": rid}
+        if model is not None:
+            request["model"] = model
         for attempt in range(1, max_retries + 2):
-            header, arrays = self._roundtrip(
-                {"op": "predict", "id": rid}, {"x": x}
-            )
+            header, arrays = self._roundtrip(request, {"x": x})
             if header.get("ok"):
-                return arrays["cate"], arrays["variance"]
+                return arrays["cate"], arrays["variance"], header
             code = header.get("error", "error")
             if code not in RETRYABLE or attempt > max_retries:
                 if code in RETRYABLE:
@@ -123,8 +171,27 @@ class CateClient:
                     )
                 raise ServingError(code, header.get("message", ""))
             self.retry_counts[code] = self.retry_counts.get(code, 0) + 1
-            time.sleep(float(header.get("retry_after_s", 0.05)))
+            delay = retry_backoff_delay(
+                rid, code, attempt,
+                float(header.get("retry_after_s", 0.05)),
+                cap_s=self.max_backoff_s,
+            )
+            self.backoff_s_total += delay
+            time.sleep(delay)
         raise AssertionError("unreachable")
+
+    def predict(
+        self,
+        x: np.ndarray,
+        request_id: str | None = None,
+        max_retries: int = 16,
+        model: str | None = None,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """:meth:`predict_full` without the reply header."""
+        cate, var, _ = self.predict_full(
+            x, request_id=request_id, max_retries=max_retries, model=model
+        )
+        return cate, var
 
     def ping(self) -> dict:
         header, _ = self._roundtrip({"op": "ping"})
@@ -150,6 +217,26 @@ class CateClient:
             raise ServingError(header.get("error", "error"),
                                header.get("message", ""))
         return list(header.get("paths", ()))
+
+    def rotate(self, checkpoint: str, model: str | None = None) -> str:
+        """Ask the daemon for a zero-downtime hot-swap of ``model``
+        (None = default) onto ``checkpoint``. Returns the rotation
+        status (``rotated`` / ``refused`` / ``busy`` /
+        ``unknown_model``) — a refusal keeps the last good model
+        serving, by contract."""
+        request: dict = {"op": "rotate", "checkpoint": checkpoint}
+        if model is not None:
+            request["model"] = model
+        header, _ = self._roundtrip(request)
+        if "status" not in header:
+            raise ServingError(header.get("error", "error"),
+                               header.get("message", ""))
+        return str(header["status"])
+
+    def retire(self, model: str) -> bool:
+        """Retire a fleet model; returns whether the id existed."""
+        header, _ = self._roundtrip({"op": "retire", "model": model})
+        return bool(header.get("ok"))
 
     def shutdown(self) -> None:
         """Ask the daemon to exit (acknowledged before it stops)."""

@@ -108,14 +108,21 @@ class PendingRequest:
         "request_id", "x", "rows", "enqueued_mono", "resolved_mono",
         "batch_closed_mono", "picked_mono", "device_start_mono",
         "device_end_mono", "batch_seq", "batch_bucket", "batch_fill",
-        "result", "error", "_done",
+        "model", "model_version", "result", "error", "_done",
     )
 
-    def __init__(self, request_id: str, x, rows: int, enqueued_mono: float):
+    def __init__(self, request_id: str, x, rows: int, enqueued_mono: float,
+                 model: str = ""):
         self.request_id = request_id
         self.x = x
         self.rows = rows
         self.enqueued_mono = enqueued_mono
+        #: fleet routing (ISSUE 11): the model id the request bound at
+        #: admission, and the model VERSION the dispatcher actually
+        #: served it with — the bit-identity partition key across a
+        #: hot-swap (old forest before the swap instant, new after).
+        self.model = model
+        self.model_version: int | None = None
         self.resolved_mono: float | None = None
         self.batch_closed_mono: float | None = None
         self.picked_mono: float | None = None
@@ -167,7 +174,9 @@ class Batch(NamedTuple):
     """A closed batch: the requests, their real row total, the compiled
     bucket it rides, the fill ratio the metrics report, plus the close
     bookkeeping (reason, clock reading, sequence number) the lifecycle
-    decomposition and the serving trace are built from."""
+    decomposition and the serving trace are built from. ``model`` is
+    the fleet routing key — a batch is model-pure by construction (one
+    padded matrix dispatches against ONE forest)."""
 
     requests: tuple[PendingRequest, ...]
     rows: int
@@ -176,6 +185,7 @@ class Batch(NamedTuple):
     close_reason: str = "bucket_full"
     closed_mono: float = 0.0
     seq: int = 0
+    model: str = ""
 
 
 class Coalescer:
@@ -232,47 +242,60 @@ class Coalescer:
     # ── batch math ───────────────────────────────────────────────────
 
     def _pack_due(self, now: float) -> Batch | None:
-        """Close a batch if one is due. The FIFO prefix that fits the
-        largest bucket is the candidate; it closes when (a) it IS the
-        largest bucket, (b) the next waiter would not fit (flushing
-        beats head-of-line blocking), (c) the oldest waiter's window
-        expired, or (d) the coalescer is draining. Re-acquires the
-        condition (an RLock underneath), so it is safe both from
-        :meth:`next_batch` and standalone in tests. The close reason is
-        recorded in precedence order (a batch that is both full and
-        expired closed because it was full)."""
+        """Close a batch if one is due. Batches are MODEL-PURE (fleet
+        routing, ISSUE 11): the candidate is the FIFO prefix *of one
+        model's waiters* that fits the largest bucket, with models
+        visited in order of their oldest waiter — so a slow tenant's
+        window wait never delays another tenant's full bucket. A
+        candidate closes when (a) it IS the largest bucket, (b) that
+        model's next waiter would not fit (flushing beats head-of-line
+        blocking), (c) the model's oldest waiter's window expired, or
+        (d) the coalescer is draining. Re-acquires the condition (an
+        RLock underneath), so it is safe both from :meth:`next_batch`
+        and standalone in tests. The close reason is recorded in
+        precedence order (a batch that is both full and expired closed
+        because it was full). With a single model this reduces exactly
+        to the pre-fleet FIFO behavior."""
         with self._cond:
-            take: list[PendingRequest] = []
-            total = 0
-            for req in self._pending:
-                if total + req.rows > self.plan.max_rows:
-                    break
-                take.append(req)
-                total += req.rows
-            if not take:
-                return None
-            expired = now - take[0].enqueued_mono >= self.window_s
-            if total == self.plan.max_rows:
-                reason = "bucket_full"
-            elif len(take) < len(self._pending):
-                reason = "next_wont_fit"
-            elif expired:
-                reason = "window_expired"
-            elif self._closed:
-                reason = "drain"
-            else:
-                return None
-            del self._pending[: len(take)]
-            bucket = self.plan.bucket_for(total)
-            batch = Batch(tuple(take), total, bucket, total / bucket,
-                          close_reason=reason, closed_mono=now,
-                          seq=next(self._seq))
-            for req in take:
-                req.batch_closed_mono = now
-                req.batch_seq = batch.seq
-                req.batch_bucket = bucket
-                req.batch_fill = batch.fill
-            return batch
+            visited: list[str] = []
+            for head in self._pending:
+                if head.model in visited:
+                    continue
+                visited.append(head.model)
+                group = [r for r in self._pending if r.model == head.model]
+                take: list[PendingRequest] = []
+                total = 0
+                for req in group:
+                    if total + req.rows > self.plan.max_rows:
+                        break
+                    take.append(req)
+                    total += req.rows
+                expired = now - take[0].enqueued_mono >= self.window_s
+                if total == self.plan.max_rows:
+                    reason = "bucket_full"
+                elif len(take) < len(group):
+                    reason = "next_wont_fit"
+                elif expired:
+                    reason = "window_expired"
+                elif self._closed:
+                    reason = "drain"
+                else:
+                    continue  # this model's waiters are not due yet
+                taken = set(map(id, take))
+                self._pending = [
+                    r for r in self._pending if id(r) not in taken
+                ]
+                bucket = self.plan.bucket_for(total)
+                batch = Batch(tuple(take), total, bucket, total / bucket,
+                              close_reason=reason, closed_mono=now,
+                              seq=next(self._seq), model=head.model)
+                for req in take:
+                    req.batch_closed_mono = now
+                    req.batch_seq = batch.seq
+                    req.batch_bucket = bucket
+                    req.batch_fill = batch.fill
+                return batch
+            return None
 
     def next_batch(self, timeout: float | None = None) -> Batch | None:
         """Dispatcher entry: block until a batch closes, the coalescer

@@ -56,6 +56,27 @@ The observability plane (ISSUE 7) rides the same machinery:
 
 None of it traces or compiles jax — the zero-compile window assertion
 in :meth:`CateServer.stop` holds with the whole plane active.
+
+The train-to-serve fleet layer (ISSUE 11) rides on top:
+
+* many models (``ATE_TPU_SERVE_FLEET``), routed by the request
+  header's ``model`` field; same-shape models share one AOT executable
+  set (the forest is a runtime argument), unknown/retired ids get
+  typed rejects, and each model carries its own lifecycle — one
+  tenant's degradation never 503s another;
+* zero-downtime rotation (:meth:`CateServer.rotate`, the ``rotate``
+  wire op, and the retrain supervisor in :mod:`.retrain`): the
+  candidate checkpoint is SHA-256 re-verified and geometry-checked,
+  then swapped atomically — in-flight batches complete against the
+  old forest, the next dispatch binds the new one, ``readyz`` stays
+  200 throughout, and a corrupt candidate is a typed refusal that
+  keeps the last good model serving;
+* SLO-burn-driven shedding: per-model multi-window burn rates from the
+  SLO engine gate admission (typed ``shed`` rejects with retry-after)
+  instead of one global depth alone.
+
+The ``rotate:`` chaos scope (corrupt candidate, fault mid-swap,
+retrain failure, slow verify) proves every refusal path in tier-1.
 """
 
 from __future__ import annotations
@@ -73,6 +94,7 @@ from ate_replication_causalml_tpu.observability.slo import (
     DEFAULT_WINDOWS,
     SLOEngine,
     default_serving_slos,
+    fleet_slos,
 )
 from ate_replication_causalml_tpu.resilience import chaos
 from ate_replication_causalml_tpu.serving import protocol
@@ -87,6 +109,11 @@ from ate_replication_causalml_tpu.serving.coalescer import (
     Coalescer,
     PendingRequest,
 )
+from ate_replication_causalml_tpu.serving.fleet import (
+    BurnShedder,
+    ModelFleet,
+    parse_fleet_spec,
+)
 
 ENV_BUCKETS = "ATE_TPU_SERVE_BUCKETS"
 ENV_WINDOW_MS = "ATE_TPU_SERVE_WINDOW_MS"
@@ -94,12 +121,22 @@ ENV_DEPTH = "ATE_TPU_SERVE_DEPTH"
 ENV_RETRY_AFTER_MS = "ATE_TPU_SERVE_RETRY_AFTER_MS"
 ENV_ADMIN_PORT = "ATE_TPU_SERVE_ADMIN_PORT"
 ENV_SLO_MS = "ATE_TPU_SERVE_SLO_MS"
+ENV_FLEET = "ATE_TPU_SERVE_FLEET"
+ENV_SHED_BURN = "ATE_TPU_SERVE_FLEET_SHED_BURN"
 
 DEFAULT_BUCKETS = "1,8,64,256"
 DEFAULT_WINDOW_MS = 2.0
 DEFAULT_DEPTH = 64
 DEFAULT_RETRY_AFTER_MS = 50.0
 DEFAULT_SLO_LATENCY_MS = 250.0
+
+#: the model id requests without a ``model`` header route to — the
+#: ``--checkpoint`` model every pre-fleet client already speaks to.
+DEFAULT_MODEL = "default"
+
+#: how often the dispatcher refreshes the shedder's burn cache (full
+#: SLO evaluation — throttled off the per-batch path).
+SHED_REFRESH_S = 0.25
 
 
 class RejectedRequest(RuntimeError):
@@ -141,6 +178,14 @@ class ServeConfig:
     slo_latency_s: float = DEFAULT_SLO_LATENCY_MS / 1e3
     #: multi-window burn-rate ladder (ascending; see observability/slo).
     slo_windows_s: tuple[float, ...] = DEFAULT_WINDOWS
+    #: extra served models (ISSUE 11): ``(model_id, checkpoint)`` pairs
+    #: beyond the ``checkpoint`` field (which serves as DEFAULT_MODEL).
+    #: Same-shape fleets share one AOT executable set.
+    fleet: tuple[tuple[str, str], ...] = ()
+    #: SLO-burn-driven per-model shedding threshold: a model sheds new
+    #: admissions (typed ``shed`` reject) while its two fastest burn
+    #: windows both exceed this. <= 0 disables shedding.
+    shed_burn_threshold: float = 0.0
 
     @classmethod
     def from_env(cls, checkpoint: str, **overrides) -> "ServeConfig":
@@ -155,11 +200,23 @@ class ServeConfig:
             slo_latency_s=float(
                 env.get(ENV_SLO_MS, DEFAULT_SLO_LATENCY_MS)
             ) / 1e3,
+            fleet=parse_fleet_spec(env.get(ENV_FLEET, "")),
+            shed_burn_threshold=float(env.get(ENV_SHED_BURN, 0.0)),
         )
         if env.get(ENV_ADMIN_PORT):
             base["admin_port"] = int(env[ENV_ADMIN_PORT])
         base.update(overrides)
         return cls(checkpoint=checkpoint, **base)
+
+    @property
+    def model_ids(self) -> tuple[str, ...]:
+        """Every served model id, DEFAULT_MODEL first."""
+        ids = (DEFAULT_MODEL,) + tuple(m for m, _ in self.fleet)
+        if len(set(ids)) != len(ids):
+            raise ValueError(
+                f"fleet model ids collide with {DEFAULT_MODEL!r}: {ids}"
+            )
+        return ids
 
 
 class CateServer:
@@ -180,9 +237,14 @@ class CateServer:
         self.admission = AdmissionController(config.max_depth)
         self.coalescer = Coalescer(config.buckets, config.window_s)
         self._lock = threading.RLock()
-        self._model = None
-        self._executables: dict[int, object] = {}
-        self._n_features: int | None = None
+        #: the fleet routing table (ISSUE 11): model id -> entry with
+        #: the forest reference, version, geometry signature and the
+        #: per-model lifecycle + reload/rotation supervisor.
+        self.fleet = ModelFleet()
+        #: AOT executables keyed by (geometry signature, bucket) —
+        #: same-shape models share, because the forest is a RUNTIME
+        #: argument of the lowered predict.
+        self._executables: dict[tuple, object] = {}
         # None until startup completes: a daemon stopped before its
         # warm phase has no serving window to enforce.
         self._compile_mark: float | None = None
@@ -190,15 +252,30 @@ class CateServer:
         self._dispatcher: threading.Thread | None = None
         # Everything the serving trace exports is filtered to records
         # at/after this mark — the event log is a process-global ring
-        # shared with whatever ran before the daemon.
+        # shared with whatever ran before the daemon. The phase-count
+        # mark (set at startup) is the metrics-side twin for the
+        # reconciliation's baseline.
         self._born_mono = time.monotonic()
+        self._phase_mark = 0
+        # The daemon-wide reloader: serve-scope faults degrade the
+        # WHOLE daemon (readyz 503) and re-verify the default model's
+        # checkpoint — the pre-fleet contract. Per-MODEL faults go
+        # through each entry's own supervisor instead and never touch
+        # this lifecycle.
         self._reloader = ReloadSupervisor(
             self.lifecycle, self._load_checkpoint, self._install_model
         )
-        self.slo = SLOEngine(default_serving_slos(
-            latency_threshold_s=config.slo_latency_s,
-            windows_s=config.slo_windows_s,
-        ))
+        self.slo = SLOEngine(
+            default_serving_slos(
+                latency_threshold_s=config.slo_latency_s,
+                windows_s=config.slo_windows_s,
+            )
+            + fleet_slos(config.model_ids, windows_s=config.slo_windows_s)
+        )
+        self._shedder = BurnShedder(
+            self.slo, threshold=config.shed_burn_threshold
+        )
+        self._shed_next_update = float("-inf")
         self._admin = None
         self._sampler: obs.MetricSampler | None = None
         self._requests = obs.counter(
@@ -238,51 +315,125 @@ class CateServer:
             "padded fraction of dispatched bucket rows (1 - fill)",
             bounds=(0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
         )
+        # Fleet routing outcomes (ISSUE 11): every terminal, per model —
+        # the family the per-model SLOs and the shedder read.
+        self._fleet_requests = obs.counter(
+            "serving_fleet_requests_total",
+            "fleet-routed serving requests by model and terminal status",
+        )
 
     # ── startup ──────────────────────────────────────────────────────
 
-    def _load_checkpoint(self):
+    def _load_forest(self, path: str):
         """SHA-256-verified model load; accepts a ``FittedCausalForest``
         or a bare ``CausalForest`` checkpoint. Raises
         ``CheckpointCorrupt`` (startup: refuse to serve; degraded
-        reload: stay degraded) on any integrity failure."""
+        reload: stay degraded; rotation: refuse the candidate) on any
+        integrity failure."""
         from ate_replication_causalml_tpu.models.causal_forest import (
             CausalForest,
             FittedCausalForest,
         )
         from ate_replication_causalml_tpu.utils.checkpoint import load_fitted
 
-        obj = load_fitted(self.config.checkpoint, verify=True)
+        obj = load_fitted(path, verify=True)
         forest = obj.forest if isinstance(obj, FittedCausalForest) else obj
         if not isinstance(forest, CausalForest):
             raise TypeError(
-                f"checkpoint {self.config.checkpoint!r} holds "
+                f"checkpoint {path!r} holds "
                 f"{type(obj).__name__}, not a causal forest"
             )
         return forest
 
+    def _load_checkpoint(self):
+        """The daemon-wide reloader's reload_fn: re-verify the DEFAULT
+        model's LAST GOOD checkpoint — the fleet entry's, which a
+        rotation advances. Re-loading the startup ``config.checkpoint``
+        here would silently roll a rotated default model back to its
+        pre-rotation bytes on the next degraded recovery."""
+        entry = self.fleet.get(DEFAULT_MODEL)
+        path = (
+            entry.checkpoint if entry is not None
+            else self.config.checkpoint
+        )
+        return self._load_forest(path)
+
+    @staticmethod
+    def _forest_signature(forest) -> tuple:
+        """The geometry key AOT executables are shared under: the full
+        pytree structure plus every leaf's (shape, dtype) — exactly the
+        avals a compiled executable accepts. Same signature ⇒ same
+        executable set; a candidate with a different signature needs a
+        re-AOT, which rotation refuses."""
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(forest)
+        return (
+            str(treedef),
+            tuple(
+                (tuple(np.shape(l)), str(np.asarray(l).dtype))
+                for l in leaves
+            ),
+        )
+
     def _install_model(self, forest) -> None:
-        """Swap the served model (startup and verified reloads). The
-        executables are keyed to the forest's SHAPES — a reload with a
-        different geometry would need a re-AOT, which degraded mode
-        refuses (same-shape redeploys are the supported hot path)."""
-        with self._lock:
-            old = self._model
-            if old is not None and (
-                old.split_feat.shape != forest.split_feat.shape
-                or old.bin_edges.shape != forest.bin_edges.shape
-            ):
+        """Reinstall the DEFAULT model (the daemon-wide degraded reload
+        path): the re-verified LAST GOOD bytes go back in WITHOUT a
+        version bump — a recovery is not a rotation, and the reply's
+        ``model_version`` partitions bit-identity across rotations
+        only. The executables are keyed to the forest's SHAPES — a
+        reload with a different geometry would need a re-AOT, which
+        degraded mode refuses (same-shape redeploys are the hot
+        path)."""
+        entry = self.fleet.get(DEFAULT_MODEL)
+        if entry is None:
+            raise RuntimeError("default model was never installed")
+        sig = self._forest_signature(forest)
+        if sig != entry.sig:
+            raise ValueError(
+                "reloaded checkpoint changed forest geometry "
+                f"for model {DEFAULT_MODEL!r}; restart the daemon to re-AOT"
+            )
+        self.fleet.reinstall(DEFAULT_MODEL, forest)
+
+    def _wire_model_supervisor(self, entry) -> None:
+        """Per-model degraded recovery (ISSUE 11): a model-scoped fault
+        re-verifies and reloads that model's LAST GOOD checkpoint in
+        the background while only that model's requests are refused
+        typed — one tenant's degradation never 503s another.
+
+        The DEFAULT model keeps the daemon-wide reloader as its ONE
+        supervisor: its faults degrade the whole daemon (the pre-fleet
+        contract — readyz 503), and, critically, its rotations share
+        that reloader's single-flight claim, so a global degraded
+        reload and a default-model rotation can never race two
+        installs into the same entry."""
+        if entry.model_id == DEFAULT_MODEL:
+            entry.supervisor = self._reloader
+            return
+
+        def reload_last_good():
+            forest = self._load_forest(entry.checkpoint)
+            if self._forest_signature(forest) != entry.sig:
                 raise ValueError(
-                    "reloaded checkpoint changed forest geometry "
-                    f"({old.split_feat.shape} -> {forest.split_feat.shape}); "
-                    "restart the daemon to re-AOT"
+                    f"model {entry.model_id!r} last-good checkpoint "
+                    "changed geometry on reload"
                 )
-            self._model = forest
-            self._n_features = int(forest.bin_edges.shape[0])
+            return forest
+
+        def reinstall(forest):
+            self.fleet.reinstall(entry.model_id, forest)
+
+        entry.supervisor = ReloadSupervisor(
+            entry.lifecycle, reload_last_good, reinstall
+        )
 
     def startup(self) -> dict[str, float]:
         """Run the three startup phases; returns their seconds (also
-        exported as ``serving_startup_seconds{phase=}`` gauges)."""
+        exported as ``serving_startup_seconds{phase=}`` gauges). With a
+        fleet configured, *load* verifies and installs every model and
+        *aot*/*warm* run once per DISTINCT geometry signature — a
+        same-shape fleet pays for one executable set."""
         from ate_replication_causalml_tpu.models.causal_forest import (
             lower_predict_cate,
         )
@@ -290,38 +441,66 @@ class CateServer:
         obs.install_jax_monitoring()
         import jax
 
+        # Reconciliation baseline (ISSUE 11): the phase histogram is
+        # process-global, but this daemon's trace window starts here —
+        # requests decomposed by an EARLIER daemon in the same process
+        # must not be misreported as this session's silent drops. The
+        # mark rides the exported trace's otherData so the analyzer
+        # subtracts the same baseline.
+        with self._lock:
+            self._phase_mark = self._phase_device_count()
         phases: dict[str, float] = {}
-        with obs.span("serving_startup", checkpoint=self.config.checkpoint):
+        specs = [(DEFAULT_MODEL, self.config.checkpoint)]
+        specs += list(self.config.fleet)
+        with obs.span("serving_startup", checkpoint=self.config.checkpoint,
+                      models=",".join(m for m, _ in specs)):
             t0 = time.perf_counter()
             with obs.span("serving_load"):
-                self._install_model(self._load_checkpoint())
+                for model_id, path in specs:
+                    forest = self._load_forest(path)
+                    entry = self.fleet.install(
+                        model_id, forest, self._forest_signature(forest),
+                        int(forest.bin_edges.shape[0]), path,
+                    )
+                    self._wire_model_supervisor(entry)
             phases["load"] = time.perf_counter() - t0
 
+            # One AOT + warm pass per distinct geometry signature (in
+            # install order), shared by every same-shape model.
+            reps: dict[tuple, object] = {}
+            for model_id, _ in specs:
+                entry = self.fleet.get(model_id)
+                reps.setdefault(entry.sig, entry.forest)
+
             t0 = time.perf_counter()
-            with self._lock:
-                model = self._model
-            for bucket in self.config.buckets.sizes:
-                with obs.span("serving_aot_compile", bucket=bucket):
-                    compiled = lower_predict_cate(
-                        model,
-                        bucket,
-                        oob=False,
-                        tree_chunk=self.config.tree_chunk,
-                        row_backend=self.config.row_backend,
-                        variance_compat=self.config.variance_compat,
-                        donate=self.config.donate,
-                    ).compile()
-                with self._lock:
-                    self._executables[bucket] = compiled
+            for sig, model in reps.items():
+                for bucket in self.config.buckets.sizes:
+                    with obs.span("serving_aot_compile", bucket=bucket):
+                        compiled = lower_predict_cate(
+                            model,
+                            bucket,
+                            oob=False,
+                            tree_chunk=self.config.tree_chunk,
+                            row_backend=self.config.row_backend,
+                            variance_compat=self.config.variance_compat,
+                            donate=self.config.donate,
+                        ).compile()
+                    with self._lock:
+                        self._executables[(sig, bucket)] = compiled
             phases["aot"] = time.perf_counter() - t0
 
             t0 = time.perf_counter()
             with obs.span("serving_warm"):
-                p = self._n_features
-                for bucket in self.config.buckets.sizes:
-                    zeros = jax.device_put(np.zeros((bucket, p), np.float32))
-                    out = self._executables[bucket](model, zeros, None)
-                    np.asarray(out.cate), np.asarray(out.variance)
+                for sig, model in reps.items():
+                    p = int(model.bin_edges.shape[0])
+                    for bucket in self.config.buckets.sizes:
+                        zeros = jax.device_put(
+                            np.zeros((bucket, p), np.float32)
+                        )
+                        out = self._executables[(sig, bucket)](
+                            model, zeros, None
+                        )
+                        np.asarray(out.cate), np.asarray(out.variance)
             phases["warm"] = time.perf_counter() - t0
 
         g = obs.gauge(
@@ -382,22 +561,33 @@ class CateServer:
 
     def _reject(self, code: str, message: str,
                 retry_after_s: float | None = None,
-                request_id: str = "") -> RejectedRequest:
+                request_id: str = "", model: str = "") -> RejectedRequest:
         self._rejects.inc(1, reason=code)
         self._requests.inc(1, status=f"rejected_{code}")
+        if model:
+            # Per-model terminal (ISSUE 11) — the family the fleet SLOs
+            # and the shedder read. Unknown ids are folded into one
+            # label so a hostile client cannot mint label cardinality.
+            self._fleet_requests.inc(
+                1, model=model, status=f"rejected_{code}"
+            )
         # The reject timeline (ISSUE 7): one instant per refusal, so
         # the serving trace/report show WHEN admission pushed back, not
         # just how often. Covers every entry path — serve_one spans and
         # raw submit() callers alike.
         obs.emit("serving_reject", status="error", reason=code,
-                 request_id=str(request_id))
+                 request_id=str(request_id), model=model)
         return RejectedRequest(code, message, retry_after_s)
 
-    def submit(self, request_id: str, x: np.ndarray) -> PendingRequest:
-        """Admission + chaos + coalesce. Returns the pending handle the
+    def submit(self, request_id: str, x: np.ndarray,
+               model: str | None = None) -> PendingRequest:
+        """Admission + routing + chaos + coalesce. ``model`` selects
+        the fleet entry (None/"" routes to DEFAULT_MODEL — the
+        pre-fleet wire contract). Returns the pending handle the
         caller waits on; raises :class:`RejectedRequest` for every typed
         refusal (the protocol layer converts those to reject frames).
         The admission slot is released by the dispatcher on resolve."""
+        model_id = model if model else DEFAULT_MODEL
         try:
             x = np.ascontiguousarray(x, dtype=np.float32)
         except (TypeError, ValueError) as e:
@@ -410,12 +600,32 @@ class CateServer:
         if x.ndim != 2:
             raise self._reject("bad_request", f"x must be 2-D, got {x.shape}",
                                request_id=request_id)
-        with self._lock:
-            p = self._n_features
-        if p is not None and x.shape[1] != p:
+        entry = self.fleet.get(model_id)
+        if entry is None:
+            if not self.fleet.ids():
+                # Nothing installed yet: the daemon is still starting —
+                # a retryable state reject, not an unknown-model typo.
+                state = self.lifecycle.state
+                raise self._reject(
+                    state, f"daemon is {state}",
+                    self.config.retry_after_s, request_id=request_id,
+                )
+            raise self._reject(
+                "unknown_model",
+                f"unknown model {model_id!r} "
+                f"(serving: {', '.join(sorted(self.fleet.ids()))})",
+                request_id=request_id, model="_unknown_",
+            )
+        if entry.lifecycle.state == "retired":
+            raise self._reject(
+                "retired_model", f"model {model_id!r} is retired",
+                request_id=request_id, model=model_id,
+            )
+        p = entry.n_features
+        if x.shape[1] != p:
             raise self._reject(
                 "bad_request", f"x has {x.shape[1]} features, model wants {p}",
-                request_id=request_id,
+                request_id=request_id, model=model_id,
             )
         rows = x.shape[0]
         if rows < 1 or rows > self.config.buckets.max_rows:
@@ -423,7 +633,7 @@ class CateServer:
                 "bad_request",
                 f"rows must be in [1, {self.config.buckets.max_rows}], "
                 f"got {rows} (chunk larger queries client-side)",
-                request_id=request_id,
+                request_id=request_id, model=model_id,
             )
         inj = chaos.active()
         if inj is not None and inj.take_serve_fault(request_id):
@@ -435,6 +645,29 @@ class CateServer:
                 "serve_fault",
                 "injected serving fault; degraded-mode recovery running",
                 self.config.retry_after_s, request_id=request_id,
+                model=model_id,
+            )
+        if not entry.lifecycle.can_serve():
+            # Model-scoped degradation (ISSUE 11): only THIS tenant's
+            # requests are refused; the daemon lifecycle — and readyz —
+            # never flip for a per-model fault.
+            raise self._reject(
+                "model_degraded",
+                f"model {model_id!r} is {entry.lifecycle.state}; "
+                "recovery running",
+                self.config.retry_after_s, request_id=request_id,
+                model=model_id,
+            )
+        if self._shedder.should_shed(model_id):
+            # SLO-burn-driven admission (ISSUE 11): this model's error
+            # budget is burning in both fast windows — shed new load
+            # typed instead of queueing more of it.
+            raise self._reject(
+                "shed",
+                f"model {model_id!r} is shedding load "
+                "(SLO burn over threshold)",
+                self.config.retry_after_s, request_id=request_id,
+                model=model_id,
             )
         if not self.lifecycle.can_serve():
             state = self.lifecycle.state
@@ -442,15 +675,17 @@ class CateServer:
                 "degraded" if state == "degraded" else state,
                 f"daemon is {state}",
                 self.config.retry_after_s, request_id=request_id,
+                model=model_id,
             )
         if not self.admission.try_admit():
             raise self._reject(
                 "overloaded",
                 f"admission queue at max depth {self.config.max_depth}",
                 self.config.retry_after_s, request_id=request_id,
+                model=model_id,
             )
         req = PendingRequest(
-            str(request_id), x, rows, time.monotonic()
+            str(request_id), x, rows, time.monotonic(), model=model_id
         )
         try:
             self.coalescer.submit(req)
@@ -459,19 +694,22 @@ class CateServer:
             raise
         return req
 
-    def serve_one(
-        self, request_id: str, x: np.ndarray, timeout: float | None = 30.0
-    ) -> tuple[np.ndarray, np.ndarray]:
-        """Blocking request path: submit, wait, return
-        ``(cate, variance)`` for exactly the submitted rows. Every call
-        gets a ``serving_request`` span; rejects raise
+    def serve_request(
+        self, request_id: str, x: np.ndarray,
+        timeout: float | None = 30.0, model: str | None = None,
+    ) -> PendingRequest:
+        """Blocking request path: submit, wait, return the resolved
+        :class:`PendingRequest` (result + the model version it was
+        served by — the bit-identity partition key across a hot-swap).
+        Every call gets a ``serving_request`` span; rejects raise
         :class:`RejectedRequest`, dispatch failures re-raise the
         dispatcher's error."""
         with obs.span("serving_request", request_id=str(request_id),
-                      rows=int(np.shape(x)[0]) if np.ndim(x) == 2 else -1
+                      rows=int(np.shape(x)[0]) if np.ndim(x) == 2 else -1,
+                      model=model or DEFAULT_MODEL,
                       ) as sp:
             try:
-                req = self.submit(request_id, x)
+                req = self.submit(request_id, x, model=model)
             except RejectedRequest as rej:
                 sp.set_status("rejected")
                 sp.set_attr("reject", rej.code)
@@ -479,6 +717,11 @@ class CateServer:
             if not req.wait(timeout):
                 sp.set_status("error")
                 self._requests.inc(1, status="timeout")
+                # NOT mirrored into serving_fleet_requests_total: the
+                # dispatcher still resolves this batch later and books
+                # the request's one terminal (ok/error) there — a
+                # second sample here would double-count the request in
+                # the per-model SLO totals the shedder reads.
                 raise TimeoutError(
                     f"request {request_id!r} not served in {timeout}s"
                 )
@@ -508,7 +751,18 @@ class CateServer:
                 sp.set_attr("batch_seq", req.batch_seq)
                 sp.set_attr("bucket", req.batch_bucket)
                 sp.set_attr("pad_fraction", round(1.0 - req.batch_fill, 6))
-            return req.result
+                sp.set_attr("model_version", req.model_version)
+            return req
+
+    def serve_one(
+        self, request_id: str, x: np.ndarray,
+        timeout: float | None = 30.0, model: str | None = None,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """:meth:`serve_request`, returning just ``(cate, variance)``
+        for exactly the submitted rows."""
+        return self.serve_request(
+            request_id, x, timeout=timeout, model=model
+        ).result
 
     # ── dispatch (the single device-owning thread) ───────────────────
 
@@ -525,15 +779,22 @@ class CateServer:
         import jax
 
         picked = time.monotonic()
+        # The bind instant (ISSUE 11): ONE consistent (forest, version)
+        # read per batch. A hot-swap landing after this keeps the old
+        # reference alive until the batch resolves — in-flight batches
+        # complete against the forest they bound; the next batch binds
+        # the new one.
+        entry = self.fleet.get(batch.model)
+        model, version = self.fleet.binding(batch.model)
         with self._lock:
-            model = self._model
-            compiled = self._executables[batch.bucket]
-            p = self._n_features
+            compiled = self._executables[(entry.sig, batch.bucket)]
+        p = entry.n_features
         now = time.monotonic
         with obs.span("serving_batch", bucket=batch.bucket,
                       rows=batch.rows, requests=len(batch.requests),
                       seq=batch.seq, close_reason=batch.close_reason,
-                      fill=round(batch.fill, 6)):
+                      fill=round(batch.fill, 6), model=batch.model,
+                      model_version=version):
             try:
                 padded = np.zeros((batch.bucket, p), np.float32)
                 off = 0
@@ -548,13 +809,21 @@ class CateServer:
                 device_end = now()
             except Exception as e:
                 # A dispatch failure fails THIS batch's requests typed
-                # and walks degraded recovery; the daemon itself
-                # survives (never-crash is the serving contract).
+                # and walks the MODEL's degraded recovery (re-verify +
+                # reload of its last good checkpoint); other tenants
+                # keep serving and the daemon itself survives
+                # (never-crash is the serving contract). The default
+                # model's supervisor IS the daemon-wide reloader, so
+                # its faults degrade the whole daemon — the pre-fleet
+                # contract.
                 for req in batch.requests:
                     req.picked_mono = picked
+                    req.model_version = version
                     req.fail(e, now())
+                    self._fleet_requests.inc(1, model=batch.model,
+                                             status="error")
                     self.admission.release()
-                self._reloader.report_fault(
+                entry.supervisor.report_fault(
                     f"dispatch:{type(e).__name__}"
                 )
                 return
@@ -563,12 +832,14 @@ class CateServer:
                 req.picked_mono = picked
                 req.device_start_mono = device_start
                 req.device_end_mono = device_end
+                req.model_version = version
                 req.resolve(
                     (cate[off:off + req.rows].copy(),
                      var[off:off + req.rows].copy()),
                     now(),
                 )
                 off += req.rows
+                self._fleet_requests.inc(1, model=batch.model, status="ok")
                 self.admission.release()
         self._batches.inc(1, bucket=batch.bucket)
         self._fill.observe(batch.fill, bucket=batch.bucket)
@@ -582,8 +853,113 @@ class CateServer:
                 self._phase_hist.observe(secs, phase=phase)
                 self._phase_total.inc(max(0.0, secs), phase=phase)
         # One SLO snapshot per dispatched batch: cheap (a dict copy per
-        # family) and exactly as fresh as the data it judges.
+        # family) and exactly as fresh as the data it judges. The
+        # shedder's full evaluation (a history scan per SLO per
+        # window) is throttled — per-batch it would grow with uptime
+        # on the single device-owning thread.
         self.slo.tick()
+        if self._shedder.threshold > 0.0:
+            now = time.monotonic()
+            with self._lock:
+                due = now >= self._shed_next_update
+                if due:
+                    self._shed_next_update = now + SHED_REFRESH_S
+            if due:
+                self._shedder.update()
+
+    # ── fleet rotation (ISSUE 11) ────────────────────────────────────
+
+    def rotate(self, model_id: str, checkpoint: str,
+               reason: str = "rotate") -> str:
+        """Zero-downtime hot-swap of ``model_id`` onto ``checkpoint``.
+
+        Runs on the CALLING thread (the retrain supervisor's, or an
+        operator op's — never the request path): the candidate is
+        SHA-256 re-verified and geometry-checked against the model's
+        compiled executables, then swapped in atomically through the
+        model's :class:`~.admission.ReloadSupervisor`. In-flight
+        batches complete against the old forest, the next dispatch
+        binds the new one, ``readyz`` never flips, and a same-shape
+        rotation compiles NOTHING (the zero-compile window assertion
+        covers it). Returns ``"rotated"`` / ``"refused"`` (corrupt or
+        wrong-geometry candidate — last good kept) / ``"busy"`` /
+        ``"unknown_model"`` / ``"retired_model"``."""
+        entry = self.fleet.get(model_id)
+        if entry is None:
+            return "unknown_model"
+        if entry.lifecycle.state == "retired":
+            # Retirement is terminal: a retired tenant cannot be
+            # rotated back into service (reinstatement is a restart
+            # with a new fleet spec, not a hot-swap).
+            return "retired_model"
+
+        def loader():
+            inj = chaos.active()
+            if inj is not None:
+                delay = inj.rotate_verify_delay_s(f"rotate/{model_id}")
+                if delay > 0:
+                    # Slow-verify chaos: serving must be provably
+                    # unaffected for this whole window.
+                    time.sleep(delay)
+            forest = self._load_forest(checkpoint)
+            if self._forest_signature(forest) != entry.sig:
+                raise ValueError(
+                    f"candidate {checkpoint!r} changed forest geometry "
+                    f"for model {model_id!r}; a rotation cannot re-AOT"
+                )
+            return forest
+
+        def installer(forest):
+            inj = chaos.active()
+            if inj is not None and inj.take_rotate_fault(
+                "mid_swap", site=f"rotate/{model_id}"
+            ):
+                from ate_replication_causalml_tpu.resilience.errors import (
+                    ChaosRotateFault,
+                )
+
+                raise ChaosRotateFault(
+                    f"chaos: injected mid-swap fault ({model_id})"
+                )
+            version = self.fleet.swap(model_id, forest, checkpoint)
+            obs.emit("serving_model_rotated", status="ok",
+                     model=model_id, version=version,
+                     checkpoint=checkpoint)
+
+        return entry.supervisor.rotate(
+            loader, installer, reason=reason, model=model_id
+        )
+
+    def retire(self, model_id: str) -> bool:
+        """Retire a model: its id keeps answering with a typed
+        ``retired_model`` reject (never ``unknown_model`` — a retired
+        tenant is a fact, not a typo). Returns whether the id
+        existed."""
+        entry = self.fleet.get(model_id)
+        if entry is None:
+            return False
+        entry.lifecycle.retire()
+        return True
+
+    def retrain_supervisor(self, model_id: str, fit_fn, publish_dir: str,
+                           **kwargs):
+        """A :class:`~.retrain.RetrainSupervisor` wired to this
+        daemon's verified-rotation entry for ``model_id``."""
+        from ate_replication_causalml_tpu.serving.retrain import (
+            RetrainSupervisor,
+        )
+
+        entry = self.fleet.get(model_id)
+        if entry is None:
+            raise KeyError(f"unknown model {model_id!r}")
+        return RetrainSupervisor(
+            model_id, fit_fn, publish_dir,
+            rotate_fn=lambda path: self.rotate(
+                model_id, path, reason="retrain"
+            ),
+            start_version=entry.version + 1,
+            **kwargs,
+        )
 
     # ── proof + shutdown ─────────────────────────────────────────────
 
@@ -600,6 +976,22 @@ class CateServer:
     def startup_seconds(self) -> dict[str, float]:
         with self._lock:
             return dict(self._startup_s)
+
+    @staticmethod
+    def _phase_device_count() -> int:
+        """The live registry's decomposed-request count (the
+        ``phase=device`` sample of ``serving_phase_seconds`` — every
+        decomposed request records each phase exactly once). Process-
+        global; the daemon marks it at startup so the reconciliation
+        counts only THIS session."""
+        m = obs.REGISTRY.family("serving_phase_seconds")
+        if m is None:
+            return 0
+        return sum(
+            int(s.get("count", 0))
+            for key, s in m.peek_counts().items()
+            if "phase=device" in key.split(",")
+        )
 
     @staticmethod
     def _label_value(key: str, label: str) -> str | None:
@@ -672,6 +1064,11 @@ class CateServer:
             "pad_fraction_mean": self.pad_fraction_mean(),
             "admin_port": admin.port if admin is not None else None,
             "slo": self.slo.health(),
+            # Fleet state (ISSUE 11): per-model version/lifecycle plus
+            # the shedder's cached per-model burn rates.
+            "fleet": self.fleet.describe(),
+            "shed_burn_threshold": self._shedder.threshold,
+            "shed_burns": self._shedder.burns(),
         }
 
     def dump_artifacts(self, outdir: str) -> list[str]:
@@ -689,20 +1086,39 @@ class CateServer:
         if not obs.enabled():
             return []
         os.makedirs(outdir, exist_ok=True)
-        paths = obs.write_run_artifacts(outdir)
         if obs.trace_enabled():
             # The event log is a process-global ring: keep only this
-            # daemon's window (same filter run_sweep applies).
+            # daemon's window (same filter run_sweep applies). The
+            # trace is built BEFORE the metrics snapshot so the
+            # reconciliation's requests_in_metrics can never undercount
+            # the trace's view (a request landing between the two bumps
+            # metrics only).
             records = [
                 r for r in obs.EVENTS.records()
                 if r.get("start_mono_s", 0.0) >= self._born_mono - 1e-6
             ]
+            with self._lock:
+                phase_mark = self._phase_mark
             tr = _trace.build_trace(records, meta=_trace.run_meta(
                 tool="serving",
                 checkpoint=self.config.checkpoint,
                 buckets=",".join(str(b) for b in self.config.buckets.sizes),
+                serving_phase_mark=phase_mark,
             ))
-            paths += _sreport.write_serving_artifacts(outdir, tr)
+            paths = obs.write_run_artifacts(outdir)
+            # The reconciliation reads the metrics.json that was just
+            # written — the same file the analyzer CLI will read — so
+            # the daemon's serving_report.json and a bit-for-bit
+            # analyzer reproduction can only agree.
+            import json as _json
+
+            with open(os.path.join(outdir, "metrics.json")) as f:
+                metrics_snapshot = _json.load(f)
+            paths += _sreport.write_serving_artifacts(
+                outdir, tr, metrics=metrics_snapshot
+            )
+        else:
+            paths = obs.write_run_artifacts(outdir)
         spath = os.path.join(outdir, _sreport.SLO_REPORT_BASENAME)
         obs.atomic_write_json(spath, self.slo.evaluate())
         paths.append(spath)
@@ -764,8 +1180,9 @@ def _handle_op(server: CateServer, header: dict, arrays: dict):
         if x is None:
             return {"ok": False, "id": rid, "error": "bad_request",
                     "message": "predict needs an 'x' array"}, {}, False
+        model = header.get("model")
         try:
-            cate, var = server.serve_one(rid, x)
+            req = server.serve_request(rid, x, model=model)
         except RejectedRequest as rej:
             reply = {"ok": False, "id": rid, "error": rej.code,
                      "message": rej.message}
@@ -781,11 +1198,35 @@ def _handle_op(server: CateServer, header: dict, arrays: dict):
                      request_id=rid, error=f"{type(e).__name__}: {e}")
             return {"ok": False, "id": rid, "error": "error",
                     "message": f"{type(e).__name__}: {e}"}, {}, False
+        cate, var = req.result
         return (
-            {"ok": True, "id": rid},
+            # The reply names the model VERSION that served it — the
+            # client-visible bit-identity partition key across a
+            # rotation.
+            {"ok": True, "id": rid, "model": req.model,
+             "model_version": req.model_version},
             {"cate": cate, "variance": var},
             False,
         )
+    if op == "rotate":
+        # Operator-triggered zero-downtime hot-swap. Serving continues
+        # for the whole verify window; a refused candidate keeps the
+        # last good model.
+        model = str(header.get("model") or DEFAULT_MODEL)
+        checkpoint = header.get("checkpoint")
+        if not checkpoint:
+            return {"ok": False, "id": rid, "error": "bad_request",
+                    "message": "rotate needs a 'checkpoint' header field"
+                    }, {}, False
+        status = server.rotate(model, str(checkpoint), reason="op")
+        return {"ok": status == "rotated", "op": "rotate",
+                "model": model, "status": status}, {}, False
+    if op == "retire":
+        model = str(header.get("model") or "")
+        known = server.retire(model)
+        return {"ok": known, "op": "retire", "model": model,
+                "status": "retired" if known else "unknown_model"
+                }, {}, False
     if op == "ping":
         return {"ok": True, "op": "ping",
                 "state": server.lifecycle.state}, {}, False

@@ -46,12 +46,13 @@ DEFAULT_MIX = "1:4,8:2,32:1"
 @dataclasses.dataclass(frozen=True)
 class ScheduledRequest:
     """One planned request: when it is offered, under what id, with how
-    many rows."""
+    many rows, against which fleet model ("" = the daemon's default)."""
 
     index: int
     request_id: str
     t_s: float
     rows: int
+    model: str = ""
 
 
 def parse_mix(spec: str) -> tuple[tuple[int, float], ...]:
@@ -82,11 +83,15 @@ def build_schedule(
     rate_hz: float = DEFAULT_RATE_HZ,
     mix: str | Sequence[tuple[int, float]] = DEFAULT_MIX,
     id_prefix: str = "r",
+    models: Sequence[str] | None = None,
 ) -> list[ScheduledRequest]:
     """The deterministic open-loop schedule: same seed ⇒ identical
-    ``(id, t_s, rows)`` triples (pinned by a tier-1 test). Draw order
-    is fixed — all gaps first, then all row counts — so adding a new
-    randomized field later cannot silently reshuffle existing ones."""
+    ``(id, t_s, rows, model)`` tuples (pinned by a tier-1 test). Draw
+    order is fixed — all gaps first, then all row counts, then (only
+    when ``models`` is given) the model assignment — so adding a new
+    randomized field later cannot silently reshuffle existing ones,
+    and a schedule built without ``models`` is bit-identical to the
+    pre-fleet one."""
     if requests < 1:
         raise ValueError(f"requests must be >= 1, got {requests}")
     if rate_hz <= 0:
@@ -100,12 +105,19 @@ def build_schedule(
         np.asarray([r for r, _ in entries], dtype=np.int64),
         size=requests, p=weights / weights.sum(),
     )
+    if models:
+        model_ids = list(models)
+        picks = rng.integers(0, len(model_ids), size=requests)
+        assigned = [model_ids[int(k)] for k in picks]
+    else:
+        assigned = [""] * requests
     return [
         ScheduledRequest(
             index=i,
             request_id=f"{id_prefix}{i}",
             t_s=float(arrivals[i]),
             rows=int(rows[i]),
+            model=assigned[i],
         )
         for i in range(requests)
     ]
@@ -158,6 +170,12 @@ def _record(
         ),
         "reject_retries": {k: retries[k] for k in sorted(retries)},
     }
+    if any(s.model for s in schedule):
+        by_model: dict[str, int] = {}
+        for s in schedule:
+            key = s.model or "default"
+            by_model[key] = by_model.get(key, 0) + 1
+        out["offered_by_model"] = {k: by_model[k] for k in sorted(by_model)}
     if latencies_s:
         out.update({
             k: round(v, 9) for k, v in _percentiles(latencies_s).items()
@@ -192,10 +210,18 @@ def run_inprocess(
             sleep(delay)
         for _ in range(max_attempts):
             try:
-                pending.append(server.submit(sched.request_id, q))
+                pending.append(
+                    server.submit(sched.request_id, q,
+                                  model=sched.model or None)
+                )
                 break
             except RejectedRequest as rej:
-                if rej.code == "bad_request":
+                if rej.code in ("bad_request", "unknown_model",
+                                "retired_model"):
+                    # Terminal: a schedule that offends the daemon's
+                    # contract (or targets a gone model) is a harness
+                    # bug, not load — retrying 500 times would only
+                    # bury the real cause.
                     raise
                 retries[rej.code] = retries.get(rej.code, 0) + 1
                 sleep(rej.retry_after_s or 0.002)
@@ -258,6 +284,7 @@ def run_wire(
                     client.predict(
                         queries[i], request_id=sched.request_id,
                         max_retries=max_retries,
+                        model=sched.model or None,
                     )
                 except BaseException as e:
                     with lock:

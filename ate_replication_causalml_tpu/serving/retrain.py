@@ -1,0 +1,273 @@
+"""Retrain supervisor: fit → publish → verify → hot-swap (ISSUE 11).
+
+The train-to-serve loop's driver. Production refit is exactly the
+cross-fit nuisance + orthogonal-moment machinery re-run on fresh
+panels; this module owns everything AROUND that fit so the daemon
+never serves a corrupt, stale, or half-loaded model:
+
+1. **fit** — ``fit_fn()`` produces a fresh fitted forest. The callable
+   is injected: production wires the pipeline's forest fit on the
+   sharded artifact plane (device-resident ``NamedSharding`` nuisances,
+   PR 8); tests wire a synthetic micro-forest. Either way it runs
+   under the resilience layer's **classified-retry/deadline
+   discipline**: transient failures (``JaxRuntimeError``, ``OSError``,
+   injected :class:`~..resilience.errors.ChaosRotateFault`) retry with
+   capped exponential backoff and deterministic crc32 jitter — the
+   exact ``parallel/retry.py`` schedule, reimplemented here without
+   the jax import so the supervisor stays wire-light; programming
+   errors raise immediately (a bug refit three times is the same bug).
+   A wall-clock ``deadline_s`` bounds the whole run.
+2. **publish** — ``save_fitted`` writes the candidate to a fresh
+   *versioned* path (``{model}-v{NNNN}.npz``), atomically (tmp +
+   rename) with the SHA-256 content digest embedded. Every attempt
+   gets a NEW version number: a refused candidate stays on disk for
+   quarantine, never overwritten.
+3. **rotate** — the path is handed to the daemon's rotation entry
+   (:meth:`~.daemon.CateServer.rotate` →
+   :meth:`~.admission.ReloadSupervisor.rotate`), which re-verifies the
+   digest, checks geometry against the compiled executables, and
+   hot-swaps with zero downtime. A failed re-verify is a typed
+   ``refused`` — the last good checkpoint keeps serving. ``busy``
+   (another reload/rotation in flight) is retried like a transient.
+
+Chaos (``rotate:`` scope): ``retrain`` faults the fit (retried),
+``corrupt`` truncates the published archive after its digest was
+embedded (the rotation re-verify must refuse it), ``mid_swap`` and
+``verify_ms`` land inside the rotation itself (daemon side).
+
+Telemetry: ``serving_retrain_total{model,status}`` terminal outcomes,
+``serving_retrain_retries_total{model}`` transient retries, a
+``retrain_run`` span per run with ``retrain_retry`` /
+``retrain_deadline`` events — the same families
+``check_metrics_schema.py`` requires on every instrumented run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import os
+import time
+from typing import Callable
+
+from ate_replication_causalml_tpu.observability import events as _events
+from ate_replication_causalml_tpu.observability import registry as _registry
+from ate_replication_causalml_tpu.resilience import chaos
+from ate_replication_causalml_tpu.resilience.backoff import (
+    BACKOFF_CAP_MULT,
+    jittered_backoff_delay,
+)
+from ate_replication_causalml_tpu.resilience.errors import (
+    ChaosRotateFault,
+    classify,
+)
+
+__all__ = ["BACKOFF_CAP_MULT", "RetrainConfig", "RetrainOutcome",
+           "RetrainSupervisor", "retrain_backoff_delay"]
+
+
+def retrain_backoff_delay(model_id: str, attempt: int, base_s: float) -> float:
+    """Backoff before retrying a transient retrain failure: exponential
+    in the attempt, crc32-jittered, capped — the PR 3 discipline (one
+    formula, ``resilience/backoff.py``), a pure function of
+    ``(model_id, attempt)`` so tests can assert the exact sleep
+    schedule."""
+    return jittered_backoff_delay(
+        f"retrain|{model_id}|{attempt}", attempt, base_s
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class RetrainConfig:
+    """Retry/deadline discipline for one supervisor."""
+
+    max_attempts: int = 3
+    backoff_s: float = 0.05
+    deadline_s: float | None = None
+
+
+@dataclasses.dataclass
+class RetrainOutcome:
+    """One ``run_once`` result. ``status`` is the terminal word:
+    ``rotated`` (new model serving), ``refused`` (candidate failed the
+    rotation's re-verify — last good kept), ``retired_model`` /
+    ``unknown_model`` (the target id is gone — terminal), ``failed``
+    (retries exhausted), ``deadline`` (wall clock cut the run),
+    ``busy`` (rotation claim contended past the retry budget)."""
+
+    model_id: str
+    status: str
+    attempts: int = 0
+    checkpoint: str | None = None
+    error: str | None = None
+
+
+class RetrainSupervisor:
+    """Drives the fit → publish → rotate pipeline for ONE model.
+
+    Everything side-effectful is injected so the state machine is
+    provable without jax: ``fit_fn`` returns the fresh forest,
+    ``publish_fn(path, forest)`` persists it (default: the atomic,
+    digest-embedding ``utils.checkpoint.save_fitted``, resolved
+    lazily), ``rotate_fn(path)`` performs the verified hot-swap and
+    returns the rotation status string (the daemon's
+    :meth:`~.daemon.CateServer.rotate` bound to this model)."""
+
+    def __init__(
+        self,
+        model_id: str,
+        fit_fn: Callable[[], object],
+        publish_dir: str,
+        rotate_fn: Callable[[str], str],
+        config: RetrainConfig = RetrainConfig(),
+        publish_fn: Callable[[str, object], None] | None = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+        start_version: int = 2,
+    ):
+        self.model_id = model_id
+        self._fit_fn = fit_fn
+        self._publish_dir = publish_dir
+        self._rotate_fn = rotate_fn
+        self.config = config
+        self._publish_fn = publish_fn
+        self._clock = clock
+        self._sleep = sleep
+        self._version = itertools.count(start_version)
+        self._runs = _registry.counter(
+            "serving_retrain_total",
+            "retrain supervisor runs by model and terminal status",
+        )
+        self._retries = _registry.counter(
+            "serving_retrain_retries_total",
+            "retrain attempts retried after a transient failure",
+        )
+
+    def _publish(self, path: str, forest) -> None:
+        if self._publish_fn is not None:
+            self._publish_fn(path, forest)
+            return
+        from ate_replication_causalml_tpu.utils.checkpoint import save_fitted
+
+        save_fitted(path, forest)
+
+    def _candidate_path(self) -> str:
+        """The next FRESH versioned path. Numbers already on disk are
+        skipped — a refused candidate stays quarantined forever, and a
+        restarted supervisor (seeded from the entry's version, which a
+        refusal does not advance) must never overwrite it."""
+        while True:
+            path = os.path.join(
+                self._publish_dir,
+                f"{self.model_id}-v{next(self._version):04d}.npz",
+            )
+            if not os.path.exists(path):
+                return path
+
+    def _attempt(self) -> tuple[str, str | None]:
+        """One fit → publish → rotate attempt; returns ``(status,
+        checkpoint_path)``. Raises on failure (classified upstream)."""
+        inj = chaos.active()
+        with _events.span("retrain_fit", model=self.model_id):
+            if inj is not None and inj.take_rotate_fault(
+                "retrain", site=f"retrain/{self.model_id}"
+            ):
+                raise ChaosRotateFault(
+                    f"chaos: injected retrain fault ({self.model_id})"
+                )
+            forest = self._fit_fn()
+        path = self._candidate_path()
+        with _events.span("retrain_publish", model=self.model_id, path=path):
+            self._publish(path, forest)
+            if inj is not None and inj.take_rotate_fault(
+                "corrupt", site=path
+            ):
+                # The artifact a torn publish would leave — AFTER the
+                # digest went in, so only the rotation's re-verify can
+                # catch it. It must.
+                os.truncate(path, max(1, (os.path.getsize(path) * 3) // 5))
+        return self._rotate_fn(path), path
+
+    def run_once(self) -> RetrainOutcome:
+        """One retrain run under the full discipline. Never raises for
+        transient trouble — the outcome record carries the terminal
+        status; programming errors (fatal classification) re-raise."""
+        cfg = self.config
+        deadline = (
+            None if cfg.deadline_s is None
+            else self._clock() + cfg.deadline_s
+        )
+        out = RetrainOutcome(self.model_id, "failed")
+        candidate: str | None = None
+        with _events.span("retrain_run", model=self.model_id) as sp:
+            while out.attempts < cfg.max_attempts:
+                if deadline is not None and self._clock() >= deadline:
+                    out.status = "deadline"
+                    break
+                out.attempts += 1
+                try:
+                    if candidate is None:
+                        status, candidate = self._attempt()
+                    else:
+                        # A prior attempt already published a verified
+                        # candidate and only the rotation claim was
+                        # contended ("busy" — a milliseconds-scale
+                        # window): retry ONLY the rotation. Re-running
+                        # the fit would cost a full refit per contended
+                        # claim and litter the publish dir.
+                        status = self._rotate_fn(candidate)
+                except Exception as e:
+                    if classify(e) == "fatal":
+                        sp.set_status("error")
+                        self._runs.inc(1, model=self.model_id,
+                                       status="fatal")
+                        raise
+                    out.error = f"{type(e).__name__}: {e}"
+                    status, candidate = "error", None
+                if status == "rotated":
+                    out.status, out.checkpoint, out.error = (
+                        "rotated", candidate, None
+                    )
+                    break
+                if status in ("refused", "retired_model", "unknown_model"):
+                    # Typed terminals, not retries: a refused candidate
+                    # would be refused again, and a retired/unknown
+                    # model id will not come back on backoff. The error
+                    # field describes THIS terminal, not a stale
+                    # earlier-attempt transient.
+                    out.status, out.checkpoint, out.error = (
+                        status, candidate, None
+                    )
+                    break
+                if status != "busy":
+                    candidate = None  # refit on the next attempt
+                # transient ("error" from the fit/publish, or "busy"
+                # from a contended rotation claim): back off and retry.
+                out.status = "busy" if status == "busy" else "failed"
+                if out.attempts >= cfg.max_attempts:
+                    break
+                delay = retrain_backoff_delay(
+                    self.model_id, out.attempts, cfg.backoff_s
+                )
+                if deadline is not None and (
+                    self._clock() + delay >= deadline
+                ):
+                    out.status = "deadline"
+                    break
+                self._retries.inc(1, model=self.model_id)
+                _events.emit(
+                    "retrain_retry", status="retrying",
+                    model=self.model_id, attempt=out.attempts,
+                    error=out.error or status,
+                )
+                self._sleep(delay)
+            if out.status != "rotated":
+                sp.set_status("error")
+                if out.status == "deadline":
+                    _events.emit(
+                        "retrain_deadline", status="error",
+                        model=self.model_id, attempts=out.attempts,
+                        deadline_s=cfg.deadline_s,
+                    )
+        self._runs.inc(1, model=self.model_id, status=out.status)
+        return out

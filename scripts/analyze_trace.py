@@ -119,10 +119,22 @@ def main(argv: list[str] | None = None) -> int:
     except (OSError, json.JSONDecodeError) as e:
         print(f"analyze_trace: cannot read {tpath}: {e}", file=sys.stderr)
         return 2
+    # The run's metrics.json (beside the trace) feeds the serving
+    # report's silent-drop reconciliation — same file the daemon's own
+    # dump read, so the recomputed report stays byte-identical.
+    metrics = None
+    mpath = os.path.join(os.path.dirname(tpath) or ".", "metrics.json")
+    if os.path.exists(mpath):
+        try:
+            with open(mpath) as f:
+                metrics = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"analyze_trace: ignoring unreadable {mpath}: {e}",
+                  file=sys.stderr)
     try:
         report = cp.overlap_report(trace)
         serving = (
-            sreport.serving_report(trace)
+            sreport.serving_report(trace, metrics=metrics)
             if sreport.has_serving_slices(trace) else None
         )
     except (KeyError, TypeError, ValueError, AttributeError) as e:

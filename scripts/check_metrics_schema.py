@@ -41,6 +41,25 @@ import argparse
 import json
 import os
 import sys
+import types
+
+# The serving-report reconciliation must count EXACTLY the way the
+# report builder does — import the canonical helpers instead of
+# re-implementing the recipe. Same stub-package trick as
+# analyze_trace.py: observability/serving_report.py is stdlib-only,
+# but executing the parent package's __init__ would pull jax.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+if "ate_replication_causalml_tpu" not in sys.modules:
+    _pkg = types.ModuleType("ate_replication_causalml_tpu")
+    _pkg.__path__ = [os.path.join(_REPO_ROOT, "ate_replication_causalml_tpu")]
+    sys.modules["ate_replication_causalml_tpu"] = _pkg
+
+from ate_replication_causalml_tpu.observability.serving_report import (  # noqa: E402
+    phase_count_from_metrics,
+    phase_mark_from_trace,
+)
 
 EXPECTED_SCHEMA_VERSION = 1
 
@@ -79,6 +98,14 @@ REQUIRED_COUNTERS = (
     # run.
     "serving_phase_seconds_total",
     "serving_batch_close_total",
+    # Train-to-serve fleet (ISSUE 11): rotations, per-model routing
+    # outcomes, and the retrain supervisor's retry/deadline families —
+    # "nothing ever rotated" and "no retrain retried" are recorded
+    # zeros, not missing keys.
+    "serving_rotations_total",
+    "serving_fleet_requests_total",
+    "serving_retrain_total",
+    "serving_retrain_retries_total",
 )
 
 _EVENT_FIELDS = (
@@ -388,6 +415,34 @@ def validate_serving_report(report: dict, tol: float = 1e-9) -> list[str]:
     if len(rej.get("timeline", ())) + rej.get("timeline_truncated", 0) != \
             rej.get("count"):
         errors.append("serving: reject timeline + truncated != count")
+    # Silent-drop reconciliation (ISSUE 11): requests submitted via raw
+    # submit() are real in the metrics but invisible to the
+    # trace-derived phase section; the report must ACCOUNT for them,
+    # consistently, never negatively.
+    rec = report.get("reconciliation")
+    if rec is not None:
+        for key in ("requests_in_metrics", "requests_in_trace",
+                    "silent_drops"):
+            if not isinstance(rec.get(key), int):
+                errors.append(f"serving: reconciliation.{key} missing")
+                return errors
+        if rec["silent_drops"] != (
+            rec["requests_in_metrics"] - rec["requests_in_trace"]
+        ):
+            errors.append(
+                "serving: reconciliation silent_drops != "
+                "requests_in_metrics - requests_in_trace"
+            )
+        if rec["requests_in_metrics"] < rec["requests_in_trace"]:
+            errors.append(
+                "serving: reconciliation has more decomposed requests in "
+                "the trace than in the metrics — impossible window"
+            )
+        if rec["requests_in_trace"] != req.get("with_phases"):
+            errors.append(
+                "serving: reconciliation.requests_in_trace != "
+                "requests.with_phases"
+            )
     return errors
 
 
@@ -663,9 +718,53 @@ def validate_trace_files(outdir: str) -> list[str]:
     if os.path.exists(spath):
         try:
             with open(spath) as f:
-                errors += validate_serving_report(json.load(f))
+                sreport = json.load(f)
+            errors += validate_serving_report(sreport)
         except (OSError, json.JSONDecodeError) as e:
             errors.append(f"serving: cannot read {spath}: {e}")
+        else:
+            # Cross-check the silent-drop accounting against the
+            # metrics.json written beside it (ISSUE 11): a serving
+            # report in a directory WITH metrics must carry the
+            # reconciliation, and its metrics-side count must match the
+            # file — otherwise raw-submit() traffic is being dropped
+            # silently, which is exactly what this section exists to
+            # flag.
+            mpath = os.path.join(outdir, "metrics.json")
+            if os.path.exists(mpath):
+                try:
+                    with open(mpath) as f:
+                        snap = json.load(f)
+                except (OSError, json.JSONDecodeError) as e:
+                    errors.append(f"serving: cannot read {mpath}: {e}")
+                    snap = None
+                if snap is not None:
+                    # The CANONICAL counting recipe (same helpers the
+                    # report builder and analyze_trace.py use); the
+                    # daemon's startup baseline in the trace otherData
+                    # windows out earlier same-process sessions.
+                    in_metrics = phase_count_from_metrics(snap) or 0
+                    mark = 0
+                    if os.path.exists(tpath):
+                        try:
+                            with open(tpath) as f:
+                                mark = phase_mark_from_trace(json.load(f))
+                        except (OSError, json.JSONDecodeError):
+                            mark = 0
+                    in_metrics = max(0, in_metrics - mark)
+                    rec = sreport.get("reconciliation")
+                    if rec is None:
+                        errors.append(
+                            "serving: metrics.json present but the report "
+                            "has no reconciliation section — silent "
+                            "submit() drops would be invisible"
+                        )
+                    elif rec.get("requests_in_metrics") != in_metrics:
+                        errors.append(
+                            "serving: reconciliation.requests_in_metrics "
+                            f"{rec.get('requests_in_metrics')} != "
+                            f"metrics.json phase count {in_metrics}"
+                        )
     lpath = os.path.join(outdir, "slo_report.json")
     if os.path.exists(lpath):
         try:

@@ -58,6 +58,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="rows:weight bucket mix, e.g. 1:4,8:2,32:1")
     ap.add_argument("--id-prefix", default="r",
                     help="request-id prefix (chaos specs select on ids)")
+    ap.add_argument("--models", default=None,
+                    help="comma-separated fleet model ids to spread the "
+                         "stream across (deterministic per-request "
+                         "assignment; default: the daemon's default model)")
     ap.add_argument("--concurrency", type=int, default=8,
                     help="TCP connections for --connect (stdio is one pipe)")
     ap.add_argument("--buckets", default=None,
@@ -69,9 +73,13 @@ def main(argv: list[str] | None = None) -> int:
                          "artifacts here after the replay")
     args = ap.parse_args(argv)
 
+    models = (
+        tuple(m.strip() for m in args.models.split(",") if m.strip())
+        if args.models else None
+    )
     schedule = loadgen.build_schedule(
         args.seed, args.requests, rate_hz=args.rate, mix=args.mix,
-        id_prefix=args.id_prefix,
+        id_prefix=args.id_prefix, models=models,
     )
     queries = loadgen.build_queries(args.seed, schedule, args.features)
 
@@ -132,6 +140,8 @@ def _attach_server_stats(client: CateClient, record: dict,
         "pad_fraction_mean": stats.get("pad_fraction_mean", 0.0),
         "compile_events_in_window": stats.get("compile_events_in_window"),
         "slo": stats.get("slo", {}),
+        "fleet": stats.get("fleet", {}),
+        "shed_burns": stats.get("shed_burns", {}),
     }
     if dump_dir:
         record["dumped"] = client.dump(dump_dir)

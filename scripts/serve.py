@@ -51,6 +51,14 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--slo-ms", type=float, default=None,
                     help="latency-SLO threshold in ms (default "
                          "$ATE_TPU_SERVE_SLO_MS or 250)")
+    ap.add_argument("--fleet", default=None,
+                    help="extra served models as id=path,id2=path2 "
+                         "(default $ATE_TPU_SERVE_FLEET; --checkpoint "
+                         "serves as model 'default'; same-shape models "
+                         "share one AOT executable set)")
+    ap.add_argument("--shed-burn", type=float, default=None,
+                    help="per-model SLO-burn shedding threshold (default "
+                         "$ATE_TPU_SERVE_FLEET_SHED_BURN or off)")
     args = ap.parse_args(argv)
 
     from ate_replication_causalml_tpu.serving.coalescer import BucketPlan
@@ -60,6 +68,7 @@ def main(argv: list[str] | None = None) -> int:
         serve_socket,
         serve_stdio,
     )
+    from ate_replication_causalml_tpu.serving.fleet import parse_fleet_spec
 
     overrides: dict = {}
     if args.buckets is not None:
@@ -74,6 +83,10 @@ def main(argv: list[str] | None = None) -> int:
         overrides["admin_port"] = args.admin_port
     if args.slo_ms is not None:
         overrides["slo_latency_s"] = args.slo_ms / 1e3
+    if args.fleet is not None:
+        overrides["fleet"] = parse_fleet_spec(args.fleet)
+    if args.shed_burn is not None:
+        overrides["shed_burn_threshold"] = args.shed_burn
     config = ServeConfig.from_env(args.checkpoint, **overrides)
 
     server = CateServer(config)
@@ -81,7 +94,8 @@ def main(argv: list[str] | None = None) -> int:
     print(
         "# startup: " + " ".join(
             f"{k}={v:.2f}s" for k, v in phases.items()
-        ) + f" buckets={list(config.buckets.sizes)}",
+        ) + f" buckets={list(config.buckets.sizes)}"
+        + f" models={list(config.model_ids)}",
         file=sys.stderr, flush=True,
     )
     admin_port = server.stats().get("admin_port")

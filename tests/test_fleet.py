@@ -1,0 +1,1311 @@
+"""Train-to-serve fleet tests (ISSUE 11).
+
+Three layers, matched to the tier-1 budget:
+
+* the no-jax fleet core — fleet-spec parsing, the model registry's
+  swap/retire/version semantics, per-model lifecycle isolation, the
+  burn-rate shedder, per-model SLO scoping, the ReloadSupervisor's
+  single-flight re-entrancy (one reload, one verify), the retrain
+  supervisor's classified-retry/deadline state machine with exact
+  crc32 backoff schedules, the client's deterministic backoff, and the
+  ``rotate:`` chaos grammar — pure-host, ~ms each;
+* ONE module-scoped in-process daemon over TWO same-shape synthetic
+  micro forests (the PR 6/7 pattern — serving doesn't care how a
+  forest was trained) proving the acceptance contract: a seeded
+  multi-tenant loadgen replay across a LIVE hot-swap with zero dropped
+  in-flight requests, answers bit-identical per checkpoint version,
+  ``readyz`` 200 for the entire window, the rotation visible as an
+  instant marker in the serving trace, zero compiles for the
+  same-shape rotation (module-teardown ``stop()`` enforces it), and —
+  under ``rotate:`` chaos — a corrupt published checkpoint NEVER
+  rotating into service;
+* the silent-drop reconciliation contract on the exported artifacts.
+
+Offline references are computed BEFORE the daemon starts: the
+no-compile window term is process-global (documented PR 6/7 gotcha).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ate_replication_causalml_tpu.observability.slo import (
+    SLO,
+    SLOEngine,
+    fleet_slos,
+)
+from ate_replication_causalml_tpu.resilience import chaos
+from ate_replication_causalml_tpu.serving import loadgen
+from ate_replication_causalml_tpu.serving.admission import (
+    ReloadSupervisor,
+    ServingLifecycle,
+)
+from ate_replication_causalml_tpu.serving.client import retry_backoff_delay
+from ate_replication_causalml_tpu.serving.coalescer import (
+    BucketPlan,
+    Coalescer,
+    PendingRequest,
+)
+from ate_replication_causalml_tpu.serving.fleet import (
+    BurnShedder,
+    ModelFleet,
+    ModelLifecycle,
+    parse_fleet_spec,
+)
+from ate_replication_causalml_tpu.serving.retrain import (
+    RetrainConfig,
+    RetrainSupervisor,
+    retrain_backoff_delay,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "scripts"))
+import check_metrics_schema as cms  # noqa: E402
+
+
+# ── fleet spec + registry (no jax) ─────────────────────────────────────
+
+
+def test_parse_fleet_spec():
+    assert parse_fleet_spec("") == ()
+    assert parse_fleet_spec("a=/x.npz, b=/y.npz") == (
+        ("a", "/x.npz"), ("b", "/y.npz"))
+    for bad in ("a", "=path", "a=", "a=/x.npz,a=/y.npz"):
+        with pytest.raises(ValueError):
+            parse_fleet_spec(bad)
+
+
+def test_model_fleet_swap_reinstall_retire():
+    fleet = ModelFleet()
+    entry = fleet.install("a", forest="F1", sig=("s",), n_features=4,
+                          checkpoint="/a-v1.npz")
+    with pytest.raises(ValueError, match="already installed"):
+        fleet.install("a", "F1b", ("s",), 4, "/dup.npz")
+    assert fleet.get("missing") is None
+    assert fleet.binding("a") == ("F1", 1)
+    # swap bumps the version and the last-good checkpoint...
+    assert fleet.swap("a", "F2", "/a-v2.npz") == 2
+    assert fleet.binding("a") == ("F2", 2)
+    assert fleet.get("a").checkpoint == "/a-v2.npz"
+    # ...reinstall (degraded recovery of the same bytes) does NOT.
+    fleet.reinstall("a", "F2rebuilt")
+    assert fleet.binding("a") == ("F2rebuilt", 2)
+    entry.lifecycle.retire()
+    assert fleet.describe()["a"]["state"] == "retired"
+    assert fleet.describe()["a"]["version"] == 2
+
+
+def test_model_lifecycle_isolation_and_protocol():
+    """The per-model lifecycle implements the ReloadSupervisor protocol
+    (single fault owner, recover, terminal retire) independently per
+    model."""
+    a, b = ModelLifecycle("a"), ModelLifecycle("b")
+    assert a.can_serve() and b.can_serve()
+    assert a.mark_fault("boom")          # first reporter owns recovery
+    assert not a.mark_fault("boom2")     # concurrent reporters coalesce
+    assert a.state == "degraded" and b.can_serve()  # b untouched
+    a.mark_recovered()
+    assert a.can_serve()
+    with pytest.raises(RuntimeError):
+        a.mark_recovered()               # not degraded
+    a.retire()
+    a.retire()                           # idempotent
+    assert a.state == "retired"
+    assert not a.mark_fault("late")      # retired models own nothing
+
+
+# ── per-model SLO scoping + the shedder (no jax) ───────────────────────
+
+
+def test_fleet_slo_scoping_and_shed_exclusion():
+    """Per-model availability SLOs see ONLY their model's samples, and
+    shed rejects are excluded from the totals (no feedback latch)."""
+    from ate_replication_causalml_tpu.observability import registry
+
+    reg = registry.MetricsRegistry()
+    c = reg.counter("serving_fleet_requests_total", "t")
+    clock = [0.0]
+    eng = SLOEngine(fleet_slos(("a", "b"), windows_s=(10.0, 60.0)),
+                    registry=reg, clock=lambda: clock[0])
+    eng.tick()  # zero baseline
+    # model a: 8 ok, 2 errors, 5 sheds + 4 client errors (both
+    # excluded — shedding must not latch on its own feedback, and a
+    # malformed-request spammer must not burn the tenant's budget);
+    # model b: 10 ok.
+    c.inc(8, model="a", status="ok")
+    c.inc(2, model="a", status="error")
+    c.inc(5, model="a", status="rejected_shed")
+    c.inc(4, model="a", status="rejected_bad_request")
+    c.inc(10, model="b", status="ok")
+    clock[0] = 60.0
+    report = eng.evaluate()
+    by_name = {s["name"]: s for s in report["slos"]}
+    wa = by_name["fleet:a"]["windows"][0]
+    wb = by_name["fleet:b"]["windows"][0]
+    # a: 8 good of 10 counted (sheds out) -> 20% error rate.
+    assert wa["good"] == 8.0 and wa["total"] == 10.0
+    assert abs(wa["error_rate"] - 0.2) < 1e-9
+    # b: clean — a's burn never spends b's budget.
+    assert wb["good"] == 10.0 and wb["total"] == 10.0
+    assert wb["error_rate"] == 0.0
+    assert by_name["fleet:a"]["burning"] and not by_name["fleet:b"]["burning"]
+
+
+def test_slo_good_match_backcompat_multi_pair():
+    """all-pairs matching keeps single-pair specs identical and makes
+    multi-pair specs conjunctive."""
+    from ate_replication_causalml_tpu.observability import registry
+
+    reg = registry.MetricsRegistry()
+    c = reg.counter("m", "t")
+    c.inc(3, status="ok", model="a")
+    c.inc(1, status="ok", model="b")
+    c.inc(1, status="error", model="a")
+    eng = SLOEngine(
+        (SLO(name="s", kind="availability", objective=0.9, metric="m",
+             windows_s=(10.0,), good_match="model=a,status=ok"),),
+        registry=reg, clock=lambda: 0.0,
+    )
+    good, total = eng._totals(eng.slos[0])
+    assert (good, total) == (3.0, 5.0)
+
+
+class _StubEngine:
+    def __init__(self):
+        self.burns = {"a": (0.0, 0.0), "b": (0.0, 0.0)}
+        self.evaluations = 0
+
+    def evaluate(self):
+        self.evaluations += 1
+        return {"slos": [
+            {"name": f"fleet:{m}", "windows": [
+                {"burn_rate": fast}, {"burn_rate": slow},
+                {"burn_rate": 99.0},  # the long window must not matter
+            ]}
+            for m, (fast, slow) in self.burns.items()
+        ]}
+
+
+def test_burn_shedder_multiwindow_confirmation():
+    eng = _StubEngine()
+    shed = BurnShedder(eng, threshold=2.0)
+    assert not shed.should_shed("a")  # empty cache: no shed
+    # fast window burning alone is NOT enough (no slow confirmation)...
+    eng.burns["a"] = (10.0, 1.0)
+    shed.update()
+    assert not shed.should_shed("a")
+    # ...both fast windows over threshold => shed, and only model a.
+    eng.burns["a"] = (10.0, 5.0)
+    shed.update()
+    assert shed.should_shed("a") and not shed.should_shed("b")
+    # The request path NEVER evaluates the engine — update() (the
+    # dispatcher's per-batch call) is the only evaluation site.
+    n = eng.evaluations
+    for _ in range(50):
+        shed.should_shed("a")
+    assert eng.evaluations == n
+    # Burn clears -> shedding stops (no latch).
+    eng.burns["a"] = (0.5, 0.2)
+    shed.update()
+    assert not shed.should_shed("a")
+    # threshold <= 0 disables entirely, with zero engine work.
+    off = BurnShedder(eng, threshold=0.0)
+    n = eng.evaluations
+    assert off.update() == {} and not off.should_shed("a")
+    assert eng.evaluations == n
+
+
+# ── ReloadSupervisor re-entrancy (satellite) ───────────────────────────
+
+
+def test_reload_supervisor_concurrent_fault_storm_coalesces():
+    """A storm of concurrent faults during an in-flight reload performs
+    ONE reload and ONE install — the single-flight contract."""
+    lc = ServingLifecycle()
+    lc.mark_ready()
+    gate = threading.Event()
+    calls = []
+    installed = []
+
+    def slow_reload():
+        calls.append(1)
+        gate.wait(5)
+        return "m2"
+
+    sup = ReloadSupervisor(lc, slow_reload, installed.append)
+    assert sup.report_fault("first")     # owns recovery
+    results = []
+    threads = [
+        threading.Thread(target=lambda: results.append(
+            sup.report_fault("storm")))
+        for _ in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == [False] * 8        # all coalesced
+    gate.set()
+    sup.join(5)
+    assert calls == [1] and installed == ["m2"]
+    assert lc.state == "serving" and lc.reload_count == 1
+
+
+def test_rotation_busy_while_reload_in_flight():
+    """A rotation arriving during a degraded reload gets a typed
+    ``busy`` — one reload, one verify, never two installs racing."""
+    lc = ServingLifecycle()
+    lc.mark_ready()
+    gate = threading.Event()
+    installed = []
+
+    def slow_reload():
+        gate.wait(5)
+        return "good"
+
+    sup = ReloadSupervisor(lc, slow_reload, installed.append)
+    assert sup.report_fault("x")
+    assert sup.rotate(lambda: "candidate", reason="t") == "busy"
+    gate.set()
+    sup.join(5)
+    assert installed == ["good"]         # the reload won; no candidate
+    assert lc.state == "serving"
+
+
+def test_rotation_refusal_keeps_serving_and_success_recovers():
+    lc = ServingLifecycle()
+    lc.mark_ready()
+    installed = []
+    sup = ReloadSupervisor(lc, lambda: "never", installed.append,
+                           inline=True)
+
+    def bad_loader():
+        raise RuntimeError("digest mismatch")
+
+    assert sup.rotate(bad_loader, reason="t") == "refused"
+    assert lc.state == "serving" and installed == []  # last good kept
+    assert sup.rotate(lambda: "v2", reason="t") == "rotated"
+    assert installed == ["v2"]
+    # A rotation landing while DEGRADED doubles as recovery.
+    lc2 = ServingLifecycle()
+    lc2.mark_ready()
+    sup2 = ReloadSupervisor(lc2, lambda: "never", installed.append,
+                            inline=True)
+    assert lc2.mark_fault("boom")  # direct lifecycle fault, no reload ran
+    assert lc2.state == "degraded"
+    assert sup2.rotate(lambda: "v3", reason="t") == "rotated"
+    assert lc2.state == "serving"
+
+
+def test_fault_during_rotation_claim_is_not_orphaned():
+    """Regression: a fault reported WHILE a rotation holds the
+    single-flight claim owns recovery but cannot launch it; when the
+    rotation ends (refused or rotated-then-refaulted), the supervisor
+    must pick the orphaned recovery up instead of staying degraded
+    until an operator retry."""
+    lc = ServingLifecycle()
+    lc.mark_ready()
+    installed = []
+    sup = ReloadSupervisor(lc, lambda: "last_good", installed.append,
+                           inline=True)
+
+    def loader_with_concurrent_fault():
+        # A dispatch fault lands mid-verify: mark_fault wins ownership
+        # but _try_begin fails (this rotation holds the claim) — the
+        # exact coalesced-into-nothing shape.
+        assert lc.mark_fault("dispatch:mid_rotation")
+        assert not sup._try_begin()
+        raise RuntimeError("candidate digest mismatch")
+
+    assert sup.rotate(loader_with_concurrent_fault, reason="t") == "refused"
+    # The orphaned recovery ran (inline): last good reinstalled,
+    # lifecycle back to serving.
+    assert installed == ["last_good"]
+    assert lc.state == "serving"
+
+
+def test_retire_wins_race_with_inflight_recovery():
+    """Regression: retiring a model while its background reload is in
+    flight must not resurrect it on reload success — and must not kill
+    the reload thread with an uncaught transition error."""
+    ml = ModelLifecycle("b")
+    gate = threading.Event()
+    installed = []
+
+    def slow_reload():
+        gate.wait(5)
+        return "bytes"
+
+    sup = ReloadSupervisor(ml, slow_reload, installed.append)
+    assert sup.report_fault("dispatch:boom")
+    assert ml.state == "degraded"
+    ml.retire()                      # operator retires mid-recovery
+    gate.set()
+    sup.join(5)
+    assert ml.state == "retired"     # retirement is terminal and wins
+    assert installed == ["bytes"]    # install happened, state did not
+
+
+def test_retrain_candidate_paths_never_overwrite_quarantine(tmp_path):
+    """Regression: a restarted supervisor seeded from the entry version
+    (which a refusal does not advance) must skip version numbers whose
+    candidate files already sit on disk — quarantined refusals are
+    forensic evidence, never overwritten."""
+    quarantined = tmp_path / "m-v0002.npz"
+    quarantined.write_bytes(b"corrupt-candidate")
+    publishes = []
+    sup = RetrainSupervisor(
+        "m", lambda: "forest", str(tmp_path), lambda p: "rotated",
+        config=RetrainConfig(max_attempts=1),
+        publish_fn=lambda path, forest: publishes.append(path),
+        sleep=lambda s: None, start_version=2,
+    )
+    out = sup.run_once()
+    assert out.status == "rotated"
+    assert os.path.basename(out.checkpoint) == "m-v0003.npz"
+    assert quarantined.read_bytes() == b"corrupt-candidate"
+
+
+def test_retrain_terminal_on_retired_or_unknown(tmp_path):
+    for terminal in ("retired_model", "unknown_model"):
+        sup = _sup(lambda: "f", lambda p, _t=terminal: _t, tmp_path,
+                   max_attempts=3)
+        out = sup.run_once()
+        assert out.status == terminal and out.attempts == 1
+
+
+def test_rotation_installer_fault_is_refused_atomically():
+    """A fault between verify and install (the bind window) must leave
+    NOTHING half-installed."""
+    lc = ServingLifecycle()
+    lc.mark_ready()
+    installed = []
+
+    def exploding_installer(obj):
+        raise RuntimeError("mid-swap fault")
+
+    sup = ReloadSupervisor(lc, lambda: "never", installed.append)
+    assert sup.rotate(lambda: "candidate", exploding_installer,
+                      reason="t") == "refused"
+    assert installed == [] and lc.state == "serving"
+    # The claim was released: the next rotation proceeds.
+    assert sup.rotate(lambda: "v2", reason="t") == "rotated"
+
+
+# ── rotate: chaos grammar + budgets (no jax) ───────────────────────────
+
+
+def test_rotate_chaos_scope_parse_and_budgets():
+    cfg = chaos.parse_chaos("rotate:corrupt,retrain,times=2,verify_ms=150")
+    rot = cfg.scope("rotate")
+    assert rot["corrupt"] and rot["retrain"] and not rot["mid_swap"]
+    assert rot["verify_ms"] == 150.0 and rot["times"] == 2
+    with pytest.raises(chaos.ChaosSpecError):
+        chaos.parse_chaos("rotate:nope=1")
+
+    inj = chaos.ChaosInjector(cfg)
+    # Independent per-kind budgets of `times` each.
+    assert inj.take_rotate_fault("corrupt", "s") is True
+    assert inj.take_rotate_fault("corrupt", "s") is True
+    assert inj.take_rotate_fault("corrupt", "s") is False
+    assert inj.take_rotate_fault("retrain", "s") is True
+    assert inj.take_rotate_fault("mid_swap", "s") is False  # not armed
+    assert inj.rotate_verify_delay_s("s") == 0.15
+    assert inj.rotate_verify_delay_s("s") == 0.15
+    assert inj.rotate_verify_delay_s("s") == 0.0  # budget spent
+    # Unarmed scope: everything off.
+    off = chaos.ChaosInjector(chaos.parse_chaos("serve:p=0.1"))
+    assert not off.take_rotate_fault("corrupt", "s")
+    assert off.rotate_verify_delay_s("s") == 0.0
+
+
+# ── retrain supervisor state machine (no jax) ──────────────────────────
+
+
+def _sup(fit_fn, rotate_fn, tmp_path, publishes=None, **cfg):
+    def publish(path, forest):
+        if publishes is not None:
+            publishes.append(path)
+        with open(path, "wb") as f:  # graftlint: disable=JGL005
+            f.write(b"x" * 64)
+
+    return RetrainSupervisor(
+        "m", fit_fn, str(tmp_path), rotate_fn,
+        config=RetrainConfig(**cfg), publish_fn=publish,
+        sleep=lambda s: None,
+    )
+
+
+def test_retrain_clean_run_versions_and_counters(tmp_path):
+    publishes = []
+    sup = _sup(lambda: "forest", lambda p: "rotated", tmp_path,
+               publishes=publishes)
+    out = sup.run_once()
+    assert out.status == "rotated" and out.attempts == 1
+    assert os.path.basename(out.checkpoint) == "m-v0002.npz"
+    out2 = sup.run_once()
+    # Every attempt gets a fresh version number — never overwritten.
+    assert os.path.basename(out2.checkpoint) == "m-v0003.npz"
+    assert publishes == [out.checkpoint, out2.checkpoint]
+
+
+def test_retrain_transient_retry_exact_backoff_schedule(tmp_path):
+    attempts = []
+
+    def flaky_fit():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise OSError("panel fetch timeout")
+        return "forest"
+
+    delays = []
+    sup = RetrainSupervisor(
+        "m", flaky_fit, str(tmp_path), lambda p: "rotated",
+        config=RetrainConfig(max_attempts=3, backoff_s=0.05),
+        publish_fn=lambda path, forest: None, sleep=delays.append,
+    )
+    out = sup.run_once()
+    assert out.status == "rotated" and out.attempts == 3
+    # The crc32-jittered schedule is a pure function — assert exactly.
+    assert delays == [retrain_backoff_delay("m", 1, 0.05),
+                      retrain_backoff_delay("m", 2, 0.05)]
+    assert all(0.05 <= d <= 0.05 * 8.0 * 1.25 for d in delays)
+
+
+def test_retrain_fatal_raises_immediately(tmp_path):
+    def buggy_fit():
+        raise TypeError("a bug is a bug")
+
+    sup = _sup(buggy_fit, lambda p: "rotated", tmp_path)
+    with pytest.raises(TypeError):
+        sup.run_once()
+
+
+def test_retrain_refused_is_terminal_not_retried(tmp_path):
+    calls = []
+
+    def rotate(path):
+        calls.append(path)
+        return "refused"
+
+    sup = _sup(lambda: "f", rotate, tmp_path, max_attempts=3)
+    out = sup.run_once()
+    assert out.status == "refused" and out.attempts == 1
+    assert len(calls) == 1  # republishing the same fit == same refusal
+
+
+def test_retrain_busy_retried_then_deadline(tmp_path):
+    clock = [0.0]
+    fits = []
+
+    def ticking_sleep(s):
+        clock[0] += s
+
+    sup = RetrainSupervisor(
+        "m", lambda: fits.append(1) or "f", str(tmp_path),
+        lambda p: "busy",
+        config=RetrainConfig(max_attempts=10, backoff_s=1.0,
+                             deadline_s=2.0),
+        publish_fn=lambda path, forest: None,
+        clock=lambda: clock[0], sleep=ticking_sleep,
+    )
+    out = sup.run_once()
+    assert out.status in ("busy", "deadline")
+    assert clock[0] <= 2.0 + 1e-9  # no backoff sleep past the deadline
+    assert fits == [1]  # busy retries never re-run the fit
+
+
+def test_retrain_busy_retries_rotation_only_not_the_fit(tmp_path):
+    """A contended rotation claim ("busy", a milliseconds window) must
+    retry ONLY the rotate on the already-published candidate — never
+    pay a full refit or publish a duplicate versioned file."""
+    fits = []
+    publishes = []
+    rotations = []
+
+    def rotate(path):
+        rotations.append(path)
+        return "busy" if len(rotations) < 3 else "rotated"
+
+    sup = RetrainSupervisor(
+        "m", lambda: fits.append(1) or "forest", str(tmp_path), rotate,
+        config=RetrainConfig(max_attempts=5, backoff_s=0.001),
+        publish_fn=lambda path, forest: publishes.append(path),
+        sleep=lambda s: None,
+    )
+    out = sup.run_once()
+    assert out.status == "rotated" and out.attempts == 3
+    assert fits == [1] and len(publishes) == 1  # one fit, one candidate
+    assert rotations == [publishes[0]] * 3      # same path retried
+
+
+def test_retrain_chaos_fault_walks_retry(tmp_path):
+    with chaos.override("rotate:retrain,times=1"):
+        delays = []
+        sup = RetrainSupervisor(
+            "m", lambda: "f", str(tmp_path), lambda p: "rotated",
+            config=RetrainConfig(max_attempts=3, backoff_s=0.01),
+            publish_fn=lambda path, forest: None, sleep=delays.append,
+        )
+        out = sup.run_once()
+    assert out.status == "rotated" and out.attempts == 2
+    assert len(delays) == 1
+
+
+# ── client backoff (satellite, no jax) ─────────────────────────────────
+
+
+def test_client_backoff_deterministic_jittered_capped():
+    # Pure function of (id, code, attempt, hint): same args, same sleep.
+    d1 = retry_backoff_delay("r7", "shed", 1, 0.02)
+    assert d1 == retry_backoff_delay("r7", "shed", 1, 0.02)
+    # Exponential growth with jitter in [0, 25%).
+    for attempt in (1, 2, 3):
+        d = retry_backoff_delay("r7", "shed", attempt, 0.02)
+        raw = 0.02 * 2.0 ** (attempt - 1)
+        assert raw <= d < raw * 1.25
+    # Capped at 8x the hint...
+    assert retry_backoff_delay("r7", "shed", 10, 0.02) <= 8.0 * 0.02
+    # ...and at the absolute ceiling; zero/None-ish hints sleep 0.
+    assert retry_backoff_delay("r7", "shed", 10, 1.0, cap_s=0.5) == 0.5
+    assert retry_backoff_delay("r7", "shed", 1, 0.0) == 0.0
+    # Different ids de-herd.
+    assert retry_backoff_delay("a", "shed", 2, 0.02) != \
+        retry_backoff_delay("b", "shed", 2, 0.02)
+
+
+# ── multi-tenant coalescing (no jax) ───────────────────────────────────
+
+
+def test_coalescer_batches_are_model_pure():
+    """Requests for different models never share a padded matrix, and
+    one tenant's window wait does not block another's full bucket."""
+    clock = [100.0]
+    co = Coalescer(BucketPlan.parse("4,16"), window_s=10.0,
+                   clock=lambda: clock[0])
+
+    def req(rid, rows, model):
+        return PendingRequest(rid, None, rows, clock[0], model=model)
+
+    co.submit(req("a0", 2, "a"))          # a waits on its window...
+    for i in range(4):
+        co.submit(req(f"b{i}", 4, "b"))   # ...b fills its bucket NOW
+    batch = co.next_batch(timeout=0)
+    assert batch.model == "b" and batch.close_reason == "bucket_full"
+    assert [r.request_id for r in batch.requests] == [
+        "b0", "b1", "b2", "b3"]
+    assert co.next_batch(timeout=0) is None   # a still inside its window
+    clock[0] += 10.0
+    batch2 = co.next_batch(timeout=0)
+    assert batch2.model == "a" and batch2.close_reason == "window_expired"
+    assert [r.request_id for r in batch2.requests] == ["a0"]
+
+
+def test_loadgen_schedule_models_deterministic_and_backcompat():
+    kw = dict(rate_hz=100.0, mix="1:4,8:2")
+    plain = loadgen.build_schedule(7, 30, **kw)
+    with_models = loadgen.build_schedule(7, 30, models=("a", "b"), **kw)
+    # The pre-model draws are bit-identical (draw-order contract).
+    assert [(s.request_id, s.t_s, s.rows) for s in plain] == \
+        [(s.request_id, s.t_s, s.rows) for s in with_models]
+    assert all(s.model == "" for s in plain)
+    assert {s.model for s in with_models} == {"a", "b"}
+    again = loadgen.build_schedule(7, 30, models=("a", "b"), **kw)
+    assert with_models == again
+
+
+# ── the fleet rig (ONE module-scoped daemon, two tenants) ──────────────
+
+
+def _synthetic_forest(rng):
+    """Same micro-forest shape as the PR 6/7 serving rig."""
+    import jax.numpy as jnp
+
+    from ate_replication_causalml_tpu.models.causal_forest import CausalForest
+
+    T, D, n, p, nb = 8, 3, 50, 4, 8
+    return CausalForest(
+        split_feat=jnp.asarray(
+            rng.integers(0, p, size=(T, D, 1 << D)).astype(np.int32)
+        ),
+        split_bin=jnp.asarray(
+            rng.integers(0, nb - 1, size=(T, D, 1 << D)).astype(np.int32)
+        ),
+        leaf_stats=jnp.asarray(
+            (np.abs(rng.normal(size=(T, 1 << D, 5))) + 0.5).astype(np.float32)
+        ),
+        in_sample=jnp.asarray(rng.uniform(size=(T, n)) < 0.5),
+        bin_edges=jnp.asarray(
+            np.sort(rng.normal(size=(p, nb - 1)), axis=1).astype(np.float32)
+        ),
+        ci_group_size=2,
+    )
+
+
+N_REQUESTS = 80
+_SIZES = (1, 3, 4, 9)
+
+
+@pytest.fixture(scope="module")
+def fleet_rig(tmp_path_factory):
+    """Two same-shape tenants + a rotation candidate, offline
+    references for ALL THREE versions traced BEFORE startup (the
+    process-global no-compile gotcha), ONE running fleet daemon."""
+    import jax.numpy as jnp
+
+    from ate_replication_causalml_tpu.models.causal_forest import predict_cate
+    from ate_replication_causalml_tpu.serving.daemon import (
+        CateServer,
+        ServeConfig,
+    )
+    from ate_replication_causalml_tpu.utils.checkpoint import save_fitted
+
+    tmp = tmp_path_factory.mktemp("fleet")
+    rng = np.random.default_rng(0)
+    forests = {
+        "default_v1": _synthetic_forest(rng),
+        "b_v1": _synthetic_forest(rng),
+        "default_v2": _synthetic_forest(rng),
+    }
+    ckpts = {}
+    for name, forest in forests.items():
+        ckpts[name] = str(tmp / f"{name}.npz")
+        save_fitted(ckpts[name], forest)
+
+    xs = [
+        rng.normal(size=(_SIZES[i % len(_SIZES)], 4)).astype(np.float32)
+        for i in range(N_REQUESTS)
+    ]
+    cat = jnp.asarray(np.concatenate(xs))
+    refs = {}
+    for name, forest in forests.items():
+        out = predict_cate(forest, cat, oob=False, row_backend="matmul")
+        refs[name] = (np.asarray(out.cate), np.asarray(out.variance))
+
+    server = CateServer(ServeConfig(
+        checkpoint=ckpts["default_v1"],
+        fleet=(("b", ckpts["b_v1"]),),
+        buckets=BucketPlan.parse("4,16"),
+        window_s=0.002,
+        max_depth=32,
+        retry_after_s=0.005,
+    ))
+    phases = server.startup()
+    yield dict(server=server, xs=xs, refs=refs, ckpts=ckpts,
+               phases=phases, publish_dir=str(tmp))
+    # Module teardown ENFORCES the zero-compile window over everything —
+    # including the live rotations and the chaos refusals.
+    server.stop()
+
+
+def _offsets(xs):
+    offs, off = [], 0
+    for x in xs:
+        offs.append(off)
+        off += x.shape[0]
+    return offs
+
+
+def test_same_shape_fleet_shares_executables(fleet_rig):
+    server = fleet_rig["server"]
+    # Two models, one geometry signature: exactly one executable per
+    # bucket, shared — the forest is a runtime argument.
+    assert len(server._executables) == 2
+    assert {b for (_, b) in server._executables} == {4, 16}
+    assert set(server.fleet.ids()) == {"default", "b"}
+
+
+def test_multi_tenant_replay_across_live_rotation(fleet_rig):
+    """THE acceptance criterion: a seeded multi-tenant open-loop replay
+    across a LIVE hot-swap — zero dropped in-flight requests, answers
+    bit-identical per checkpoint version (old forest before the swap
+    instant, new after), readyz 200 for the entire window, the
+    rotation an instant marker in the serving trace, and (module
+    teardown) zero compiles for the same-shape rotation."""
+    from ate_replication_causalml_tpu.serving.admin import handle_admin_path
+    from ate_replication_causalml_tpu.serving.daemon import RejectedRequest
+
+    server = fleet_rig["server"]
+    xs = fleet_rig["xs"]
+    refs = fleet_rig["refs"]
+    offs = _offsets(xs)
+
+    schedule = loadgen.build_schedule(
+        5, N_REQUESTS, rate_hz=4000.0, mix="1:2,3:1,4:1,9:1",
+        id_prefix="mt", models=("default", "b"),
+    )
+    # Row counts must match the precomputed reference slices.
+    schedule = [
+        loadgen.ScheduledRequest(s.index, s.request_id, s.t_s,
+                                 xs[s.index].shape[0], s.model)
+        for s in schedule
+    ]
+
+    readyz: list[int] = []
+    done = threading.Event()
+
+    def poll_readyz():
+        while not done.is_set():
+            readyz.append(handle_admin_path(server, "/readyz")[0])
+            time.sleep(0.002)
+
+    poller = threading.Thread(target=poll_readyz, daemon=True)
+    poller.start()
+
+    rotated = threading.Event()
+
+    def rotate_mid_stream():
+        status = server.rotate(
+            "default", fleet_rig["ckpts"]["default_v2"], reason="test"
+        )
+        assert status == "rotated"
+        rotated.set()
+
+    rotator = threading.Thread(target=rotate_mid_stream, daemon=True)
+
+    t0 = time.monotonic()
+    pending = []
+    for i, sched in enumerate(schedule):
+        if i == N_REQUESTS // 2:
+            rotator.start()  # the hot-swap lands INSIDE the stream
+        delay = t0 + sched.t_s - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        for _ in range(200):
+            try:
+                pending.append(server.submit(
+                    sched.request_id, xs[sched.index], model=sched.model
+                ))
+                break
+            except RejectedRequest as rej:
+                assert rej.code != "bad_request"
+                time.sleep(rej.retry_after_s or 0.002)
+        else:
+            raise AssertionError(f"no progress on {sched.request_id}")
+    rotator.join(30)
+    assert rotated.is_set()
+
+    # Zero dropped in-flight requests: every submission resolves clean.
+    for req in pending:
+        assert req.wait(30), f"request {req.request_id} dropped"
+        assert req.error is None, req.error
+
+    # A few post-rotation requests guarantee version-2 coverage even if
+    # the replay outran the swap.
+    post = [
+        server.serve_request(f"post{i}", xs[i], model="default")
+        for i in range(4)
+    ]
+
+    # Bit-identity per checkpoint version: the version each request
+    # BOUND says which offline reference its bytes must equal.
+    versions_seen = set()
+    for req, sched in list(zip(pending, schedule)) + [
+        (r, loadgen.ScheduledRequest(i, r.request_id, 0.0,
+                                     xs[i].shape[0], "default"))
+        for i, r in enumerate(post)
+    ]:
+        if sched.model == "b":
+            key = "b_v1"
+            assert req.model_version == 1
+        else:
+            assert req.model_version in (1, 2)
+            versions_seen.add(req.model_version)
+            key = "default_v1" if req.model_version == 1 else "default_v2"
+        refc, refv = refs[key]
+        lo = offs[sched.index]
+        hi = lo + xs[sched.index].shape[0]
+        cate, var = req.result
+        assert np.array_equal(cate, refc[lo:hi]), (
+            req.request_id, sched.model, req.model_version)
+        assert np.array_equal(var, refv[lo:hi])
+    assert 2 in versions_seen  # the new forest actually served
+
+    done.set()
+    poller.join(5)
+    # readyz was 200 for the ENTIRE window, rotation included.
+    assert readyz and set(readyz) == {200}
+
+    # The rotation is on the books and on the timeline.
+    from ate_replication_causalml_tpu import observability as obs
+
+    rot = obs.REGISTRY.peek("serving_rotations_total")
+    assert rot.get("model=default,status=rotated", 0) >= 1
+    assert server.fleet.get("default").version == 2
+    assert server.fleet.get("b").version == 1
+
+
+def test_rotation_trace_marker_and_artifact_contract(fleet_rig, tmp_path):
+    """The exported serving trace carries the rotation as an instant
+    marker, the artifact set passes the schema gate (including the
+    silent-drop reconciliation), and the analyzer CLI reproduces
+    serving_report.json bit-for-bit from (trace, metrics)."""
+    server = fleet_rig["server"]
+    outdir = str(tmp_path / "dump")
+    paths = server.dump_artifacts(outdir)
+    names = {os.path.basename(p) for p in paths}
+    assert {"metrics.json", "trace.json", "serving_report.json",
+            "slo_report.json"} <= names
+    assert cms.validate_trace_files(outdir) == []
+
+    with open(os.path.join(outdir, "trace.json")) as f:
+        trace = json.load(f)
+    markers = [
+        ev for ev in trace["traceEvents"]
+        if ev.get("name") == "serving_rotated" and ev.get("ph") == "i"
+    ]
+    assert markers, "rotation instant marker missing from the trace"
+    assert markers[0]["args"]["model"] == "default"
+
+    with open(os.path.join(outdir, "serving_report.json")) as f:
+        rep = json.load(f)
+    rec = rep["reconciliation"]
+    # The replay used raw submit() — those requests are real in the
+    # metrics but invisible to the trace-derived phase section; the
+    # report must ACCOUNT for them.
+    assert rec["silent_drops"] >= 0
+    assert rec["requests_in_metrics"] == \
+        rec["requests_in_trace"] + rec["silent_drops"]
+    assert rec["requests_in_trace"] == rep["requests"]["with_phases"]
+
+    # Analyzer CLI reproduces the report bit-for-bit.
+    import analyze_trace
+
+    before = open(os.path.join(outdir, "serving_report.json"), "rb").read()
+    assert analyze_trace.main([os.path.join(outdir, "trace.json")]) == 0
+    after = open(os.path.join(outdir, "serving_report.json"), "rb").read()
+    assert after == before
+
+
+def test_global_degraded_recovery_keeps_rotated_default(fleet_rig):
+    """Regression: after the default model rotated to v2, a daemon-wide
+    degraded recovery must re-verify the ROTATED last-good checkpoint —
+    not silently roll back to the startup config.checkpoint — and must
+    not mint a phantom model_version (a recovery is not a rotation).
+    The default model's supervisor IS the daemon-wide reloader, so the
+    two paths cannot race two installs."""
+    server = fleet_rig["server"]
+    xs = fleet_rig["xs"]
+    refs = fleet_rig["refs"]
+    entry = server.fleet.get("default")
+    # The replay test above rotated default -> v2.
+    assert entry.version == 2
+    assert entry.supervisor is server._reloader  # one supervisor
+    ckpt_before = entry.checkpoint
+
+    assert server._reloader.report_fault("test:global_fault")
+    server._reloader.join(10)
+    assert server.lifecycle.state == "serving"
+    # Same version, same last-good path, same v2 bytes — no rollback.
+    assert entry.version == 2 and entry.checkpoint == ckpt_before
+    req = server.serve_request("gd0", xs[0])
+    assert req.model_version == 2
+    assert np.array_equal(req.result[0],
+                          refs["default_v2"][0][:xs[0].shape[0]])
+
+
+def test_corrupt_published_checkpoint_never_rotates(fleet_rig):
+    """THE acceptance criterion: under rotate: chaos a corrupt
+    published checkpoint is a typed refusal — the last good model keeps
+    serving bit-identically and readyz never flips."""
+    from ate_replication_causalml_tpu.serving.admin import handle_admin_path
+
+    server = fleet_rig["server"]
+    xs = fleet_rig["xs"]
+    refs = fleet_rig["refs"]
+    offs = _offsets(xs)
+    version_before = server.fleet.get("b").version
+
+    fit_forest = [None]
+
+    def fit_fn():
+        # Serving doesn't care how the candidate was trained; reuse the
+        # rig's default_v2 forest object as b's fresh fit.
+        if fit_forest[0] is None:
+            from ate_replication_causalml_tpu.utils.checkpoint import (
+                load_fitted,
+            )
+
+            fit_forest[0] = load_fitted(
+                fleet_rig["ckpts"]["default_v2"], verify=True
+            )
+        return fit_forest[0]
+
+    sup = server.retrain_supervisor(
+        "b", fit_fn, fleet_rig["publish_dir"],
+        config=RetrainConfig(max_attempts=1, backoff_s=0.001),
+    )
+    with chaos.override("rotate:corrupt"):
+        out = sup.run_once()
+    assert out.status == "refused"
+    # The corrupt candidate is on disk (quarantine), NOT in service.
+    assert os.path.exists(out.checkpoint)
+    assert server.fleet.get("b").version == version_before
+    assert server.fleet.get("b").lifecycle.state == "serving"
+    assert handle_admin_path(server, "/readyz")[0] == 200
+    # Last good bytes still serve, bit-identically.
+    req = server.serve_request("cr0", xs[0], model="b")
+    refc, _ = refs["b_v1"]
+    assert np.array_equal(req.result[0], refc[offs[0]:offs[0] + xs[0].shape[0]])
+    assert req.model_version == version_before
+
+    from ate_replication_causalml_tpu import observability as obs
+
+    rot = obs.REGISTRY.peek("serving_rotations_total")
+    assert rot.get("model=b,status=refused", 0) >= 1
+
+
+def test_slow_verify_rotation_does_not_stall_serving(fleet_rig):
+    """rotate:verify_ms chaos: while one tenant's rotation verify
+    crawls, BOTH tenants keep serving and readyz stays 200."""
+    from ate_replication_causalml_tpu.serving.admin import handle_admin_path
+
+    server = fleet_rig["server"]
+    xs = fleet_rig["xs"]
+    done = threading.Event()
+    status = []
+
+    def rotate_slow():
+        # Same-bytes rotation: version bumps, values stay b_v1.
+        status.append(server.rotate(
+            "b", fleet_rig["ckpts"]["b_v1"], reason="slow"
+        ))
+        done.set()
+
+    with chaos.override("rotate:verify_ms=200"):
+        t = threading.Thread(target=rotate_slow, daemon=True)
+        t.start()
+        served = 0
+        while not done.is_set():
+            server.serve_one(f"sv{served}", xs[served % len(xs)])
+            server.serve_one(f"svb{served}", xs[served % len(xs)],
+                             model="b")
+            assert handle_admin_path(server, "/readyz")[0] == 200
+            served += 1
+        t.join(10)
+    assert status == ["rotated"]
+    assert served >= 1  # requests flowed during the verify window
+
+
+def test_mid_swap_chaos_refused_atomically(fleet_rig):
+    server = fleet_rig["server"]
+    xs = fleet_rig["xs"]
+    refs = fleet_rig["refs"]
+    version_before = server.fleet.get("b").version
+    with chaos.override("rotate:mid_swap"):
+        status = server.rotate("b", fleet_rig["ckpts"]["b_v1"],
+                               reason="midswap")
+    assert status == "refused"
+    assert server.fleet.get("b").version == version_before
+    req = server.serve_request("ms0", xs[0], model="b")
+    assert np.array_equal(req.result[0],
+                          refs["b_v1"][0][:xs[0].shape[0]])
+
+
+def test_per_model_degradation_never_503s_another(fleet_rig):
+    """A model-scoped fault degrades ONLY that tenant: its requests get
+    typed retryable rejects while recovery re-verifies its last good
+    checkpoint; the other tenant and the global readyz are untouched."""
+    from ate_replication_causalml_tpu.serving.admin import handle_admin_path
+    from ate_replication_causalml_tpu.serving.daemon import RejectedRequest
+
+    server = fleet_rig["server"]
+    xs = fleet_rig["xs"]
+    entry = server.fleet.get("b")
+    gate = threading.Event()
+    real_reload = entry.supervisor._reload_fn
+    entry.supervisor._reload_fn = lambda: (gate.wait(5), real_reload())[1]
+    try:
+        assert entry.supervisor.report_fault("test:model_fault")
+        assert entry.lifecycle.state == "degraded"
+        # b rejects typed-retryable; default serves; readyz stays 200.
+        with pytest.raises(RejectedRequest, match="model_degraded") as ei:
+            server.serve_one("pd0", xs[0], model="b")
+        assert ei.value.retry_after_s is not None
+        server.serve_one("pd1", xs[1])  # the other tenant is fine
+        assert handle_admin_path(server, "/readyz")[0] == 200
+        assert server.lifecycle.state == "serving"
+    finally:
+        gate.set()
+        entry.supervisor.join(10)
+        entry.supervisor._reload_fn = real_reload
+    assert entry.lifecycle.state == "serving"  # recovery reloaded
+    server.serve_one("pd2", xs[2], model="b")  # and b serves again
+
+
+def test_shed_reject_is_typed_and_metered(fleet_rig):
+    """The shed wiring end to end: when the shedder says a model is
+    burning, its admissions get typed retryable ``shed`` rejects,
+    metered per model; other models are untouched."""
+    from ate_replication_causalml_tpu import observability as obs
+    from ate_replication_causalml_tpu.serving.daemon import RejectedRequest
+
+    server = fleet_rig["server"]
+    xs = fleet_rig["xs"]
+
+    class _ForcedShed:
+        threshold = 2.0
+
+        def should_shed(self, model_id):
+            return model_id == "b"
+
+        def burns(self):
+            return {"b": 9.9}
+
+        def update(self):
+            return {}
+
+    real = server._shedder
+    server._shedder = _ForcedShed()
+    try:
+        with pytest.raises(RejectedRequest, match="shed") as ei:
+            server.serve_one("sh0", xs[0], model="b")
+        assert ei.value.code == "shed"
+        assert ei.value.retry_after_s is not None
+        server.serve_one("sh1", xs[1])  # default unaffected
+    finally:
+        server._shedder = real
+    fleet_counts = obs.REGISTRY.peek("serving_fleet_requests_total")
+    assert fleet_counts.get("model=b,status=rejected_shed", 0) >= 1
+    assert server.stats()["shed_burn_threshold"] == 0.0  # rig default
+
+
+def test_wire_fleet_routing_and_rotate_op(fleet_rig, tmp_path):
+    """Over the wire: the model header routes, replies carry the
+    serving model version, unknown ids are typed terminal errors, and
+    the rotate/retire ops work — plus the satellite regression: a
+    retrying client converges under serve: + rotate: chaos TOGETHER,
+    bit-identical per served version."""
+    import socket as socketlib
+
+    from ate_replication_causalml_tpu.serving.client import (
+        CateClient,
+        ServingError,
+    )
+    from ate_replication_causalml_tpu.serving.daemon import serve_stream
+
+    server = fleet_rig["server"]
+    xs = fleet_rig["xs"]
+    refs = fleet_rig["refs"]
+    offs = _offsets(xs)
+
+    a, b = socketlib.socketpair()
+    rw = b.makefile("rwb")
+    t = threading.Thread(target=serve_stream, args=(server, rw, rw),
+                         daemon=True)
+    t.start()
+    with CateClient(a.makefile("rb"), a.makefile("wb"), sock=a) as client:
+        cate, _, header = client.predict_full(
+            xs[0], request_id="wf0", model="b"
+        )
+        assert header["model"] == "b"
+        assert np.array_equal(
+            cate, refs["b_v1"][0][offs[0]:offs[0] + xs[0].shape[0]]
+        )
+        with pytest.raises(ServingError, match="unknown_model"):
+            client.predict(xs[0], request_id="wf1", model="nope")
+
+        # serve: chaos (global degraded windows) + rotate: slow-verify
+        # chaos on a concurrent rotation — the client's jittered
+        # backoff absorbs every typed reject and the answers stay
+        # bit-identical to the version that served them.
+        with chaos.override("serve:p=0.3,seed=4;rotate:verify_ms=50"):
+            rot_status = []
+            rot = threading.Thread(
+                target=lambda: rot_status.append(server.rotate(
+                    "b", fleet_rig["ckpts"]["b_v1"], reason="wire"
+                )),
+                daemon=True,
+            )
+            rot.start()
+            for i in range(12):
+                cate, var, header = client.predict_full(
+                    xs[i], request_id=f"wc{i}", model="b",
+                    max_retries=64,
+                )
+                refc, refv = refs["b_v1"]  # same bytes at any version
+                lo = offs[i]
+                hi = lo + xs[i].shape[0]
+                assert np.array_equal(cate, refc[lo:hi])
+                assert np.array_equal(var, refv[lo:hi])
+            rot.join(15)
+            assert rot_status == ["rotated"]
+        # The chaos spec faulted ~30% of ids: the client ABSORBED them.
+        planned = [
+            f"wc{i}" for i in range(12)
+            if chaos._unit(4, "serve", f"wc{i}") < 0.3
+        ]
+        if planned:
+            assert client.retry_counts.get("serve_fault", 0) >= 1
+            assert client.backoff_s_total > 0.0
+
+        # Operator rotate op over the wire (same-bytes candidate).
+        assert client.rotate(fleet_rig["ckpts"]["b_v1"], model="b") == \
+            "rotated"
+        assert client.rotate(str(tmp_path / "missing.npz"),
+                             model="b") == "refused"
+        assert client.rotate(fleet_rig["ckpts"]["b_v1"],
+                             model="ghost") == "unknown_model"
+    t.join(5)
+    assert not t.is_alive()
+    assert server.lifecycle.state == "serving"
+
+
+def test_fleet_loadgen_inprocess_replay(fleet_rig):
+    """run_inprocess with a multi-tenant schedule: every scheduled
+    request serves and the record carries the per-model offered mix."""
+    server = fleet_rig["server"]
+    schedule = loadgen.build_schedule(
+        11, 24, rate_hz=3000.0, mix="1:2,4:1", id_prefix="flg",
+        models=("default", "b"),
+    )
+    queries = loadgen.build_queries(11, schedule, 4)
+    record = loadgen.run_inprocess(server, schedule, queries,
+                                   timeout_s=30.0)
+    assert record["served"] == 24
+    assert set(record["offered_by_model"]) == {"default", "b"}
+    assert sum(record["offered_by_model"].values()) == 24
+
+
+def test_retire_is_terminal_last(fleet_rig):
+    """LAST rig test by design (retirement is terminal): a retired
+    tenant answers typed ``retired_model`` — to predicts AND to
+    rotation attempts — and never ``unknown_model``; the other tenant
+    is untouched."""
+    from ate_replication_causalml_tpu.serving.daemon import RejectedRequest
+
+    server = fleet_rig["server"]
+    xs = fleet_rig["xs"]
+    assert server.retire("b") is True
+    assert server.retire("ghost") is False
+    with pytest.raises(RejectedRequest, match="retired_model"):
+        server.serve_one("rt0", xs[0], model="b")
+    assert server.rotate("b", fleet_rig["ckpts"]["b_v1"]) == \
+        "retired_model"
+    server.serve_one("rt1", xs[1])  # default keeps serving
+    assert server.fleet.describe()["b"]["state"] == "retired"
+
+
+# ── validator corruption cases (no jax) ────────────────────────────────
+
+
+def test_validator_flags_broken_reconciliation():
+    base = {
+        "schema_version": 1, "window_s": 1.0,
+        "requests": {
+            "count": 3, "status": {"ok": 3}, "with_phases": 2,
+            "e2e": {"count": 2, "sum_s": 0.2, "p50_s": 0.1,
+                    "p99_s": 0.1, "max_s": 0.1},
+            "phases": {
+                k: {"count": 2, "sum_s": 0.01, "p50_s": 0.005,
+                    "p99_s": 0.005, "max_s": 0.005}
+                for k in ("coalesce_wait", "queue_wait", "dispatch",
+                          "device", "reply")
+            },
+        },
+        "batches": {"count": 1, "rows": 3, "by_bucket": {"4": 1},
+                    "fill_mean": 0.75, "pad_fraction_mean": 0.25,
+                    "close_reasons": {"drain": 1}},
+        "rejects": {"count": 0, "by_reason": {}, "timeline": [],
+                    "timeline_truncated": 0},
+    }
+    ok = dict(base, reconciliation={
+        "requests_in_metrics": 5, "requests_in_trace": 2,
+        "silent_drops": 3,
+    })
+    assert cms.validate_serving_report(ok) == []
+    # Inconsistent delta, impossible window, and trace/report mismatch
+    # must each FAIL — silent drops may not be silently misreported.
+    bad_delta = dict(base, reconciliation={
+        "requests_in_metrics": 5, "requests_in_trace": 2,
+        "silent_drops": 1,
+    })
+    assert any("silent_drops" in e
+               for e in cms.validate_serving_report(bad_delta))
+    impossible = dict(base, reconciliation={
+        "requests_in_metrics": 1, "requests_in_trace": 2,
+        "silent_drops": -1,
+    })
+    assert any("impossible" in e
+               for e in cms.validate_serving_report(impossible))
+    mismatch = dict(base, reconciliation={
+        "requests_in_metrics": 5, "requests_in_trace": 4,
+        "silent_drops": 1,
+    })
+    assert any("with_phases" in e
+               for e in cms.validate_serving_report(mismatch))
+
+
+def test_validator_requires_reconciliation_beside_metrics(tmp_path):
+    """A serving_report.json sitting beside a metrics.json without the
+    reconciliation section is flagged — silent submit() drops would be
+    invisible."""
+    outdir = str(tmp_path)
+    report = {
+        "schema_version": 1, "window_s": 0.0,
+        "requests": {"count": 0, "status": {}, "with_phases": 0,
+                     "e2e": {"count": 0, "sum_s": 0.0, "p50_s": 0.0,
+                             "p99_s": 0.0, "max_s": 0.0},
+                     "phases": {
+                         k: {"count": 0, "sum_s": 0.0, "p50_s": 0.0,
+                             "p99_s": 0.0, "max_s": 0.0}
+                         for k in ("coalesce_wait", "queue_wait",
+                                   "dispatch", "device", "reply")
+                     }},
+        "batches": {"count": 0, "rows": 0, "by_bucket": {},
+                    "fill_mean": 0.0, "pad_fraction_mean": 0.0,
+                    "close_reasons": {}},
+        "rejects": {"count": 0, "by_reason": {}, "timeline": [],
+                    "timeline_truncated": 0},
+    }
+    with open(os.path.join(outdir, "serving_report.json"), "w") as f:  # graftlint: disable=JGL005
+        json.dump(report, f)
+    with open(os.path.join(outdir, "metrics.json"), "w") as f:  # graftlint: disable=JGL005
+        json.dump({"schema_version": 1, "counters": {}, "gauges": {},
+                   "histograms": {}, "bucket_histograms": {
+                       "serving_phase_seconds": {
+                           "phase=device": {"count": 7}}}}, f)
+    errors = cms.validate_trace_files(outdir)
+    assert any("no reconciliation" in e for e in errors)
+    # With a reconciliation whose metrics-side count disagrees with the
+    # metrics.json file: also flagged.
+    report["reconciliation"] = {"requests_in_metrics": 3,
+                                "requests_in_trace": 0,
+                                "silent_drops": 3}
+    with open(os.path.join(outdir, "serving_report.json"), "w") as f:  # graftlint: disable=JGL005
+        json.dump(report, f)
+    errors = cms.validate_trace_files(outdir)
+    assert any("phase count" in e for e in errors)
+    # And the consistent report passes.
+    report["reconciliation"] = {"requests_in_metrics": 7,
+                                "requests_in_trace": 0,
+                                "silent_drops": 7}
+    with open(os.path.join(outdir, "serving_report.json"), "w") as f:  # graftlint: disable=JGL005
+        json.dump(report, f)
+    assert cms.validate_trace_files(outdir) == []
+
+
+def test_graftlint_jgl008_covers_fleet_and_retrain_modules():
+    """The unlocked-shared-state rule's serving/ scope includes the new
+    fleet/retrain modules (path-scoped, zero new suppressions)."""
+    from ate_replication_causalml_tpu.analysis.core import lint_source
+
+    src = (
+        "import threading\n"
+        "class Fleet:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._entries = {}\n"
+        "    def bad(self, k, v):\n"
+        "        self._entries[k] = v\n"
+    )
+    for rel in ("pkg/serving/fleet.py", "pkg/serving/retrain.py"):
+        res = lint_source(src, relpath=rel, select=["JGL008"])
+        assert [f.line for f in res.findings] == [7], rel
