@@ -1,4 +1,4 @@
-"""The graftlint rule set (JGL001–JGL010).
+"""The graftlint rule set (JGL001–JGL011).
 
 Each rule targets a failure class that has actually bitten (or nearly
 bitten) this codebase on TPU — see ADVICE.md and the rule docstrings.
@@ -1128,3 +1128,97 @@ class UnmeteredHostMaterialization(Rule):
                     "bytes are counted and the mesh-lane discipline "
                     "holds",
                 )
+
+
+# ---------------------------------------------------------------- JGL011
+
+#: Function names that ARE the predict path in models/: routing, leaf
+#: indexing and CATE scoring. Grow-time code is out of scope — its
+#: gathers are the growers' business (and its hot loops were converted
+#: separately).
+_PREDICT_FN_RE = re.compile(
+    r"(predict|route|leaf_index|forest_apply|apply_trees|per_tree)"
+)
+
+#: Subscript index names that look like a per-row id vector. Matching
+#: is deliberately narrow (exact id-ish tokens), so loop counters
+#: (``level``, ``i``) and static shape math never false-positive.
+_ROW_ID_NAME_RE = re.compile(
+    r"^(node|nodes|ids|idx|node_of_row|leaf_index|li|train_leaf)$"
+    r"|(^|_)(node|leaf)_(ids?|idx)(_|$)"
+    r"|_ids?$|_idx$"
+)
+
+_TAKE_CALLS = {"jax.numpy.take", "numpy.take", "jax.lax.gather"}
+
+
+@register
+class PredictPathRowGather(Rule):
+    """ISSUE 12's predict-path contract: per-row dynamic gathers
+    (``jnp.take`` / ``codes[node_ids]``) serialize on TPU — measured at
+    ~2/3 of forest wall-clock before the routing loops were converted
+    (models/causal_forest.py::_tree_route docstring) — and they bypass
+    the sanctioned formulations: the exact one-hot matmuls, the PACKED
+    contractions (``ops/pack.py`` + ``route_rows_packed``), and the
+    Pallas row kernels (``ops/tree_pallas.py``). A gather creeping back
+    into a predict-path function is a silent 10×-class regression the
+    bit-identity tests cannot catch (the VALUES are right), so the lint
+    catches the form."""
+
+    id = "JGL011"
+    name = "predict-row-gather"
+    description = (
+        "jnp.take/[...] per-row dynamic gather in a models/ predict-path "
+        "function — use the one-hot/packed contractions or the Pallas "
+        "row kernels"
+    )
+
+    def _in_scope(self, relpath: str) -> bool:
+        return "/models/" in f"/{relpath}"
+
+    def _is_row_id_index(self, idx: ast.expr) -> bool:
+        """A bare row-id Name, or a tuple index carrying one (slices,
+        constants and arithmetic are static selection — fine)."""
+        if isinstance(idx, ast.Name):
+            return bool(_ROW_ID_NAME_RE.search(idx.id))
+        if isinstance(idx, ast.Tuple):
+            return any(self._is_row_id_index(e) for e in idx.elts)
+        return False
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if not self._in_scope(module.relpath):
+            return
+        seen: set[int] = set()
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not _PREDICT_FN_RE.search(fn.name):
+                continue
+            for node in ast.walk(fn):
+                if id(node) in seen:
+                    continue
+                seen.add(id(node))
+                if isinstance(node, ast.Call):
+                    name = module.resolve(node.func)
+                    if name in _TAKE_CALLS:
+                        yield self.finding(
+                            module,
+                            node,
+                            f"{name} is a per-row dynamic gather in "
+                            f"predict-path function {fn.name!r} — "
+                            "serializes on TPU; use the one-hot/packed "
+                            "contraction or the Pallas row kernels "
+                            "(ops/tree_pallas.py::table_lookup)",
+                        )
+                elif isinstance(node, ast.Subscript) and self._is_row_id_index(
+                    node.slice
+                ):
+                    yield self.finding(
+                        module,
+                        node,
+                        f"[...] indexing by a row-id vector in "
+                        f"predict-path function {fn.name!r} is a per-row "
+                        "dynamic gather — serializes on TPU; use the "
+                        "one-hot/packed contraction or the Pallas row "
+                        "kernels",
+                    )

@@ -59,6 +59,7 @@ from ate_replication_causalml_tpu.data.frame import CausalFrame
 from ate_replication_causalml_tpu.models.forest import (
     _meter_hist_dispatches,
     apply_trees_chunked,
+    route_rows_packed,
     auto_tree_chunk,
     bin_onehot,
     binarize,
@@ -80,9 +81,14 @@ from ate_replication_causalml_tpu.ops.hist_pallas import (
     bin_histogram_shared,
     mode_for_width,
     node_sums_shared,
-    resolve_hist_mode,
+    resolve_hist_mode_packed,
 )
 from ate_replication_causalml_tpu.ops.linalg import _PREC
+from ate_replication_causalml_tpu.ops.pack import (
+    pack_codes,
+    packable,
+    resolve_predict_pack,
+)
 from ate_replication_causalml_tpu.ops.tree_pallas import (
     codes_transposed,
     route_bits,
@@ -208,7 +214,7 @@ def grow_causal_forest(
     # so the input rounding buys nothing. Explicit "pallas_bf16" remains
     # available.
     hist_backend = resolve_hist_backend(hist_backend, n_rows=n, n_bins=n_bins)
-    hist_mode = resolve_hist_mode(hist_mode)
+    hist_mode = resolve_hist_mode_packed(hist_mode, n_bins)
     edges = quantile_bins(x, n_bins)
     codes = binarize(x, edges)
     xb_onehot = bin_onehot(codes, n_bins) if hist_backend == "onehot" else None
@@ -339,7 +345,7 @@ def grow_causal_forest_sharded(
     hist_backend = resolve_hist_backend(
         hist_backend, allow_onehot=False, n_rows=n, n_bins=n_bins
     )
-    hist_mode = resolve_hist_mode(hist_mode)
+    hist_mode = resolve_hist_mode_packed(hist_mode, n_bins)
     axis_size = mesh.shape[axis_name]
     per_dev_groups = -(-n_groups // axis_size)
     streaming = hist_backend.startswith("pallas")
@@ -797,13 +803,17 @@ def fit_causal_forest(
     return FittedCausalForest(forest=forest, y_hat=y_hat, w_hat=w_hat, x=x, y=y, w=w)
 
 
-def _tree_route(feats, bins, codes, depth):
+def _tree_route(feats, bins, codes, depth, packed=None):
     """Leaf index of every query row down one tree: (n,) int32.
 
     Per-level one-hot matmuls, not gathers: per-row dynamic gathers
     serialize on TPU (measured ~2/3 of forest wall-clock before the
     grow loop was converted the same way). All quantities are small
     ints in f32, so comparisons are exact.
+
+    ``packed`` (ISSUE 12): the caller's shared :func:`pack_codes`
+    operand — when given, every level routes through the 3×-narrower
+    packed contraction (``route_rows_packed``; bit-identical routing).
     """
     rows = codes.shape[0]
     codes_f = codes.astype(jnp.float32)
@@ -811,7 +821,14 @@ def _tree_route(feats, bins, codes, depth):
     for level in range(depth):
         m = 1 << level
         node_oh = jax.nn.one_hot(node, m, dtype=jnp.float32)
-        node = route_rows(node_oh, feats[level][:m], bins[level][:m], codes_f, node)
+        if packed is not None:
+            node = route_rows_packed(
+                node_oh, feats[level][:m], bins[level][:m], packed, node
+            )
+        else:
+            node = route_rows(
+                node_oh, feats[level][:m], bins[level][:m], codes_f, node
+            )
     return node
 
 
@@ -834,10 +851,44 @@ def _tree_route_stream(feats, bins, codes_t, depth, backend="pallas"):
     return node
 
 
-@functools.partial(jax.jit, static_argnames=("tree_chunk", "row_chunk"))
+def _resolve_pack_for(forest: CausalForest, pack) -> bool:
+    """Config-time pack resolution for one forest: the policy
+    (``ATE_TPU_PREDICT_PACK`` or an explicit argument) AND the 7-bit
+    exactness bound — a forest binned wider than 128 keeps the
+    identical unpacked path silently (ops/pack.py::packable)."""
+    return resolve_predict_pack(pack) and packable(
+        int(forest.bin_edges.shape[1]) + 1
+    )
+
+
+def _leaf_index_dtype(depth: int):
+    # Leaf ids are < 2^depth: store the (T, n) cache in the smallest
+    # integer type (int32 would be 8 GB at 2000 trees × 1M rows — the
+    # exact scale the cache exists for).
+    return jnp.uint8 if depth <= 8 else (
+        jnp.int16 if depth <= 15 else jnp.int32
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("tree_chunk", "row_chunk", "pack")
+)
+def _compute_leaf_index_impl(
+    forest: CausalForest, x: jax.Array, tree_chunk: int, row_chunk: int,
+    pack: bool,
+) -> jax.Array:
+    codes = binarize(x, forest.bin_edges)
+    depth = forest.depth
+    return apply_trees_chunked(
+        forest.split_feat, forest.split_bin, codes, depth,
+        post=lambda node, _: node.astype(_leaf_index_dtype(depth)),
+        tree_chunk=tree_chunk, row_chunk=row_chunk, pack=pack,
+    )
+
+
 def compute_leaf_index(
     forest: CausalForest, x: jax.Array, tree_chunk: int = 32,
-    row_chunk: int = 65536,
+    row_chunk: int = 65536, pack: bool | str | None = None,
 ) -> jax.Array:
     """Per-(tree, row) leaf indices for a fixed query matrix: (T, n).
 
@@ -849,18 +900,110 @@ def compute_leaf_index(
     processed in ``row_chunk`` blocks so the per-level (rows, nodes)
     one-hots stay bounded at the million-row scale, exactly as in
     :func:`predict_cate`.
+
+    An un-jitted dispatcher (the JGL001 discipline): ``pack``
+    (``ATE_TPU_PREDICT_PACK`` when None — ISSUE 12's 3×-fewer-MAC
+    packed routing, bit-identical output) resolves HERE on the host and
+    enters the jitted body as a static.
     """
-    codes = binarize(x, forest.bin_edges)
-    depth = forest.depth
-    # Leaf ids are < 2^depth: store the (T, n) cache in the smallest
-    # integer type (int32 would be 8 GB at 2000 trees × 1M rows — the
-    # exact scale the cache exists for).
-    dtype = jnp.uint8 if depth <= 8 else (jnp.int16 if depth <= 15 else jnp.int32)
-    return apply_trees_chunked(
-        forest.split_feat, forest.split_bin, codes, depth,
-        post=lambda node, _: node.astype(dtype),
-        tree_chunk=tree_chunk, row_chunk=row_chunk,
+    return _compute_leaf_index_impl(
+        forest, x, tree_chunk, row_chunk, _resolve_pack_for(forest, pack)
     )
+
+
+@functools.lru_cache(maxsize=32)
+def _sharded_leaf_index_fn(mesh, axis_name, tree_chunk, row_chunk, pack):
+    """The jitted shard_map leaf-index executable, cached on
+    (mesh, plan, statics) like ``_sharded_cf_grow_fn`` — per-call
+    re-wrapping would re-trace every rotation."""
+    from jax.sharding import PartitionSpec as P
+
+    def device_body(forest, xs):
+        # Rows are independent: each device routes ITS row slice with
+        # the exact integer selections — identical bytes to the serial
+        # build's same columns, whatever the blocking.
+        codes = binarize(xs, forest.bin_edges)
+        depth = forest.depth
+        return apply_trees_chunked(
+            forest.split_feat, forest.split_bin, codes, depth,
+            post=lambda node, _: node.astype(_leaf_index_dtype(depth)),
+            tree_chunk=tree_chunk, row_chunk=row_chunk, pack=pack,
+        )
+
+    return jax.jit(_shard_map(
+        device_body,
+        mesh=mesh,
+        in_specs=(P(), P(axis_name)),
+        out_specs=P(None, axis_name),
+    ))
+
+
+def compute_leaf_index_sharded(
+    forest: CausalForest,
+    x,
+    mesh=None,
+    axis_name: str | None = None,
+    tree_chunk: int = 32,
+    row_chunk: int = 65536,
+    pack: bool | str | None = None,
+) -> np.ndarray:
+    """:func:`compute_leaf_index` as a row-sharded mesh program
+    (ISSUE 12, tentpole a — ROADMAP 5a's serial-prefix killer).
+
+    The flagship leaf-index cache build is a pure per-row routing sweep
+    — BENCH_r05 measured it at 8.0 s as a SERIAL prefix on every model
+    load/rotation. Rows are independent, so the build shards perfectly:
+    the query matrix row-shards over the mesh's data axis (padded to a
+    shard-divisible row count; jax 0.4.37 rejects uneven shards), every
+    device routes its slice through all trees with the same exact
+    integer selections, and the (T, n) result assembles column-sharded.
+    **Sharded == serial bit-identity (dtype included) holds exactly**
+    — routing is integer compares, unaffected by row blocking — and is
+    asserted at 1/2/4/8 devices in tier-1.
+
+    Every byte that crosses a layout boundary moves through the
+    artifact plane (``parallel/shardio.py``) and is metered into
+    ``artifact_transfer_bytes_total{artifact="leaf_index..."}``: one
+    upload/reshard of the query rows in, one host gather of the index
+    out. Returns the HOST (numpy, read-only) (T, n) index — the form
+    the serving fleet stores against a checkpoint; consumers upload it
+    with their predict operands (``predict_cate(leaf_index=...)``
+    accepts it directly).
+
+    The daemon's rotation path calls this BEFORE the swap instant
+    (serving/daemon.py) so a hot-swap binds a warm index instead of
+    paying the serial build on the first post-rotation predict.
+    """
+    from ate_replication_causalml_tpu.parallel import shardio
+    from ate_replication_causalml_tpu.parallel.mesh import get_mesh
+
+    mesh = get_mesh() if mesh is None else mesh
+    axis_name = axis_name or mesh.axis_names[0]
+    d = int(mesh.shape[axis_name])
+    n = int(np.shape(x)[0])
+    n_pad = -(-n // d) * d
+    pack_flag = _resolve_pack_for(forest, pack)
+    with obs.span("leaf_index_sharded_build", rows=n, devices=d,
+                  trees=forest.n_trees):
+        if isinstance(x, jax.Array):
+            xp = jnp.pad(x.astype(jnp.float32), ((0, n_pad - n), (0, 0)))
+            xs = shardio.reshard(
+                xp, shardio.row_sharding(mesh, n_pad, axis_name),
+                artifact="leaf_index_x",
+            )
+        else:
+            xp = np.pad(
+                np.asarray(x, np.float32), ((0, n_pad - n), (0, 0))
+            )
+            xs = shardio.commit(
+                xp, shardio.row_sharding(mesh, n_pad, axis_name),
+                artifact="leaf_index_x",
+            )
+        li = _sharded_leaf_index_fn(
+            mesh, axis_name, tree_chunk, row_chunk, pack_flag
+        )(forest, xs)
+        host = shardio.gather_host(li, artifact="leaf_index")
+    return host[:, :n]
 
 
 def _grf_df_flag(variance_compat: str) -> jnp.float32:
@@ -896,6 +1039,7 @@ def predict_cate(
     leaf_index: jax.Array | None = None,
     row_backend: str | None = None,
     variance_compat: str = "unbiased",
+    pack: bool | str | None = None,
 ) -> CatePredictions:
     """Forest-weighted CATE τ̂(x) with little-bags variance. The little-
     bag grouping (``forest.ci_group_size``) travels with the forest.
@@ -908,6 +1052,12 @@ def predict_cate(
     for this exact ``x``: skips tree traversal entirely, so repeated
     scoring of the same rows is one one-hot contraction per tree.
     Results are identical with or without it.
+
+    ``pack`` — the packed-code routing policy (ISSUE 12;
+    ``ATE_TPU_PREDICT_PACK`` when None): 3 codes per f32 word through
+    the routing contractions, 3× fewer permute MACs, output
+    bit-identical either way (matmul row backend; the Pallas row
+    kernels have no packed formulation and ignore it).
 
     Rows are processed in blocks of ``row_chunk`` (rows are independent
     in every aggregation), bounding the (rows, nodes) one-hot operands
@@ -942,13 +1092,18 @@ def predict_cate(
     # between-variance numerator is bit-identical — the documented
     # exact (gn−1)/gn ratio holds on every row (validated at config
     # time here, never at trace time).
+    # ``pack`` (ISSUE 12): the packed-code routing policy
+    # (ATE_TPU_PREDICT_PACK when None) — resolved here on the host,
+    # entering the jitted body as a static; output bit-identical either
+    # way (asserted in tests/test_predict_pack.py).
     return _predict_cate_traced(
         forest, x, oob, tree_chunk, row_chunk, leaf_index, row_backend,
-        _grf_df_flag(variance_compat),
+        _grf_df_flag(variance_compat), _resolve_pack_for(forest, pack),
     )
 
 
-_PREDICT_CATE_STATICS = ("oob", "tree_chunk", "row_chunk", "row_backend")
+_PREDICT_CATE_STATICS = ("oob", "tree_chunk", "row_chunk", "row_backend",
+                         "pack")
 
 
 def _predict_cate_impl(
@@ -960,6 +1115,7 @@ def _predict_cate_impl(
     leaf_index: jax.Array | None,
     row_backend: str,
     grf_df: jax.Array,
+    pack: bool = False,
 ) -> CatePredictions:
     """:func:`predict_cate`'s traceable body (``row_backend`` concrete;
     ``grf_df`` a traced f32 0/1 scalar selecting the between-group df —
@@ -984,7 +1140,8 @@ def _predict_cate_impl(
 
     streaming = row_backend != "matmul"
 
-    def per_tree(feats, bins, leaf_stats, in_row, li, codes_b, codes_t_b):
+    def per_tree(feats, bins, leaf_stats, in_row, li, codes_b, codes_t_b,
+                 packed_b):
         if li is not None:
             node = li
         elif streaming:
@@ -992,7 +1149,7 @@ def _predict_cate_impl(
                 feats, bins, codes_t_b, depth, backend=row_backend
             )
         else:
-            node = _tree_route(feats, bins, codes_b, depth)
+            node = _tree_route(feats, bins, codes_b, depth, packed=packed_b)
         if streaming:
             stats = table_lookup(
                 leaf_stats.T, node, backend=row_backend
@@ -1058,6 +1215,15 @@ def _predict_cate_impl(
             if streaming and leaf_index is None
             else None
         )
+        # ONE packed operand per row block, shared across every tree
+        # chunk and level (ISSUE 12; matmul routing only — the Pallas
+        # route kernel has no packed formulation, and a cached routing
+        # skips the contraction entirely).
+        packed_blk = (
+            pack_codes(codes_blk)
+            if pack and not streaming and leaf_index is None
+            else None
+        )
 
         def chunk_fn(args):
             feats, bins, stats, inr, li = args  # (gc, k, …)
@@ -1071,7 +1237,8 @@ def _predict_cate_impl(
                 rest = list(rest)
                 i = rest.pop(0) if inr is not None else None
                 l = rest.pop(0) if li is not None else None
-                return per_tree(f, b, s, i, l, codes_blk, codes_t_blk)
+                return per_tree(f, b, s, i, l, codes_blk, codes_t_blk,
+                                packed_blk)
 
             m, valid = jax.vmap(jax.vmap(one))(*vargs)
             # m: (gc, k, rb, 5) per-tree normalized moments. The
@@ -1201,16 +1368,65 @@ def _predict_cate_aot_fn(grf: bool, donate: bool):
     the flag as a runtime operand (one executable for both compat
     modes). Cached so repeated lowers reuse one function identity."""
 
-    def body(forest, x, oob, tree_chunk, row_chunk, leaf_index, row_backend):
+    def body(forest, x, oob, tree_chunk, row_chunk, leaf_index, row_backend,
+             pack):
         return _predict_cate_impl(
             forest, x, oob, tree_chunk, row_chunk, leaf_index, row_backend,
-            jnp.float32(grf),
+            jnp.float32(grf), pack,
         )
 
     kw: dict = dict(static_argnames=_PREDICT_CATE_STATICS)
     if donate:
         kw["donate_argnums"] = (1,)
     return jax.jit(body, **kw)
+
+
+@functools.lru_cache(maxsize=None)
+def _predict_cate_aot_masked_fn(grf: bool, donate: bool):
+    """The FUSED-bucket AOT wrapper (ISSUE 12, tentpole c): same body,
+    plus a traced (batch,) f32 0/1 row-mask operand applied to the
+    outputs — the round-5 traced-0/1-flag discipline. Real rows
+    multiply by 1.0 (``1·x ≡ x`` exactly: fused dispatch is
+    bit-identical to per-bucket dispatch for every served row), masked
+    rows multiply their finite garbage by 0.0 and contribute EXACTLY
+    zero — a fused executable's pad region is deterministic, never
+    garbage. Compiled signature: ``compiled(forest, x, mask, None)``
+    (the trailing ``None`` is still the empty leaf_index pytree)."""
+
+    def body(forest, x, mask, oob, tree_chunk, row_chunk, leaf_index,
+             row_backend, pack):
+        out = _predict_cate_impl(
+            forest, x, oob, tree_chunk, row_chunk, leaf_index, row_backend,
+            jnp.float32(grf), pack,
+        )
+        return CatePredictions(cate=out.cate * mask,
+                               variance=out.variance * mask)
+
+    kw: dict = dict(static_argnames=_PREDICT_CATE_STATICS)
+    if donate:
+        kw["donate_argnums"] = (1,)
+    return jax.jit(body, **kw)
+
+
+def _resolve_lower_config(forest, batch, row_backend, donate,
+                          variance_compat):
+    """The shared config-time preamble of both AOT lowers: backend
+    default, donation gating (ONE warning, never jax's per-dispatch
+    stream), compat validation, and the query ShapeDtypeStruct — one
+    site, so the fused and per-bucket executables can never drift on
+    resolution behavior."""
+    if row_backend is None:
+        row_backend = "pallas" if jax.default_backend() == "tpu" else "matmul"
+    backend = jax.default_backend()
+    if donate is None:
+        donate = backend == "tpu"
+    elif donate and backend != "tpu":
+        _warn_donation_unsupported(backend)
+        donate = False
+    _grf_df_flag(variance_compat)  # validate at config time
+    p = forest.bin_edges.shape[0]
+    x_spec = jax.ShapeDtypeStruct((int(batch), p), jnp.float32)
+    return row_backend, donate, x_spec
 
 
 def lower_predict_cate(
@@ -1223,6 +1439,7 @@ def lower_predict_cate(
     row_backend: str | None = None,
     variance_compat: str = "unbiased",
     donate: bool | None = None,
+    pack: bool | str | None = None,
 ) -> jax.stages.Lowered:
     """AOT-lower the CATE predict executable for a fixed ``(batch, p)``
     query shape (ISSUE 6, the serving daemon's startup phase).
@@ -1242,24 +1459,48 @@ def lower_predict_cate(
     (ISSUE 7 satellite): one Python warning here, at startup/lower
     time, and the non-donated executable — never jax's per-dispatch
     warning stream out of a serving loop."""
-    if row_backend is None:
-        row_backend = "pallas" if jax.default_backend() == "tpu" else "matmul"
-    backend = jax.default_backend()
-    if donate is None:
-        donate = backend == "tpu"
-    elif donate and backend != "tpu":
-        _warn_donation_unsupported(backend)
-        donate = False
-    _grf_df_flag(variance_compat)  # validate at config time
-    p = forest.bin_edges.shape[0]
-    x_spec = jax.ShapeDtypeStruct((int(batch), p), jnp.float32)
+    row_backend, donate, x_spec = _resolve_lower_config(
+        forest, batch, row_backend, donate, variance_compat
+    )
     # The AOT path closes over the df flag as a trace-time CONSTANT so
     # the compiled call signature stays ``compiled(forest, x, None)``
     # (the serving daemon's documented contract). Serving never needs
     # cross-compat bit-identity — each daemon compiles one convention.
     fn = _predict_cate_aot_fn(variance_compat == "grf", donate)
     return fn.lower(
-        forest, x_spec, oob, tree_chunk, row_chunk, None, row_backend
+        forest, x_spec, oob, tree_chunk, row_chunk, None, row_backend,
+        _resolve_pack_for(forest, pack),
+    )
+
+
+def lower_predict_cate_masked(
+    forest: CausalForest,
+    batch: int,
+    *,
+    oob: bool = False,
+    tree_chunk: int = 32,
+    row_chunk: int = 65536,
+    row_backend: str | None = None,
+    variance_compat: str = "unbiased",
+    donate: bool | None = None,
+    pack: bool | str | None = None,
+) -> jax.stages.Lowered:
+    """:func:`lower_predict_cate` for a FUSED bucket group (ISSUE 12):
+    the executable additionally takes a traced (batch,) f32 row-mask
+    and is dispatched as ``compiled(forest, x, mask, None)``. One
+    masked executable serves every bucket of its fusion group — the
+    serving daemon's executable count per model DROPS — with real rows
+    bit-identical to the per-bucket dispatch (×1.0 is exact) and masked
+    rows exactly zero. Same donation gating as the unmasked lower
+    (shared preamble — the two lowers cannot drift)."""
+    row_backend, donate, x_spec = _resolve_lower_config(
+        forest, batch, row_backend, donate, variance_compat
+    )
+    mask_spec = jax.ShapeDtypeStruct((int(batch),), jnp.float32)
+    fn = _predict_cate_aot_masked_fn(variance_compat == "grf", donate)
+    return fn.lower(
+        forest, x_spec, mask_spec, oob, tree_chunk, row_chunk, None,
+        row_backend, _resolve_pack_for(forest, pack),
     )
 
 

@@ -48,9 +48,16 @@ from ate_replication_causalml_tpu.ops.hist_pallas import (
     mode_for_width,
     node_sums,
     resolve_hist_backend,
-    resolve_hist_mode,
+    resolve_hist_mode_packed,
 )
 from ate_replication_causalml_tpu.ops.linalg import _PREC
+from ate_replication_causalml_tpu.ops.pack import pack_codes as _pack_codes
+from ate_replication_causalml_tpu.ops.pack import (
+    packable as _codes_packable,
+)
+from ate_replication_causalml_tpu.ops.pack import (
+    resolve_predict_pack,
+)
 from ate_replication_causalml_tpu.ops.tree_pallas import (
     codes_transposed,
     route_bits,
@@ -169,6 +176,40 @@ def route_rows(node_oh, best_feat, best_bin, codes_f, node_of_row):
 # 64 MB per tree per block — an 8-tree vmapped chunk keeps ~512 MB of
 # transient block one-hots, and 1M rows need only 8 lax.map iterations.
 _ROUTE_BLOCK = 131072
+
+
+def route_rows_packed(node_oh, best_feat, best_bin, packed_f, node_of_row):
+    """:func:`route_rows` over PACKED codes (ISSUE 12, ``ops/pack.py``):
+    ``packed_f`` is (rows, ceil(p/3)) f32 words carrying three 7-bit
+    codes each, and the route table selects the packed WORD (one-hot
+    over ``best_feat // 3``) plus a slot index (``best_feat % 3``)
+    instead of the p-wide feature one-hot — the permutation contraction
+    shrinks 3×. The extracted code is the SAME f32 integer the unpacked
+    path reads (divide-by-power-of-two / floor / subtract on integers
+    below 2^24 are exact), so the routing decision — and with it every
+    downstream byte — is bit-identical; asserted against
+    :func:`route_rows` in tests/test_predict_pack.py.
+
+    Runs in f32 even on TPU: a packed word does not fit bf16's mantissa
+    (see ops/pack.py) — packing trades route_rows' bf16 bandwidth
+    halving for the 3× MAC cut, the A/B ``bench.py --predict-ab``
+    records.
+    """
+    from ate_replication_causalml_tpu.ops.pack import PACK_SLOTS, extract_slot
+
+    p3 = packed_f.shape[1]
+    route_tab = jnp.concatenate(
+        [
+            best_bin.astype(jnp.float32)[:, None],
+            (best_feat % PACK_SLOTS).astype(jnp.float32)[:, None],
+            jax.nn.one_hot(best_feat // PACK_SLOTS, p3, dtype=jnp.float32),
+        ],
+        axis=1,
+    )  # (M, 2 + p3)
+    row_route = jnp.matmul(node_oh, route_tab, precision=_PREC)
+    word = jnp.sum(packed_f * row_route[:, 2:], axis=1)
+    code = extract_slot(word, row_route[:, 1])
+    return node_of_row * 2 + (code > row_route[:, 0]).astype(jnp.int32)
 
 
 def route_rows_blocked(
@@ -343,7 +384,9 @@ def hist_partition_active(hist_mode: str, depth: int, hist_floor: int,
     the partition kernel's fixed VMEM transients
     (ops/hist_pallas.py::batched_tree_cap(partition=True))."""
     return any(
-        mode_for_width(hist_mode, w, kernel_weights, p, n_bins) == "partition"
+        mode_for_width(
+            hist_mode, w, kernel_weights, p, n_bins
+        ).startswith("partition")
         for w in streaming_hist_widths(depth, hist_floor)
     )
 
@@ -914,7 +957,7 @@ def fit_forest_classifier(
     hist_backend = resolve_hist_backend(
         hist_backend, n_rows=n, n_bins=n_bins, integer_weights=y01
     )
-    hist_mode = resolve_hist_mode(hist_mode)
+    hist_mode = resolve_hist_mode_packed(hist_mode, n_bins)
     hist_floor = 1 if hist_backend == "pallas_interpret" else _HIST_M_FLOOR
     # (n_bins ≤ 256 is enforced at the binarize() chokepoint.)
     # Explicit chunks are clamped too: the per-level routing one-hot is
@@ -1204,7 +1247,7 @@ def _grow_chunk(tree_keys, codes, yf, xb_onehot, center, *, depth, mtry, n_bins,
 
 def apply_trees_chunked(
     split_feat, split_bin, codes, depth, post, tree_aux=None,
-    tree_chunk: int = 32, row_chunk: int = 65536,
+    tree_chunk: int = 32, row_chunk: int = 65536, pack: bool = False,
 ):
     """Tiled tree application: route every (tree, row) pair with
     per-level one-hot matmuls (``route_rows``) in bounded
@@ -1222,6 +1265,11 @@ def apply_trees_chunked(
       post: ``(node_ids (rb,), aux_t) -> (rb,) array`` per-tile output
         (e.g. leaf-value contraction, or the ids themselves).
       tree_aux: optional per-tree array (T, …) passed to ``post``.
+      pack: route through the packed-code contraction (ISSUE 12 — one
+        :func:`~..ops.pack.pack_codes` per row block, shared by every
+        tree and level; bit-identical routing, 3× fewer permute MACs).
+        A config-time-resolved static — callers thread
+        ``resolve_predict_pack``, never the environment.
 
     Returns: (T, n) stacked ``post`` outputs.
     """
@@ -1246,13 +1294,23 @@ def apply_trees_chunked(
     codes_b = jnp.pad(codes_f, ((0, n_pad - n), (0, 0))).reshape(n_blocks, rb, -1)
 
     def block_fn(codes_blk):
+        # ONE packed operand per row block, shared by every tree chunk
+        # and level of this block (ISSUE 12).
+        packed_blk = _pack_codes(codes_blk) if pack else None
+
         def one_tree(feats, bins, aux):
             node = jnp.zeros(rb, jnp.int32)
             for level in range(depth):
                 m = 1 << level
                 node_oh = jax.nn.one_hot(node, m, dtype=jnp.float32)
-                node = route_rows(node_oh, feats[level][:m], bins[level][:m],
-                                  codes_blk, node)
+                if pack:
+                    node = route_rows_packed(
+                        node_oh, feats[level][:m], bins[level][:m],
+                        packed_blk, node,
+                    )
+                else:
+                    node = route_rows(node_oh, feats[level][:m],
+                                      bins[level][:m], codes_blk, node)
             return post(node, aux)
 
         def chunk(fba):
@@ -1468,7 +1526,7 @@ def fit_forest_sharded(
         hist_backend, allow_onehot=False, n_rows=n, n_bins=n_bins,
         integer_weights=y01,
     )
-    hist_mode = resolve_hist_mode(hist_mode)
+    hist_mode = resolve_hist_mode_packed(hist_mode, n_bins)
     hist_floor = 1 if hist_backend == "pallas_interpret" else _HIST_M_FLOOR
     axis_size = mesh.shape[axis_name]
     per_dev_total = -(-n_trees // axis_size)
@@ -1569,7 +1627,7 @@ def sharded_fit_plan(
     resolved = resolve_hist_backend(
         hist_backend, allow_onehot=False, n_rows=n_rows, n_bins=n_bins,
     )
-    mode = resolve_hist_mode(hist_mode)
+    mode = resolve_hist_mode_packed(hist_mode, n_bins)
     floor = 1 if resolved == "pallas_interpret" else _HIST_M_FLOOR
     return plan_tree_dispatch(
         n_rows, depth, per_dev_total,

@@ -27,6 +27,7 @@ import time
 from typing import Callable
 
 from ate_replication_causalml_tpu.observability.device import (
+    PAD_FRACTION_BOUNDS,
     compile_event_count,
     install_jax_monitoring,
     record_compiled_cost,
@@ -69,6 +70,7 @@ from ate_replication_causalml_tpu.observability.trace import (
 )
 
 __all__ = [
+    "PAD_FRACTION_BOUNDS",
     "DEFAULT_LATENCY_BUCKETS",
     "EVENTS", "EventLog", "BucketHistogram", "MetricSampler",
     "MetricsRegistry", "REGISTRY", "SCHEMA_VERSION",

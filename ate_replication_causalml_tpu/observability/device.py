@@ -62,6 +62,11 @@ _COMPILE_EVENT_KINDS = {
     "/jax/core/compile/backend_compile_duration": "backend_compile",
 }
 
+#: The shared pad/masked-fraction bucket ladder (ISSUE 12): the
+#: daemon and the pre-creation below MUST agree — the registry rejects
+#: re-creation of a bucket-histogram family with different bounds.
+PAD_FRACTION_BOUNDS = (0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
+
 _installed = False
 _WATCHED_CACHE_DIRS: set[str] = set()
 
@@ -156,6 +161,29 @@ def install_jax_monitoring() -> bool:
             "retrain supervisor runs by model and terminal status").inc(0)
     counter("serving_retrain_retries_total",
             "retrain attempts retried after a transient failure").inc(0)
+    # Predict-path families (ISSUE 12): the pad/masked split. ``pad``
+    # is TRUE waste (unmasked garbage rows a per-bucket dispatch
+    # computes and discards); ``masked`` is a fused dispatch's
+    # deterministic exact-zero region. Both fractions share one fixed
+    # ladder (the daemon must pass the same bounds), and the row-count
+    # counters are the REQUIRED_COUNTERS contract pair — "no row was
+    # ever padded/masked" is a recorded 0 on every instrumented run.
+    counter("serving_pad_rows_total",
+            "unmasked pad rows dispatched by per-bucket executables"
+            ).inc(0)
+    counter("serving_masked_rows_total",
+            "masked (exact-zero) rows dispatched by fused executables"
+            ).inc(0)
+    bucket_histogram(
+        "serving_pad_fraction",
+        "unmasked pad fraction of per-bucket dispatches (true waste)",
+        bounds=PAD_FRACTION_BOUNDS,
+    )
+    bucket_histogram(
+        "serving_masked_fraction",
+        "masked fraction of fused-bucket dispatches (exact zeros)",
+        bounds=PAD_FRACTION_BOUNDS,
+    )
     if _installed:
         return True
     try:

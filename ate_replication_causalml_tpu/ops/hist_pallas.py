@@ -56,6 +56,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ate_replication_causalml_tpu.ops.pack import PACK_RADIX, PACK_SLOTS
+
 # Renamed TPUCompilerParams -> CompilerParams across jax releases; one
 # local alias (imported by tree_pallas / scripts) serves both without
 # mutating the jax module.
@@ -332,7 +334,8 @@ _PART_BLOCK = 8
 
 def _hist_kernel_batched_partition(codes_ref, node_ref, w_ref, out_ref, *,
                                    n_weights, n_trees, max_nodes, bw, f_pb,
-                                   n_bins, in_dtype, shared_weights=False):
+                                   n_bins, in_dtype, shared_weights=False,
+                                   pack=False):
     """Partition-mode grid step (ISSUE 10): same contract and
     layouts as :func:`_hist_kernel_batched`, different FLOP structure.
 
@@ -376,6 +379,21 @@ def _hist_kernel_batched_partition(codes_ref, node_ref, w_ref, out_ref, *,
     stacks (the classifier engine's counts / counts·y, every f32 sum
     exact below 2^24) are bit-identical in any association and the
     tier-1 A/B matrix asserts them with ``array_equal``.
+
+    ``pack=True`` (ISSUE 12, the NEXT §2 candidate follow-up): the
+    codes permutation matmul — the dominant regroup term,
+    TILE·TP·C MACs per tree — contracts a PACKED operand instead. The
+    tile's per-column static lane offsets are stripped once, three raw
+    7-bit codes pack per f32 word (``ops/pack.py``: exact below the
+    24-bit mantissa), the per-tree permutation moves ``ceil(C/3)``
+    columns (3× fewer permute MACs), and the partitioned words unpack
+    and re-offset before the bin one-hot. Packing, permuting a one-hot,
+    and unpacking are all exact integer f32 arithmetic, so the
+    partitioned CODES are bit-identical to the unpacked path; the only
+    observable difference is which lane a zero-weight slack row's
+    exact ±0 lands on — packed == unpacked is asserted ``array_equal``
+    for float stacks too. Pack/unpack run as matmuls against static
+    0/1 selection operands (Mosaic-safe: no strided lane slicing).
     """
     @pl.when(pl.program_id(1) == 0)
     def _zero():
@@ -394,6 +412,38 @@ def _hist_kernel_batched_partition(codes_ref, node_ref, w_ref, out_ref, *,
     tp_iota = lax.broadcasted_iota(jnp.int32, (tp, tile), 0)
     blk_start = lax.broadcasted_iota(jnp.int32, (nb, m1), 0) * b
 
+    if pack:
+        # Packed regroup operands, built ONCE per tile (ops/pack.py).
+        # _offset_codes baked (c mod f_pb)·n_bins into every column;
+        # strip that static offset, pack 3 raw 7-bit codes per f32 word
+        # through a static radix-selection matmul, and keep the unpack
+        # selectors for after the per-tree permutation. Everything is
+        # matmul or elementwise on exact small integers — no strided
+        # lane slicing for Mosaic to refuse, no inexact f32 op anywhere.
+        c_cols = codes_f.shape[1]
+        slots = float(PACK_SLOTS)
+        c3 = -(-c_cols // PACK_SLOTS)
+        r1, r2 = float(PACK_RADIX), float(PACK_RADIX**2)
+        col = lax.broadcasted_iota(jnp.float32, (1, c_cols), 1)
+        lane_off = (col - jnp.floor(col / f_pb) * f_pb) * n_bins
+        ci = lax.broadcasted_iota(jnp.float32, (c_cols, c3), 0)
+        wi = lax.broadcasted_iota(jnp.float32, (c_cols, c3), 1)
+        slot = ci - jnp.floor(ci / slots) * slots
+        radix = jnp.where(slot > 1.5, r2, jnp.where(slot > 0.5, r1, 1.0))
+        pack_mat = jnp.where(jnp.floor(ci / slots) == wi, radix, 0.0)
+        unpack_sel = []
+        for s in range(PACK_SLOTS):
+            wj = lax.broadcasted_iota(jnp.float32, (c3, c_cols), 0)
+            cj = lax.broadcasted_iota(jnp.float32, (c3, c_cols), 1)
+            unpack_sel.append(
+                (cj == slots * wj + float(s)).astype(jnp.float32)
+            )
+        packed_codes = lax.dot_general(
+            codes_f - lane_off, pack_mat,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                # (TILE, C3)
+
     for t in range(n_trees):  # static unroll — T is a chunk-sized constant
         node_row = node_ref[t : t + 1, :]                # (1, TILE)
         in_range = (node_row >= 0) & (node_row < max_nodes)
@@ -410,11 +460,34 @@ def _hist_kernel_batched_partition(codes_ref, node_ref, w_ref, out_ref, *,
         # Gather-free regroup: one-hot permutation matmuls (exact —
         # every output row receives exactly one unit product).
         perm = (tp_iota == dst).astype(jnp.float32)      # (TP, TILE)
-        codes_part = lax.dot_general(
-            perm, codes_f,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ).astype(jnp.int32)                              # (TP, C)
+        if pack:
+            # Permute the 3×-narrower packed words, then unpack and
+            # re-offset — identical integers on every real row (slack
+            # rows reconstruct to bin 0 of each feature instead of
+            # lane 0, killed by their exactly-zero weights either way);
+            # 3× fewer permute MACs. Histograms asserted array_equal
+            # against pack=False in tests.
+            packed_part = lax.dot_general(
+                perm, packed_codes,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                            # (TP, C3)
+            raw_part = jnp.zeros((tp, c_cols), jnp.float32)
+            for s in range(PACK_SLOTS):
+                v = jnp.floor(packed_part / (r1 ** s))
+                v = v - r1 * jnp.floor(v / r1)
+                raw_part = raw_part + lax.dot_general(
+                    v, unpack_sel[s],
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            codes_part = (raw_part + lane_off).astype(jnp.int32)
+        else:
+            codes_part = lax.dot_general(
+                perm, codes_f,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ).astype(jnp.int32)                          # (TP, C)
         if shared_weights:
             w_rows = w_ref[...]                          # (K, TILE)
         else:
@@ -606,7 +679,7 @@ def _batched_unlayout(out, n_trees, k_w, max_nodes, p_groups, bw, f_pb,
 @functools.partial(
     jax.jit,
     static_argnames=("max_nodes", "n_bins", "tile", "bw", "interpret", "bf16",
-                     "partition"),
+                     "partition", "pack"),
 )
 def bin_histogram_pallas_batched(
     codes: jax.Array,
@@ -620,6 +693,7 @@ def bin_histogram_pallas_batched(
     interpret: bool = False,
     bf16: bool = False,
     partition: bool = False,
+    pack: bool = False,
 ) -> jax.Array:
     """Tree-batched histograms: T trees sharing one ``codes`` stream.
 
@@ -667,9 +741,12 @@ def bin_histogram_pallas_batched(
         ((0, 0), (0, n_pad - n)),
     )
 
-    kernel_body = (
-        _hist_kernel_batched_partition if partition else _hist_kernel_batched
-    )
+    if partition:
+        kernel_body = functools.partial(
+            _hist_kernel_batched_partition, pack=pack
+        )
+    else:
+        kernel_body = _hist_kernel_batched
     grid = (p_groups, n_pad // tile)
     out = pl.pallas_call(
         functools.partial(
@@ -700,7 +777,7 @@ def bin_histogram_pallas_batched(
 @functools.partial(
     jax.jit,
     static_argnames=("max_nodes", "n_bins", "tile", "bw", "interpret", "bf16",
-                     "partition"),
+                     "partition", "pack"),
 )
 def bin_histogram_pallas_batched_shared(
     codes: jax.Array,
@@ -714,6 +791,7 @@ def bin_histogram_pallas_batched_shared(
     interpret: bool = False,
     bf16: bool = False,
     partition: bool = False,
+    pack: bool = False,
 ) -> jax.Array:
     """:func:`bin_histogram_pallas_batched` with ONE weight stack
     shared by every tree: ``weights`` is (K, n), not (T, K, n).
@@ -750,9 +828,12 @@ def bin_histogram_pallas_batched_shared(
     )
     w_kn = jnp.pad(weights.astype(jnp.float32), ((0, 0), (0, n_pad - n)))
 
-    kernel_body = (
-        _hist_kernel_batched_partition if partition else _hist_kernel_batched
-    )
+    if partition:
+        kernel_body = functools.partial(
+            _hist_kernel_batched_partition, pack=pack
+        )
+    else:
+        kernel_body = _hist_kernel_batched
     grid = (p_groups, n_pad // tile)
     out = pl.pallas_call(
         functools.partial(
@@ -838,6 +919,51 @@ def batched_tree_cap(max_nodes: int, n_weights: int, tile: int = 2048,
 _HIST_MODE_ENV = "ATE_TPU_HIST_MODE"
 HIST_MODES = ("dense", "partition", "auto")
 
+#: ISSUE 12: the packed-code regroup rides the EXISTING hist_mode
+#: plumbing as a mode suffix ("partition+pack"), so the growers'
+#: config-time-resolved static threads both decisions without a second
+#: parameter trickling through every chunk/grow signature. The suffix
+#: is attached by the growers (resolve_predict_pack at config time),
+#: preserved by mode_for_width's per-width decision, and split off at
+#: the kernel dispatchers.
+PACK_SUFFIX = "+pack"
+
+
+def with_pack_mode(mode: str, pack: bool) -> str:
+    """Attach the pack suffix to a resolved policy mode ("dense" stays
+    packless-capable: the suffix only ever matters where the partition
+    regroup runs, but "auto+pack" must survive resolution)."""
+    base, _ = split_pack_mode(mode)
+    return base + PACK_SUFFIX if pack else base
+
+
+def split_pack_mode(mode: str) -> tuple[str, bool]:
+    """→ (base mode, packed?)."""
+    if mode.endswith(PACK_SUFFIX):
+        return mode[: -len(PACK_SUFFIX)], True
+    return mode, False
+
+
+def resolve_hist_mode_packed(mode: str | None = None,
+                             n_bins: int = 64) -> str:
+    """:func:`resolve_hist_mode` plus the ISSUE 12 pack policy: the
+    growers' ONE config-time call. An explicit ``+pack`` suffix on
+    ``mode`` wins; otherwise ``ATE_TPU_PREDICT_PACK`` decides
+    (``ops/pack.py``); either way packing only engages where a 7-bit
+    slot is exact (``n_bins`` ≤ 128) — wider-bin forests silently keep
+    the identical unpacked path rather than refuse."""
+    from ate_replication_causalml_tpu.ops.pack import (
+        packable,
+        resolve_predict_pack,
+    )
+
+    explicit = False
+    if isinstance(mode, str):
+        mode, explicit = split_pack_mode(mode)
+    base = resolve_hist_mode(mode)
+    pack = (explicit or resolve_predict_pack(None)) and packable(n_bins)
+    return with_pack_mode(base, pack)
+
 
 def resolve_hist_mode(mode: str | None = None) -> str:
     """The single CONFIG-TIME entry for the kernel-mode policy.
@@ -876,9 +1002,17 @@ def hist_level_flops(mode: str, n_rows: int, max_nodes: int, n_weights: int,
     depth); partition total is the permutation matmuls + the node-pure
     block dots, ``rows_pad·(TP/tile)·(C + K) + TP_rows·K·L`` — NO M
     factor in any term, so its useful fraction is depth-independent
-    (asserted in tests and schema-validated in the bench record)."""
-    if mode not in ("dense", "partition"):
-        raise ValueError(f"flop model mode must be dense|partition, got {mode!r}")
+    (asserted in tests and schema-validated in the bench record).
+
+    ``"partition+pack"`` (ISSUE 12) models the packed regroup: the
+    codes permutation contracts ``ceil(C/3)`` packed columns, plus the
+    pack matmul (once per tile) and the three unpack selections (per
+    tree) — all small against the 3×-shrunk permutation term."""
+    mode, packed = split_pack_mode(mode)
+    if mode not in ("dense", "partition") or (packed and mode == "dense"):
+        raise ValueError(
+            f"flop model mode must be dense|partition[+pack], got {mode!r}"
+        )
     f_pb = max(1, _LANES // n_bins)
     p_blocks = -(-p // f_pb)
     lanes = p_blocks * _LANES
@@ -890,8 +1024,17 @@ def hist_level_flops(mode: str, n_rows: int, max_nodes: int, n_weights: int,
         total = 2.0 * rows_pad * n_weights * max_nodes * lanes
     else:
         tp = tile + (max_nodes + 1) * _PART_BLOCK
+        if packed:
+            c3 = -(-c_cols // 3)
+            code_perm = (
+                tp * tile * c3          # packed codes permutation
+                + tile * c_cols * c3    # pack matmul (once per tile)
+                + 3 * tp * c3 * c_cols  # unpack selections
+            )
+        else:
+            code_perm = tp * tile * c_cols  # codes permutation matmul
         per_tile = (
-            tp * tile * c_cols          # codes permutation matmul
+            code_perm
             + n_weights * tile * tp     # weight permutation matmul
             + tp * n_weights * lanes    # node-pure block dots
         )
@@ -932,19 +1075,30 @@ def mode_for_width(mode: str, width: int, n_weights: int, p: int = 21,
     width means each width compiles in exactly ONE mode — the partition
     kernel reuses the existing instantiation set instead of multiplying
     it (executable count is a first-class cost, NEXT.md hardware
-    lessons)."""
-    if mode in ("dense", "partition"):
-        return mode
-    if mode != "auto":
+    lessons).
+
+    A ``+pack`` suffix (ISSUE 12) passes through: the packed regroup is
+    a property of the partition kernel only, so "auto+pack" resolves to
+    "dense" below the crossover and "partition+pack" past it — dense
+    instantiations are byte-identical to the packless policy."""
+    mode, pack = split_pack_mode(mode)
+    if mode == "auto":
+        mode = (
+            "partition"
+            if width >= partition_crossover_width(n_weights, p, n_bins)
+            else "dense"
+        )
+    elif mode not in ("dense", "partition"):
         raise ValueError(f"unknown histogram mode {mode!r}")
-    if width >= partition_crossover_width(n_weights, p, n_bins):
-        return "partition"
-    return "dense"
+    if mode == "partition" and pack:
+        return mode + PACK_SUFFIX
+    return mode
 
 
 @functools.lru_cache(maxsize=None)
 def _pallas_batched_vmappable(max_nodes: int, n_bins: int, bf16: bool,
-                              interpret: bool, partition: bool = False):
+                              interpret: bool, partition: bool = False,
+                              pack: bool = False):
     """The tree-batched kernel as a `custom_vmap` callable.
 
     The forest growers call :func:`bin_histogram` per tree under
@@ -974,7 +1128,7 @@ def _pallas_batched_vmappable(max_nodes: int, n_bins: int, bf16: bool,
             bin_histogram_pallas_batched(
                 codes, node[s : s + cap], weights[s : s + cap],
                 max_nodes=max_nodes, n_bins=n_bins, bf16=bf16,
-                interpret=interpret, partition=partition,
+                interpret=interpret, partition=partition, pack=pack,
             )
             for s in range(0, t, cap)
         ]
@@ -1015,7 +1169,8 @@ def _pallas_batched_vmappable(max_nodes: int, n_bins: int, bf16: bool,
 @functools.lru_cache(maxsize=None)
 def _pallas_batched_shared_vmappable(max_nodes: int, n_bins: int, bf16: bool,
                                      interpret: bool,
-                                     partition: bool = False):
+                                     partition: bool = False,
+                                     pack: bool = False):
     """The shared-weights tree-batched kernel as a `custom_vmap`
     callable: g(codes (n, p), node (T, n), weights (K, n)).
 
@@ -1039,7 +1194,7 @@ def _pallas_batched_shared_vmappable(max_nodes: int, n_bins: int, bf16: bool,
             bin_histogram_pallas_batched_shared(
                 codes, node[s : s + cap], weights,
                 max_nodes=max_nodes, n_bins=n_bins, bf16=bf16,
-                interpret=interpret, partition=partition,
+                interpret=interpret, partition=partition, pack=pack,
             )
             for s in range(0, t, cap)
         ]
@@ -1076,22 +1231,30 @@ def _pallas_batched_shared_vmappable(max_nodes: int, n_bins: int, bf16: bool,
     return g
 
 
-def _check_mode(mode: str, backend: str) -> bool:
+def _check_mode(mode: str, backend: str) -> tuple[bool, bool]:
     """Validate a RESOLVED kernel mode against a RESOLVED backend and
-    return whether the partition kernels should run. 'auto' is not
-    accepted here — callers resolve it per kernel width with
-    :func:`mode_for_width` at config/trace time (a dispatcher seeing
-    'auto' means a caller skipped the heuristic)."""
-    if mode not in ("dense", "partition"):
+    return ``(partition?, packed?)``. 'auto' is not accepted here —
+    callers resolve it per kernel width with :func:`mode_for_width` at
+    config/trace time (a dispatcher seeing 'auto' means a caller
+    skipped the heuristic). The ``+pack`` suffix is only meaningful on
+    the partition kernel (ISSUE 12) and is rejected on dense so a
+    policy bug surfaces instead of silently dropping."""
+    base, pack = split_pack_mode(mode)
+    if base not in ("dense", "partition"):
         raise ValueError(
             f"histogram kernel mode must be 'dense' or 'partition' at "
             f"dispatch (resolve 'auto' via mode_for_width), got {mode!r}"
         )
-    if mode == "partition" and not backend.startswith("pallas"):
+    if pack and base != "partition":
+        raise ValueError(
+            f"the {PACK_SUFFIX!r} suffix applies to the partition kernel "
+            f"only, got {mode!r} (mode_for_width strips it on dense)"
+        )
+    if base == "partition" and not backend.startswith("pallas"):
         raise ValueError(
             f"mode='partition' requires a pallas backend, got {backend!r}"
         )
-    return mode == "partition"
+    return base == "partition", pack
 
 
 def bin_histogram_shared(
@@ -1117,11 +1280,11 @@ def bin_histogram_shared(
     backend = resolve_hist_backend(
         backend, allow_onehot=False, n_rows=codes.shape[0], n_bins=n_bins
     )
-    partition = _check_mode(mode, backend)
+    partition, pack = _check_mode(mode, backend)
     if backend in ("pallas", "pallas_bf16", "pallas_interpret"):
         g = _pallas_batched_shared_vmappable(
             max_nodes, n_bins, backend == "pallas_bf16",
-            backend == "pallas_interpret", partition,
+            backend == "pallas_interpret", partition, pack,
         )
         return g(codes, node_of_row[None], weights)[0]
     if backend == "xla":
@@ -1147,11 +1310,11 @@ def bin_histogram_batched(
     backend = resolve_hist_backend(
         backend, allow_onehot=False, n_rows=codes.shape[0], n_bins=n_bins
     )
-    partition = _check_mode(mode, backend)
+    partition, pack = _check_mode(mode, backend)
     if backend in ("pallas", "pallas_bf16", "pallas_interpret"):
         g = _pallas_batched_vmappable(
             max_nodes, n_bins, backend == "pallas_bf16",
-            backend == "pallas_interpret", partition,
+            backend == "pallas_interpret", partition, pack,
         )
         return g(codes, node_of_row, weights)
     if backend == "xla":
@@ -1289,7 +1452,7 @@ def bin_histogram(
     :func:`resolve_hist_mode` policy.
     """
     backend = resolve_hist_backend(backend, allow_onehot=False)
-    partition = _check_mode(mode, backend)
+    partition, pack = _check_mode(mode, backend)
     if backend in ("pallas", "pallas_bf16", "pallas_interpret"):
         # Through the custom_vmap wrapper: callers vmap this per tree
         # (nested vmaps in the causal grower), and the rule collapses
@@ -1297,7 +1460,7 @@ def bin_histogram(
         # kernel call per grow level instead of a per-tree grid sweep.
         g = _pallas_batched_vmappable(
             max_nodes, n_bins, backend == "pallas_bf16",
-            backend == "pallas_interpret", partition,
+            backend == "pallas_interpret", partition, pack,
         )
         return g(codes, node_of_row[None], weights[None])[0]
     if backend == "xla":

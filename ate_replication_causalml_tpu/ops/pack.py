@@ -1,0 +1,173 @@
+"""Packed-code permutation contractions (ISSUE 12, tentpole b).
+
+The predict path's routing and the partition kernel's regroup both
+move integer bin codes through exact one-hot matmuls — the repo's
+standard gather-free idiom (per-row dynamic gathers serialize on TPU).
+Every code is < ``n_bins`` ≤ 128, i.e. 7 bits, but each one rides a
+full f32 lane through those contractions. This module packs THREE
+pre-offset 7-bit codes per f32 mantissa::
+
+    word = c0 + 128·c1 + 128²·c2          (word < 2^21 ≤ 2^24)
+
+so the permutation/selection matmuls contract a ``ceil(p/3)``-column
+operand instead of ``p`` — 3× fewer permute MACs — and the consumer
+extracts its slot back with exact f32 arithmetic (divide by a power of
+two, floor, subtract): every value involved is an integer below the
+24-bit mantissa, so pack → permute → unpack is **bit-exact**, not
+approximate. The property tests pin the boundary codes (0 and 127 in
+every slot) and the full round trip under vmapped and sharded layouts.
+
+Two consumers (both behind the ONE config-time policy below):
+
+* ``models/forest.py::route_rows_packed`` — the per-level routing
+  contraction of ``_tree_route`` / ``apply_trees_chunked`` /
+  ``_predict_cate_impl``: the route table carries the packed-WORD
+  one-hot plus a slot selector instead of the p-wide feature one-hot.
+* ``ops/hist_pallas.py::_hist_kernel_batched_partition`` — the
+  in-kernel regroup packs the tile's raw codes once, permutes the
+  packed operand per tree, and unpacks before the bin one-hot (the
+  NEXT.md §2 candidate follow-up).
+
+Packed contractions run in f32 even on TPU: a packed word (< 2^21)
+does NOT fit bf16's 8 mantissa bits, so the bf16 fast path of
+``route_rows`` must never see packed operands — the packed formulation
+trades that bandwidth halving for the 3× MAC reduction, which is
+exactly the A/B ``bench.py --predict-ab`` records.
+
+Policy discipline (the JGL001/JGL003 dispatcher rule PR 2 established,
+same shape as ``resolve_hist_mode``): :func:`resolve_predict_pack`
+reads ``ATE_TPU_PREDICT_PACK`` on the host in un-jitted config code and
+the result enters every jitted body as a concrete STATIC — a cached
+trace can never serve a pack decision made under a different
+environment. ``auto`` currently resolves to UNPACKED: the identity is
+exact either way, and the MAC win's wall-clock consequence is
+TPU-blocked on this image (NEXT.md §5) — the default flips only after
+a hardware round measures it, exactly like the hist-mode crossover.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+ENV_PACK = "ATE_TPU_PREDICT_PACK"
+PACK_MODES = ("0", "1", "auto")
+
+#: codes per packed f32 word and the per-slot radix. 3 slots × 7 bits =
+#: 21 bits < the 24-bit f32 mantissa — the largest exact packing.
+PACK_SLOTS = 3
+PACK_RADIX = 128  # 2^7 — exact for codes < 128, i.e. n_bins ≤ 128
+
+
+def resolve_predict_pack(pack: bool | str | None = None) -> bool:
+    """The single CONFIG-TIME entry for the packed-code policy.
+
+    ``pack`` (a caller's explicit argument — bool or a mode string)
+    wins when given; otherwise ``ATE_TPU_PREDICT_PACK`` ("0" | "1" |
+    "auto", case-insensitive, default "auto"). A bad value raises HERE,
+    at config time, never at trace time. Deliberately un-jitted
+    (graftlint JGL001): callers pass the result into jitted bodies as a
+    static.
+
+    "auto" resolves to unpacked on this round — packed == unpacked is
+    bit-exact, so the choice is pure wall-clock, and that measurement
+    is TPU-blocked (the module docstring says why)."""
+    if isinstance(pack, bool):
+        return pack
+    raw = pack if pack is not None else os.environ.get(ENV_PACK, "auto")
+    val = str(raw).strip().lower()
+    if val not in PACK_MODES:
+        raise ValueError(
+            f"{ENV_PACK}/pack must be one of {PACK_MODES} "
+            f"(case-insensitive) or a bool, got {raw!r}"
+        )
+    return val == "1"
+
+
+def packable(n_bins: int) -> bool:
+    """Whether codes from an ``n_bins``-bin quantization fit a 7-bit
+    slot exactly. ``binarize`` allows up to 256 bins; packing requires
+    ≤ 128 — callers gate the packed path on this instead of raising, so
+    an opted-in policy degrades to the exact unpacked path rather than
+    refusing a wide-bin forest."""
+    return int(n_bins) <= PACK_RADIX
+
+
+def packed_width(p: int) -> int:
+    """Packed column count: ``ceil(p / 3)``."""
+    return -(-int(p) // PACK_SLOTS)
+
+
+def pack_codes(codes: jax.Array) -> jax.Array:
+    """(rows, p) integer bin codes < 128 → (rows, ceil(p/3)) f32 packed
+    words; feature f lands in word ``f // 3``, slot ``f % 3``. Missing
+    trailing slots pack as 0 (never read back — no feature maps to
+    them). Exact: each word is an integer < 2^21."""
+    rows, p = codes.shape
+    p3 = packed_width(p)
+    cf = jnp.pad(codes.astype(jnp.float32), ((0, 0), (0, p3 * PACK_SLOTS - p)))
+    cf = cf.reshape(rows, p3, PACK_SLOTS)
+    return (
+        cf[:, :, 0]
+        + float(PACK_RADIX) * cf[:, :, 1]
+        + float(PACK_RADIX**2) * cf[:, :, 2]
+    )
+
+
+def extract_slot(word: jax.Array, slot: jax.Array) -> jax.Array:
+    """The 7-bit code at ``slot`` (f32 values in {0, 1, 2}) of packed
+    ``word`` — exact f32 arithmetic throughout: dividing by a power of
+    two only moves the exponent, and floor/subtract on integers below
+    2^24 are exact. Broadcasting follows jnp semantics."""
+    r1, r2 = float(PACK_RADIX), float(PACK_RADIX**2)
+    div = jnp.where(slot > 1.5, r2, jnp.where(slot > 0.5, r1, 1.0))
+    v = jnp.floor(word / div)
+    return v - r1 * jnp.floor(v / r1)
+
+
+def unpack_codes(packed: jax.Array, p: int) -> jax.Array:
+    """Inverse of :func:`pack_codes`: (rows, ceil(p/3)) words →
+    (rows, p) f32 codes (exact)."""
+    rows, p3 = packed.shape
+    slots = [
+        extract_slot(packed, jnp.float32(s)) for s in range(PACK_SLOTS)
+    ]
+    out = jnp.stack(slots, axis=2).reshape(rows, p3 * PACK_SLOTS)
+    return out[:, :p]
+
+
+def route_mac_model(rows: int, p: int, levels_nodes: list[int],
+                    pack: bool) -> dict:
+    """Analytic MAC model of the one-hot ROUTING contractions for one
+    tree routed over ``rows`` query rows (the ``bench.py --predict-ab``
+    record's fields; mirrors ``route_rows``/``route_rows_packed``).
+
+    Per level with M live nodes the unpacked path contracts
+    ``(rows, M) @ (M, 1+p)`` (threshold + feature one-hot broadcast)
+    then the ``(rows, p)`` code-permutation dot; the packed path
+    contracts ``(rows, M) @ (M, 2+p3)`` (threshold + slot + word
+    one-hot) and a ``(rows, p3)`` dot. ``permute`` counts the
+    code-permutation dot alone — the term packing divides by exactly
+    ``p / ceil(p/3)`` (3× when 3 | p); ``useful`` is mode-independent
+    by construction: every row reads ONE code per level, whatever the
+    contraction that delivers it."""
+    p3 = packed_width(p)
+    permute = 0
+    table = 0
+    useful = 0
+    for m in levels_nodes:
+        useful += rows
+        if pack:
+            permute += rows * p3
+            table += rows * m * (2 + p3)
+        else:
+            permute += rows * p
+            table += rows * m * (1 + p)
+    return {
+        "useful_macs": useful,
+        "permute_macs": permute,
+        "table_macs": table,
+        "total_macs": permute + table,
+    }

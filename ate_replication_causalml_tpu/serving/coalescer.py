@@ -94,6 +94,64 @@ class BucketPlan:
         return None if idx == len(self.sizes) else self.sizes[idx]
 
 
+@dataclasses.dataclass(frozen=True)
+class FusionPlan:
+    """Serve-time bucket fusion (ISSUE 12, tentpole c): adjacent
+    buckets of a :class:`BucketPlan` fuse into GROUPS, and the daemon
+    AOT-compiles ONE masked executable per group (at the group's max
+    width, ``compiled(forest, x, mask, None)``) instead of one per
+    bucket — the executable count per model DROPS, which is a
+    first-class cost (NEXT.md hardware lessons: 1-5 s per executable
+    through the remote toolchain, paid per distinct geometry at every
+    daemon startup).
+
+    A batch that would have ridden bucket ``b`` rides its group's
+    width instead, with a traced 0/1 row-mask marking real rows: the
+    executable's trailing region is deterministic exact zeros (masked),
+    never garbage (pad), and the dispatcher back-fills it with the next
+    pending requests of the same model (``Coalescer.take_fill``) — pad
+    FLOPs become useful FLOPs whenever traffic is queued.
+
+    ``groups`` partitions ``plan.sizes`` ascending; pairing walks from
+    the LARGEST bucket down (``pair_adjacent``) so the big buckets —
+    where an executable is expensive and pad rows are plentiful —
+    always share, and an odd count leaves the SMALLEST bucket alone."""
+
+    plan: BucketPlan
+    groups: tuple[tuple[int, ...], ...]
+
+    def __post_init__(self):
+        flat = [s for g in self.groups for s in g]
+        if tuple(flat) != self.plan.sizes:
+            raise ValueError(
+                f"groups {self.groups!r} must partition the plan's "
+                f"sizes {self.plan.sizes!r} in ascending order"
+            )
+
+    @classmethod
+    def pair_adjacent(cls, plan: BucketPlan) -> "FusionPlan":
+        sizes = list(plan.sizes)
+        groups: list[tuple[int, ...]] = []
+        while sizes:
+            take = sizes[-2:] if len(sizes) >= 2 else sizes[-1:]
+            groups.insert(0, tuple(take))
+            del sizes[-len(take):]
+        return cls(plan, tuple(groups))
+
+    @property
+    def widths(self) -> tuple[int, ...]:
+        """One executable width per group (the group max), ascending."""
+        return tuple(g[-1] for g in self.groups)
+
+    def width_for(self, bucket: int) -> int:
+        """The fused executable width a ``bucket`` batch dispatches
+        on."""
+        for g in self.groups:
+            if bucket in g:
+                return g[-1]
+        raise ValueError(f"bucket {bucket} is not in the plan")
+
+
 class PendingRequest:
     """One admitted request travelling through the coalescer. The
     producer blocks on :meth:`wait`; the dispatcher fills exactly one of
@@ -296,6 +354,38 @@ class Coalescer:
                     req.batch_fill = batch.fill
                 return batch
             return None
+
+    def take_fill(self, model: str, capacity: int,
+                  now: float) -> tuple[PendingRequest, ...]:
+        """Back-fill for a FUSED dispatch (ISSUE 12): remove and return
+        the FIFO prefix of ``model``'s pending requests whose rows fit
+        ``capacity`` — the rows that would otherwise dispatch as masked
+        zeros. Stops at the first waiter that does not fit (FIFO
+        fairness: never reorder past a waiter), returns () when nothing
+        is queued. The caller stamps batch marks (seq/bucket/fill) once
+        the fused batch's final composition is known; only the close
+        clock is stamped here."""
+        if capacity < 1:
+            return ()
+        with self._cond:
+            take: list[PendingRequest] = []
+            total = 0
+            for req in self._pending:
+                if req.model != model:
+                    continue
+                if total + req.rows > capacity:
+                    break
+                take.append(req)
+                total += req.rows
+            if not take:
+                return ()
+            taken = set(map(id, take))
+            self._pending = [
+                r for r in self._pending if id(r) not in taken
+            ]
+            for req in take:
+                req.batch_closed_mono = now
+            return tuple(take)
 
     def next_batch(self, timeout: float | None = None) -> Batch | None:
         """Dispatcher entry: block until a batch closes, the coalescer

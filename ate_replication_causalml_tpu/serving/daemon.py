@@ -107,6 +107,7 @@ from ate_replication_causalml_tpu.serving.coalescer import (
     Batch,
     BucketPlan,
     Coalescer,
+    FusionPlan,
     PendingRequest,
 )
 from ate_replication_causalml_tpu.serving.fleet import (
@@ -123,6 +124,7 @@ ENV_ADMIN_PORT = "ATE_TPU_SERVE_ADMIN_PORT"
 ENV_SLO_MS = "ATE_TPU_SERVE_SLO_MS"
 ENV_FLEET = "ATE_TPU_SERVE_FLEET"
 ENV_SHED_BURN = "ATE_TPU_SERVE_FLEET_SHED_BURN"
+ENV_FUSE = "ATE_TPU_SERVE_FUSE"
 
 DEFAULT_BUCKETS = "1,8,64,256"
 DEFAULT_WINDOW_MS = 2.0
@@ -186,6 +188,14 @@ class ServeConfig:
     #: admissions (typed ``shed`` reject) while its two fastest burn
     #: windows both exceed this. <= 0 disables shedding.
     shed_burn_threshold: float = 0.0
+    #: Serve-time bucket fusion (ISSUE 12): adjacent buckets share ONE
+    #: masked AOT executable per fusion group (``compiled(forest, x,
+    #: mask, None)``) — fewer executables per model, deterministic
+    #: exact-zero masked rows, and the dispatcher back-fills the masked
+    #: region with queued same-model requests. Off by default: the
+    #: per-bucket signature ``compiled(forest, x, None)`` is the
+    #: documented pre-fusion contract.
+    fuse_buckets: bool = False
 
     @classmethod
     def from_env(cls, checkpoint: str, **overrides) -> "ServeConfig":
@@ -202,6 +212,8 @@ class ServeConfig:
             ) / 1e3,
             fleet=parse_fleet_spec(env.get(ENV_FLEET, "")),
             shed_burn_threshold=float(env.get(ENV_SHED_BURN, 0.0)),
+            fuse_buckets=env.get(ENV_FUSE, "0").strip().lower()
+            in ("1", "true", "on"),
         )
         if env.get(ENV_ADMIN_PORT):
             base["admin_port"] = int(env[ENV_ADMIN_PORT])
@@ -236,6 +248,22 @@ class CateServer:
         self.lifecycle = ServingLifecycle()
         self.admission = AdmissionController(config.max_depth)
         self.coalescer = Coalescer(config.buckets, config.window_s)
+        #: bucket-fusion plan (ISSUE 12): None = per-bucket executables
+        #: (the pre-fusion contract); a plan = one masked executable per
+        #: group of adjacent buckets.
+        self._fusion = (
+            FusionPlan.pair_adjacent(config.buckets)
+            if config.fuse_buckets else None
+        )
+        #: (geometry signature, panel row count) pairs whose sharded
+        #: leaf-index build executables are already traced
+        #: (startup/pre-mark builds) — a rotation prewarm only builds
+        #: in-window when it is a cache hit, preserving the
+        #: zero-compile proof (see rotate()). The sig is part of the
+        #: key: the build executable is shaped by the FOREST too, so a
+        #: row-count collision across different-geometry models must
+        #: not read as warm.
+        self._index_shapes: set[tuple] = set()
         self._lock = threading.RLock()
         #: the fleet routing table (ISSUE 11): model id -> entry with
         #: the forest reference, version, geometry signature and the
@@ -310,10 +338,29 @@ class CateServer:
         self._close_reasons = obs.counter(
             "serving_batch_close_total", "micro-batch close reasons"
         )
+        # Pad/masked split (ISSUE 12 satellite): ``pad`` is TRUE waste —
+        # unmasked garbage rows a per-bucket dispatch computes and
+        # discards; ``masked`` is a fused dispatch's deterministic
+        # exact-zero region (partially reclaimed by take_fill). The
+        # row-count counter mirrors are the schema-contract families
+        # (REQUIRED_COUNTERS): "no row was ever padded" is a recorded 0.
         self._pad = obs.bucket_histogram(
             "serving_pad_fraction",
-            "padded fraction of dispatched bucket rows (1 - fill)",
-            bounds=(0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
+            "unmasked pad fraction of per-bucket dispatches (true waste)",
+            bounds=obs.PAD_FRACTION_BOUNDS,
+        )
+        self._masked = obs.bucket_histogram(
+            "serving_masked_fraction",
+            "masked fraction of fused-bucket dispatches (exact zeros)",
+            bounds=obs.PAD_FRACTION_BOUNDS,
+        )
+        self._pad_rows = obs.counter(
+            "serving_pad_rows_total",
+            "unmasked pad rows dispatched by per-bucket executables",
+        )
+        self._masked_rows = obs.counter(
+            "serving_masked_rows_total",
+            "masked (exact-zero) rows dispatched by fused executables",
         )
         # Fleet routing outcomes (ISSUE 11): every terminal, per model —
         # the family the per-model SLOs and the shedder read.
@@ -344,6 +391,50 @@ class CateServer:
                 f"{type(obj).__name__}, not a causal forest"
             )
         return forest
+
+    def _load_model(self, path: str):
+        """:meth:`_load_forest` keeping the training panel too:
+        → ``(forest, train_x | None)``. A ``FittedCausalForest``
+        checkpoint carries the matrix its in-sample (oob) predictions
+        score — the rows whose leaf-index cache the rotation path
+        pre-builds (ISSUE 12)."""
+        from ate_replication_causalml_tpu.models.causal_forest import (
+            CausalForest,
+            FittedCausalForest,
+        )
+        from ate_replication_causalml_tpu.utils.checkpoint import load_fitted
+
+        obj = load_fitted(path, verify=True)
+        if isinstance(obj, FittedCausalForest):
+            return obj.forest, obj.x
+        if not isinstance(obj, CausalForest):
+            raise TypeError(
+                f"checkpoint {path!r} holds "
+                f"{type(obj).__name__}, not a causal forest"
+            )
+        return obj, None
+
+    def _build_leaf_index(self, model_id: str, forest, train_x):
+        """The mesh-sharded leaf-index build (ISSUE 12, tentpole a):
+        one metered sharded routing sweep over the training panel —
+        the 8.0 s serial prefix of BENCH_r05, spread over the data
+        axis. Called at startup (before the no-compile mark) and from
+        the rotation path BEFORE the swap instant."""
+        from ate_replication_causalml_tpu.models.causal_forest import (
+            compute_leaf_index_sharded,
+        )
+
+        t0 = time.perf_counter()
+        li = compute_leaf_index_sharded(forest, train_x)
+        obs.gauge(
+            "serving_leaf_index_build_seconds",
+            "pre-swap sharded leaf-index build duration",
+        ).set(time.perf_counter() - t0, model=model_id)
+        with self._lock:
+            self._index_shapes.add(
+                (self._forest_signature(forest), int(np.shape(train_x)[0]))
+            )
+        return li
 
     def _load_checkpoint(self):
         """The daemon-wide reloader's reload_fn: re-verify the DEFAULT
@@ -455,14 +546,17 @@ class CateServer:
         with obs.span("serving_startup", checkpoint=self.config.checkpoint,
                       models=",".join(m for m, _ in specs)):
             t0 = time.perf_counter()
+            panels: dict[str, object] = {}
             with obs.span("serving_load"):
                 for model_id, path in specs:
-                    forest = self._load_forest(path)
+                    forest, train_x = self._load_model(path)
                     entry = self.fleet.install(
                         model_id, forest, self._forest_signature(forest),
                         int(forest.bin_edges.shape[0]), path,
                     )
                     self._wire_model_supervisor(entry)
+                    if train_x is not None:
+                        panels[model_id] = train_x
             phases["load"] = time.perf_counter() - t0
 
             # One AOT + warm pass per distinct geometry signature (in
@@ -473,35 +567,89 @@ class CateServer:
                 reps.setdefault(entry.sig, entry.forest)
 
             t0 = time.perf_counter()
+            from ate_replication_causalml_tpu.models.causal_forest import (
+                lower_predict_cate_masked,
+            )
+
             for sig, model in reps.items():
-                for bucket in self.config.buckets.sizes:
-                    with obs.span("serving_aot_compile", bucket=bucket):
-                        compiled = lower_predict_cate(
-                            model,
-                            bucket,
-                            oob=False,
-                            tree_chunk=self.config.tree_chunk,
-                            row_backend=self.config.row_backend,
-                            variance_compat=self.config.variance_compat,
-                            donate=self.config.donate,
-                        ).compile()
-                    with self._lock:
-                        self._executables[(sig, bucket)] = compiled
+                if self._fusion is not None:
+                    # ONE masked executable per fusion group (ISSUE 12):
+                    # the executable count per model DROPS from
+                    # len(buckets) to len(groups).
+                    for width in self._fusion.widths:
+                        with obs.span("serving_aot_compile", bucket=width,
+                                      fused=1):
+                            compiled = lower_predict_cate_masked(
+                                model,
+                                width,
+                                oob=False,
+                                tree_chunk=self.config.tree_chunk,
+                                row_backend=self.config.row_backend,
+                                variance_compat=self.config.variance_compat,
+                                donate=self.config.donate,
+                            ).compile()
+                        with self._lock:
+                            self._executables[(sig, "fused", width)] = (
+                                compiled
+                            )
+                else:
+                    for bucket in self.config.buckets.sizes:
+                        with obs.span("serving_aot_compile", bucket=bucket):
+                            compiled = lower_predict_cate(
+                                model,
+                                bucket,
+                                oob=False,
+                                tree_chunk=self.config.tree_chunk,
+                                row_backend=self.config.row_backend,
+                                variance_compat=self.config.variance_compat,
+                                donate=self.config.donate,
+                            ).compile()
+                        with self._lock:
+                            self._executables[(sig, bucket)] = compiled
             phases["aot"] = time.perf_counter() - t0
 
             t0 = time.perf_counter()
             with obs.span("serving_warm"):
                 for sig, model in reps.items():
                     p = int(model.bin_edges.shape[0])
-                    for bucket in self.config.buckets.sizes:
-                        zeros = jax.device_put(
-                            np.zeros((bucket, p), np.float32)
-                        )
-                        out = self._executables[(sig, bucket)](
-                            model, zeros, None
-                        )
-                        np.asarray(out.cate), np.asarray(out.variance)
+                    if self._fusion is not None:
+                        for width in self._fusion.widths:
+                            zeros = jax.device_put(
+                                np.zeros((width, p), np.float32)
+                            )
+                            ones = jax.device_put(
+                                np.ones((width,), np.float32)
+                            )
+                            out = self._executables[(sig, "fused", width)](
+                                model, zeros, ones, None
+                            )
+                            np.asarray(out.cate), np.asarray(out.variance)
+                    else:
+                        for bucket in self.config.buckets.sizes:
+                            zeros = jax.device_put(
+                                np.zeros((bucket, p), np.float32)
+                            )
+                            out = self._executables[(sig, bucket)](
+                                model, zeros, None
+                            )
+                            np.asarray(out.cate), np.asarray(out.variance)
             phases["warm"] = time.perf_counter() - t0
+
+            if panels:
+                # Fitted checkpoints: pre-build each training panel's
+                # leaf-index cache SHARDED over the mesh (ISSUE 12) —
+                # inside the startup window, so the build executables
+                # are traced BEFORE the no-compile mark and a same-shape
+                # rotation's pre-swap rebuild is a pure cache hit.
+                t0 = time.perf_counter()
+                with obs.span("serving_leaf_index",
+                              models=",".join(sorted(panels))):
+                    for model_id, train_x in panels.items():
+                        entry = self.fleet.get(model_id)
+                        entry.leaf_index = self._build_leaf_index(
+                            model_id, entry.forest, train_x
+                        )
+                phases["index"] = time.perf_counter() - t0
 
         g = obs.gauge(
             "serving_startup_seconds", "daemon startup phase durations"
@@ -786,24 +934,56 @@ class CateServer:
         # the new one.
         entry = self.fleet.get(batch.model)
         model, version = self.fleet.binding(batch.model)
+        requests = batch.requests
+        rows = batch.rows
+        if self._fusion is not None:
+            # Fused dispatch (ISSUE 12): ride the bucket's GROUP width
+            # and back-fill the masked region with whatever same-model
+            # requests are already queued — rows that would dispatch as
+            # exact zeros carry real work instead. take_fill preserves
+            # FIFO order, so fairness and the per-request marks hold.
+            width = self._fusion.width_for(batch.bucket)
+            fill_reqs = self.coalescer.take_fill(
+                batch.model, width - rows, picked
+            )
+            if fill_reqs:
+                requests = requests + fill_reqs
+                rows += sum(r.rows for r in fill_reqs)
+            # Restamp the dispatch-level marks so every request in the
+            # fused batch reports the geometry it actually rode.
+            for req in requests:
+                req.batch_seq = batch.seq
+                req.batch_bucket = width
+                req.batch_fill = rows / width
+        else:
+            width = batch.bucket
         with self._lock:
-            compiled = self._executables[(entry.sig, batch.bucket)]
+            compiled = self._executables[
+                (entry.sig, "fused", width) if self._fusion is not None
+                else (entry.sig, width)
+            ]
         p = entry.n_features
         now = time.monotonic
-        with obs.span("serving_batch", bucket=batch.bucket,
-                      rows=batch.rows, requests=len(batch.requests),
+        with obs.span("serving_batch", bucket=width,
+                      rows=rows, requests=len(requests),
                       seq=batch.seq, close_reason=batch.close_reason,
-                      fill=round(batch.fill, 6), model=batch.model,
-                      model_version=version):
+                      fill=round(rows / width, 6), model=batch.model,
+                      model_version=version,
+                      fused=int(self._fusion is not None)):
             try:
-                padded = np.zeros((batch.bucket, p), np.float32)
+                padded = np.zeros((width, p), np.float32)
                 off = 0
-                for req in batch.requests:
+                for req in requests:
                     padded[off:off + req.rows] = req.x
                     off += req.rows
                 x_dev = jax.device_put(padded)
                 device_start = now()
-                out = compiled(model, x_dev, None)
+                if self._fusion is not None:
+                    mask = np.zeros((width,), np.float32)
+                    mask[:rows] = 1.0
+                    out = compiled(model, x_dev, jax.device_put(mask), None)
+                else:
+                    out = compiled(model, x_dev, None)
                 cate = np.asarray(out.cate)
                 var = np.asarray(out.variance)
                 device_end = now()
@@ -816,7 +996,7 @@ class CateServer:
                 # model's supervisor IS the daemon-wide reloader, so
                 # its faults degrade the whole daemon — the pre-fleet
                 # contract.
-                for req in batch.requests:
+                for req in requests:
                     req.picked_mono = picked
                     req.model_version = version
                     req.fail(e, now())
@@ -828,7 +1008,7 @@ class CateServer:
                 )
                 return
             off = 0
-            for req in batch.requests:
+            for req in requests:
                 req.picked_mono = picked
                 req.device_start_mono = device_start
                 req.device_end_mono = device_end
@@ -841,11 +1021,20 @@ class CateServer:
                 off += req.rows
                 self._fleet_requests.inc(1, model=batch.model, status="ok")
                 self.admission.release()
-        self._batches.inc(1, bucket=batch.bucket)
-        self._fill.observe(batch.fill, bucket=batch.bucket)
+        self._batches.inc(1, bucket=width)
+        fill = rows / width
+        self._fill.observe(fill, bucket=width)
         self._close_reasons.inc(1, reason=batch.close_reason)
-        self._pad.observe(1.0 - batch.fill, bucket=batch.bucket)
-        for req in batch.requests:
+        if self._fusion is not None:
+            # The pad/masked split (ISSUE 12 satellite): a fused
+            # dispatch has NO unmasked garbage rows — its empty region
+            # is deterministic exact zeros, reported as masked.
+            self._masked.observe(1.0 - fill, bucket=width)
+            self._masked_rows.inc(width - rows)
+        else:
+            self._pad.observe(1.0 - fill, bucket=width)
+            self._pad_rows.inc(width - rows)
+        for req in requests:
             ph = req.phase_seconds()
             if ph is None:
                 continue
@@ -894,6 +1083,8 @@ class CateServer:
             return "retired_model"
 
         def loader():
+            import jax
+
             inj = chaos.active()
             if inj is not None:
                 delay = inj.rotate_verify_delay_s(f"rotate/{model_id}")
@@ -901,15 +1092,52 @@ class CateServer:
                     # Slow-verify chaos: serving must be provably
                     # unaffected for this whole window.
                     time.sleep(delay)
-            forest = self._load_forest(checkpoint)
+            forest, train_x = self._load_model(checkpoint)
             if self._forest_signature(forest) != entry.sig:
                 raise ValueError(
                     f"candidate {checkpoint!r} changed forest geometry "
                     f"for model {model_id!r}; a rotation cannot re-AOT"
                 )
-            return forest
+            # Pre-swap prewarm (ISSUE 12, the PR 11 rotation gap): the
+            # candidate binds DEVICE-RESIDENT, fully materialized
+            # buffers — the first post-swap dispatch pays no transfer —
+            # and a fitted candidate's training-panel leaf index is
+            # built SHARDED over the mesh here, BEFORE the swap
+            # instant, so no post-rotation rescore pays the serial
+            # build (BENCH_r05's 8.0 s prefix). All of it runs on the
+            # rotation caller's thread; serving continues throughout.
+            forest = jax.device_put(forest)
+            for leaf in jax.tree_util.tree_leaves(forest):
+                if hasattr(leaf, "block_until_ready"):
+                    leaf.block_until_ready()
+            li = None
+            if train_x is not None:
+                key = (entry.sig, int(np.shape(train_x)[0]))
+                with self._lock:
+                    warm = key in self._index_shapes
+                    armed = self._compile_mark is not None
+                if warm or not armed:
+                    with obs.span("serving_leaf_index_prebuild",
+                                  model=model_id,
+                                  rows=int(np.shape(train_x)[0])):
+                        li = self._build_leaf_index(
+                            model_id, forest, train_x
+                        )
+                else:
+                    # A NEW panel row count would trace the sharded
+                    # build executable inside the armed no-compile
+                    # window — skip the prebuild (recorded, never
+                    # silent) rather than poison the serving proof;
+                    # the swap itself stays warm.
+                    obs.emit(
+                        "serving_leaf_index_prebuild_skipped",
+                        status="skipped", model=model_id,
+                        rows=int(np.shape(train_x)[0]),
+                    )
+            return forest, li
 
-        def installer(forest):
+        def installer(pair):
+            forest, li = pair
             inj = chaos.active()
             if inj is not None and inj.take_rotate_fault(
                 "mid_swap", site=f"rotate/{model_id}"
@@ -921,7 +1149,8 @@ class CateServer:
                 raise ChaosRotateFault(
                     f"chaos: injected mid-swap fault ({model_id})"
                 )
-            version = self.fleet.swap(model_id, forest, checkpoint)
+            version = self.fleet.swap(model_id, forest, checkpoint,
+                                      leaf_index=li)
             obs.emit("serving_model_rotated", status="ok",
                      model=model_id, version=version,
                      checkpoint=checkpoint)
@@ -1035,14 +1264,23 @@ class CateServer:
                 out[reason] = int(v)
         return out
 
-    def pad_fraction_mean(self) -> float:
-        """Mean padded fraction across all dispatched batches."""
-        m = obs.REGISTRY.family("serving_pad_fraction")
+    @staticmethod
+    def _fraction_mean(family: str) -> float:
+        m = obs.REGISTRY.family(family)
         if m is None:
             return 0.0
         counts = m.peek_counts()
         n = sum(s["count"] for s in counts.values())
         return sum(s["sum"] for s in counts.values()) / n if n else 0.0
+
+    def pad_fraction_mean(self) -> float:
+        """Mean TRUE-waste pad fraction across per-bucket dispatches
+        (fused dispatches report masked, not pad — ISSUE 12)."""
+        return self._fraction_mean("serving_pad_fraction")
+
+    def masked_fraction_mean(self) -> float:
+        """Mean masked (exact-zero) fraction across fused dispatches."""
+        return self._fraction_mean("serving_masked_fraction")
 
     def stats(self) -> dict:
         """The ``stats`` op payload: state, depth, startup phases, the
@@ -1062,6 +1300,11 @@ class CateServer:
             "phases": self.phase_stats(),
             "close_reasons": self.close_reason_counts(),
             "pad_fraction_mean": self.pad_fraction_mean(),
+            "masked_fraction_mean": self.masked_fraction_mean(),
+            "fused_buckets": (
+                None if self._fusion is None
+                else [list(g) for g in self._fusion.groups]
+            ),
             "admin_port": admin.port if admin is not None else None,
             "slo": self.slo.health(),
             # Fleet state (ISSUE 11): per-model version/lifecycle plus

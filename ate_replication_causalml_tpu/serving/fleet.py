@@ -152,21 +152,30 @@ class ModelLifecycle:
 
 class ModelEntry:
     """One served model: the forest reference and its metadata. The
-    forest/version/checkpoint fields are mutated only through
-    :class:`ModelFleet` under the fleet lock; ``lifecycle`` and
-    ``supervisor`` have their own internal locking."""
+    forest/version/checkpoint/leaf_index fields are mutated only
+    through :class:`ModelFleet` under the fleet lock; ``lifecycle`` and
+    ``supervisor`` have their own internal locking.
+
+    ``leaf_index`` (ISSUE 12): the pre-built (T, n) training-matrix
+    routing cache for a FITTED checkpoint — built sharded over the mesh
+    BEFORE the swap instant (``compute_leaf_index_sharded``), so a
+    rotation never pays the serial build on its first in-sample
+    rescore. None for bare-forest checkpoints; ALWAYS overwritten by a
+    swap (a stale index against a new forest would be silently
+    wrong)."""
 
     __slots__ = ("model_id", "forest", "version", "sig", "n_features",
-                 "checkpoint", "lifecycle", "supervisor")
+                 "checkpoint", "lifecycle", "supervisor", "leaf_index")
 
     def __init__(self, model_id: str, forest, sig, n_features: int,
-                 checkpoint: str):
+                 checkpoint: str, leaf_index=None):
         self.model_id = model_id
         self.forest = forest
         self.version = 1
         self.sig = sig
         self.n_features = int(n_features)
         self.checkpoint = checkpoint
+        self.leaf_index = leaf_index
         self.lifecycle = ModelLifecycle(model_id)
         self.supervisor = None  # wired by the daemon after install
 
@@ -179,9 +188,10 @@ class ModelFleet:
         self._entries: dict[str, ModelEntry] = {}
 
     def install(self, model_id: str, forest, sig, n_features: int,
-                checkpoint: str) -> ModelEntry:
+                checkpoint: str, leaf_index=None) -> ModelEntry:
         """Register a verified model at version 1 (startup only)."""
-        entry = ModelEntry(model_id, forest, sig, n_features, checkpoint)
+        entry = ModelEntry(model_id, forest, sig, n_features, checkpoint,
+                           leaf_index)
         with self._lock:
             if model_id in self._entries:
                 raise ValueError(f"model {model_id!r} already installed")
@@ -212,16 +222,20 @@ class ModelFleet:
         with self._lock:
             self._entries[model_id].forest = forest
 
-    def swap(self, model_id: str, forest, checkpoint: str) -> int:
+    def swap(self, model_id: str, forest, checkpoint: str,
+             leaf_index=None) -> int:
         """The hot-swap instant: replace the forest reference, bump the
-        version, record the new last-good checkpoint. Returns the new
-        version. In-flight batches keep the reference they already
-        bound; new dispatches see the new pair."""
+        version, record the new last-good checkpoint and the candidate's
+        PRE-BUILT leaf index (None clears a stale one — ISSUE 12: an old
+        index against the new forest would be silently wrong). Returns
+        the new version. In-flight batches keep the reference they
+        already bound; new dispatches see the new pair."""
         with self._lock:
             entry = self._entries[model_id]
             entry.forest = forest
             entry.version += 1
             entry.checkpoint = checkpoint
+            entry.leaf_index = leaf_index
             return entry.version
 
     def describe(self) -> dict:
@@ -234,6 +248,10 @@ class ModelFleet:
                     "version": e.version,
                     "checkpoint": e.checkpoint,
                     "n_features": e.n_features,
+                    "leaf_index_rows": (
+                        None if e.leaf_index is None
+                        else int(e.leaf_index.shape[1])
+                    ),
                 }
                 for e in self._entries.values()
             }

@@ -49,6 +49,9 @@ FOREST_BASELINE_S_PER_1M = 6_700.0
 # malformed value fails before the AIPW stage burns minutes).
 DEFAULT_FOREST_ROWS = int(os.environ.get("ATE_BENCH_FOREST_ROWS", 1_000_000))
 
+# Default-mode predict-path A/B scale (ISSUE 12; smoke override).
+PREDICT_AB_ROWS = int(os.environ.get("ATE_BENCH_PREDICT_AB_ROWS", 16_384))
+
 # Set when this process re-execs a CPU child that runs the real bench —
 # the child then owns the $ATE_TPU_METRICS_DIR export (see main()).
 _delegated_to_child = False
@@ -403,6 +406,345 @@ def bench_hist_ab(n=N_ROWS, trees=32, depth=9):
         unit="ms/tree",
         vs_baseline=round(results["xla"] / results["pallas_bf16"], 3),
     )))
+
+
+def _synthetic_predict_forest(key, trees, depth, n_rows, p, n_bins):
+    """A structurally valid CausalForest from random arrays — the
+    predict path doesn't care how the forest was trained, and skipping
+    the fit keeps the A/B seconds, not minutes (the serving-rig
+    pattern)."""
+    from ate_replication_causalml_tpu.models.causal_forest import CausalForest
+
+    ks = jax.random.split(key, 5)
+    leaves = 1 << depth
+    max_nodes = 1 << (depth - 1)
+    return CausalForest(
+        split_feat=jax.random.randint(
+            ks[0], (trees, depth, max_nodes), 0, p, jnp.int32
+        ),
+        split_bin=jax.random.randint(
+            ks[1], (trees, depth, max_nodes), 0, n_bins - 1, jnp.int32
+        ),
+        leaf_stats=jnp.abs(
+            jax.random.normal(ks[2], (trees, leaves, 5), jnp.float32)
+        ) + 0.5,
+        in_sample=jax.random.uniform(ks[3], (trees, n_rows)) < 0.5,
+        bin_edges=jnp.sort(
+            jax.random.normal(ks[4], (p, n_bins - 1), jnp.float32), axis=1
+        ),
+        ci_group_size=2,
+    )
+
+
+def predict_ab_record(rows=16_384, trees=16, depth=8, p=21, n_bins=64,
+                      reps=2):
+    """The ISSUE 12 predict-path A/B record (``bench.py --predict-ab``,
+    committed as PREDICT_AB.json, schema-validated by
+    ``check_metrics_schema.py::validate_predict_ab_record``). Three
+    sections, each a bit-identity verdict plus modeled accounting:
+
+    * ``pack`` — packed vs unpacked routing/predict on one synthetic
+      forest: outputs asserted ``array_equal`` (dtype included), the
+      permute-MAC model showing the 3× reduction
+      (``ops/pack.py::route_mac_model``), and same-window timings
+      (honest wall-clock on TPU; XLA:CPU matmul time here).
+    * ``fusion`` — per-bucket vs fused-masked dispatch over ONE seeded
+      coalescer replay: every batch dispatched both ways through real
+      AOT executables, per-row outputs asserted bit-equal, and the
+      row-waste accounting (pad vs masked-after-fill) that must close
+      and must not regress.
+    * ``sharded_build`` — the mesh-sharded leaf-index build at
+      1/2/4/8 devices vs the serial build: bit-equal at every axis
+      size, wall-clock per size (time-slicing on virtual CPU devices —
+      the curve's shape is the transferable claim only on real chips).
+    """
+    from ate_replication_causalml_tpu.models.causal_forest import (
+        compute_leaf_index,
+        compute_leaf_index_sharded,
+        lower_predict_cate,
+        lower_predict_cate_masked,
+        predict_cate,
+    )
+    from ate_replication_causalml_tpu.ops.pack import route_mac_model
+    from ate_replication_causalml_tpu.parallel.mesh import make_mesh
+    from ate_replication_causalml_tpu.serving.coalescer import (
+        BucketPlan,
+        Coalescer,
+        FusionPlan,
+        PendingRequest,
+    )
+
+    import numpy as np
+
+    on_tpu = jax.default_backend() == "tpu"
+    row_backend = "pallas" if on_tpu else "matmul"
+    key = jax.random.key(7)
+    forest = _synthetic_predict_forest(key, trees, depth, rows, p, n_bins)
+    x = jax.random.normal(jax.random.key(8), (rows, p), jnp.float32)
+
+    # ── pack A/B ─────────────────────────────────────────────────────
+    def timed(fn):
+        fn()  # trace/compile
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn()
+        jax.tree_util.tree_map(
+            lambda a: a.block_until_ready() if hasattr(
+                a, "block_until_ready") else a, out,
+        )
+        return (time.perf_counter() - t0) / reps, out
+
+    unpacked_s, li_unpacked = timed(
+        lambda: compute_leaf_index(forest, x, pack=False)
+    )
+    packed_s, li_packed = timed(
+        lambda: compute_leaf_index(forest, x, pack=True)
+    )
+    li_equal = bool(jnp.array_equal(li_unpacked, li_packed)) and (
+        li_unpacked.dtype == li_packed.dtype
+    )
+    pu = predict_cate(forest, x, oob=False, row_backend=row_backend,
+                      pack=False)
+    pp = predict_cate(forest, x, oob=False, row_backend=row_backend,
+                      pack=True)
+    predict_equal = bool(jnp.array_equal(pu.cate, pp.cate)) and bool(
+        jnp.array_equal(pu.variance, pp.variance)
+    )
+    levels_nodes = [1 << lv for lv in range(depth)]
+    mac_unpacked = route_mac_model(rows, p, levels_nodes, pack=False)
+    mac_packed = route_mac_model(rows, p, levels_nodes, pack=True)
+    mac_unpacked = {k: v * trees for k, v in mac_unpacked.items()}
+    mac_packed = {k: v * trees for k, v in mac_packed.items()}
+    pack_section = {
+        "rows": rows, "p": p, "n_bins": n_bins, "depth": depth,
+        "trees": trees,
+        "bit_equal": li_equal and predict_equal,
+        "unpacked": mac_unpacked,
+        "packed": mac_packed,
+        "permute_mac_ratio": mac_unpacked["permute_macs"]
+        / mac_packed["permute_macs"],
+        "leaf_index_unpacked_ms": round(unpacked_s * 1e3, 3),
+        "leaf_index_packed_ms": round(packed_s * 1e3, 3),
+    }
+    print(
+        f"# predict-ab pack: bit_equal={pack_section['bit_equal']} "
+        f"permute MACs {mac_unpacked['permute_macs']:.3g} -> "
+        f"{mac_packed['permute_macs']:.3g} "
+        f"({pack_section['permute_mac_ratio']:.2f}x)",
+        file=sys.stderr,
+    )
+
+    # ── fusion A/B ───────────────────────────────────────────────────
+    # A seeded replay through the REAL coalescer with an injected
+    # clock (deterministic batches), every batch dispatched BOTH ways
+    # through real AOT executables on a micro forest: per-bucket
+    # (padded) and fused-masked with queued-batch back-fill — the
+    # daemon's take_fill regime when the dispatcher is busy.
+    micro = _synthetic_predict_forest(jax.random.key(9), 8, 3, 50, 4, 8)
+    plan = BucketPlan((4, 8, 16, 32))
+    fusion = FusionPlan.pair_adjacent(plan)
+    rng = np.random.default_rng(5)
+    n_req = 64
+    req_rows = rng.integers(1, 13, size=n_req)
+    queries = [
+        rng.normal(size=(int(r), 4)).astype(np.float32) for r in req_rows
+    ]
+    clock_now = [0.0]
+    co = Coalescer(plan, window_s=0.005, clock=lambda: clock_now[0])
+    batches = []
+    for i, q in enumerate(queries):
+        co.submit(PendingRequest(f"q{i}", q, q.shape[0], clock_now[0]))
+        # Bursty arrivals: several requests share an instant, then the
+        # window expires — the regime where batches close partial and
+        # queue while a dispatch is in flight.
+        if i % 4 == 3:
+            clock_now[0] += 0.006
+            while True:
+                b = co.next_batch(timeout=0.0)
+                if b is None:
+                    break
+                batches.append(b)
+    co.close()
+    while True:
+        b = co.next_batch(timeout=0.0)
+        if b is None:
+            break
+        batches.append(b)
+
+    per_bucket_exec = {
+        b: lower_predict_cate(micro, b, row_backend=row_backend).compile()
+        for b in plan.sizes
+    }
+    fused_exec = {
+        w: lower_predict_cate_masked(
+            micro, w, row_backend=row_backend
+        ).compile()
+        for w in fusion.widths
+    }
+
+    def run_per_bucket(reqs, bucket):
+        padded = np.zeros((bucket, 4), np.float32)
+        off = 0
+        for r in reqs:
+            padded[off:off + r.rows] = r.x
+            off += r.rows
+        out = per_bucket_exec[bucket](micro, jnp.asarray(padded), None)
+        return np.asarray(out.cate)[:off], np.asarray(out.variance)[:off]
+
+    def run_fused(reqs, width):
+        padded = np.zeros((width, 4), np.float32)
+        off = 0
+        for r in reqs:
+            padded[off:off + r.rows] = r.x
+            off += r.rows
+        mask = np.zeros((width,), np.float32)
+        mask[:off] = 1.0
+        out = fused_exec[width](
+            micro, jnp.asarray(padded), jnp.asarray(mask), None
+        )
+        return np.asarray(out.cate)[:off], np.asarray(out.variance)[:off]
+
+    real_rows = int(sum(b.rows for b in batches))
+    pb_dispatched = 0
+    per_row_pb: dict[str, tuple] = {}
+    for b in batches:
+        pb_dispatched += b.bucket
+        cate, var = run_per_bucket(b.requests, b.bucket)
+        off = 0
+        for r in b.requests:
+            per_row_pb[r.request_id] = (
+                cate[off:off + r.rows], var[off:off + r.rows]
+            )
+            off += r.rows
+    # Fused dispatches: FIFO over the SAME closed batches, back-filling
+    # each dispatch from the batches already queued behind it (the
+    # take_fill regime; FIFO order preserved).
+    fused_dispatched = 0
+    fused_dispatches = 0
+    fill_rows = 0
+    bit_equal_fused = True
+    queue = list(batches)
+    while queue:
+        first = queue.pop(0)
+        width = fusion.width_for(first.bucket)
+        reqs = list(first.requests)
+        total = first.rows
+        while queue and queue[0].rows + total <= width:
+            nxt = queue.pop(0)
+            reqs.extend(nxt.requests)
+            fill_rows += nxt.rows
+            total += nxt.rows
+        fused_dispatched += width
+        fused_dispatches += 1
+        cate, var = run_fused(reqs, width)
+        off = 0
+        for r in reqs:
+            ref_c, ref_v = per_row_pb[r.request_id]
+            if not (np.array_equal(cate[off:off + r.rows], ref_c)
+                    and np.array_equal(var[off:off + r.rows], ref_v)):
+                bit_equal_fused = False
+            off += r.rows
+    fusion_section = {
+        "buckets": list(plan.sizes),
+        "groups": [list(g) for g in fusion.groups],
+        "executables": {
+            "per_bucket": len(plan.sizes),
+            "fused": len(fusion.widths),
+        },
+        "batches": len(batches),
+        "fused_dispatches": fused_dispatches,
+        "real_rows": real_rows,
+        "per_bucket_dispatched_rows": pb_dispatched,
+        "per_bucket_pad_rows": pb_dispatched - real_rows,
+        "fused_dispatched_rows": fused_dispatched,
+        "fused_masked_rows": fused_dispatched - real_rows,
+        "fused_fill_rows": fill_rows,
+        "bit_equal": bit_equal_fused,
+    }
+    print(
+        f"# predict-ab fusion: {len(batches)} batches -> "
+        f"{fused_dispatches} fused dispatches, pad "
+        f"{fusion_section['per_bucket_pad_rows']} -> masked "
+        f"{fusion_section['fused_masked_rows']} rows "
+        f"(bit_equal={bit_equal_fused})",
+        file=sys.stderr,
+    )
+
+    # ── sharded leaf-index build curve ───────────────────────────────
+    li_serial = np.asarray(li_unpacked)
+    devices = []
+    walls = []
+    bit_equal_shard = []
+    d = 1
+    while d <= jax.device_count():
+        mesh = make_mesh(("data",), (d,), jax.devices()[:d])
+        compute_leaf_index_sharded(forest, np.asarray(x), mesh=mesh)  # warm
+        t0 = time.perf_counter()
+        li_s = compute_leaf_index_sharded(forest, np.asarray(x), mesh=mesh)
+        walls.append(round(time.perf_counter() - t0, 4))
+        devices.append(d)
+        bit_equal_shard.append(
+            bool(np.array_equal(li_serial, li_s))
+            and li_serial.dtype == li_s.dtype
+        )
+        print(
+            f"# predict-ab sharded build d={d}: {walls[-1]:.3f}s "
+            f"bit_equal={bit_equal_shard[-1]}",
+            file=sys.stderr,
+        )
+        d *= 2
+    sharded_section = {
+        "rows": rows, "trees": trees,
+        "devices": devices, "wall_s": walls,
+        "serial_wall_s": round(unpacked_s, 4),
+        "bit_equal": bit_equal_shard,
+    }
+
+    return obs.bench_record(
+        metric=f"predict_path_ab_{rows}_rows",
+        # The headline transferable claim: the modeled permute-MAC
+        # reduction of the packed routing contraction.
+        value=round(pack_section["permute_mac_ratio"], 3),
+        unit="x_modeled_permute_macs",
+        vs_baseline=round(unpacked_s / max(packed_s, 1e-9), 3),
+        backend=jax.default_backend(),
+        pack=pack_section,
+        fusion=fusion_section,
+        sharded_build=sharded_section,
+        note=(
+            "wall-clock/MFU consequence TPU-blocked on this image: CPU "
+            "matmul timings and virtual-device time-slicing; the "
+            "bit-identity verdicts and the MAC/row accounting are the "
+            "transferable claims"
+        ),
+    )
+
+
+def bench_predict_ab(rows=16_384):
+    """``--predict-ab``: generate + commit PREDICT_AB.json (ISSUE 12)
+    and print the record. On a single-device CPU host the sharded-build
+    curve needs the 8-virtual-device child (provisioning must precede
+    backend init — the --sharded/--mesh-scaling pattern); on TPU the
+    real device set is used as-is."""
+    if os.environ.get("_ATE_SHARDED_CHILD") == "1":
+        # In the child: provision the 8 virtual CPU devices BEFORE any
+        # jax call initializes the backend.
+        _cpu_child_reexec("--predict-ab")
+    elif jax.default_backend() != "tpu" and jax.device_count() < 2:
+        # The re-exec'd argv carries only the mode flag — thread an
+        # explicit --rows through the env knob or the child would
+        # silently fall back to the default scale.
+        os.environ["ATE_BENCH_PREDICT_AB_ROWS"] = str(rows)
+        _cpu_child_reexec("--predict-ab")  # parent: exits with child rc
+    record = predict_ab_record(rows)
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "PREDICT_AB.json")
+    with open(out_path + ".tmp", "w") as f:
+        json.dump(record, f, indent=1, sort_keys=True)
+    os.replace(out_path + ".tmp", out_path)
+    print(f"# predict-path A/B record: {out_path}", file=sys.stderr)
+    print(json.dumps(record))
+    return record
 
 
 def _cpu_child_reexec(flag):
@@ -1281,6 +1623,12 @@ def _main():
         if "--rows" in sys.argv:
             rows = int(sys.argv[sys.argv.index("--rows") + 1])
         return bench_hist_ab(rows)
+    if "--predict-ab" in sys.argv:
+        rows = PREDICT_AB_ROWS
+        if "--rows" in sys.argv:
+            rows = int(sys.argv[sys.argv.index("--rows") + 1])
+        bench_predict_ab(rows)
+        return None
     if "--forest-predict" in sys.argv:
         rows = FOREST_ROWS
         if "--rows" in sys.argv:
@@ -1381,8 +1729,13 @@ def _main():
     # timed stages above. Print order keeps the flagship forest line
     # LAST for single-line parsers.
     sweep_record = bench_sweep_quick()
+    # Predict-path A/B (ISSUE 12) runs BEFORE the serving stage (which
+    # clears jax caches for its cold baseline) — its pack/fusion
+    # bit-identity legs want warm caches, like the stages above.
+    predict_ab = predict_ab_record(PREDICT_AB_ROWS)
     serving_record = bench_serving_quick()
     print(json.dumps(sweep_record))
+    print(json.dumps(predict_ab))
     print(json.dumps(serving_record))
     print(json.dumps(aipw_record))
     print(json.dumps(predict_record))

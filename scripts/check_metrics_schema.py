@@ -8,6 +8,7 @@ Usage::
         [--require-stages "naive,oracle,..."]
     python scripts/check_metrics_schema.py MESH_SCALING.json   # ISSUE 8
     python scripts/check_metrics_schema.py HIST_AB.json        # ISSUE 10
+    python scripts/check_metrics_schema.py PREDICT_AB.json     # ISSUE 12
 
 Checks ``metrics.json`` (schema version, section shapes, the counter
 families every instrumented run must carry — shard retry, compile
@@ -106,6 +107,13 @@ REQUIRED_COUNTERS = (
     "serving_fleet_requests_total",
     "serving_retrain_total",
     "serving_retrain_retries_total",
+    # Predict-path pad/masked split (ISSUE 12): "no row was ever
+    # padded" (per-bucket true waste) and "no row was ever masked"
+    # (fused exact-zero region) are recorded zeros on every
+    # instrumented run — the pair that makes serving_pad_fraction's
+    # under-fusion mis-report impossible.
+    "serving_pad_rows_total",
+    "serving_masked_rows_total",
 )
 
 _EVENT_FIELDS = (
@@ -695,6 +703,139 @@ def validate_hist_ab_record(record: dict, tol: float = 1e-9) -> list[str]:
     return errors
 
 
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def validate_predict_ab_record(record: dict, tol: float = 1e-9) -> list[str]:
+    """Internal-consistency checks on the ``bench.py --predict-ab``
+    record (ISSUE 12) — the committed PREDICT_AB.json. Three sections,
+    each carrying a bit-identity verdict plus the modeled accounting a
+    hand-edited record must not be able to fake:
+
+    * ``pack`` — packed == unpacked predict must be bit-equal; useful
+      MACs are mode-independent BY DEFINITION (every row reads one code
+      per level however it is delivered); the permute-MAC ratio is
+      ``p / ceil(p/3)`` — exactly 3× when 3 | p, and never above 3;
+      packed total MACs must actually be smaller.
+    * ``fusion`` — fused dispatch must be bit-equal to per-bucket; the
+      executable count must DROP; row accounting must close
+      (dispatched = real + pad/masked on each side); and the fused
+      masked-row waste must not exceed the per-bucket pad waste on the
+      replayed schedule — pad FLOPs became useful FLOPs, or at worst
+      stayed even.
+    * ``sharded_build`` — the leaf-index build curve: devices strictly
+      ascending from 1, one wall-clock sample per axis size, sharded ==
+      serial bit-identity at EVERY size.
+    """
+    errors: list[str] = []
+    pk = record.get("pack")
+    if not isinstance(pk, dict):
+        errors.append("predict_ab: missing pack section")
+    else:
+        if pk.get("bit_equal") is not True:
+            errors.append("predict_ab: pack.bit_equal is not true")
+        up, pp = pk.get("unpacked"), pk.get("packed")
+        if not (isinstance(up, dict) and isinstance(pp, dict)):
+            errors.append("predict_ab: pack.unpacked/packed malformed")
+        else:
+            for key in ("useful_macs", "permute_macs", "total_macs"):
+                if not (_num(up.get(key)) and _num(pp.get(key))):
+                    errors.append(f"predict_ab: pack.*.{key} non-numeric")
+            if _num(up.get("useful_macs")) and _num(pp.get("useful_macs")):
+                if up["useful_macs"] != pp["useful_macs"]:
+                    errors.append(
+                        "predict_ab: packed useful MACs "
+                        f"{pp['useful_macs']} != unpacked "
+                        f"{up['useful_macs']} — useful is "
+                        "mode-independent by definition"
+                    )
+            if _num(up.get("permute_macs")) and _num(pp.get("permute_macs")):
+                ratio = up["permute_macs"] / max(pp["permute_macs"], 1)
+                if not (2.0 <= ratio <= 3.0 + tol):
+                    errors.append(
+                        f"predict_ab: permute-MAC ratio {ratio:.3f} "
+                        "outside (2, 3] — packing promises ~3x"
+                    )
+                rec_ratio = pk.get("permute_mac_ratio")
+                if _num(rec_ratio) and abs(rec_ratio - ratio) > 1e-6:
+                    errors.append(
+                        "predict_ab: recorded permute_mac_ratio "
+                        f"{rec_ratio} != computed {ratio}"
+                    )
+            if _num(up.get("total_macs")) and _num(pp.get("total_macs")):
+                if pp["total_macs"] >= up["total_macs"]:
+                    errors.append(
+                        "predict_ab: packed total MACs do not shrink"
+                    )
+    fu = record.get("fusion")
+    if not isinstance(fu, dict):
+        errors.append("predict_ab: missing fusion section")
+    else:
+        if fu.get("bit_equal") is not True:
+            errors.append("predict_ab: fusion.bit_equal is not true")
+        ex = fu.get("executables", {})
+        if not (isinstance(ex, dict) and _num(ex.get("per_bucket"))
+                and _num(ex.get("fused"))):
+            errors.append("predict_ab: fusion.executables malformed")
+        elif ex["fused"] >= ex["per_bucket"]:
+            errors.append(
+                "predict_ab: fused executable count "
+                f"{ex['fused']} did not drop below per-bucket "
+                f"{ex['per_bucket']}"
+            )
+        keys = ("real_rows", "per_bucket_dispatched_rows",
+                "per_bucket_pad_rows", "fused_dispatched_rows",
+                "fused_masked_rows")
+        if all(_num(fu.get(k)) for k in keys):
+            if (fu["per_bucket_dispatched_rows"]
+                    != fu["real_rows"] + fu["per_bucket_pad_rows"]):
+                errors.append(
+                    "predict_ab: per-bucket row accounting does not close"
+                )
+            if (fu["fused_dispatched_rows"]
+                    != fu["real_rows"] + fu["fused_masked_rows"]):
+                errors.append(
+                    "predict_ab: fused row accounting does not close"
+                )
+            if fu["fused_masked_rows"] > fu["per_bucket_pad_rows"]:
+                errors.append(
+                    "predict_ab: fused masked waste "
+                    f"{fu['fused_masked_rows']} exceeds per-bucket pad "
+                    f"waste {fu['per_bucket_pad_rows']} — fusion must "
+                    "not dispatch more dead rows than padding did"
+                )
+        else:
+            errors.append("predict_ab: fusion row accounting non-numeric")
+    sb = record.get("sharded_build")
+    if not isinstance(sb, dict):
+        errors.append("predict_ab: missing sharded_build section")
+    else:
+        devs, walls = sb.get("devices"), sb.get("wall_s")
+        if not (isinstance(devs, list) and devs and devs[0] == 1
+                and all(isinstance(d, int) for d in devs)
+                and all(a < b for a, b in zip(devs, devs[1:]))):
+            errors.append(
+                "predict_ab: sharded_build.devices must ascend from 1"
+            )
+        if not (isinstance(walls, list) and isinstance(devs, list)
+                and len(walls) == len(devs)
+                and all(_num(w) and w >= 0 for w in walls)):
+            errors.append(
+                "predict_ab: sharded_build.wall_s malformed"
+            )
+        be = sb.get("bit_equal")
+        ok = (be is True) or (
+            isinstance(be, list) and be and all(b is True for b in be)
+        )
+        if not ok:
+            errors.append(
+                "predict_ab: sharded_build.bit_equal must be true at "
+                "every axis size"
+            )
+    return errors
+
+
 def validate_trace_files(outdir: str) -> list[str]:
     """Validate trace.json / overlap_report.json / serving_report.json
     / slo_report.json in ``outdir`` when present (tracing and serving
@@ -810,6 +951,7 @@ def main(argv: list[str] | None = None) -> int:
     _EVIDENCE_VALIDATORS = (
         ("MESH_SCALING", "mesh_scaling", validate_mesh_scaling),
         ("HIST_AB", "hist_ab", validate_hist_ab_record),
+        ("PREDICT_AB", "predict_ab", validate_predict_ab_record),
     )
     if len(args.paths) == 1:
         base = os.path.basename(args.paths[0])

@@ -59,6 +59,12 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--shed-burn", type=float, default=None,
                     help="per-model SLO-burn shedding threshold (default "
                          "$ATE_TPU_SERVE_FLEET_SHED_BURN or off)")
+    ap.add_argument("--fuse", action="store_true", default=None,
+                    help="fuse adjacent buckets into one masked AOT "
+                         "executable per group (ISSUE 12; default "
+                         "$ATE_TPU_SERVE_FUSE or off) — fewer "
+                         "executables, masked rows exact zeros, queued "
+                         "requests back-fill the masked region")
     args = ap.parse_args(argv)
 
     from ate_replication_causalml_tpu.serving.coalescer import BucketPlan
@@ -87,6 +93,8 @@ def main(argv: list[str] | None = None) -> int:
         overrides["fleet"] = parse_fleet_spec(args.fleet)
     if args.shed_burn is not None:
         overrides["shed_burn_threshold"] = args.shed_burn
+    if args.fuse:
+        overrides["fuse_buckets"] = True
     config = ServeConfig.from_env(args.checkpoint, **overrides)
 
     server = CateServer(config)

@@ -164,10 +164,11 @@ def test_serving_quick_record_schema_stubbed(monkeypatch):
 
 
 @pytest.mark.slow
-def test_default_bench_emits_five_records_cpu_smoke():
+def test_default_bench_emits_six_records_cpu_smoke():
     """`python bench.py` must print one JSON record per metric (quick
-    sweep, serving, AIPW, cached predict+variance, forest fit), forest
-    fit LAST (the driver's single-line parse lands on the flagship).
+    sweep, predict-path A/B, serving, AIPW, cached predict+variance,
+    forest fit), forest fit LAST (the driver's single-line parse lands
+    on the flagship).
     Run on the CPU backend at smoke scale. @slow since ISSUE 4: the
     three quick-sweep legs pushed this past the tier-1 budget (memory:
     the 870 s single-process run was already near its ceiling); the
@@ -183,6 +184,7 @@ def test_default_bench_emits_five_records_cpu_smoke():
         ATE_BENCH_FOREST_ROWS="1500",
         ATE_BENCH_SWEEP_ROWS="500",
         ATE_BENCH_SERVE_ROWS="200",
+        ATE_BENCH_PREDICT_AB_ROWS="2048",
         ATE_NO_COMPILE_CACHE="1",
         # No virtual-device mesh in the child, but keep the suite's
         # compile-time opt level (the child is ~90% XLA compile too —
@@ -203,25 +205,32 @@ def test_default_bench_emits_five_records_cpu_smoke():
     assert out.returncode == 0, out.stderr[-2000:]
     lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
     records = [json.loads(l) for l in lines]
-    assert len(records) == 5, lines
+    assert len(records) == 6, lines
     metrics = [r["metric"] for r in records]
     assert metrics[0] == "sweep_wall_clock_quick"
-    assert metrics[1] == "serving_quick"
-    assert metrics[2] == "aipw_bootstrap_se_10k_replicates_1m_rows"
-    assert metrics[3] == "causal_forest_predict_var_sec_per_1m_rows"
+    assert metrics[1] == "predict_path_ab_2048_rows"
+    assert metrics[2] == "serving_quick"
+    assert metrics[3] == "aipw_bootstrap_se_10k_replicates_1m_rows"
+    assert metrics[4] == "causal_forest_predict_var_sec_per_1m_rows"
     # Flagship fit metric LAST — the driver's single-line parse.
-    assert metrics[4] == "causal_forest_2000_trees_sec_per_1m_rows"
+    assert metrics[5] == "causal_forest_2000_trees_sec_per_1m_rows"
     for r in records:
         for field in ("metric", "value", "unit", "vs_baseline"):
             assert field in r, (field, r)
-    for r in records[2:]:
+    for r in records[3:]:
         assert "samples_s" in r, r
     for field in ("sequential_s", "concurrent_s", "workers", "rows"):
         assert field in records[0], field
+    # The predict-path A/B record must validate under the SAME schema
+    # gate as the committed PREDICT_AB.json (ISSUE 12).
+    sys.path.insert(0, os.path.join(_REPO, "scripts"))
+    from check_metrics_schema import validate_predict_ab_record
+
+    assert validate_predict_ab_record(records[1]) == []
     for field in ("startup_aot_s", "p99_ms", "zero_compile"):
-        assert field in records[1], field
-    assert records[1]["zero_compile"] is True
+        assert field in records[2], field
+    assert records[2]["zero_compile"] is True
     for field in ("rows", "analytic_tflops", "mfu_bf16_pct"):
-        assert field in records[4], field
+        assert field in records[5], field
     for field in ("rows", "leaf_index_s"):
-        assert field in records[3], field
+        assert field in records[4], field

@@ -851,6 +851,63 @@ def test_jgl010_quiet_on_plane_calls_and_honors_suppressions():
     assert [f.line for f in res.suppressed] == [5]
 
 
+# --------------------------------------------------------------- JGL011
+
+
+JGL011_BAD = """\
+import jax.numpy as jnp
+
+def predict_values(leaf_stats, node_of_row, leaf_value):
+    stats = jnp.take(leaf_stats, node_of_row, axis=0)   # line 4: take
+    vals = leaf_value[node_of_row]                      # line 5: gather
+    return stats, vals
+
+def _tree_route_slow(codes, feat_ids):
+    picked = codes[:, feat_ids]                         # line 9: gather
+    return picked
+"""
+
+JGL011_GOOD = """\
+import jax
+import jax.numpy as jnp
+
+def predict_values(leaf_stats, node_of_row, level):
+    oh = jax.nn.one_hot(node_of_row, leaf_stats.shape[0])
+    stats = jnp.matmul(oh, leaf_stats)      # sanctioned one-hot matmul
+    table = leaf_stats[level][:4]           # constant index + slice: fine
+    chans = [stats[..., i] for i in (1, 2)] # loop-constant index: fine
+    return stats, table, chans
+
+def grow_one(leaf_value, node_of_row):
+    return leaf_value[node_of_row]          # grow path: out of scope
+"""
+
+
+def test_jgl011_fires_in_models_predict_fns_only():
+    """ISSUE 12: per-row dynamic gathers serialize on TPU — in a
+    models/ predict-path function they are a silent 10×-class
+    regression the bit-identity tests cannot catch."""
+    assert _lines(
+        JGL011_BAD, "JGL011", relpath="pkg/models/causal_forest.py"
+    ) == [4, 5, 9]
+    # outside models/ the rule is silent
+    assert _lines(JGL011_BAD, "JGL011", relpath="pkg/ops/mod.py") == []
+    assert _lines(JGL011_BAD, "JGL011", relpath="pkg/serving/daemon.py") == []
+
+
+def test_jgl011_quiet_on_sanctioned_forms_and_grow_fns():
+    assert _lines(
+        JGL011_GOOD, "JGL011", relpath="pkg/models/forest.py"
+    ) == []
+    src = JGL011_BAD.replace(
+        "    vals = leaf_value[node_of_row]                      # line 5: gather",
+        "    vals = leaf_value[node_of_row]  # graftlint: disable=JGL011",
+    )
+    res = lint_source(src, relpath="pkg/models/forest.py", select=["JGL011"])
+    assert [f.line for f in res.findings] == [4, 9]
+    assert [f.line for f in res.suppressed] == [5]
+
+
 # ----------------------------------------------------- suppressions etc.
 
 

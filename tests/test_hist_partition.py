@@ -258,8 +258,11 @@ def test_resolve_hist_mode_bad_value_raises_at_config_time(monkeypatch):
 def test_dispatch_rejects_unresolved_mode():
     """'auto' must never reach a kernel dispatcher (the heuristic runs
     in the growers), and partition mode has no XLA formulation."""
-    assert _check_mode("partition", "pallas") is True
-    assert _check_mode("dense", "xla") is False
+    # ISSUE 12: _check_mode returns (partition?, packed?) — the +pack
+    # suffix rides the mode string (tests/test_predict_pack.py covers
+    # the packed arm).
+    assert _check_mode("partition", "pallas") == (True, False)
+    assert _check_mode("dense", "xla") == (False, False)
     with pytest.raises(ValueError, match="auto"):
         _check_mode("auto", "pallas")
     with pytest.raises(ValueError, match="pallas"):

@@ -1,0 +1,414 @@
+"""ISSUE 12 predict-path tests: packed-code exactness (boundary codes,
+every slot, vmapped and sharded layouts), packed == unpacked
+bit-identity end-to-end (routing, leaf index, predict, the partition
+kernel's regroup), the mesh-sharded leaf-index build's sharded ==
+serial matrix at 1/2/4/8 devices with its byte metering, the pack
+policy's config-time discipline, and the PREDICT_AB record validator's
+corruption rejection.
+"""
+
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ate_replication_causalml_tpu.ops.pack import (
+    ENV_PACK,
+    PACK_RADIX,
+    extract_slot,
+    pack_codes,
+    packable,
+    packed_width,
+    resolve_predict_pack,
+    route_mac_model,
+    unpack_codes,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "scripts"))
+import check_metrics_schema as cms  # noqa: E402
+
+
+# ── pack/unpack exactness (property tests, no forest) ──────────────────
+
+
+def test_pack_roundtrip_boundary_codes_every_slot():
+    """Codes 0 and 127 (the 7-bit boundary) in EVERY slot position must
+    survive pack → extract exactly — the exactness contract packing
+    rides on (3×7 bits < the 24-bit f32 mantissa)."""
+    rows = []
+    for s0 in (0, 127):
+        for s1 in (0, 127):
+            for s2 in (0, 127):
+                rows.append([s0, s1, s2])
+    codes = jnp.asarray(np.array(rows, np.int32))
+    packed = pack_codes(codes)
+    assert packed.shape == (8, 1)
+    # The all-127 word is the largest packable value — still exact.
+    assert float(packed[-1, 0]) == 127 + 127 * 128 + 127 * 128 * 128
+    out = unpack_codes(packed, 3)
+    assert jnp.array_equal(out.astype(jnp.int32), codes)
+    # extract_slot agrees with unpack per slot.
+    for s in range(3):
+        got = extract_slot(packed[:, 0], jnp.float32(s))
+        assert np.array_equal(np.asarray(got), np.array(rows)[:, s])
+
+
+def test_pack_roundtrip_random_and_ragged_width():
+    """Random codes, p not divisible by 3 (trailing slots pad as 0)."""
+    rng = np.random.default_rng(0)
+    for p in (1, 2, 3, 7, 21, 22, 23):
+        codes = jnp.asarray(rng.integers(0, 128, size=(64, p)).astype(np.int32))
+        packed = pack_codes(codes)
+        assert packed.shape == (64, packed_width(p))
+        out = unpack_codes(packed, p)
+        assert jnp.array_equal(out.astype(jnp.int32), codes)
+
+
+def test_pack_exact_under_vmap():
+    """The vmapped layout (a leading batch axis, as the predict path's
+    per-tree vmap sees it) packs/extracts the same exact integers."""
+    rng = np.random.default_rng(1)
+    codes = jnp.asarray(rng.integers(0, 128, size=(4, 32, 7)).astype(np.int32))
+    packed = jax.vmap(pack_codes)(codes)
+    out = jax.vmap(lambda pc: unpack_codes(pc, 7))(packed)
+    assert jnp.array_equal(out.astype(jnp.int32), codes)
+
+
+def test_pack_exact_under_sharded_layout():
+    """pack → extract inside a shard_map over the row axis: every
+    device's slice reconstructs exactly (the layout the sharded
+    leaf-index build routes through)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ate_replication_causalml_tpu.parallel.mesh import (
+        make_mesh,
+        shard_map,
+    )
+
+    d = min(4, jax.device_count())
+    mesh = make_mesh(("data",), (d,), jax.devices()[:d])
+    rng = np.random.default_rng(2)
+    codes = jnp.asarray(rng.integers(0, 128, size=(8 * d, 21)).astype(np.int32))
+
+    def body(c):
+        return unpack_codes(pack_codes(c), 21).astype(jnp.int32)
+
+    fn = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(P("data"),), out_specs=P("data")
+    ))
+    assert jnp.array_equal(fn(codes), codes)
+
+
+def test_packed_route_matches_unpacked_route():
+    """route_rows_packed == route_rows on random split tables — the
+    exact same integer comparison, delivered through the 3×-narrower
+    contraction."""
+    from ate_replication_causalml_tpu.models.forest import (
+        route_rows,
+        route_rows_packed,
+    )
+
+    rng = np.random.default_rng(3)
+    rows, p, m, n_bins = 256, 21, 8, 64
+    codes = jnp.asarray(rng.integers(0, n_bins, size=(rows, p)).astype(np.int32))
+    node = jnp.asarray(rng.integers(0, m, size=(rows,)).astype(np.int32))
+    node_oh = jax.nn.one_hot(node, m, dtype=jnp.float32)
+    bf = jnp.asarray(rng.integers(0, p, size=(m,)).astype(np.int32))
+    bb = jnp.asarray(rng.integers(0, n_bins, size=(m,)).astype(np.int32))
+    base = route_rows(node_oh, bf, bb, codes.astype(jnp.float32), node)
+    packed = route_rows_packed(node_oh, bf, bb, pack_codes(codes), node)
+    assert jnp.array_equal(base, packed)
+
+
+# ── policy discipline ──────────────────────────────────────────────────
+
+
+def test_resolve_predict_pack_config_time(monkeypatch):
+    monkeypatch.delenv(ENV_PACK, raising=False)
+    assert resolve_predict_pack() is False  # auto = unpacked this round
+    assert resolve_predict_pack(True) is True
+    assert resolve_predict_pack("1") is True
+    assert resolve_predict_pack("0") is False
+    monkeypatch.setenv(ENV_PACK, "1")
+    assert resolve_predict_pack() is True
+    monkeypatch.setenv(ENV_PACK, " AUTO ")
+    assert resolve_predict_pack() is False
+    monkeypatch.setenv(ENV_PACK, "bogus")
+    with pytest.raises(ValueError, match="ATE_TPU_PREDICT_PACK"):
+        resolve_predict_pack()
+    # the 7-bit exactness bound
+    assert packable(64) and packable(128) and not packable(256)
+    assert PACK_RADIX == 128
+
+
+def test_mode_suffix_plumbing():
+    """The +pack suffix survives auto resolution on partition widths,
+    strips on dense, and is rejected at the kernel dispatch on dense."""
+    from ate_replication_causalml_tpu.ops.hist_pallas import (
+        _check_mode,
+        mode_for_width,
+        resolve_hist_mode_packed,
+        split_pack_mode,
+        with_pack_mode,
+    )
+
+    assert split_pack_mode("partition+pack") == ("partition", True)
+    assert split_pack_mode("dense") == ("dense", False)
+    assert with_pack_mode("auto", True) == "auto+pack"
+    assert with_pack_mode("partition+pack", False) == "partition"
+    assert mode_for_width("auto+pack", 64, 2) == "partition+pack"
+    assert mode_for_width("auto+pack", 1, 2) == "dense"
+    assert mode_for_width("dense+pack", 64, 2) == "dense"
+    assert resolve_hist_mode_packed("partition+pack", 64) == "partition+pack"
+    # wide bins exceed the 7-bit slot: pack silently disengages
+    assert resolve_hist_mode_packed("partition+pack", 256) == "partition"
+    assert _check_mode("partition+pack", "pallas") == (True, True)
+    assert _check_mode("partition", "pallas") == (True, False)
+    with pytest.raises(ValueError, match="partition kernel only"):
+        _check_mode("dense+pack", "pallas")
+
+
+def test_route_mac_model_three_x():
+    up = route_mac_model(1000, 21, [1, 2, 4, 8], pack=False)
+    pk = route_mac_model(1000, 21, [1, 2, 4, 8], pack=True)
+    assert up["useful_macs"] == pk["useful_macs"]
+    assert up["permute_macs"] / pk["permute_macs"] == 3.0  # 3 | 21
+    assert pk["total_macs"] < up["total_macs"]
+
+
+# ── partition-kernel regroup: packed == unpacked, bit-for-bit ──────────
+
+
+@pytest.mark.parametrize("shared", [False, True])
+@pytest.mark.parametrize("weights_kind", ["int", "float"])
+def test_partition_kernel_pack_bit_identity(shared, weights_kind):
+    """The packed regroup permutes 3×-narrower words, unpacks, and
+    re-offsets — identical integers on every real row, so the
+    histograms are bit-identical for integer AND float stacks (the only
+    delta is which lane a zero-weight slack row's exact ±0 lands on)."""
+    from ate_replication_causalml_tpu.ops.hist_pallas import (
+        bin_histogram,
+        bin_histogram_shared,
+    )
+
+    rng = np.random.default_rng(4)
+    n, p, n_bins, m, k = 5000, 21, 64, 16, 3
+    codes = jnp.asarray(rng.integers(0, n_bins, size=(n, p)).astype(np.int32))
+    ids = jnp.asarray(rng.integers(-1, m, size=(n,)).astype(np.int32))
+    if weights_kind == "int":
+        w = jnp.asarray(rng.integers(0, 5, size=(k, n)).astype(np.float32))
+    else:
+        w = jnp.asarray(rng.normal(size=(k, n)).astype(np.float32))
+    fn = bin_histogram_shared if shared else bin_histogram
+    base = fn(codes, ids, w, max_nodes=m, n_bins=n_bins,
+              backend="pallas_interpret", mode="partition")
+    packed = fn(codes, ids, w, max_nodes=m, n_bins=n_bins,
+                backend="pallas_interpret", mode="partition+pack")
+    assert jnp.array_equal(base, packed)
+
+
+# ── end-to-end predict-path bit-identity ───────────────────────────────
+
+
+def _synthetic_forest(rng, T=8, D=4, n=60, p=7, nb=16):
+    from ate_replication_causalml_tpu.models.causal_forest import CausalForest
+
+    return CausalForest(
+        split_feat=jnp.asarray(
+            rng.integers(0, p, size=(T, D, 1 << (D - 1))).astype(np.int32)
+        ),
+        split_bin=jnp.asarray(
+            rng.integers(0, nb - 1, size=(T, D, 1 << (D - 1))).astype(np.int32)
+        ),
+        leaf_stats=jnp.asarray(
+            (np.abs(rng.normal(size=(T, 1 << D, 5))) + 0.5).astype(np.float32)
+        ),
+        in_sample=jnp.asarray(rng.uniform(size=(T, n)) < 0.5),
+        bin_edges=jnp.asarray(
+            np.sort(rng.normal(size=(p, nb - 1)), axis=1).astype(np.float32)
+        ),
+        ci_group_size=2,
+    )
+
+
+def test_predict_and_leaf_index_packed_bit_identity():
+    """packed == unpacked (dtype included) for compute_leaf_index AND
+    the full predict_cate output on a synthetic forest — the tier-1
+    half of the ISSUE 12 bit-identity matrix."""
+    from ate_replication_causalml_tpu.models.causal_forest import (
+        compute_leaf_index,
+        predict_cate,
+    )
+
+    rng = np.random.default_rng(5)
+    forest = _synthetic_forest(rng)
+    x = jnp.asarray(rng.normal(size=(53, 7)).astype(np.float32))
+    li0 = compute_leaf_index(forest, x, pack=False)
+    li1 = compute_leaf_index(forest, x, pack=True)
+    assert li0.dtype == li1.dtype
+    assert jnp.array_equal(li0, li1)
+    a = predict_cate(forest, x, oob=False, row_backend="matmul", pack=False)
+    b = predict_cate(forest, x, oob=False, row_backend="matmul", pack=True)
+    assert a.cate.dtype == b.cate.dtype
+    assert jnp.array_equal(a.cate, b.cate)
+    assert jnp.array_equal(a.variance, b.variance)
+    # the cached-routing path accepts either build
+    c = predict_cate(forest, x, oob=False, row_backend="matmul",
+                     leaf_index=li1)
+    assert jnp.array_equal(a.cate, c.cate)
+
+
+# ── mesh-sharded leaf-index build (tentpole a) ─────────────────────────
+
+
+def test_sharded_leaf_index_bit_identity_1_2_4_8_devices():
+    """THE tentpole-a acceptance: sharded == serial (array_equal, dtype
+    included) at every axis size, including non-divisible row counts
+    (padded shards), with every boundary byte metered through the
+    artifact plane."""
+    from ate_replication_causalml_tpu import observability as obs
+    from ate_replication_causalml_tpu.models.causal_forest import (
+        compute_leaf_index,
+        compute_leaf_index_sharded,
+    )
+    from ate_replication_causalml_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(6)
+    forest = _synthetic_forest(rng, T=6, D=4, n=77, p=7, nb=16)
+    x = rng.normal(size=(77, 7)).astype(np.float32)  # 77: divides nothing
+    serial = np.asarray(compute_leaf_index(forest, jnp.asarray(x)))
+    for d in (1, 2, 4, 8):
+        if d > jax.device_count():
+            pytest.skip(f"only {jax.device_count()} devices provisioned")
+        mesh = make_mesh(("data",), (d,), jax.devices()[:d])
+        before = dict(obs.REGISTRY.peek("artifact_transfer_bytes_total") or {})
+        sharded = compute_leaf_index_sharded(forest, x, mesh=mesh)
+        after = obs.REGISTRY.peek("artifact_transfer_bytes_total") or {}
+        assert sharded.dtype == serial.dtype
+        assert np.array_equal(serial, sharded), f"d={d}"
+        # the query upload and the index gather are both metered
+        up_key = "artifact=leaf_index_x,path=host_upload"
+        out_key = "artifact=leaf_index,path=host_gather"
+        assert after.get(up_key, 0) > before.get(up_key, 0), f"d={d}"
+        assert after.get(out_key, 0) > before.get(out_key, 0), f"d={d}"
+
+
+def test_sharded_leaf_index_accepts_device_arrays_and_pack():
+    """A device-resident query matrix reshards (metered device path)
+    instead of uploading, and the packed build is bit-identical."""
+    from ate_replication_causalml_tpu.models.causal_forest import (
+        compute_leaf_index,
+        compute_leaf_index_sharded,
+    )
+    from ate_replication_causalml_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(7)
+    forest = _synthetic_forest(rng)
+    x = rng.normal(size=(64, 7)).astype(np.float32)
+    d = min(2, jax.device_count())
+    mesh = make_mesh(("data",), (d,), jax.devices()[:d])
+    serial = np.asarray(compute_leaf_index(forest, jnp.asarray(x)))
+    via_device = compute_leaf_index_sharded(forest, jnp.asarray(x), mesh=mesh)
+    packed = compute_leaf_index_sharded(forest, x, mesh=mesh, pack=True)
+    assert np.array_equal(serial, via_device)
+    assert np.array_equal(serial, packed)
+    assert packed.dtype == serial.dtype
+
+
+# ── PREDICT_AB record validation ───────────────────────────────────────
+
+
+def _valid_record():
+    return {
+        "metric": "predict_path_ab_16384_rows",
+        "pack": {
+            "bit_equal": True,
+            "unpacked": {"useful_macs": 100, "permute_macs": 2100,
+                         "table_macs": 5000, "total_macs": 7100},
+            "packed": {"useful_macs": 100, "permute_macs": 700,
+                       "table_macs": 2000, "total_macs": 2700},
+            "permute_mac_ratio": 3.0,
+        },
+        "fusion": {
+            "bit_equal": True,
+            "executables": {"per_bucket": 4, "fused": 2},
+            "real_rows": 400,
+            "per_bucket_dispatched_rows": 500,
+            "per_bucket_pad_rows": 100,
+            "fused_dispatched_rows": 480,
+            "fused_masked_rows": 80,
+        },
+        "sharded_build": {
+            "devices": [1, 2, 4, 8],
+            "wall_s": [0.5, 0.5, 0.5, 0.5],
+            "bit_equal": [True, True, True, True],
+        },
+    }
+
+
+def test_committed_predict_ab_record_validates():
+    path = os.path.join(_REPO, "PREDICT_AB.json")
+    with open(path) as f:
+        record = json.load(f)
+    assert cms.validate_predict_ab_record(record) == []
+    # and the record carries the modeled 3× claim
+    assert record["pack"]["permute_mac_ratio"] == 3.0
+    assert record["fusion"]["executables"]["fused"] < (
+        record["fusion"]["executables"]["per_bucket"]
+    )
+
+
+def test_predict_ab_validator_accepts_and_rejects():
+    assert cms.validate_predict_ab_record(_valid_record()) == []
+
+    r = _valid_record()
+    r["pack"]["bit_equal"] = False
+    assert any("bit_equal" in e for e in cms.validate_predict_ab_record(r))
+
+    r = _valid_record()
+    r["pack"]["packed"]["useful_macs"] = 99  # useful is mode-independent
+    assert any("useful" in e for e in cms.validate_predict_ab_record(r))
+
+    r = _valid_record()
+    r["pack"]["packed"]["permute_macs"] = 2000  # ratio collapses
+    assert any("permute-MAC ratio" in e
+               for e in cms.validate_predict_ab_record(r))
+
+    r = _valid_record()
+    r["pack"]["permute_mac_ratio"] = 2.5  # recorded != computed
+    assert any("permute_mac_ratio" in e
+               for e in cms.validate_predict_ab_record(r))
+
+    r = _valid_record()
+    r["fusion"]["executables"]["fused"] = 4  # count must DROP
+    assert any("executable count" in e
+               for e in cms.validate_predict_ab_record(r))
+
+    r = _valid_record()
+    r["fusion"]["fused_masked_rows"] = 150  # more waste than padding
+    r["fusion"]["fused_dispatched_rows"] = 550
+    assert any("exceeds per-bucket pad" in e
+               for e in cms.validate_predict_ab_record(r))
+
+    r = _valid_record()
+    r["fusion"]["per_bucket_dispatched_rows"] = 501  # books don't close
+    assert any("accounting does not close" in e
+               for e in cms.validate_predict_ab_record(r))
+
+    r = _valid_record()
+    r["sharded_build"]["bit_equal"] = [True, True, False, True]
+    assert any("every axis size" in e
+               for e in cms.validate_predict_ab_record(r))
+
+    r = _valid_record()
+    r["sharded_build"]["devices"] = [2, 4, 8]  # must start at 1 (serial ref)
+    assert any("ascend from 1" in e for e in cms.validate_predict_ab_record(r))
+
+    r = _valid_record()
+    del r["pack"]
+    assert any("missing pack" in e for e in cms.validate_predict_ab_record(r))

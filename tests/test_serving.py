@@ -802,11 +802,18 @@ def test_live_admin_endpoint_over_http(serving_rig):
     assert "serving_batch_close_total" in varz
 
 
+@pytest.mark.slow
 def test_loadgen_inprocess_replay_against_rig(serving_rig):
     """A seeded open-loop replay against the live daemon: every
     scheduled request serves, the record carries offered vs achieved
     rate and client latencies, and retryable rejects (if any) were
-    absorbed under the same ids."""
+    absorbed under the same ids.
+
+    @slow since ISSUE 12 (tier-1 budget): the fleet rig's multi-tenant
+    replay ACROSS A LIVE ROTATION (tests/test_fleet.py) runs the same
+    loadgen core against a daemon in tier-1 with strictly more at
+    stake, making this single-tenant replay redundant coverage; the
+    budget pays for the fused-bucket + rotation-prewarm rig instead."""
     server = serving_rig["server"]
     schedule = loadgen.build_schedule(
         3, 24, rate_hz=3000.0, mix="1:2,4:1,16:1", id_prefix="lg",

@@ -1,0 +1,342 @@
+"""ISSUE 12 serving tests: bucket fusion (one masked executable per
+fused group — executable count drops, fused == per-bucket bit-identity
+across the full bucket plan, masked rows exactly zero, the pad/masked
+metric split) and the rotation prewarm (fitted checkpoints pre-build
+the sharded leaf index BEFORE the swap instant; no post-swap latency
+cliff; zero post-swap compiles) — all on ONE module-scoped fused
+daemon whose teardown stop() enforces the zero-compile window over
+everything, rotations included.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from ate_replication_causalml_tpu.serving.coalescer import (
+    BucketPlan,
+    Coalescer,
+    FusionPlan,
+    PendingRequest,
+)
+
+# ── FusionPlan / take_fill units (no jax) ──────────────────────────────
+
+
+def test_fusion_plan_pairs_adjacent_from_largest():
+    plan = BucketPlan((1, 8, 64, 256))
+    fp = FusionPlan.pair_adjacent(plan)
+    assert fp.groups == ((1, 8), (64, 256))
+    assert fp.widths == (8, 256)
+    assert fp.width_for(1) == 8 and fp.width_for(8) == 8
+    assert fp.width_for(64) == 256 and fp.width_for(256) == 256
+    with pytest.raises(ValueError):
+        fp.width_for(32)
+    # odd count leaves the SMALLEST bucket alone
+    fp3 = FusionPlan.pair_adjacent(BucketPlan((1, 8, 64)))
+    assert fp3.groups == ((1,), (8, 64))
+    # groups must partition the plan
+    with pytest.raises(ValueError):
+        FusionPlan(plan, ((1, 8), (256, 64)))
+    with pytest.raises(ValueError):
+        FusionPlan(plan, ((1, 8),))
+
+
+class _Clock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _req(rid, rows, clock, model=""):
+    return PendingRequest(rid, None, rows, clock(), model=model)
+
+
+def test_take_fill_fifo_model_pure_and_capacity():
+    clock = _Clock()
+    co = Coalescer(BucketPlan((4, 16)), window_s=1.0, clock=clock)
+    for i, (rows, model) in enumerate(
+        [(3, "a"), (2, "b"), (4, "a"), (9, "a")]
+    ):
+        co.submit(_req(f"r{i}", rows, clock, model))
+    # capacity 8, model a: FIFO prefix r0(3) + r2(4); r3(9) won't fit
+    # and nothing may be reordered past it; r1 is another tenant.
+    fill = co.take_fill("a", 8, clock())
+    assert [r.request_id for r in fill] == ["r0", "r2"]
+    assert all(r.batch_closed_mono == clock() for r in fill)
+    assert co.pending_depth() == 2
+    # nothing fits → nothing taken, queue untouched
+    assert co.take_fill("a", 0, clock()) == ()
+    assert co.take_fill("b", 1, clock()) == ()
+    assert co.pending_depth() == 2
+    # remaining model-a waiter still packs a normal batch
+    clock.t += 2.0
+    batch = co.next_batch(timeout=0)
+    assert batch is not None and batch.model in ("a", "b")
+
+
+# ── the fused + fitted rig ─────────────────────────────────────────────
+
+N_REQUESTS = 36
+_SIZES = (1, 3, 4, 9, 16, 5)
+
+
+def _synthetic_forest(rng):
+    """Same micro-forest shape as the PR 6/7/11 serving rigs."""
+    import jax.numpy as jnp
+
+    from ate_replication_causalml_tpu.models.causal_forest import CausalForest
+
+    T, D, n, p, nb = 8, 3, 50, 4, 8
+    return CausalForest(
+        split_feat=jnp.asarray(
+            rng.integers(0, p, size=(T, D, 1 << D)).astype(np.int32)
+        ),
+        split_bin=jnp.asarray(
+            rng.integers(0, nb - 1, size=(T, D, 1 << D)).astype(np.int32)
+        ),
+        leaf_stats=jnp.asarray(
+            (np.abs(rng.normal(size=(T, 1 << D, 5))) + 0.5).astype(np.float32)
+        ),
+        in_sample=jnp.asarray(rng.uniform(size=(T, n)) < 0.5),
+        bin_edges=jnp.asarray(
+            np.sort(rng.normal(size=(p, nb - 1)), axis=1).astype(np.float32)
+        ),
+        ci_group_size=2,
+    )
+
+
+def _fitted(rng, forest):
+    import jax.numpy as jnp
+
+    from ate_replication_causalml_tpu.models.causal_forest import (
+        FittedCausalForest,
+    )
+
+    n, p = 50, 4
+    x = jnp.asarray(rng.normal(size=(n, p)).astype(np.float32))
+    w = jnp.asarray(rng.integers(0, 2, size=(n,)).astype(np.float32))
+    y = jnp.asarray(rng.normal(size=(n,)).astype(np.float32))
+    return FittedCausalForest(
+        forest=forest, y_hat=y * 0, w_hat=w * 0 + 0.5, x=x, y=y, w=w
+    )
+
+
+@pytest.fixture(scope="module")
+def fused_rig(tmp_path_factory):
+    """FITTED v1/v2 checkpoints (the rotation-prewarm path), offline
+    references AND serial leaf indices for both versions computed
+    BEFORE startup (the process-global no-compile gotcha — jnp slicing
+    references inside the window would count as compiles), ONE running
+    FUSED daemon."""
+    import jax.numpy as jnp
+
+    from ate_replication_causalml_tpu.models.causal_forest import (
+        compute_leaf_index,
+        predict_cate,
+    )
+    from ate_replication_causalml_tpu.serving.daemon import (
+        CateServer,
+        ServeConfig,
+    )
+    from ate_replication_causalml_tpu.utils.checkpoint import save_fitted
+
+    tmp = tmp_path_factory.mktemp("fused")
+    rng = np.random.default_rng(0)
+    f1, f2 = _synthetic_forest(rng), _synthetic_forest(rng)
+    ft1, ft2 = _fitted(rng, f1), _fitted(rng, f2)
+    ckpts = {"v1": str(tmp / "v1.npz"), "v2": str(tmp / "v2.npz")}
+    save_fitted(ckpts["v1"], ft1)
+    save_fitted(ckpts["v2"], ft2)
+
+    xs = [
+        rng.normal(size=(_SIZES[i % len(_SIZES)], 4)).astype(np.float32)
+        for i in range(N_REQUESTS)
+    ]
+    cat = jnp.asarray(np.concatenate(xs))
+    refs = {}
+    for name, forest in (("v1", f1), ("v2", f2)):
+        out = predict_cate(forest, cat, oob=False, row_backend="matmul")
+        refs[name] = (np.asarray(out.cate), np.asarray(out.variance))
+    lis = {
+        "v1": np.asarray(compute_leaf_index(f1, ft1.x)),
+        "v2": np.asarray(compute_leaf_index(f2, ft2.x)),
+    }
+
+    server = CateServer(ServeConfig(
+        checkpoint=ckpts["v1"],
+        buckets=BucketPlan.parse("4,16"),
+        window_s=0.002,
+        max_depth=16,
+        retry_after_s=0.005,
+        fuse_buckets=True,
+    ))
+    phases = server.startup()
+    yield dict(server=server, xs=xs, refs=refs, lis=lis, ckpts=ckpts,
+               phases=phases)
+    # Module teardown ENFORCES the zero-compile window over everything —
+    # fused dispatches, the live rotation, and its leaf-index prebuild.
+    server.stop()
+
+
+def _offsets(xs):
+    offs, off = [0], 0
+    for x in xs:
+        off += x.shape[0]
+        offs.append(off)
+    return offs
+
+
+def test_fused_executable_count_drops_and_index_phase(fused_rig):
+    """One masked executable per fusion group instead of one per
+    bucket; the fitted startup paid an explicit 'index' phase whose
+    product equals the serial build bit-for-bit."""
+    server = fused_rig["server"]
+    assert server._fusion.groups == ((4, 16),)
+    keys = list(server._executables)
+    assert len(keys) == 1  # 2 buckets -> 1 fused executable
+    assert keys[0][1:] == ("fused", 16)
+    assert set(fused_rig["phases"]) == {"load", "aot", "warm", "index"}
+    entry = server.fleet.get("default")
+    assert entry.leaf_index is not None
+    assert np.array_equal(np.asarray(entry.leaf_index), fused_rig["lis"]["v1"])
+    assert entry.leaf_index.dtype == fused_rig["lis"]["v1"].dtype
+
+
+def test_fused_dispatch_bit_identity_across_bucket_plan(fused_rig):
+    """THE tentpole-c acceptance half: every request size across the
+    full bucket plan (1..16 rows — both buckets of the fused group)
+    served through the masked executable, bit-identical to offline
+    predict_cate; zero compile events; the masked metric carries the
+    empty region and the pad metric stays at zero (the split)."""
+    from ate_replication_causalml_tpu import observability as obs
+
+    server = fused_rig["server"]
+    xs = fused_rig["xs"]
+    refc, refv = fused_rig["refs"]["v1"]
+    offs = _offsets(xs)
+    half = N_REQUESTS // 2
+    # The registry is PROCESS-GLOBAL and other suites run unfused
+    # daemons in the same tier-1 process — assert DELTAS, not totals.
+    def totals():
+        masked = obs.REGISTRY.peek("serving_masked_rows_total") or {}
+        pad = obs.REGISTRY.peek("serving_pad_rows_total") or {}
+        batches = obs.REGISTRY.peek("serving_batches_total") or {}
+        return sum(masked.values()), sum(pad.values()), dict(batches)
+
+    masked0, pad0, batches0 = totals()
+    for i in range(half):
+        cate, var = server.serve_one(f"r{i}", xs[i])
+        assert np.array_equal(cate, refc[offs[i]:offs[i + 1]])
+        assert np.array_equal(var, refv[offs[i]:offs[i + 1]])
+    assert server.compile_events_in_window() == 0.0
+    masked1, pad1, batches1 = totals()
+    assert masked1 > masked0   # partial batches rode the mask
+    assert pad1 == pad0        # nothing reported as garbage pad
+    assert server.masked_fraction_mean() > 0.0
+    st = server.stats()
+    assert st["fused_buckets"] == [[4, 16]]
+    # every batch THIS rig dispatched rode the fused width
+    grew = {
+        k for k, v in batches1.items() if v > batches0.get(k, 0)
+    }
+    assert grew == {"bucket=16"}
+
+
+def test_masked_rows_are_exactly_zero(fused_rig):
+    """The traced row-mask discipline: the fused executable's empty
+    region is deterministic EXACT zeros, never garbage (dispatched
+    directly against the AOT executable — inside the no-compile
+    window, which proves the probe itself compiles nothing)."""
+    import jax
+
+    server = fused_rig["server"]
+    entry = server.fleet.get("default")
+    compiled = server._executables[(entry.sig, "fused", 16)]
+    x = np.zeros((16, 4), np.float32)
+    x[:3] = fused_rig["xs"][0][:3] if fused_rig["xs"][0].shape[0] >= 3 else 1.0
+    mask = np.zeros((16,), np.float32)
+    mask[:3] = 1.0
+    out = compiled(entry.forest, jax.device_put(x), jax.device_put(mask),
+                   None)
+    assert (np.asarray(out.cate)[3:] == 0.0).all()
+    assert (np.asarray(out.variance)[3:] == 0.0).all()
+    assert server.compile_events_in_window() == 0.0
+
+
+def test_rotation_prewarms_leaf_index_no_latency_cliff(fused_rig):
+    """THE rotation-gap acceptance (PR 11 satellite): a live rotation
+    onto a FITTED candidate pre-builds the sharded leaf index BEFORE
+    the swap instant, compiles NOTHING (the build executables were
+    traced at startup), serves bit-identically per version, and shows
+    no first-predict latency cliff — the post-swap p99 over fresh
+    requests stays within a stated factor of the steady p99."""
+    server = fused_rig["server"]
+    xs = fused_rig["xs"]
+    offs = _offsets(xs)
+    half = N_REQUESTS // 2
+
+    # Steady-state latency sample (the daemon is warm from the earlier
+    # tests in this module).
+    steady = []
+    for i in range(8):
+        x = xs[i % half]
+        t0 = time.monotonic()
+        server.serve_one(f"steady{i}", x)
+        steady.append(time.monotonic() - t0)
+    steady_p99 = sorted(steady)[-1]
+
+    status = server.rotate("default", fused_rig["ckpts"]["v2"],
+                           reason="test")
+    assert status == "rotated"
+    # Zero post-swap compiles: prewarm reused the startup-traced build.
+    assert server.compile_events_in_window() == 0.0
+    entry = server.fleet.get("default")
+    assert entry.version == 2
+    assert np.array_equal(np.asarray(entry.leaf_index),
+                          fused_rig["lis"]["v2"])
+
+    # First post-swap predicts: warm (device-resident forest, shared
+    # executables) — bounded by steady p99 × 25, a generous factor that
+    # still catches a transfer/compile cliff (either costs 100×+ here).
+    refc, refv = fused_rig["refs"]["v2"]
+    post = []
+    for j in range(half, N_REQUESTS):
+        t0 = time.monotonic()
+        cate, var = server.serve_one(f"post{j}", xs[j])
+        post.append(time.monotonic() - t0)
+        assert np.array_equal(cate, refc[offs[j]:offs[j + 1]])
+        assert np.array_equal(var, refv[offs[j]:offs[j + 1]])
+    # Compare like with like: p99 against p99, min against min. The
+    # min is the cliff-sensitive bound (a post-swap cold path would
+    # slow EVERY early request); the p99 bound guards the tail.
+    assert min(post) <= max(steady_p99, 1e-3) * 25, (min(post), steady_p99)
+    assert sorted(post)[-1] <= max(steady_p99, 1e-3) * 25, (post, steady_p99)
+
+    rotations = __import__(
+        "ate_replication_causalml_tpu.observability", fromlist=["REGISTRY"]
+    ).REGISTRY.peek("serving_rotations_total")
+    assert rotations.get("model=default,status=rotated", 0) >= 1
+
+
+def test_rotation_to_bare_forest_clears_stale_index(fused_rig):
+    """A bare-forest candidate (no training panel) must CLEAR the
+    entry's leaf index on swap — a stale index against the new forest
+    would be silently wrong."""
+    from ate_replication_causalml_tpu.utils.checkpoint import save_fitted
+
+    server = fused_rig["server"]
+    import os
+    import tempfile
+
+    rng = np.random.default_rng(99)
+    bare = _synthetic_forest(rng)
+    path = os.path.join(tempfile.mkdtemp(), "bare.npz")
+    save_fitted(path, bare)
+    assert server.rotate("default", path, reason="test") == "rotated"
+    entry = server.fleet.get("default")
+    assert entry.leaf_index is None
+    assert entry.version == 3
+    assert server.compile_events_in_window() == 0.0
