@@ -143,6 +143,23 @@ class JitAmbientState(Rule):
 
 _KEY_PARAM_RE = re.compile(r"^(key|rng|prng\w*|\w*_key|\w*_rng)$")
 
+#: replicate-axis key ARRAYS (the scenario matrix's vmapped key
+#: batches) — tracked like scalar keys inside scenarios/: feeding the
+#: whole axis to two jax.random calls draws the same stream per
+#: replicate twice.
+_KEY_ARRAY_PARAM_RE = re.compile(r"^(keys|\w*_keys)$")
+
+
+def _in_scenarios_scope(relpath: str) -> bool:
+    return "scenarios/" in relpath.replace("\\", "/")
+
+
+def _branches_compatible(a: tuple, b: tuple) -> bool:
+    """Whether two If-arm paths can co-execute: incompatible iff they
+    take DIFFERENT arms of the same ``if`` statement."""
+    arms = dict(a)
+    return all(arms.get(if_id, arm) == arm for if_id, arm in b)
+
 _KEY_ORIGINS = {
     "jax.random.key",
     "jax.random.PRNGKey",
@@ -172,13 +189,22 @@ class PrngKeyReuse(Rule):
     Sanctioned idioms stay quiet: ``key, sub = split(key)`` (rebind in
     the consuming statement) and ``fold_in(key, i)`` (derivation — its
     contract is minting many keys from one live parent; only ``split``
-    retires its input)."""
+    retires its input).
+
+    Inside ``scenarios/`` (ISSUE 13), where the whole Monte-Carlo
+    discipline is ``fold_in(root, cell_id)``, two extra checks arm:
+    two ``fold_in`` call SITES with identical (key, data) operands mint
+    the same derived key twice (the matrix's correlated-cells bug), and
+    replicate-axis key ARRAYS (params named ``keys``/``*_keys``) are
+    tracked like scalar keys — consuming the axis in two jax.random
+    calls replays every replicate's stream."""
 
     id = "JGL002"
     name = "prng-key-reuse"
     description = (
         "PRNG key consumed by >=2 jax.random calls, consumed in a loop, "
-        "or split output partially discarded"
+        "split output partially discarded; in scenarios/: duplicate "
+        "fold_in operands or replicate-axis key-array reuse"
     )
 
     def check(self, module: ModuleInfo) -> Iterator[Finding]:
@@ -196,10 +222,42 @@ class PrngKeyReuse(Rule):
             for n in ast.walk(fn)
             if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
         }
+        in_scenarios = _in_scenarios_scope(module.relpath)
         # name -> (bound_loop_depth, consumed_count, first_use_line)
         state: dict[str, list] = {
-            p: [0, 0, 0] for p in rec.param_names() if _KEY_PARAM_RE.match(p)
+            p: [0, 0, 0]
+            for p in rec.param_names()
+            if _KEY_PARAM_RE.match(p)
+            or (in_scenarios and _KEY_ARRAY_PARAM_RE.match(p))
         }
+        # (key operand dump, data operand dump) -> [(site, branch path)]
+        # — the scenarios/ duplicate-derivation check. Operand dumps are
+        # TEXTUAL, so two guards keep the check sound: skip operands
+        # naming anything reassigned in the function (`key =
+        # fold_in(key, 7)` twice folds a DIFFERENT key each time — the
+        # rethreading idiom this rule recommends), and never pair sites
+        # from mutually exclusive If arms (only one executes).
+        fold_sites: dict[tuple, list] = {}
+        # Names with >= 2 binding sites: their value can differ
+        # between two textually identical operand dumps, so they are
+        # excluded from the duplicate-derivation check (a single
+        # binding site yields one value per execution — a derived
+        # key like `data_key = fold_in(root, cid)` stays checkable).
+        # A parameter IS a binding site: `key = fold_in(key, 7)` then
+        # folding `key` again folds the rebound value.
+        assign_counts: dict[str, int] = {p: 1 for p in rec.param_names()}
+        for n in ast.walk(fn):
+            if isinstance(n, (ast.AnnAssign, ast.AugAssign, ast.NamedExpr)):
+                targets = (n.target,)
+            elif isinstance(n, ast.Assign):
+                targets = tuple(n.targets)
+            else:
+                continue
+            for t in targets:
+                for el in ast.walk(t):
+                    if isinstance(el, ast.Name):
+                        assign_counts[el.id] = assign_counts.get(el.id, 0) + 1
+        multiply_assigned = {k for k, c in assign_counts.items() if c >= 2}
         findings: list[Finding] = []
 
         def bind(name: str, depth: int) -> None:
@@ -287,7 +345,8 @@ class PrngKeyReuse(Rule):
                             unbind(el.id)
 
         def scan_expr(
-            node: ast.AST, depth: int, rebound: set[str] = frozenset()
+            node: ast.AST, depth: int, rebound: set[str] = frozenset(),
+            branch: tuple = (),
         ) -> None:
             if isinstance(
                 node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
@@ -296,16 +355,16 @@ class PrngKeyReuse(Rule):
                 # while bound outside is the same n-identical-draws bug
                 # as the `for` form.
                 for gen in node.generators:
-                    scan_expr(gen.iter, depth, rebound)
+                    scan_expr(gen.iter, depth, rebound, branch)
                     for cond in gen.ifs:
-                        scan_expr(cond, depth + 1, rebound)
+                        scan_expr(cond, depth + 1, rebound, branch)
                 parts = (
                     (node.key, node.value)
                     if isinstance(node, ast.DictComp)
                     else (node.elt,)
                 )
                 for part in parts:
-                    scan_expr(part, depth + 1, rebound)
+                    scan_expr(part, depth + 1, rebound, branch)
                 return
             if (
                 isinstance(node, ast.Subscript)
@@ -324,6 +383,51 @@ class PrngKeyReuse(Rule):
                 )
             if isinstance(node, ast.Call):
                 fr = module.resolve(node.func)
+                if (
+                    in_scenarios
+                    and fr == "jax.random.fold_in"
+                    and len(node.args) >= 2
+                ):
+                    # Duplicate derivation: two distinct call SITES
+                    # folding the same (key, data) pair mint the SAME
+                    # key twice — in the cell-id discipline that means
+                    # two consumers silently share a stream. One site
+                    # reached many times (a loop over cell ids) is the
+                    # sanctioned idiom and has one signature per
+                    # distinct data expression. Excluded: operands
+                    # naming a multiply-assigned variable (textual
+                    # equality no longer means value equality), and
+                    # site pairs in mutually exclusive If arms.
+                    operand_names = {
+                        el.id
+                        for arg in node.args[:2]
+                        for el in ast.walk(arg)
+                        if isinstance(el, ast.Name)
+                    }
+                    if not (operand_names & multiply_assigned):
+                        sig = (ast.dump(node.args[0]),
+                               ast.dump(node.args[1]))
+                        site = (node.lineno, node.col_offset)
+                        entries = fold_sites.setdefault(sig, [])
+                        if all(s != site for s, _ in entries):
+                            clash = next(
+                                (s for s, b in entries
+                                 if _branches_compatible(b, branch)),
+                                None,
+                            )
+                            if clash is not None:
+                                findings.append(
+                                    self.finding(
+                                        module,
+                                        node,
+                                        "fold_in duplicates the derivation "
+                                        f"at line {clash[0]} — identical "
+                                        "(key, data) operands mint the same "
+                                        "key twice; give each consumer its "
+                                        "own fold constant",
+                                    )
+                                )
+                            entries.append((site, branch))
                 # fold_in is derivation, not consumption: it exists to
                 # mint many independent keys from one live parent
                 # (per-iteration fold_in is what this rule's own
@@ -340,7 +444,7 @@ class PrngKeyReuse(Rule):
                         if isinstance(arg, ast.Name) and arg.id not in rebound:
                             consume(arg.id, node, depth)
             for child in ast.iter_child_nodes(node):
-                scan_expr(child, depth, rebound)
+                scan_expr(child, depth, rebound, branch)
 
         def rebound_targets(node: ast.Assign | ast.AnnAssign) -> set[str]:
             """Target names of a key-origin assignment whose value also
@@ -367,49 +471,53 @@ class PrngKeyReuse(Rule):
                 out |= {el.id for el in elts if isinstance(el, ast.Name)}
             return out
 
-        def walk(body: Iterable[ast.stmt], depth: int) -> None:
+        def walk(body: Iterable[ast.stmt], depth: int,
+                 branch: tuple = ()) -> None:
             for stmt in body:
                 if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
                     continue  # separate scope, checked on its own
                 if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
                     if stmt.value is not None:
-                        scan_expr(stmt.value, depth, rebound_targets(stmt))
+                        scan_expr(stmt.value, depth, rebound_targets(stmt),
+                                  branch)
                     handle_assign(stmt, depth)
                     continue
                 if isinstance(stmt, (ast.For, ast.AsyncFor)):
-                    scan_expr(stmt.iter, depth)
+                    scan_expr(stmt.iter, depth, branch=branch)
                     # Any tracked name in the (possibly tuple) loop
                     # target is rebound per iteration — `for i, key in
                     # enumerate(split(key, n))` is hygienic.
                     for el in ast.walk(stmt.target):
                         if isinstance(el, ast.Name) and el.id in state:
                             bind(el.id, depth + 1)
-                    walk(stmt.body, depth + 1)
-                    walk(stmt.orelse, depth)
+                    walk(stmt.body, depth + 1, branch)
+                    walk(stmt.orelse, depth, branch)
                     continue
                 if isinstance(stmt, ast.While):
-                    scan_expr(stmt.test, depth)
-                    walk(stmt.body, depth + 1)
-                    walk(stmt.orelse, depth)
+                    scan_expr(stmt.test, depth, branch=branch)
+                    walk(stmt.body, depth + 1, branch)
+                    walk(stmt.orelse, depth, branch)
                     continue
                 if isinstance(stmt, (ast.If,)):
-                    scan_expr(stmt.test, depth)
-                    walk(stmt.body, depth)
-                    walk(stmt.orelse, depth)
+                    scan_expr(stmt.test, depth, branch=branch)
+                    # The two arms are mutually exclusive: a duplicate
+                    # fold_in pair split across them never co-executes.
+                    walk(stmt.body, depth, branch + ((id(stmt), 0),))
+                    walk(stmt.orelse, depth, branch + ((id(stmt), 1),))
                     continue
                 if isinstance(stmt, (ast.With, ast.AsyncWith)):
                     for item in stmt.items:
-                        scan_expr(item.context_expr, depth)
-                    walk(stmt.body, depth)
+                        scan_expr(item.context_expr, depth, branch=branch)
+                    walk(stmt.body, depth, branch)
                     continue
                 if isinstance(stmt, ast.Try):
-                    walk(stmt.body, depth)
+                    walk(stmt.body, depth, branch)
                     for h in stmt.handlers:
-                        walk(h.body, depth)
-                    walk(stmt.orelse, depth)
-                    walk(stmt.finalbody, depth)
+                        walk(h.body, depth, branch)
+                    walk(stmt.orelse, depth, branch)
+                    walk(stmt.finalbody, depth, branch)
                     continue
-                scan_expr(stmt, depth)
+                scan_expr(stmt, depth, branch=branch)
 
         walk(fn.body, 0)
         yield from findings

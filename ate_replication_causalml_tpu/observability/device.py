@@ -184,6 +184,20 @@ def install_jax_monitoring() -> bool:
         "masked fraction of fused-bucket dispatches (exact zeros)",
         bounds=PAD_FRACTION_BOUNDS,
     )
+    # Scenario-matrix families (ISSUE 13): cell outcomes by column, the
+    # batch dispatch meter (vmapped vs sequential — the O(columns)
+    # executables contract's denominator), and the per-column AOT
+    # compile count. "No matrix ever ran" is a recorded 0 on every
+    # instrumented run.
+    counter("scenario_cells_total",
+            "scenario-matrix cells by column and computed/resumed/failed "
+            "status").inc(0)
+    counter("scenario_batch_dispatch_total",
+            "scenario-matrix batch dispatches by column and "
+            "vmapped/sequential mode").inc(0)
+    counter("scenario_column_compile_total",
+            "scenario column executables AOT-compiled, by column and kind"
+            ).inc(0)
     if _installed:
         return True
     try:

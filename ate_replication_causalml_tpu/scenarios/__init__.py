@@ -1,0 +1,46 @@
+"""Monte-Carlo scenario matrix (ISSUE 13): seeded synthetic DGP
+library, batched (vmapped-replicate) estimator entry points, and the
+matrix runner on the SweepEngine. One executable per scenario COLUMN,
+thousands of cells — see ``scenarios/matrix.py`` for the contracts."""
+
+from ate_replication_causalml_tpu.scenarios.batched import (
+    MAX_VMAP_COLLAPSE_ULP,
+    SCENARIO_ESTIMATORS,
+    ScenarioEstimator,
+    cell_fn,
+    clear_executables,
+    column_cache_key,
+    column_executable,
+    scalar_executable,
+)
+from ate_replication_causalml_tpu.scenarios.dgp import (
+    DGPSpec,
+    STOCK_DGPS,
+    data_cell_id,
+    estimator_salt,
+    generate,
+)
+from ate_replication_causalml_tpu.scenarios.matrix import (
+    ColumnPlan,
+    MatrixReport,
+    MatrixSpec,
+    cell_row_id,
+    column_aggregates,
+    column_name,
+    compare_cells,
+    micro_matrix_spec,
+    plan_columns,
+    run_matrix,
+    run_scalar_replay,
+)
+
+__all__ = [
+    "MAX_VMAP_COLLAPSE_ULP", "SCENARIO_ESTIMATORS", "STOCK_DGPS",
+    "ColumnPlan", "DGPSpec", "MatrixReport", "MatrixSpec",
+    "ScenarioEstimator",
+    "cell_fn", "cell_row_id", "clear_executables", "column_aggregates",
+    "column_cache_key", "column_executable", "column_name",
+    "compare_cells", "data_cell_id", "estimator_salt", "generate",
+    "micro_matrix_spec", "plan_columns", "run_matrix",
+    "run_scalar_replay", "scalar_executable",
+]

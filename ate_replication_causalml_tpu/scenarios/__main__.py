@@ -1,0 +1,8 @@
+"""``python -m ate_replication_causalml_tpu.scenarios`` — the matrix
+CLI (avoids runpy's found-in-sys.modules warning that the
+``.scenarios.matrix`` form triggers, since the package __init__
+imports the module)."""
+
+from ate_replication_causalml_tpu.scenarios.matrix import main
+
+main()

@@ -1,0 +1,323 @@
+"""Batched estimator entry points (ISSUE 13, tentpole part b).
+
+The round-5 hardware lesson says executable COUNT is a first-class cost
+(1–5 s of remote compile each), so a Monte-Carlo matrix of thousands of
+(DGP × estimator × seed) cells must not compile per cell. This module
+vmaps the REPLICATE axis of the closed-form/GLM/LASSO estimators into
+one fit+estimate executable per (DGP-shape × estimator × config)
+column:
+
+* :func:`cell_fn` — one replicate, a pure function of
+  ``(root_key, cell_id)``: fold in the data key, generate the DGP draw
+  (``scenarios/dgp.py``), derive the estimator's private key, estimate.
+  The SAME function is the scalar-replay path, so batched-vs-sequential
+  bit-identity is an assertion about vmap collapse, not about two
+  implementations agreeing.
+* :func:`column_executable` — ``jit(vmap(cell_fn))`` AOT-lowered and
+  compiled ONCE per column cache key; every batch of replicate seeds in
+  the column dispatches through it. The cache key
+  (:func:`column_cache_key`) is the DGP spec's full field tuple plus
+  the estimator name and batch width — two configs can never share an
+  executable.
+* forest-class engines (``vmapped=False``) cannot vmap a whole fit;
+  the planner (``scenarios/matrix.py``) packs them at width 1 and the
+  stage body dispatches each cell eagerly through the models' existing
+  dispatch machinery instead.
+
+Batched == scalar bit-identity: every estimator here reduces over the
+ROW axis, which vmap leaves untouched, and XLA:CPU folds dot-product K
+axes in 256-wide panels position-independently (the PR 10 probe) — so
+collapse is exact for the stock estimators at the stock shapes; the
+micro-matrix test asserts ``array_equal`` and any future estimator that
+legitimately reassociates must pin its ulp bound there with a
+rationale, not widen the default.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ate_replication_causalml_tpu import observability as obs
+from ate_replication_causalml_tpu.scenarios.dgp import (
+    DGPSpec,
+    estimator_salt,
+    generate,
+)
+
+#: bump when the cell function's derivation chain changes shape — old
+#: journals must not resume against new numerics.
+SCHEMA_TAG = "scenarios-v1"
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioEstimator:
+    """One estimator the matrix can schedule.
+
+    ``fn(spec, x, w, y, key) -> (ate, se)`` must be pure jax when
+    ``vmapped`` (it is traced under ``vmap``+``jit``); non-vmapped
+    engines receive concrete arrays and may dispatch however the
+    underlying model does. ``has_se`` gates the coverage/power
+    aggregates (the LASSO point estimates carry ``se=NaN`` like the
+    reference's no-SE rows)."""
+
+    name: str
+    fn: Callable
+    vmapped: bool = True
+    has_se: bool = True
+    #: GLM/OLS designs need n > p + 2 (intercept + treatment columns);
+    #: the planner refuses inapplicable (DGP, estimator) columns.
+    needs_tall: bool = True
+    #: whether the batched column is BIT-identical to its scalar replay
+    #: (the PR 10 discipline). True for pure row-reduction estimators
+    #: (vmap leaves the reduction axis untouched). False where the
+    #: estimator's matmuls reassociate under batching: XLA:CPU lowers a
+    #: scalar (n,p)@(p,) to a sequential gemv but the vmapped
+    #: (B,n,p)@(p,) to a panel-folded gemm (the PR 10 probe — K folds
+    #: in 256-wide panels, shape-dependent), so GLM/OLS columns are
+    #: pinned at MAX_VMAP_COLLAPSE_ULP instead, with this rationale.
+    vmap_collapse_exact: bool = False
+
+    def applicable(self, spec: DGPSpec) -> bool:
+        return (not self.needs_tall) or spec.n > spec.p + 2
+
+
+def _est_naive(spec, x, w, y, key):
+    from ate_replication_causalml_tpu.estimators.naive import _naive_core
+
+    return _naive_core(w, y)
+
+
+def _est_ols(spec, x, w, y, key):
+    from ate_replication_causalml_tpu.estimators.ols import _direct_core
+
+    return _direct_core(x, w, y)
+
+
+def _est_ipw_logit(spec, x, w, y, key):
+    from ate_replication_causalml_tpu.estimators.ipw import (
+        _psw_core,
+        logistic_propensity,
+    )
+
+    return _psw_core(x, w, y, logistic_propensity(x, w))
+
+
+def _est_aipw_logit(spec, x, w, y, key):
+    """Textbook AIPW (``compat="fixed"`` sign — the doubly-robust form,
+    not the reference's published quirk) with sandwich SE: the coverage
+    claims validated against Chernozhukov et al. rates must use the
+    estimator the theory is about."""
+    from ate_replication_causalml_tpu.estimators.aipw import (
+        _outcome_model_mu,
+        aipw_sandwich_se,
+    )
+    from ate_replication_causalml_tpu.ops import bootstrap as bt
+    from ate_replication_causalml_tpu.ops.glm import logistic_glm
+    from ate_replication_causalml_tpu.ops.linalg import add_intercept
+
+    p = logistic_glm(add_intercept(x), w).fitted
+    mu0, mu1 = _outcome_model_mu(x, w, y)
+    tau = bt._aipw_tau(w, y, p, mu0, mu1, -1.0)
+    return tau, aipw_sandwich_se(w, y, p, mu0, mu1, tau)
+
+
+def _est_lasso(spec, x, w, y, key):
+    """Single-equation LASSO (W never shrunk) — the p≫n column's
+    estimator (Belloni-style sparse designs). Point estimate only, like
+    the reference's no-SE LASSO rows."""
+    from ate_replication_causalml_tpu.ops.lasso import cv_glmnet, default_foldid
+
+    xw = jnp.concatenate([x, w[:, None]], axis=1)
+    pfac = jnp.concatenate(
+        [jnp.ones(x.shape[1], xw.dtype), jnp.zeros(1, xw.dtype)]
+    )
+    foldid = default_foldid(key, x.shape[0])
+    cv = cv_glmnet(xw, y, family="gaussian", penalty_factor=pfac,
+                   foldid=foldid)
+    _, coefs = cv.coef_at("1se")
+    return coefs[-1], jnp.full((), jnp.nan, xw.dtype)
+
+
+def _est_aipw_rf(spec, x, w, y, key):
+    """AIPW over a micro random-forest OOB propensity — the
+    representative NON-vmappable engine: a whole forest fit cannot ride
+    a vmap axis, so the planner packs these cells at width 1 and each
+    dispatch goes through the forest's existing chunked-dispatch path
+    (the scheduler/nuisance-cache discipline, not a batched column)."""
+    from ate_replication_causalml_tpu.data.frame import CausalFrame
+    from ate_replication_causalml_tpu.estimators.aipw import doubly_robust
+    from ate_replication_causalml_tpu.models.forest import rf_oob_propensity
+
+    frame = CausalFrame(x=jnp.asarray(x), w=jnp.asarray(w),
+                        y=jnp.asarray(y), schema=None)
+    res = doubly_robust(
+        frame,
+        lambda f: rf_oob_propensity(f, key=key, n_trees=16, depth=4),
+        compat="fixed",
+    )
+    return res.ate, res.se
+
+
+#: ulp budget (in units of f32 spacing at the compared magnitude) for
+#: estimators whose vmap collapse legitimately reassociates. Measured
+#: on this image: ≤ 4 ulp at n=128 (every reduction under XLA:CPU's
+#: 256-wide gemm K panel — gemv and batched gemm accumulate in the
+#: same order), ≤ ~200 ulp at n=384 (K crosses the panel width, the
+#: two lowerings genuinely reassociate n-length IRLS/OLS reductions,
+#: and the weak-overlap IPW column amplifies the drift through its
+#: near-singular weighting). 512 bounds the measured regime with
+#: headroom; a real numerics bug (wrong data, wrong key threading)
+#: diverges by orders of magnitude more, not ulps.
+MAX_VMAP_COLLAPSE_ULP = 512.0
+
+SCENARIO_ESTIMATORS: dict[str, ScenarioEstimator] = {
+    e.name: e
+    for e in (
+        ScenarioEstimator("naive", _est_naive, needs_tall=False,
+                          vmap_collapse_exact=True),
+        ScenarioEstimator("ols", _est_ols),
+        ScenarioEstimator("ipw_logit", _est_ipw_logit),
+        ScenarioEstimator("aipw_logit", _est_aipw_logit),
+        ScenarioEstimator("lasso", _est_lasso, has_se=False,
+                          needs_tall=False),
+        ScenarioEstimator("aipw_rf", _est_aipw_rf, vmapped=False),
+    )
+}
+
+
+def cell_fn(spec: DGPSpec, est: ScenarioEstimator) -> Callable:
+    """The per-replicate function ``(root_key, cell_id) ->
+    (ate, se, tau_true)`` — shared verbatim by the batched executable
+    and the scalar replay. The data key is ``fold_in(root, cell_id)``
+    (estimator-independent: every estimator in a (DGP, rep) row sees
+    the same draw); the estimator's private key folds a per-estimator
+    salt off the data key."""
+    salt = np.uint32(estimator_salt(est.name))
+
+    def run(root_key, cid):
+        data_key = jax.random.fold_in(root_key, cid)
+        x, w, y, tau_true = generate(spec, data_key)
+        est_key = jax.random.fold_in(data_key, salt)
+        ate, se = est.fn(spec, x, w, y, est_key)
+        return (jnp.asarray(ate), jnp.asarray(se), tau_true)
+
+    return run
+
+
+def column_cache_key(spec: DGPSpec, estimator: str, width: int | None) -> tuple:
+    """The executable-cache identity of one scenario column: the DGP
+    spec's FULL field tuple (two specs differing in any knob can never
+    share an executable), the estimator name, the packed batch width
+    (``None`` = the scalar-replay executable), and the schema tag."""
+    return (SCHEMA_TAG, spec.fields(), estimator, width)
+
+
+#: compiled column executables by column_cache_key — the process-global
+#: fit-once store that makes `jax_compiles_total` grow with COLUMNS,
+#: not cells. Guarded by _EXE_LOCK (graftlint JGL008 discipline).
+_EXECUTABLES: dict[tuple, object] = {}
+_EXE_LOCK = threading.Lock()
+
+
+def clear_executables() -> None:
+    """Test hook: drop the compiled-column cache (compile-count
+    assertions need a cold start)."""
+    with _EXE_LOCK:
+        _EXECUTABLES.clear()
+
+
+def _compile_counter():
+    return obs.counter(
+        "scenario_column_compile_total",
+        "scenario column executables AOT-compiled, by column and kind",
+    )
+
+
+def cached_executable(key: tuple, build: Callable, column: str, kind: str):
+    """The fit-once executable-cache discipline every scenario
+    executable family shares: lock-guarded lookup, ``build()`` (the
+    ``lower().compile()``) outside the lock, ``setdefault`` commit — a
+    compile race loses benignly, both compiles are the same function
+    and the first writer wins the cache slot — and one per-column
+    compile-counter tick for the thread that actually compiled."""
+    with _EXE_LOCK:
+        exe = _EXECUTABLES.get(key)
+    if exe is not None:
+        return exe
+    compiled = build()
+    with _EXE_LOCK:
+        exe = _EXECUTABLES.setdefault(key, compiled)
+    _compile_counter().inc(1, column=column, kind=kind)
+    return exe
+
+
+def column_executable(
+    spec: DGPSpec, est: ScenarioEstimator, width: int, column: str = "",
+    ids_sharding=None,
+):
+    """The column's ONE batched executable:
+    ``compiled(root_key, cell_ids[width]) -> (ate[width], se[width],
+    tau_true[width])``, AOT-lowered and compiled on first request and
+    shared by every batch in the column (and by identical columns in
+    later matrices in the same process).
+
+    ``ids_sharding`` (a ``NamedSharding`` over the replicate axis, the
+    matrix runner's ``ATE_TPU_SCENARIO_SHARD`` path) lowers the program
+    with the cell-id input row-sharded over the mesh and the outputs
+    replicated: the replicate axis is embarrassingly parallel, so each
+    device computes its replicate slice and the result gathers once.
+    The sharding joins the cache key — a sharded and an unsharded run
+    never share an executable (their input layouts differ), but each
+    still compiles exactly one per column. Callers dispatch sharded
+    executables inside the mesh lane (a multi-device program launched
+    off-lane can interleave another collective's rendezvous — the PR 4
+    rule)."""
+    if not est.vmapped:
+        raise ValueError(
+            f"estimator {est.name!r} is not vmappable — the planner must "
+            "pack it at width 1 through the sequential path"
+        )
+    key = column_cache_key(spec, est.name, width) + (ids_sharding,)
+
+    def build():
+        fn = jax.vmap(cell_fn(spec, est), in_axes=(None, 0))
+        root = jax.random.key(0)
+        ids = jnp.zeros((width,), jnp.uint32)
+        if ids_sharding is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            rep = NamedSharding(ids_sharding.mesh, P())
+            jitted = jax.jit(fn, in_shardings=(rep, ids_sharding),
+                             out_shardings=rep)
+            ids = jax.device_put(np.zeros((width,), np.uint32), ids_sharding)
+            root = jax.device_put(root, rep)
+        else:
+            jitted = jax.jit(fn)
+        return jitted.lower(root, ids).compile()
+
+    return cached_executable(
+        key, build, column or f"{spec.name}:{est.name}", "batched")
+
+
+def scalar_executable(spec: DGPSpec, est: ScenarioEstimator, column: str = ""):
+    """The scalar-replay executable for the same cell function —
+    ``compiled(root_key, cell_id) -> (ate, se, tau_true)``. One compile
+    per column here too; the sequential leg pays per-CELL dispatches,
+    not per-cell compiles (that is the honest baseline the batching is
+    measured against)."""
+    key = column_cache_key(spec, est.name, None)
+
+    def build():
+        fn = cell_fn(spec, est)
+        root = jax.random.key(0)
+        cid = jnp.zeros((), jnp.uint32)
+        return jax.jit(fn).lower(root, cid).compile()
+
+    return cached_executable(
+        key, build, column or f"{spec.name}:{est.name}", "scalar")

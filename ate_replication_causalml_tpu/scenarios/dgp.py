@@ -1,0 +1,173 @@
+"""Seeded synthetic DGP library for the Monte-Carlo scenario matrix
+(ISSUE 13, tentpole part a).
+
+Every cell's data is a PURE function of ``fold_in(root_key, cell_id)``:
+the generator takes a key and a frozen :class:`DGPSpec` and returns the
+replicate's ``(x, w, y, tau_true)`` with no ambient state — which is
+what lets the batched estimator entry points (``scenarios/batched.py``)
+vmap the replicate axis into ONE executable per scenario column, and
+what makes checkpoint/resume at cell granularity bit-identical (the
+same ``cell_id`` always regenerates the same bits).
+
+The knobs stress exactly what the literature proves:
+
+* ``tau="hetero"`` — smooth heterogeneous τ(x) surfaces in the style of
+  Wager & Athey (arXiv:1510.04342, the honest-forest asymptotics
+  benchmark surfaces);
+* ``confounding`` — propensity loading on x₁ (γ in
+  ``e(x) = η + (1-2η)·σ(γ·x₁)``), the cross-fitting stress of
+  Chernozhukov et al. (arXiv:1608.00060);
+* ``overlap`` — η above: the minimum propensity. Small η pushes e(x)
+  toward {0,1}, the overlap-violation regime residual balancing
+  (arXiv:1604.07125) targets;
+* ``sparsity``/large ``p`` — p≫n designs with Belloni-style decaying
+  coefficients (arXiv:1201.0224, post-double-selection).
+
+The outcome is binary through a logit link, so the per-replicate truth
+``tau_true = mean(p₁(x) - p₀(x))`` is EXACT (the sample-average
+treatment effect on the probability scale, computed from the potential
+probabilities, not from realized draws) — coverage/bias/RMSE per cell
+need no Monte-Carlo approximation of the estimand.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class DGPSpec:
+    """One synthetic design, fully determined by its fields (the fields
+    ARE the column cache key — see ``scenarios.batched.column_cache_key``).
+
+    ``tau``: ``"constant"`` (τ(x) ≡ ``tau_scale`` on the logit scale —
+    the calibration design every correctly-specified estimator must
+    cover at nominal rate) or ``"hetero"`` (the Wager–Athey-style smooth
+    surface above).
+    """
+
+    name: str
+    n: int = 512
+    p: int = 4
+    tau: str = "constant"
+    tau_scale: float = 0.8
+    confounding: float = 0.0
+    overlap: float = 0.5
+    sparsity: int = 0
+    dtype: str = "float32"
+
+    def __post_init__(self) -> None:
+        if self.tau not in ("constant", "hetero"):
+            raise ValueError(f"tau must be 'constant' or 'hetero', got {self.tau!r}")
+        if not (0.0 < self.overlap <= 0.5):
+            raise ValueError(f"overlap must be in (0, 0.5], got {self.overlap!r}")
+        if self.sparsity < 0 or self.sparsity > self.p:
+            raise ValueError(f"sparsity must be in [0, p], got {self.sparsity!r}")
+
+    def fields(self) -> tuple:
+        """The spec as a flat tuple — the hashable identity the column
+        cache key and the checkpoint fingerprint are built from."""
+        return tuple(
+            getattr(self, f.name) for f in dataclasses.fields(self)
+        )
+
+
+def data_cell_id(dgp_name: str, rep: int) -> int:
+    """Stable uint32 id of one replicate's DATA (shared by every
+    estimator in the same (DGP, rep) row — the standard MC design: all
+    estimators see the same draw). ``fold_in(root_key, data_cell_id)``
+    is the replicate's data key."""
+    return zlib.crc32(f"dgp={dgp_name}|rep={rep}".encode())
+
+
+def estimator_salt(estimator_name: str) -> int:
+    """Stable uint32 fold-in constant deriving an estimator's private
+    key (fold masks, any internal randomness) from the replicate's data
+    key — distinct estimators on the same data draw independent keys."""
+    return zlib.crc32(f"est={estimator_name}".encode())
+
+
+def _beta(spec: DGPSpec, dtype) -> jax.Array:
+    """Deterministic baseline coefficients. Dense designs load every
+    column at 1/√p; sparse designs (``sparsity`` = s > 0) use the
+    Belloni-style 1/(j+1) decay on the first s columns and exact zeros
+    elsewhere — the approximately-sparse regime of arXiv:1201.0224."""
+    idx = jnp.arange(spec.p, dtype=dtype)
+    if spec.sparsity > 0:
+        return jnp.where(idx < spec.sparsity, 1.0 / (idx + 1.0), 0.0)
+    return jnp.full((spec.p,), 1.0 / jnp.sqrt(jnp.asarray(spec.p, dtype)))
+
+
+def propensity(spec: DGPSpec, x: jax.Array) -> jax.Array:
+    """``e(x) = η + (1-2η)·σ(γ·x₁)``: γ=0 is a randomized design with
+    e ≡ 1/2 (the calibration DGP); η bounds e away from {0,1}, so small
+    η under strong γ is a graded overlap violation, never a hard one —
+    IPW variance blows up smoothly instead of dividing by zero."""
+    dtype = x.dtype
+    eta = jnp.asarray(spec.overlap, dtype)
+    gamma = jnp.asarray(spec.confounding, dtype)
+    return eta + (1.0 - 2.0 * eta) * jax.nn.sigmoid(gamma * x[:, 0])
+
+
+def tau_surface(spec: DGPSpec, x: jax.Array) -> jax.Array:
+    """τ(x) on the logit scale. ``"hetero"`` composes the Wager–Athey
+    bump ``ς(v) = 1 + 1/(1+exp(-20(v-1/3)))`` (arXiv:1510.04342, their
+    heterogeneous-effect surfaces on U(0,1) covariates) over
+    ``σ(x₁)``/``σ(x₂)`` — smooth, bounded, genuinely x-dependent."""
+    dtype = x.dtype
+    scale = jnp.asarray(spec.tau_scale, dtype)
+    if spec.tau == "constant":
+        return jnp.full((x.shape[0],), scale)
+    varsigma = lambda v: 1.0 + 1.0 / (1.0 + jnp.exp(-20.0 * (v - 1.0 / 3.0)))
+    u1 = jax.nn.sigmoid(x[:, 0])
+    u2 = jax.nn.sigmoid(x[:, 1 % spec.p])
+    return scale * varsigma(u1) * varsigma(u2) / 4.0
+
+
+def generate(
+    spec: DGPSpec, key: jax.Array
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """One replicate: ``(x, w, y, tau_true)``, a pure function of
+    ``(spec, key)``.
+
+    Binary outcome through a logit link: ``p₀ = σ(x·β)``,
+    ``p₁ = σ(x·β + τ(x))``; realized ``y`` uses a SHARED uniform for
+    both potential outcomes (monotone potential outcomes — the same
+    device the repo's GGL generator uses). ``tau_true`` is the exact
+    sample-average effect ``mean(p₁ - p₀)`` — the estimand coverage is
+    measured against."""
+    dtype = jnp.dtype(spec.dtype)
+    kx, kw, ky = jax.random.split(key, 3)
+    x = jax.random.normal(kx, (spec.n, spec.p), dtype)
+    e = propensity(spec, x)
+    w = (jax.random.uniform(kw, (spec.n,), dtype) < e).astype(dtype)
+    eta0 = jnp.matmul(x, _beta(spec, dtype))
+    p0 = jax.nn.sigmoid(eta0)
+    p1 = jax.nn.sigmoid(eta0 + tau_surface(spec, x))
+    u = jax.random.uniform(ky, (spec.n,), dtype)
+    y = jnp.where(w == 1.0, (u < p1), (u < p0)).astype(dtype)
+    tau_true = jnp.mean(p1 - p0)
+    return x, w, y, tau_true
+
+
+#: The stock designs the micro matrix, the bench record and the tests
+#: draw from. ``calibration`` is the randomized correctly-specified
+#: design whose coverage must sit at nominal (the SCENARIO_MATRIX.json
+#: contract); the others turn one literature knob each.
+STOCK_DGPS: dict[str, DGPSpec] = {
+    d.name: d
+    for d in (
+        DGPSpec(name="calibration", n=512, p=4, tau="constant",
+                tau_scale=0.8, confounding=0.0, overlap=0.5),
+        DGPSpec(name="hetero_confounded", n=512, p=4, tau="hetero",
+                tau_scale=0.8, confounding=1.0, overlap=0.1),
+        DGPSpec(name="overlap_violation", n=512, p=4, tau="constant",
+                tau_scale=0.8, confounding=2.0, overlap=0.02),
+        DGPSpec(name="sparse_highdim", n=128, p=384, tau="constant",
+                tau_scale=0.8, confounding=0.5, overlap=0.2, sparsity=4),
+    )
+}

@@ -1,0 +1,824 @@
+"""Monte-Carlo scenario-matrix runner (ISSUE 13, tentpole part c).
+
+Runs a (DGP × estimator × seed) cell grid through the PR 4
+:class:`~..scheduler.SweepEngine`: each scenario COLUMN contributes one
+executable artifact (``exe:{column}`` — the AOT-compiled vmapped
+fit+estimate program from ``scenarios/batched.py``) plus one stage per
+packed replicate batch; commit order is declaration order, so
+``cells.jsonl`` is deterministic whatever the worker pool does.
+
+Contracts carried here:
+
+* **O(columns) executables** — all replicate seeds in a column
+  dispatch through its single compiled program; the per-column cache
+  key (:func:`~.batched.column_cache_key`) means identical columns in
+  later runs of the same process compile ZERO times. The bench/tests
+  assert ``jax_compiles_total`` deltas against the column count, never
+  the cell count.
+* **degrade-don't-abort per cell** — a failed batch (or a non-finite
+  point estimate) becomes ``status="failed"`` rows for exactly the
+  affected cells; the matrix keeps going (``fail_policy="raise"``
+  aborts, for debugging).
+* **checkpoint/resume at cell granularity** — rows append to
+  ``cells.jsonl`` (the pipeline's ``_Checkpoint`` journal, config-
+  fingerprinted, torn-line tolerant); a resumed run packs only the
+  missing replicates into batches and a fully-completed column
+  declares no artifact needs, so it schedules zero fits and zero
+  compiles — by construction, the ISSUE 4 resume guarantee.
+* **sharded dispatch** (``ATE_TPU_SCENARIO_SHARD=1``, multi-device) —
+  the replicate axis itself is row-sharded over the data-axis mesh:
+  batch widths pad to the device count (``shardio.pad_to_multiple``,
+  the satellite helper lifting the replicated fallback), cell-id
+  uploads and result gathers move through the metered PR 8 artifact
+  plane, and the collective dispatches serialize through the "mesh"
+  lane (the PR 4 rendezvous discipline).
+
+Batch width is deliberately NOT part of the checkpoint fingerprint:
+batched columns are bit-identical to their scalar replays (asserted in
+tests/test_scenarios.py), so journals resume across widths — exactly
+like the sweep's concurrent/sequential modes sharing one journal.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import time
+from typing import Callable, Iterable
+
+import numpy as np
+
+from ate_replication_causalml_tpu import observability as obs
+from ate_replication_causalml_tpu.scenarios.batched import (
+    SCENARIO_ESTIMATORS,
+    SCHEMA_TAG,
+    column_cache_key,
+    column_executable,
+    scalar_executable,
+)
+from ate_replication_causalml_tpu.scenarios.dgp import (
+    DGPSpec,
+    STOCK_DGPS,
+    data_cell_id,
+    estimator_salt,
+)
+
+_BATCH_ENV = "ATE_TPU_SCENARIO_BATCH"
+_REPS_ENV = "ATE_TPU_SCENARIO_REPS"
+_SHARD_ENV = "ATE_TPU_SCENARIO_SHARD"
+
+#: 95% normal critical value, matching estimators.base.Z_95.
+_Z95 = 1.96
+
+
+def _env_int(name: str, default: int) -> int:
+    """Bad values raise at config time (the ATE_TPU_HIST_MODE /
+    ATE_TPU_PREDICT_PACK discipline): a typo'd knob must not silently
+    run a multi-hour grid at the default scale."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        value = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r}: expected a positive integer"
+        ) from None
+    if value < 1:
+        raise ValueError(f"{name}={value}: expected a positive integer")
+    return value
+
+
+def default_batch_width() -> int:
+    return _env_int(_BATCH_ENV, 32)
+
+
+def default_reps() -> int:
+    return _env_int(_REPS_ENV, 64)
+
+
+def _env_shard() -> bool:
+    return os.environ.get(_SHARD_ENV, "").strip().lower() in (
+        "1", "true", "yes", "on"
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class MatrixSpec:
+    """One scenario matrix: the DGP grid, the estimator set, and the
+    replicate/batching policy. ``shard=None`` defers to
+    ``ATE_TPU_SCENARIO_SHARD``."""
+
+    dgps: tuple[DGPSpec, ...]
+    estimators: tuple[str, ...]
+    n_reps: int = 64
+    batch_width: int = 32
+    seed: int = 0
+    fail_policy: str = "degrade"
+    shard: bool | None = None
+
+    def __post_init__(self) -> None:
+        if self.fail_policy not in ("degrade", "raise"):
+            raise ValueError(
+                f"fail_policy must be 'degrade' or 'raise', got "
+                f"{self.fail_policy!r}"
+            )
+        for name in self.estimators:
+            if name not in SCENARIO_ESTIMATORS:
+                raise ValueError(
+                    f"unknown scenario estimator {name!r}; known: "
+                    f"{sorted(SCENARIO_ESTIMATORS)}"
+                )
+        # Names are the column/journal/cell-id namespace: two DGPs (or
+        # estimator entries) sharing one silently collide on journal
+        # keys and merge their aggregates.
+        dgp_names = [d.name for d in self.dgps]
+        for seq, what in ((dgp_names, "DGP"), (self.estimators, "estimator")):
+            dupes = {x for x in seq if list(seq).count(x) > 1}
+            if dupes:
+                raise ValueError(
+                    f"duplicate {what} name(s) in MatrixSpec: {sorted(dupes)}"
+                )
+
+    def fingerprint(self) -> str:
+        """Resume validity: DGP field tuples + estimator set + seed +
+        schema tag. Replicate count and batch width are deliberately
+        absent — extending reps resumes completed cells, and batched ==
+        scalar bit-identity (asserted in-suite) makes widths
+        interchangeable over one journal."""
+        dgps = ";".join(repr(d.fields()) for d in self.dgps)
+        return (
+            f"{SCHEMA_TAG}|dgps=[{dgps}]|est={list(self.estimators)!r}"
+            f"|seed={self.seed}"
+        )
+
+
+def micro_matrix_spec(
+    n_reps: int | None = None, batch_width: int | None = None,
+    n: int = 384, seed: int = 0,
+) -> MatrixSpec:
+    """The canonical micro matrix (2 DGPs × 3 estimators): the
+    calibration design (coverage must sit at nominal) and the
+    heterogeneous confounded design, through the three vmapped GLM-class
+    estimators. Shared by ``bench.py --scenario-matrix`` and the
+    acceptance test so the committed SCENARIO_MATRIX.json and the
+    tier-1 assertion exercise the same grid."""
+    calib = dataclasses.replace(STOCK_DGPS["calibration"], n=n)
+    hetero = dataclasses.replace(STOCK_DGPS["hetero_confounded"], n=n)
+    return MatrixSpec(
+        dgps=(calib, hetero),
+        estimators=("naive", "ipw_logit", "aipw_logit"),
+        n_reps=default_reps() if n_reps is None else n_reps,
+        batch_width=default_batch_width() if batch_width is None else batch_width,
+        seed=seed,
+    )
+
+
+def column_name(dgp: DGPSpec, estimator: str) -> str:
+    return f"{dgp.name}:{estimator}"
+
+
+def cell_row_id(dgp_name: str, estimator: str, rep: int) -> str:
+    """The journal key of one cell — ``_Checkpoint`` keys rows by
+    ``method``, so the cell id IS the method field."""
+    return f"{dgp_name}:{estimator}:{rep}"
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnPlan:
+    """One scheduled column: which replicates still need computing and
+    how they pack into fixed-width batches (the last batch pads to the
+    declared width with duplicate ids whose outputs are discarded — one
+    executable shape per column, the compile-count contract)."""
+
+    name: str
+    dgp: DGPSpec
+    estimator: str
+    width: int
+    mode: str                      # "vmapped" | "sequential"
+    remaining: tuple[int, ...]
+    batches: tuple[tuple[int, ...], ...]
+
+
+def plan_columns(
+    spec: MatrixSpec, done: Callable[[str], bool] = lambda _cell: False,
+    devices: int = 1,
+) -> tuple[list[ColumnPlan], list[str]]:
+    """Pure cell-batching planner: pack each column's not-yet-done
+    replicate seeds into fixed-width batches. Non-vmappable engines
+    (forest-class) pack at width 1 — each cell dispatches through the
+    model's own machinery. Sharded runs pad the width up to the device
+    count. Returns ``(plans, skipped)`` where ``skipped`` names
+    (DGP, estimator) pairs the estimator declared inapplicable
+    (e.g. OLS on a p≫n design)."""
+    plans: list[ColumnPlan] = []
+    skipped: list[str] = []
+    shard = _env_shard() if spec.shard is None else spec.shard
+    for dgp in spec.dgps:
+        for est_name in spec.estimators:
+            est = SCENARIO_ESTIMATORS[est_name]
+            col = column_name(dgp, est_name)
+            if not est.applicable(dgp):
+                skipped.append(col)
+                continue
+            width = min(spec.batch_width, spec.n_reps) if est.vmapped else 1
+            if shard and est.vmapped and devices > 1:
+                from ate_replication_causalml_tpu.parallel.shardio import (
+                    pad_to_multiple,
+                )
+
+                width = pad_to_multiple(width, devices)
+            remaining = tuple(
+                r for r in range(spec.n_reps)
+                if not done(cell_row_id(dgp.name, est_name, r))
+            )
+            batches = tuple(
+                remaining[i:i + width]
+                for i in range(0, len(remaining), width)
+            )
+            plans.append(ColumnPlan(
+                name=col, dgp=dgp, estimator=est_name, width=width,
+                mode="vmapped" if est.vmapped else "sequential",
+                remaining=remaining, batches=batches,
+            ))
+    return plans, skipped
+
+
+# ── aggregates ────────────────────────────────────────────────────────
+
+
+def column_aggregates(rows: Iterable[dict], nominal: float = 0.95) -> dict:
+    """Per-column Monte-Carlo summaries from cell rows (pure, jax-free,
+    unit-tested): coverage of the per-replicate truth by the 95% CI,
+    bias / RMSE of the point estimate, power of the |ate|/se > z test
+    against τ=0, and the binomial MC standard errors the validator's
+    within-MC-error bands are built from. Failed cells count into
+    ``n_failed`` and nothing else; no-SE estimators (LASSO point rows)
+    report ``coverage=None``/``power=None``."""
+    rows = list(rows)
+    ok = [
+        r for r in rows
+        if r.get("status", "ok") == "ok"
+        and isinstance(r.get("ate"), (int, float))
+        and math.isfinite(r["ate"])
+    ]
+    with_se = [
+        r for r in ok
+        if isinstance(r.get("se"), (int, float)) and math.isfinite(r["se"])
+    ]
+    out: dict = {
+        "n_cells": len(rows),
+        "n_ok": len(ok),
+        "n_failed": len(rows) - len(ok),
+        "coverage": None,
+        "power": None,
+        "bias": None,
+        "rmse": None,
+        "coverage_mc_se": None,
+        "nominal": nominal,
+    }
+    if ok:
+        errs = [r["ate"] - r["tau_true"] for r in ok]
+        out["bias"] = sum(errs) / len(errs)
+        out["rmse"] = math.sqrt(sum(e * e for e in errs) / len(errs))
+        out["mean_tau_true"] = sum(r["tau_true"] for r in ok) / len(ok)
+    if with_se:
+        covered = sum(
+            1 for r in with_se
+            if r["lower_ci"] <= r["tau_true"] <= r["upper_ci"]
+        )
+        rejected = sum(
+            1 for r in with_se if abs(r["ate"]) > _Z95 * r["se"]
+        )
+        n = len(with_se)
+        cov = covered / n
+        out["coverage"] = cov
+        out["power"] = rejected / n
+        # Binomial MC standard error at the NOMINAL rate — the
+        # validator's band is nominal ± z·this (using the nominal p
+        # keeps the band honest when the observed rate is degenerate).
+        out["coverage_mc_se"] = math.sqrt(nominal * (1.0 - nominal) / n)
+    return out
+
+
+def compare_cells(cells_a: Iterable[dict], cells_b: Iterable[dict]) -> dict:
+    """Per-column batched-vs-scalar comparison (bench + tests): for each
+    column the max deviation of ate/se/tau_true in f32 ULPS at the
+    compared magnitude (NaN == NaN). Returns ``{"columns": {col:
+    max_ulp}, "max_ulp": float, "exact_columns": [cols at 0 ulp],
+    "missing": [cell ids present on one side only]}``."""
+    am = {r["method"]: r for r in cells_a}
+    bm = {r["method"]: r for r in cells_b}
+    missing = sorted(set(am) ^ set(bm))
+    per_col: dict[str, float] = {}
+    for cell in set(am) & set(bm):
+        ra, rb = am[cell], bm[cell]
+        worst = per_col.get(ra["column"], 0.0)
+        for field in ("ate", "se", "tau_true"):
+            a, b = ra.get(field), rb.get(field)
+            a_nan = not _finite(a)
+            b_nan = not _finite(b)
+            if a_nan and b_nan:
+                continue
+            if a_nan != b_nan:
+                worst = float("inf")
+                continue
+            if a == b:
+                continue
+            scale = float(np.spacing(np.float32(max(abs(a), abs(b)))))
+            worst = max(worst, abs(a - b) / scale)
+        per_col[ra["column"]] = worst
+    finite_ulps = [u for u in per_col.values() if math.isfinite(u)]
+    return {
+        "columns": per_col,
+        "max_ulp": (float("inf") if len(finite_ulps) < len(per_col)
+                    else max(finite_ulps, default=0.0)),
+        "exact_columns": sorted(c for c, u in per_col.items() if u == 0.0),
+        "missing": missing,
+    }
+
+
+# ── the runner ────────────────────────────────────────────────────────
+
+
+@dataclasses.dataclass
+class MatrixReport:
+    """Everything one matrix run produces: per-cell rows (notebook
+    order), per-column aggregates, and the perf evidence (wall seconds,
+    compile-event delta, executables compiled) the bench record and the
+    in-suite O(columns) assertion read."""
+
+    cells: list = dataclasses.field(default_factory=list)
+    columns: dict = dataclasses.field(default_factory=dict)
+    skipped_columns: list = dataclasses.field(default_factory=list)
+    n_resumed: int = 0
+    n_computed: int = 0
+    n_failed: int = 0
+    wall_s: float = 0.0
+    compile_events_delta: float = 0.0
+    n_columns: int = 0
+    n_batches: int = 0
+
+
+def _cells_counter():
+    return obs.counter(
+        "scenario_cells_total",
+        "scenario-matrix cells by column and computed/resumed/failed status",
+    )
+
+
+def _dispatch_counter():
+    return obs.counter(
+        "scenario_batch_dispatch_total",
+        "scenario-matrix batch dispatches by column and vmapped/sequential mode",
+    )
+
+
+def _finite(v) -> bool:
+    return isinstance(v, (int, float)) and math.isfinite(v)
+
+
+def _cell_record(
+    plan: ColumnPlan, rep: int, ate: float, se: float, tau_true: float,
+    seconds: float,
+) -> dict:
+    ate, se, tau_true = float(ate), float(se), float(tau_true)
+    status = "ok" if math.isfinite(ate) else "failed"
+    rec = {
+        "method": cell_row_id(plan.dgp.name, plan.estimator, rep),
+        "column": plan.name,
+        "dgp": plan.dgp.name,
+        "estimator": plan.estimator,
+        "rep": rep,
+        "ate": ate,
+        "se": se,
+        "lower_ci": ate - _Z95 * se if math.isfinite(se) else ate,
+        "upper_ci": ate + _Z95 * se if math.isfinite(se) else ate,
+        "tau_true": tau_true,
+        "status": status,
+        "seconds": round(seconds, 6),
+    }
+    if status == "failed":
+        rec["error"] = f"NonFiniteResult: ate={ate!r}"
+    return rec
+
+
+def _failed_record(plan: ColumnPlan, rep: int, error: str) -> dict:
+    nan = float("nan")
+    return {
+        "method": cell_row_id(plan.dgp.name, plan.estimator, rep),
+        "column": plan.name,
+        "dgp": plan.dgp.name,
+        "estimator": plan.estimator,
+        "rep": rep,
+        "ate": nan, "se": nan, "lower_ci": nan, "upper_ci": nan,
+        "tau_true": nan,
+        "status": "failed",
+        "error": error,
+        "seconds": 0.0,
+    }
+
+
+def run_matrix(
+    spec: MatrixSpec,
+    outdir: str | None = None,
+    workers: int | None = None,
+    scheduler: str | None = None,
+    prefetch: bool | None = None,
+    log: Callable[[str], None] = print,
+) -> MatrixReport:
+    """Run the matrix through the real SweepEngine. See module
+    docstring for the contracts; telemetry exports to ``outdir`` beside
+    ``cells.jsonl`` and ``matrix_report.json``."""
+    import jax
+
+    from ate_replication_causalml_tpu.pipeline import (
+        _Checkpoint,
+        _resolve_scheduler,
+        _row_resumable,
+    )
+    from ate_replication_causalml_tpu.scheduler import (
+        ArtifactSpec,
+        StageSpec,
+        SweepEngine,
+    )
+
+    obs.install_jax_monitoring()
+    n_workers = _resolve_scheduler(scheduler, workers, log)
+    t_start = time.monotonic()
+    compiles_before = obs.compile_event_count()
+    if outdir:
+        os.makedirs(outdir, exist_ok=True)
+    ckpt = _Checkpoint(
+        os.path.join(outdir, "cells.jsonl") if outdir else None,
+        spec.fingerprint(), log=log,
+    )
+
+    def resumable(cell: str) -> bool:
+        rec = ckpt.get(cell)
+        return rec is not None and _row_resumable(rec)[0]
+
+    shard = _env_shard() if spec.shard is None else spec.shard
+    devices = jax.device_count()
+    shard = bool(shard and devices > 1)
+    plans, skipped = plan_columns(spec, done=resumable,
+                                  devices=devices if shard else 1)
+
+    report = MatrixReport(skipped_columns=skipped, n_columns=len(plans))
+    cells_c, disp_c = _cells_counter(), _dispatch_counter()
+    root_key = jax.random.key(spec.seed)
+
+    mesh = None
+    ids_sharding = None
+    root_dispatch = root_key
+    if shard:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ate_replication_causalml_tpu.parallel.mesh import (
+            DATA_AXIS,
+            make_mesh,
+        )
+
+        mesh = make_mesh((DATA_AXIS,))
+        ids_sharding = NamedSharding(mesh, P(DATA_AXIS))
+        # The AOT executable's key operand is lowered replicated — bind
+        # the dispatch copy once, not per batch.
+        root_dispatch = jax.device_put(root_key, NamedSharding(mesh, P()))
+        log(f"scenario matrix: sharded dispatch over {devices} devices")
+
+    # Resumed cells never reach the engine: collect their rows now, in
+    # plan order, so the report carries the full grid either way.
+    for plan in plans:
+        remaining = set(plan.remaining)
+        for rep in range(spec.n_reps):
+            cell = cell_row_id(plan.dgp.name, plan.estimator, rep)
+            if rep in remaining:
+                continue
+            rec = ckpt.get(cell)
+            if rec is not None:
+                report.cells.append(rec)
+                report.n_resumed += 1
+                cells_c.inc(1, column=plan.name, status="resumed")
+
+    artifacts: list = []
+    stages: list = []
+    lane = "mesh" if shard else None
+
+    def make_exe_artifact(plan: ColumnPlan) -> str:
+        name = f"exe:{plan.name}"
+        # Fit and warm are the same compile-once call (the executable
+        # cache makes the second invocation a lookup) — bind it once.
+        fit = lambda c=None, p=plan: column_executable(
+            p.dgp, SCENARIO_ESTIMATORS[p.estimator], p.width,
+            column=p.name, ids_sharding=ids_sharding,
+        )
+        artifacts.append(ArtifactSpec(
+            name, fit=fit,
+            key=(spec.fingerprint(),
+                 column_cache_key(plan.dgp, plan.estimator, plan.width)),
+            warm=fit,
+            exclusive=lane,
+        ))
+        return name
+
+    def vmapped_stage(plan: ColumnPlan, bi: int, batch: tuple[int, ...],
+                      exe_name: str) -> StageSpec:
+        def run(cache, plan=plan, batch=batch, exe_name=exe_name):
+            t0 = time.perf_counter()
+            exe = cache.get(exe_name)
+            # Pad the final partial batch to the column's one executable
+            # width with duplicate ids; padded outputs are discarded
+            # host-side (never journaled).
+            ids = np.asarray(
+                [data_cell_id(plan.dgp.name, r) for r in batch]
+                + [data_cell_id(plan.dgp.name, batch[0])]
+                * (plan.width - len(batch)),
+                dtype=np.uint32,
+            )
+            if ids_sharding is not None:
+                from ate_replication_causalml_tpu.parallel import shardio
+
+                ids_dev = shardio.commit(ids, ids_sharding,
+                                         artifact=plan.name)
+                ate, se, tt = exe(root_dispatch, ids_dev)
+                ate, se, tt = shardio.gather_host(
+                    (ate, se, tt), artifact=plan.name
+                )
+            else:
+                ate, se, tt = exe(root_key, jax.numpy.asarray(ids))
+                ate, se, tt = (np.asarray(ate), np.asarray(se),
+                               np.asarray(tt))
+            dt = time.perf_counter() - t0
+            disp_c.inc(1, column=plan.name, mode="vmapped")
+            per_cell = dt / max(1, len(batch))
+            return [
+                _cell_record(plan, rep, ate[i], se[i], tt[i], per_cell)
+                for i, rep in enumerate(batch)
+            ]
+
+        return StageSpec(f"{plan.name}#b{bi}", run, needs=(exe_name,),
+                         exclusive=lane)
+
+    def sequential_stage(plan: ColumnPlan, bi: int,
+                         batch: tuple[int, ...]) -> StageSpec:
+        def run(cache, plan=plan, batch=batch):
+            import jax.numpy as jnp
+
+            est = SCENARIO_ESTIMATORS[plan.estimator]
+            gen = scalar_generate_executable(plan.dgp, column=plan.name)
+            salt = np.uint32(estimator_salt(est.name))
+            rows = []
+            for rep in batch:
+                t0 = time.perf_counter()
+                cid = jnp.asarray(data_cell_id(plan.dgp.name, rep),
+                                  jnp.uint32)
+                x, w, y, tau_true, est_key = gen(root_key, cid, salt)
+                ate, se = est.fn(plan.dgp, x, w, y, est_key)
+                disp_c.inc(1, column=plan.name, mode="sequential")
+                rows.append(_cell_record(
+                    plan, rep, float(ate), float(se), float(tau_true),
+                    time.perf_counter() - t0,
+                ))
+            return rows
+
+        return StageSpec(f"{plan.name}#b{bi}", run, needs=(),
+                         exclusive=lane)
+
+    def wrap_degrade(spec_stage: StageSpec, plan: ColumnPlan,
+                     batch: tuple[int, ...]) -> StageSpec:
+        inner = spec_stage.run
+
+        def run(cache):
+            try:
+                return inner(cache)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                if spec.fail_policy != "degrade":
+                    raise
+                err = f"{type(e).__name__}: {e}"
+                obs.emit("scenario_batch_failed", status="error",
+                         column=plan.name, batch=len(batch), error=err)
+                return [_failed_record(plan, rep, err) for rep in batch]
+
+        return dataclasses.replace(spec_stage, run=run)
+
+    for plan in plans:
+        if not plan.batches:
+            continue
+        exe_name = None
+        if plan.mode == "vmapped":
+            exe_name = make_exe_artifact(plan)
+        for bi, batch in enumerate(plan.batches):
+            st = (
+                vmapped_stage(plan, bi, batch, exe_name)
+                if plan.mode == "vmapped"
+                else sequential_stage(plan, bi, batch)
+            )
+            stages.append(wrap_degrade(st, plan, batch))
+            report.n_batches += 1
+
+    def commit(spec_stage: StageSpec, rows: list) -> None:
+        for rec in rows:
+            ckpt.put(rec)
+            report.cells.append(rec)
+            if rec.get("status", "ok") == "ok":
+                report.n_computed += 1
+                cells_c.inc(1, column=rec["column"], status="computed")
+            else:
+                report.n_failed += 1
+                cells_c.inc(1, column=rec["column"], status="failed")
+        ok = sum(1 for r in rows if r.get("status", "ok") == "ok")
+        log(f"  [{spec_stage.name}] {ok}/{len(rows)} cells ok")
+
+    try:
+        with obs.span("run_matrix", columns=len(plans),
+                      reps=spec.n_reps, out=outdir or "") as root_sp:
+            if stages:
+                engine = SweepEngine(
+                    artifacts, stages, commit=commit, workers=n_workers,
+                    prefetch=prefetch,
+                    span_parent=getattr(root_sp, "span_id", None),
+                )
+                engine.run()
+    finally:
+        report.wall_s = time.monotonic() - t_start
+        report.compile_events_delta = (
+            obs.compile_event_count() - compiles_before
+        )
+        # Per-column aggregates over whatever completed — a failed run's
+        # partial report is the one that matters for diagnosis.
+        by_col: dict[str, list] = {}
+        for rec in report.cells:
+            by_col.setdefault(rec["column"], []).append(rec)
+        report.columns = {
+            col: column_aggregates(rows) for col, rows in by_col.items()
+        }
+        if outdir:
+            try:
+                obs.atomic_write_json(
+                    os.path.join(outdir, "matrix_report.json"),
+                    _report_json(spec, report),
+                )
+                obs.write_run_artifacts(outdir)
+            except Exception as e:  # noqa: BLE001 — the export must not
+                # replace the run's real exception.
+                log(f"matrix export failed: {e!r}")
+    log(
+        f"scenario matrix: {report.n_computed} computed, "
+        f"{report.n_resumed} resumed, {report.n_failed} failed across "
+        f"{report.n_columns} columns in {report.wall_s:.1f}s "
+        f"(compile events +{report.compile_events_delta:.0f})"
+    )
+    return report
+
+
+def _report_json(spec: MatrixSpec, report: MatrixReport) -> dict:
+    def _san(v):
+        if isinstance(v, float) and not math.isfinite(v):
+            return None
+        if isinstance(v, dict):
+            return {k: _san(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return [_san(x) for x in v]
+        return v
+
+    return _san({
+        "fingerprint": spec.fingerprint(),
+        "n_reps": spec.n_reps,
+        "batch_width": spec.batch_width,
+        "columns": report.columns,
+        "skipped_columns": report.skipped_columns,
+        "n_computed": report.n_computed,
+        "n_resumed": report.n_resumed,
+        "n_failed": report.n_failed,
+        "wall_s": round(report.wall_s, 3),
+        "compile_events_delta": report.compile_events_delta,
+        "cells": report.cells,
+    })
+
+
+#: per-column compiled DGP-draw program for the sequential (forest)
+#: path — the data generation still compiles once per column even when
+#: the fit cannot ride a vmap axis.
+def scalar_generate_executable(dgp: DGPSpec, column: str = ""):
+    import jax
+    import jax.numpy as jnp
+
+    from ate_replication_causalml_tpu.scenarios.batched import cached_executable
+    from ate_replication_causalml_tpu.scenarios.dgp import generate
+
+    key = ("scenario-gen", dgp.fields())
+
+    def build():
+        def gen(root_key, cid, salt):
+            data_key = jax.random.fold_in(root_key, cid)
+            x, w, y, tau_true = generate(dgp, data_key)
+            return x, w, y, tau_true, jax.random.fold_in(data_key, salt)
+
+        return jax.jit(gen).lower(
+            jax.random.key(0), jnp.zeros((), jnp.uint32),
+            jnp.zeros((), jnp.uint32),
+        ).compile()
+
+    return cached_executable(key, build, column or dgp.name, "generate")
+
+
+def run_scalar_replay(
+    spec: MatrixSpec, log: Callable[[str], None] = print
+) -> MatrixReport:
+    """The sequential scalar baseline for the VMAPPED columns: every
+    cell through the per-column SCALAR executable (same cell function,
+    unvmapped) — one compile per column, one dispatch per CELL. This is
+    the leg the bench's batched-vs-sequential wall/compile comparison
+    and the bit-identity assertion run against. Non-vmapped (forest)
+    columns have no batched-vs-scalar distinction — their cells already
+    dispatch one at a time in ``run_matrix`` — so they are excluded
+    here and reported as ``skipped_columns``, keeping ``n_columns``
+    consistent with the cells this report actually carries."""
+    import jax
+    import jax.numpy as jnp
+
+    obs.install_jax_monitoring()
+    t0 = time.monotonic()
+    compiles_before = obs.compile_event_count()
+    plans, skipped = plan_columns(spec)
+    skipped = list(skipped) + [
+        f"{p.name}: non-vmapped — no scalar-replay leg"
+        for p in plans
+        if not SCENARIO_ESTIMATORS[p.estimator].vmapped
+    ]
+    plans = [p for p in plans if SCENARIO_ESTIMATORS[p.estimator].vmapped]
+    report = MatrixReport(skipped_columns=skipped, n_columns=len(plans))
+    root_key = jax.random.key(spec.seed)
+    for plan in plans:
+        est = SCENARIO_ESTIMATORS[plan.estimator]
+        exe = scalar_executable(plan.dgp, est, column=plan.name)
+        for rep in range(spec.n_reps):
+            tc = time.perf_counter()
+            cid = jnp.asarray(data_cell_id(plan.dgp.name, rep), jnp.uint32)
+            try:
+                ate, se, tt = exe(root_key, cid)
+                rec = _cell_record(
+                    plan, rep, float(ate), float(se), float(tt),
+                    time.perf_counter() - tc,
+                )
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                # Mirror the batched leg's degrade-don't-abort: the two
+                # legs of the bench comparison must account cells the
+                # same way or their ok/failed columns contradict.
+                if spec.fail_policy != "degrade":
+                    raise
+                rec = _failed_record(plan, rep, f"{type(e).__name__}: {e}")
+            report.cells.append(rec)
+            if rec["status"] == "ok":
+                report.n_computed += 1
+            else:
+                report.n_failed += 1
+    report.wall_s = time.monotonic() - t0
+    report.compile_events_delta = obs.compile_event_count() - compiles_before
+    by_col: dict[str, list] = {}
+    for rec in report.cells:
+        by_col.setdefault(rec["column"], []).append(rec)
+    report.columns = {c: column_aggregates(r) for c, r in by_col.items()}
+    log(f"scalar replay: {report.n_computed} cells "
+        f"({report.n_failed} failed) in {report.wall_s:.1f}s")
+    return report
+
+
+def main(argv: list[str] | None = None) -> MatrixReport:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Run a Monte-Carlo scenario matrix (ISSUE 13)")
+    ap.add_argument("--out", default=None, help="output directory "
+                    "(cells.jsonl + matrix_report.json + telemetry)")
+    ap.add_argument("--dgps", default="calibration,hetero_confounded",
+                    help=f"comma list from {sorted(STOCK_DGPS)}")
+    ap.add_argument("--estimators", default="naive,ipw_logit,aipw_logit")
+    ap.add_argument("--reps", type=int, default=None)
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--sequential", action="store_true")
+    ap.add_argument("--workers", type=int, default=None)
+    args = ap.parse_args(argv)
+    spec = MatrixSpec(
+        dgps=tuple(STOCK_DGPS[d] for d in args.dgps.split(",") if d),
+        estimators=tuple(e for e in args.estimators.split(",") if e),
+        n_reps=default_reps() if args.reps is None else args.reps,
+        batch_width=(default_batch_width() if args.batch is None
+                     else args.batch),
+        seed=args.seed,
+    )
+    return run_matrix(
+        spec, outdir=args.out,
+        scheduler="sequential" if args.sequential else None,
+        workers=args.workers,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -6,9 +6,10 @@ Usage::
     python scripts/check_metrics_schema.py results/
     python scripts/check_metrics_schema.py metrics.json events.jsonl \
         [--require-stages "naive,oracle,..."]
-    python scripts/check_metrics_schema.py MESH_SCALING.json   # ISSUE 8
-    python scripts/check_metrics_schema.py HIST_AB.json        # ISSUE 10
-    python scripts/check_metrics_schema.py PREDICT_AB.json     # ISSUE 12
+    python scripts/check_metrics_schema.py MESH_SCALING.json    # ISSUE 8
+    python scripts/check_metrics_schema.py HIST_AB.json         # ISSUE 10
+    python scripts/check_metrics_schema.py PREDICT_AB.json      # ISSUE 12
+    python scripts/check_metrics_schema.py SCENARIO_MATRIX.json # ISSUE 13
 
 Checks ``metrics.json`` (schema version, section shapes, the counter
 families every instrumented run must carry — shard retry, compile
@@ -114,6 +115,12 @@ REQUIRED_COUNTERS = (
     # under-fusion mis-report impossible.
     "serving_pad_rows_total",
     "serving_masked_rows_total",
+    # Scenario matrix (ISSUE 13): cell accounting, the vmapped-vs-
+    # sequential dispatch meter, and per-column executable compiles —
+    # "no matrix ever ran" is a recorded 0 on every instrumented run.
+    "scenario_cells_total",
+    "scenario_batch_dispatch_total",
+    "scenario_column_compile_total",
 )
 
 _EVENT_FIELDS = (
@@ -836,6 +843,175 @@ def validate_predict_ab_record(record: dict, tol: float = 1e-9) -> list[str]:
     return errors
 
 
+#: generous per-column jax_compiles_total allowance for a cold batched
+#: leg: one AOT lower+compile is 3 events, but nested jitted estimator
+#: cores each contribute trace events per column, plus fixed process
+#: overhead (key creation, journal plumbing). The bound's JOB is to
+#: fail when compiles grow with CELLS — at 32+ replicates per column a
+#: per-cell compile regression overshoots 60/column immediately.
+SCENARIO_COMPILES_PER_COLUMN = 60
+#: resume must schedule zero refits: a handful of eager-op events is
+#: tolerated, a recompiled column (>= ~35 events) is not.
+SCENARIO_RESUME_COMPILES_MAX = 20
+
+
+def validate_scenario_matrix_record(record: dict, tol: float = 1e-9) -> list[str]:
+    """Internal-consistency checks on the ``bench.py --scenario-matrix``
+    record (ISSUE 13) — the committed SCENARIO_MATRIX.json:
+
+    * cell accounting closes on both legs (columns × reps = cells =
+      ok + failed) and the resume leg resumed EVERY cell with ~zero
+      compile events and zero recomputes;
+    * executables grow with COLUMNS, never cells: per-leg executables
+      == columns and the batched compile-event delta stays within
+      ``SCENARIO_COMPILES_PER_COLUMN`` per column;
+    * batched == sequential bit identity: declared-exact columns at 0
+      ulp, everything else within the recorded ulp bound;
+    * calibration-DGP coverage sits within 3 binomial MC standard
+      errors of nominal 95% — the statistical validity gate.
+    """
+    errors: list[str] = []
+    for key in ("columns", "cells", "n_reps", "batch_width", "devices"):
+        if not _num(record.get(key)):
+            errors.append(f"scenario_matrix: {key} non-numeric")
+    if errors:
+        return errors
+    columns, cells, reps = record["columns"], record["cells"], record["n_reps"]
+    if cells != columns * reps:
+        errors.append(
+            f"scenario_matrix: cells {cells} != columns {columns} × reps "
+            f"{reps} — cell accounting does not close"
+        )
+    for leg in ("batched", "sequential"):
+        sec = record.get(leg)
+        if not isinstance(sec, dict):
+            errors.append(f"scenario_matrix: missing {leg} section")
+            continue
+        for key in ("wall_s", "wall_warm_s", "compile_events",
+                    "executables", "dispatches", "cells_ok",
+                    "cells_failed"):
+            if not _num(sec.get(key)):
+                errors.append(f"scenario_matrix: {leg}.{key} non-numeric")
+        if _num(sec.get("wall_warm_s")) and sec["wall_warm_s"] <= 0:
+            errors.append(f"scenario_matrix: {leg}.wall_warm_s not positive")
+        if not all(_num(sec.get(k)) for k in ("cells_ok", "cells_failed")):
+            continue
+        if sec["cells_ok"] + sec["cells_failed"] != cells:
+            errors.append(
+                f"scenario_matrix: {leg} ok+failed "
+                f"{sec['cells_ok']}+{sec['cells_failed']} != cells {cells}"
+            )
+        if sec.get("executables") != columns:
+            errors.append(
+                f"scenario_matrix: {leg}.executables {sec.get('executables')}"
+                f" != columns {columns} — one executable per column is the "
+                "contract"
+            )
+        if _num(sec.get("wall_s")) and sec["wall_s"] <= 0:
+            errors.append(f"scenario_matrix: {leg}.wall_s not positive")
+    bt = record.get("batched", {})
+    if _num(bt.get("compile_events")) and (
+        bt["compile_events"] > columns * SCENARIO_COMPILES_PER_COLUMN
+    ):
+        errors.append(
+            f"scenario_matrix: batched compile events "
+            f"{bt['compile_events']} exceed {SCENARIO_COMPILES_PER_COLUMN}"
+            f"/column × {columns} columns — executables are growing with "
+            "cells, not columns"
+        )
+    if _num(bt.get("dispatches")) and _num(record.get("batch_width")):
+        want = columns * -(-reps // record["batch_width"])
+        if bt["dispatches"] != want:
+            errors.append(
+                f"scenario_matrix: batched dispatches {bt['dispatches']} "
+                f"!= ceil(reps/width)×columns = {want}"
+            )
+    sq = record.get("sequential", {})
+    if _num(sq.get("dispatches")) and sq["dispatches"] != cells:
+        errors.append(
+            f"scenario_matrix: sequential dispatches {sq['dispatches']} "
+            f"!= cells {cells} — the scalar replay pays one per cell"
+        )
+    if all(_num(x.get("wall_warm_s")) for x in (bt, sq)) and _num(
+        record.get("vs_baseline")
+    ):
+        ratio = sq["wall_warm_s"] / bt["wall_warm_s"]
+        if abs(record["vs_baseline"] - ratio) > 0.05 * max(ratio, 1.0):
+            errors.append(
+                f"scenario_matrix: recorded vs_baseline "
+                f"{record['vs_baseline']} != warm-wall ratio {ratio:.3f}"
+            )
+    rs = record.get("resume")
+    if not isinstance(rs, dict):
+        errors.append("scenario_matrix: missing resume section")
+    else:
+        if rs.get("recomputed_cells") != 0:
+            errors.append(
+                f"scenario_matrix: resume recomputed "
+                f"{rs.get('recomputed_cells')} cells — completed columns "
+                "must schedule zero refits"
+            )
+        if rs.get("resumed_cells") != cells:
+            errors.append(
+                f"scenario_matrix: resume leg resumed "
+                f"{rs.get('resumed_cells')} of {cells} cells"
+            )
+        if not _num(rs.get("compile_events")) or (
+            rs["compile_events"] > SCENARIO_RESUME_COMPILES_MAX
+        ):
+            errors.append(
+                f"scenario_matrix: resume compile events "
+                f"{rs.get('compile_events')!r} exceed "
+                f"{SCENARIO_RESUME_COMPILES_MAX} — a resumed matrix must "
+                "not rebuild executables"
+            )
+    bi = record.get("bit_identity")
+    if not isinstance(bi, dict):
+        errors.append("scenario_matrix: missing bit_identity section")
+    else:
+        bound = bi.get("bound_ulp")
+        cols = bi.get("columns")
+        if not (_num(bound) and isinstance(cols, dict) and cols):
+            errors.append("scenario_matrix: bit_identity malformed")
+        else:
+            exact = set(bi.get("exact_columns") or ())
+            for col, ulp in cols.items():
+                if not _num(ulp):
+                    errors.append(
+                        f"scenario_matrix: bit_identity[{col!r}] non-numeric"
+                    )
+                elif col in exact and ulp != 0:
+                    errors.append(
+                        f"scenario_matrix: column {col!r} listed exact but "
+                        f"recorded {ulp} ulp"
+                    )
+                elif ulp > bound:
+                    errors.append(
+                        f"scenario_matrix: column {col!r} at {ulp} ulp "
+                        f"exceeds the recorded bound {bound}"
+                    )
+    cov = record.get("coverage")
+    mc_se = record.get("coverage_mc_se")
+    nominal = record.get("coverage_nominal")
+    if not (isinstance(cov, dict) and cov and isinstance(mc_se, dict)
+            and _num(nominal)):
+        errors.append("scenario_matrix: coverage section malformed or empty")
+    else:
+        for col, c in cov.items():
+            se = mc_se.get(col)
+            if not _num(c) or not _num(se) or se <= 0:
+                errors.append(
+                    f"scenario_matrix: coverage[{col!r}] or its MC SE "
+                    "non-numeric"
+                )
+            elif abs(c - nominal) > 3.0 * se + tol:
+                errors.append(
+                    f"scenario_matrix: coverage[{col!r}] = {c} outside "
+                    f"nominal {nominal} ± 3×{se} Monte-Carlo error"
+                )
+    return errors
+
+
 def validate_trace_files(outdir: str) -> list[str]:
     """Validate trace.json / overlap_report.json / serving_report.json
     / slo_report.json in ``outdir`` when present (tracing and serving
@@ -952,6 +1128,8 @@ def main(argv: list[str] | None = None) -> int:
         ("MESH_SCALING", "mesh_scaling", validate_mesh_scaling),
         ("HIST_AB", "hist_ab", validate_hist_ab_record),
         ("PREDICT_AB", "predict_ab", validate_predict_ab_record),
+        ("SCENARIO_MATRIX", "scenario_matrix",
+         validate_scenario_matrix_record),
     )
     if len(args.paths) == 1:
         base = os.path.basename(args.paths[0])

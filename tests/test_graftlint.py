@@ -278,6 +278,150 @@ def test_jgl002_slice_discard_outside_assignment():
     assert _lines(src, "JGL002") == [3]
 
 
+# JGL002 scenarios/ extension (ISSUE 13): duplicate fold_in operands
+# and replicate-axis key-array reuse, scoped to scenarios/ modules.
+
+JGL002_BAD_FOLD_DUP = """\
+import jax
+
+def cell(root_key, cid):
+    a = jax.random.fold_in(root_key, cid)
+    b = jax.random.fold_in(root_key, cid)   # line 5: same (key, data)
+    return a, b
+"""
+
+JGL002_GOOD_FOLD = """\
+import jax
+
+def cell(root_key, cid, salt):
+    data_key = jax.random.fold_in(root_key, cid)
+    est_key = jax.random.fold_in(data_key, salt)   # distinct operands
+    return data_key, est_key
+
+def per_cell(root_key, cids):
+    return [jax.random.fold_in(root_key, c) for c in cids]  # one site
+"""
+
+JGL002_BAD_KEYS_ARRAY = """\
+import jax
+
+def draw(keys):
+    a = jax.random.normal(keys, (3,))
+    b = jax.random.uniform(keys, (3,))     # line 5: axis replayed
+    return a + b
+"""
+
+
+def test_jgl002_scenarios_duplicate_fold_in():
+    assert _lines(JGL002_BAD_FOLD_DUP, "JGL002",
+                  relpath="pkg/scenarios/dgp.py") == [5]
+    msgs = _messages(JGL002_BAD_FOLD_DUP, "JGL002",
+                     relpath="pkg/scenarios/dgp.py")
+    assert "line 4" in msgs[0] and "fold constant" in msgs[0]
+    # Out of scope the derivation idiom stays sanctioned — the general
+    # rule deliberately never counts fold_in as a spend.
+    assert _lines(JGL002_BAD_FOLD_DUP, "JGL002", relpath="pkg/mod.py") == []
+
+
+def test_jgl002_scenarios_fold_in_distinct_operands_quiet():
+    assert _lines(JGL002_GOOD_FOLD, "JGL002",
+                  relpath="pkg/scenarios/batched.py") == []
+
+
+def test_jgl002_scenarios_key_array_reuse():
+    assert _lines(JGL002_BAD_KEYS_ARRAY, "JGL002",
+                  relpath="pkg/scenarios/batched.py") == [5]
+    # plural-array params are only tracked inside scenarios/ — the
+    # general scope keeps its narrower param shape.
+    assert _lines(JGL002_BAD_KEYS_ARRAY, "JGL002", relpath="pkg/mod.py") == []
+
+
+def test_jgl002_scenarios_suppression_form():
+    suppressed = JGL002_BAD_FOLD_DUP.replace(
+        "# line 5: same (key, data)", "# graftlint: disable=JGL002"
+    )
+    assert _lines(suppressed, "JGL002",
+                  relpath="pkg/scenarios/dgp.py") == []
+
+
+JGL002_GOOD_FOLD_RETHREAD = """\
+import jax
+
+def f(key):
+    key = jax.random.fold_in(key, 7)
+    key = jax.random.fold_in(key, 7)   # rebinding: a DIFFERENT key
+    return key
+"""
+
+JGL002_GOOD_FOLD_BRANCHES = """\
+import jax
+
+def f(root_key, cid, flag):
+    if flag:
+        k = jax.random.fold_in(root_key, cid)
+    else:
+        k = jax.random.fold_in(root_key, cid)   # exclusive arm
+    return k
+"""
+
+JGL002_BAD_FOLD_SAME_ARM = """\
+import jax
+
+def f(root_key, cid, flag):
+    if flag:
+        a = jax.random.fold_in(root_key, cid)
+        b = jax.random.fold_in(root_key, cid)   # line 6: co-executes
+        return a, b
+    return None
+"""
+
+JGL002_BAD_FOLD_DERIVED = """\
+import jax
+
+def f(root_key, cid, salt):
+    data_key = jax.random.fold_in(root_key, cid)
+    x = jax.random.fold_in(data_key, salt)
+    y = jax.random.fold_in(data_key, salt)   # line 6: single-assignment
+    return x, y
+"""
+
+
+def test_jgl002_scenarios_fold_in_rethreading_quiet():
+    """`key = fold_in(key, c)` twice rebinds between the sites — the
+    textually identical operands name DIFFERENT key values (the rule's
+    own recommended rethreading), so the duplicate check stays quiet."""
+    assert _lines(JGL002_GOOD_FOLD_RETHREAD, "JGL002",
+                  relpath="pkg/scenarios/dgp.py") == []
+    # A parameter is a binding site too: one rebind then one bare use.
+    param_rethread = (
+        "import jax\n\n"
+        "def f(key):\n"
+        "    key = jax.random.fold_in(key, 7)\n"
+        "    return jax.random.fold_in(key, 7)\n"
+    )
+    assert _lines(param_rethread, "JGL002",
+                  relpath="pkg/scenarios/dgp.py") == []
+
+
+def test_jgl002_scenarios_fold_in_exclusive_branches_quiet():
+    """Identical fold_in sites in mutually exclusive If arms never
+    co-execute — only one mints the key."""
+    assert _lines(JGL002_GOOD_FOLD_BRANCHES, "JGL002",
+                  relpath="pkg/scenarios/dgp.py") == []
+
+
+def test_jgl002_scenarios_fold_in_same_arm_still_flagged():
+    assert _lines(JGL002_BAD_FOLD_SAME_ARM, "JGL002",
+                  relpath="pkg/scenarios/dgp.py") == [6]
+
+
+def test_jgl002_scenarios_fold_in_derived_key_still_flagged():
+    """A key assigned ONCE is a stable value — duplicating a fold off a
+    derived key is the correlated-streams bug and must still flag."""
+    assert _lines(JGL002_BAD_FOLD_DERIVED, "JGL002",
+                  relpath="pkg/scenarios/dgp.py") == [6]
+
+
 # --------------------------------------------------------------- JGL003
 
 

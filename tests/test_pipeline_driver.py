@@ -6,6 +6,8 @@ import dataclasses
 import json
 import os
 
+import pytest
+
 from ate_replication_causalml_tpu.data.pipeline import PrepConfig
 from ate_replication_causalml_tpu.pipeline import SweepConfig, run_sweep
 
@@ -163,10 +165,17 @@ def test_changed_config_invalidates_checkpoint(tmp_path):
     assert new_header["fingerprint"] == header["fingerprint"] + "|changed"
 
 
+@pytest.mark.slow
 def test_sweep_no_outdir_runs_in_memory():
-    # The sequential escape hatch carries tier-1 coverage here (the
-    # full sweep test above exercises the concurrent default); compiles
-    # are already in this process's jit caches from the MICRO run.
+    # @slow since ISSUE 13 (the documented tier-1 budget swap): the
+    # scenario-matrix acceptance module (tests/test_scenarios.py,
+    # ~35 s) displaced this ~40 s run. What this test added over the
+    # rest of tier-1 was thin by then — the sequential escape hatch is
+    # exercised by test_changed_config_invalidates_checkpoint's MICRO
+    # sweep (which also pays these shapes' compiles) and by the traced
+    # sequential micro sweep in tests/test_trace.py; only the
+    # outdir=None plumbing branch (checkpoint + exports disabled) is
+    # unique here, and it keeps end-to-end coverage in this tier.
     report = run_sweep(MICRO, outdir=None, plots=False, log=lambda s: None,
                        scheduler="sequential")
     assert len(report.results) == len(EXPECTED_METHODS)

@@ -1,0 +1,623 @@
+"""Scenario-matrix acceptance + units (ISSUE 13).
+
+The acceptance micro matrix (2 DGPs × 3 estimators × 32 vmapped
+replicate seeds through the REAL SweepEngine) runs ONCE in a
+module-scoped fixture; every integration assertion — the O(columns)
+``jax_compiles_total`` contract, batched == scalar bit-identity /
+documented-ulp, calibration coverage within binomial MC error of 95%,
+cell-granular resume with zero refits, counter metering, exported
+telemetry validating — reads that one run.
+
+TIER-1 BUDGET (ISSUE 13 satellite): this module costs ~35 s, paid for
+by moving ``tests/test_pipeline_driver.py::
+test_sweep_no_outdir_runs_in_memory`` (~40 s) to @slow — its
+sequential-scheduler coverage was already carried by
+``test_changed_config_invalidates_checkpoint``'s sequential MICRO
+sweep and the traced sequential micro sweep in ``tests/test_trace.py``;
+only the thin outdir=None plumbing branch rode it, now covered @slow.
+"""
+
+import dataclasses
+import json
+import math
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ate_replication_causalml_tpu import observability as obs
+from ate_replication_causalml_tpu import scenarios as sc
+from ate_replication_causalml_tpu.scenarios.batched import ScenarioEstimator
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# ── DGP units ─────────────────────────────────────────────────────────
+
+
+def test_generate_is_pure_and_seeded():
+    import jax
+
+    spec = sc.STOCK_DGPS["calibration"]
+    key = jax.random.key(7)
+    x1, w1, y1, t1 = sc.generate(spec, key)
+    x2, w2, y2, t2 = sc.generate(spec, key)
+    assert np.array_equal(np.asarray(x1), np.asarray(x2))
+    assert np.array_equal(np.asarray(w1), np.asarray(w2))
+    assert np.array_equal(np.asarray(y1), np.asarray(y2))
+    assert float(t1) == float(t2)
+    x3, _, _, _ = sc.generate(spec, jax.random.key(8))
+    assert not np.array_equal(np.asarray(x1), np.asarray(x3))
+    assert x1.shape == (spec.n, spec.p)
+    assert str(x1.dtype) == spec.dtype
+
+
+def test_propensity_knobs():
+    import jax
+
+    x, _, _, _ = sc.generate(sc.STOCK_DGPS["calibration"], jax.random.key(0))
+    from ate_replication_causalml_tpu.scenarios.dgp import propensity
+
+    # Randomized design: confounding 0 ⇒ e ≡ 1/2 exactly.
+    e = np.asarray(propensity(sc.STOCK_DGPS["calibration"], x))
+    assert np.all(e == 0.5)
+    # Overlap-violation knob: e bounded by [η, 1-η], and a strong
+    # confounder actually pushes toward the bounds.
+    viol = sc.STOCK_DGPS["overlap_violation"]
+    ev = np.asarray(propensity(viol, x))
+    assert ev.min() >= viol.overlap - 1e-6
+    assert ev.max() <= 1.0 - viol.overlap + 1e-6
+    assert ev.min() < 0.1 and ev.max() > 0.9
+
+
+def test_dgp_spec_validation():
+    with pytest.raises(ValueError, match="tau"):
+        sc.DGPSpec(name="x", tau="wiggly")
+    with pytest.raises(ValueError, match="overlap"):
+        sc.DGPSpec(name="x", overlap=0.0)
+    with pytest.raises(ValueError, match="sparsity"):
+        sc.DGPSpec(name="x", p=4, sparsity=9)
+
+
+def test_sparse_design_uses_decaying_support():
+    from ate_replication_causalml_tpu.scenarios.dgp import _beta
+
+    spec = sc.STOCK_DGPS["sparse_highdim"]
+    beta = np.asarray(_beta(spec, np.float32))
+    assert beta.shape == (spec.p,)
+    assert np.count_nonzero(beta) == spec.sparsity
+    assert spec.p > spec.n  # the p≫n regime is real
+
+
+def test_cell_ids_and_salts_are_stable_and_distinct():
+    a = sc.data_cell_id("calibration", 0)
+    assert a == sc.data_cell_id("calibration", 0)
+    assert a != sc.data_cell_id("calibration", 1)
+    assert a != sc.data_cell_id("hetero_confounded", 0)
+    assert sc.estimator_salt("naive") != sc.estimator_salt("ipw_logit")
+
+
+# ── cache key + planner units (satellite: per-column cache keying) ────
+
+
+def test_column_cache_key_sensitivity():
+    base = sc.STOCK_DGPS["calibration"]
+    k0 = sc.column_cache_key(base, "naive", 32)
+    assert k0 == sc.column_cache_key(base, "naive", 32)
+    seen = {k0}
+    for variant in (
+        dataclasses.replace(base, n=base.n + 1),
+        dataclasses.replace(base, p=base.p + 1),
+        dataclasses.replace(base, tau="hetero"),
+        dataclasses.replace(base, tau_scale=base.tau_scale + 0.1),
+        dataclasses.replace(base, confounding=1.5),
+        dataclasses.replace(base, overlap=0.25),
+        dataclasses.replace(base, sparsity=2),
+        dataclasses.replace(base, name="other"),
+    ):
+        k = sc.column_cache_key(variant, "naive", 32)
+        assert k not in seen, variant
+        seen.add(k)
+    assert sc.column_cache_key(base, "ipw_logit", 32) not in seen
+    assert sc.column_cache_key(base, "naive", 16) not in seen
+    assert sc.column_cache_key(base, "naive", None) not in seen  # scalar
+
+
+def test_plan_columns_packing_and_applicability():
+    spec = sc.MatrixSpec(
+        dgps=(sc.STOCK_DGPS["calibration"], sc.STOCK_DGPS["sparse_highdim"]),
+        estimators=("naive", "ols", "lasso", "aipw_rf"),
+        n_reps=10, batch_width=4,
+    )
+    plans, skipped = sc.plan_columns(spec)
+    by_name = {p.name: p for p in plans}
+    # OLS is refused on the p≫n design, available on the tall one.
+    assert "sparse_highdim:ols" in skipped
+    assert "calibration:ols" in by_name
+    cal_naive = by_name["calibration:naive"]
+    assert cal_naive.width == 4 and cal_naive.mode == "vmapped"
+    assert cal_naive.batches == ((0, 1, 2, 3), (4, 5, 6, 7), (8, 9))
+    # Forest-class engines pack at width 1 through the sequential path.
+    rf = by_name["calibration:aipw_rf"]
+    assert rf.width == 1 and rf.mode == "sequential"
+    assert len(rf.batches) == 10
+    # A done-filter removes exactly the completed cells.
+    done = {sc.cell_row_id("calibration", "naive", r) for r in (0, 1, 5)}
+    plans2, _ = sc.plan_columns(spec, done=lambda c: c in done)
+    cal2 = {p.name: p for p in plans2}["calibration:naive"]
+    assert cal2.remaining == (2, 3, 4, 6, 7, 8, 9)
+    # Sharded runs pad the width to the device count.
+    spec_sh = dataclasses.replace(spec, shard=True)
+    plans3, _ = sc.plan_columns(spec_sh, devices=8)
+    assert {p.name: p for p in plans3}["calibration:naive"].width == 8
+
+
+def test_matrix_spec_validation_and_fingerprint():
+    with pytest.raises(ValueError, match="unknown scenario estimator"):
+        sc.MatrixSpec(dgps=(sc.STOCK_DGPS["calibration"],),
+                      estimators=("nope",))
+    with pytest.raises(ValueError, match="fail_policy"):
+        sc.MatrixSpec(dgps=(sc.STOCK_DGPS["calibration"],),
+                      estimators=("naive",), fail_policy="explode")
+    # Names are the column/journal namespace: duplicates would collide
+    # on journal keys and merge aggregates across distinct designs.
+    with pytest.raises(ValueError, match="duplicate DGP"):
+        sc.MatrixSpec(
+            dgps=(sc.STOCK_DGPS["calibration"],
+                  dataclasses.replace(sc.STOCK_DGPS["calibration"], n=128)),
+            estimators=("naive",))
+    with pytest.raises(ValueError, match="duplicate estimator"):
+        sc.MatrixSpec(dgps=(sc.STOCK_DGPS["calibration"],),
+                      estimators=("naive", "naive"))
+    a = sc.micro_matrix_spec(n_reps=8, batch_width=8)
+    b = sc.micro_matrix_spec(n_reps=32, batch_width=4)
+    # reps/width are journal-compatible — deliberately absent.
+    assert a.fingerprint() == b.fingerprint()
+    c = dataclasses.replace(a, seed=1)
+    assert c.fingerprint() != a.fingerprint()
+    d = dataclasses.replace(a, estimators=("naive",))
+    assert d.fingerprint() != a.fingerprint()
+
+
+# ── aggregate + comparison units ──────────────────────────────────────
+
+
+def _row(ate, se, tau, status="ok"):
+    return {
+        "ate": ate, "se": se, "tau_true": tau,
+        "lower_ci": ate - 1.96 * se if math.isfinite(se) else ate,
+        "upper_ci": ate + 1.96 * se if math.isfinite(se) else ate,
+        "status": status,
+    }
+
+
+def test_column_aggregates_known_answers():
+    rows = [
+        _row(0.5, 0.1, 0.5),      # covered, rejects H0
+        _row(0.5, 0.1, 0.8),      # NOT covered, rejects
+        _row(0.05, 0.1, 0.1),     # covered, fails to reject
+        _row(float("nan"), float("nan"), 0.5, status="failed"),
+    ]
+    agg = sc.column_aggregates(rows)
+    assert agg["n_cells"] == 4 and agg["n_ok"] == 3 and agg["n_failed"] == 1
+    assert agg["coverage"] == pytest.approx(2 / 3)
+    assert agg["power"] == pytest.approx(2 / 3)
+    assert agg["bias"] == pytest.approx((0.0 - 0.3 - 0.05) / 3)
+    assert agg["rmse"] == pytest.approx(
+        math.sqrt((0.0 + 0.09 + 0.0025) / 3))
+    assert agg["coverage_mc_se"] == pytest.approx(
+        math.sqrt(0.95 * 0.05 / 3))
+    # No-SE rows: bias/rmse still defined, coverage/power not.
+    point_only = [_row(0.4, float("nan"), 0.5)]
+    agg2 = sc.column_aggregates(point_only)
+    assert agg2["coverage"] is None and agg2["power"] is None
+    assert agg2["bias"] == pytest.approx(-0.1)
+    assert sc.column_aggregates([])["n_cells"] == 0
+
+
+def test_compare_cells_ulp_and_missing():
+    a = [dict(_row(0.5, 0.1, 0.5), method="c:e:0", column="c:e"),
+         dict(_row(float("nan"), float("nan"), 0.5, status="failed"),
+              method="c:e:1", column="c:e")]
+    assert sc.compare_cells(a, a)["max_ulp"] == 0.0
+    b = [dict(r) for r in a]
+    b[0] = dict(b[0], ate=float(np.nextafter(np.float32(0.5),
+                                             np.float32(1.0))))
+    cmp = sc.compare_cells(a, b)
+    assert cmp["columns"]["c:e"] == pytest.approx(1.0)
+    assert cmp["exact_columns"] == []
+    # NaN == NaN (both failed) — no divergence from the failed row.
+    cmp2 = sc.compare_cells(a[1:], b[1:])
+    assert cmp2["max_ulp"] == 0.0
+    # one-sided cells are reported, never silently dropped
+    assert sc.compare_cells(a, a[:1])["missing"] == ["c:e:1"]
+
+
+# ── the acceptance run (ISSUE 13 acceptance criteria) ─────────────────
+
+REPS = 32
+
+
+@pytest.fixture(scope="module")
+def micro_run(tmp_path_factory):
+    """One micro matrix (2 DGPs × 3 estimators × 32 vmapped seeds)
+    through the real engine, plus the three companion legs every
+    integration test below reads: a full-journal resume, an
+    extended-reps resume (16 new cells per column, ZERO new
+    executables), and the sequential scalar replay."""
+    import jax  # noqa: F401 — backend must exist before compile counting
+
+    outdir = str(tmp_path_factory.mktemp("scenario") / "matrix")
+    obs.install_jax_monitoring()
+    sc.clear_executables()
+    spec = sc.micro_matrix_spec(n_reps=REPS, batch_width=REPS)
+
+    c0 = obs.compile_event_count()
+    rep = sc.run_matrix(spec, outdir=outdir, log=lambda s: None)
+    d_batched = obs.compile_event_count() - c0
+
+    c0 = obs.compile_event_count()
+    rep_resumed = sc.run_matrix(spec, outdir=outdir, log=lambda s: None)
+    d_resume = obs.compile_event_count() - c0
+
+    spec_ext = dataclasses.replace(spec, n_reps=REPS + 16)
+    c0 = obs.compile_event_count()
+    rep_ext = sc.run_matrix(spec_ext, outdir=outdir, log=lambda s: None)
+    d_ext = obs.compile_event_count() - c0
+
+    rep_scalar = sc.run_scalar_replay(spec, log=lambda s: None)
+    return dict(
+        spec=spec, outdir=outdir, rep=rep, rep_resumed=rep_resumed,
+        rep_ext=rep_ext, rep_scalar=rep_scalar, d_batched=d_batched,
+        d_resume=d_resume, d_ext=d_ext,
+    )
+
+
+def test_micro_matrix_completes_through_engine(micro_run):
+    rep = micro_run["rep"]
+    assert rep.n_columns == 6 and not rep.skipped_columns
+    assert rep.n_computed == 6 * REPS and rep.n_failed == 0
+    assert rep.n_batches == 6  # one packed batch per column
+    assert os.path.exists(os.path.join(micro_run["outdir"], "cells.jsonl"))
+    # matrix_report.json reflects the LAST run on the outdir — the
+    # extended-reps resume leg: 96 computed on top of 192 resumed.
+    mr = json.load(open(os.path.join(micro_run["outdir"],
+                                     "matrix_report.json")))
+    assert mr["n_computed"] + mr["n_resumed"] == 6 * (REPS + 16)
+    assert set(mr["columns"]) == {r["column"] for r in rep.cells}
+
+
+def test_compiles_grow_with_columns_not_cells(micro_run):
+    """THE perf contract: the batched run's jax_compiles_total delta is
+    bounded per COLUMN, a resumed matrix compiles ~nothing, and adding
+    16 replicates per column (96 new cells) re-uses every executable —
+    the compile delta stays near zero while the cell count grows."""
+    assert micro_run["d_batched"] <= 6 * 60, micro_run["d_batched"]
+    assert micro_run["d_resume"] <= 10, micro_run["d_resume"]
+    assert micro_run["rep_resumed"].n_computed == 0
+    assert micro_run["rep_resumed"].n_resumed == 6 * REPS
+    # 96 new cells, zero new executables (same width ⇒ same program).
+    assert micro_run["rep_ext"].n_computed == 6 * 16
+    assert micro_run["rep_ext"].n_resumed == 6 * REPS
+    assert micro_run["d_ext"] <= 10, micro_run["d_ext"]
+
+
+def test_batched_bit_identical_or_documented_ulp(micro_run):
+    """Batched == sequential scalar replay: array-equal where the
+    estimator declares vmap-collapse-exact (pure row reductions),
+    bounded ulp drift with the gemv-vs-panel-folded-gemm rationale for
+    the GLM columns (scenarios/batched.py MAX_VMAP_COLLAPSE_ULP)."""
+    cmp = sc.compare_cells(micro_run["rep"].cells,
+                           micro_run["rep_scalar"].cells)
+    assert not cmp["missing"]
+    for col, ulp in cmp["columns"].items():
+        est = sc.SCENARIO_ESTIMATORS[col.split(":", 2)[1]]
+        if est.vmap_collapse_exact:
+            assert ulp == 0.0, (col, ulp)
+        else:
+            assert ulp <= sc.MAX_VMAP_COLLAPSE_ULP, (col, ulp)
+    assert {"calibration:naive", "hetero_confounded:naive"} <= set(
+        cmp["exact_columns"]
+    )
+
+
+def test_calibration_coverage_within_mc_error(micro_run):
+    """Statistical acceptance: on the randomized correctly-specified
+    calibration DGP every SE-carrying estimator's 95% CI covers the
+    exact per-replicate truth within 3 binomial MC standard errors of
+    nominal."""
+    cols = micro_run["rep"].columns
+    checked = 0
+    for col, agg in cols.items():
+        if not col.startswith("calibration:") or agg["coverage"] is None:
+            continue
+        band = 3.0 * agg["coverage_mc_se"]
+        assert abs(agg["coverage"] - 0.95) <= band, (col, agg["coverage"])
+        checked += 1
+    assert checked == 3
+
+
+def test_resume_rows_bit_identical(micro_run):
+    first = {r["method"]: r for r in micro_run["rep"].cells}
+    resumed = {r["method"]: r for r in micro_run["rep_resumed"].cells}
+    assert set(first) == set(resumed)
+    for cell, rec in first.items():
+        got = resumed[cell]
+        for f in ("ate", "se", "lower_ci", "upper_ci", "tau_true"):
+            assert got[f] == rec[f] or (
+                got[f] != got[f] and rec[f] != rec[f]
+            ), (cell, f)
+
+
+def test_counters_and_exported_telemetry(micro_run):
+    snap = obs.REGISTRY.snapshot()
+    cells = snap["counters"]["scenario_cells_total"]
+    disp = snap["counters"]["scenario_batch_dispatch_total"]
+    assert cells.get("column=calibration:naive,status=computed", 0) >= REPS
+    assert cells.get("column=calibration:naive,status=resumed", 0) >= REPS
+    assert disp.get("column=calibration:naive,mode=vmapped", 0) >= 1
+    # the exported artifact pair validates under the repo schema gate
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from check_metrics_schema import validate_pair
+
+    errors = validate_pair(
+        os.path.join(micro_run["outdir"], "metrics.json"),
+        os.path.join(micro_run["outdir"], "events.jsonl"),
+    )
+    assert errors == [], errors
+
+
+# ── degrade-don't-abort per cell ──────────────────────────────────────
+
+
+def test_degrade_per_cell_and_failed_rows_retry(tmp_path, monkeypatch):
+    calls = {"n": 0}
+
+    def boom(spec, x, w, y, key):
+        calls["n"] += 1
+        raise ValueError("synthetic estimator failure")
+
+    def nanest(spec, x, w, y, key):
+        import jax.numpy as jnp
+
+        return jnp.full((), jnp.nan, x.dtype), jnp.full((), jnp.nan, x.dtype)
+
+    monkeypatch.setitem(
+        sc.SCENARIO_ESTIMATORS, "boom",
+        ScenarioEstimator("boom", boom, vmapped=False, needs_tall=False))
+    monkeypatch.setitem(
+        sc.SCENARIO_ESTIMATORS, "nanest",
+        ScenarioEstimator("nanest", nanest, needs_tall=False))
+    spec = sc.MatrixSpec(
+        dgps=(dataclasses.replace(sc.STOCK_DGPS["calibration"], n=384),),
+        estimators=("naive", "boom", "nanest"),
+        n_reps=4, batch_width=REPS,
+    )
+    out = str(tmp_path / "degrade")
+    rep = sc.run_matrix(spec, outdir=out, scheduler="sequential",
+                        log=lambda s: None)
+    by_col: dict = {}
+    for r in rep.cells:
+        by_col.setdefault(r["column"], []).append(r)
+    # the healthy column is untouched by its neighbors' failures
+    assert all(r["status"] == "ok" for r in by_col["calibration:naive"])
+    # eager estimator exception → failed rows carrying the error
+    assert all(r["status"] == "failed" for r in by_col["calibration:boom"])
+    assert "synthetic estimator failure" in by_col["calibration:boom"][0]["error"]
+    # non-finite vmapped estimates degrade PER CELL (finite-value guard)
+    assert all(r["status"] == "failed" and "NonFinite" in r["error"]
+               for r in by_col["calibration:nanest"])
+    assert rep.n_failed == 8 and rep.n_computed == 4
+
+    # failed rows are not resumable: the rerun retries exactly them
+    rep2 = sc.run_matrix(spec, outdir=out, scheduler="sequential",
+                         log=lambda s: None)
+    assert rep2.n_resumed == 4          # the healthy naive rows
+    assert rep2.n_failed == 8           # retried, failed again
+    assert calls["n"] == 8              # 4 cells × 2 runs reached boom
+
+    # fail_policy="raise" aborts instead of degrading
+    spec_raise = dataclasses.replace(spec, fail_policy="raise",
+                                     estimators=("boom",))
+    with pytest.raises(ValueError, match="synthetic estimator failure"):
+        sc.run_matrix(spec_raise, scheduler="sequential", log=lambda s: None)
+
+
+def test_sequential_engine_path_matches_vmapped(monkeypatch):
+    """The width-1 sequential path (forest-class engines): data comes
+    from the per-column compiled generate executable, the fit runs
+    eagerly — for a row-reduction estimator the cells must be
+    BIT-identical to the vmapped column on the same (DGP, rep) data."""
+    from ate_replication_causalml_tpu.scenarios.batched import _est_naive
+
+    monkeypatch.setitem(
+        sc.SCENARIO_ESTIMATORS, "naive_seq",
+        ScenarioEstimator("naive_seq", _est_naive, vmapped=False,
+                          needs_tall=False))
+    dgp = dataclasses.replace(sc.STOCK_DGPS["calibration"], n=384)
+    spec = sc.MatrixSpec(dgps=(dgp,), estimators=("naive", "naive_seq"),
+                         n_reps=4, batch_width=4)
+    rep = sc.run_matrix(spec, scheduler="sequential", log=lambda s: None)
+    assert rep.n_computed == 8 and rep.n_failed == 0
+    by: dict = {}
+    for r in rep.cells:
+        by.setdefault(r["estimator"], {})[r["rep"]] = r
+    for i in range(4):
+        for f in ("ate", "se", "tau_true"):
+            assert by["naive_seq"][i][f] == by["naive"][i][f], (i, f)
+    disp = obs.REGISTRY.peek("scenario_batch_dispatch_total") or {}
+    assert disp.get("column=calibration:naive_seq,mode=sequential", 0) >= 4
+
+
+# ── sharded dispatch (ISSUE 13 + satellite: padded shard helper) ──────
+
+
+def test_sharded_dispatch_matches_unsharded(tmp_path):
+    """ATE_TPU_SCENARIO_SHARD path: the replicate axis row-sharded over
+    the 8 virtual devices through the metered artifact plane, results
+    bit-identical to the unsharded column for the vmap-collapse-exact
+    estimator."""
+    import jax
+
+    if jax.device_count() < 2:
+        pytest.skip("needs the virtual multi-device harness")
+    dgp = dataclasses.replace(sc.STOCK_DGPS["calibration"], n=64, name="shardcal")
+    spec = sc.MatrixSpec(dgps=(dgp,), estimators=("naive",),
+                         n_reps=8, batch_width=8, shard=False)
+    rep_plain = sc.run_matrix(spec, scheduler="sequential",
+                              log=lambda s: None)
+    before = dict(obs.REGISTRY.peek("artifact_transfer_bytes_total") or {})
+    spec_sh = dataclasses.replace(spec, shard=True)
+    rep_sh = sc.run_matrix(spec_sh, scheduler="sequential",
+                           log=lambda s: None)
+    cmp = sc.compare_cells(rep_plain.cells, rep_sh.cells)
+    assert not cmp["missing"]
+    assert cmp["max_ulp"] == 0.0, cmp["columns"]
+    # the cell-id upload crossed the plane, metered
+    after = obs.REGISTRY.peek("artifact_transfer_bytes_total") or {}
+    key = "artifact=shardcal:naive,path=host_upload"
+    assert after.get(key, 0) - before.get(key, 0) == 8 * 4  # uint32 ids
+
+
+# ── crash-resume at cell granularity (satellite; subprocess) ──────────
+
+_CHILD = """\
+import sys
+from ate_replication_causalml_tpu import scenarios as sc
+
+out, die_after = sys.argv[1], int(sys.argv[2])
+spec = sc.micro_matrix_spec(n_reps=8, batch_width=4, n=128)
+done = {"n": 0}
+
+def log(s):
+    print(s, flush=True)
+    if "cells ok" in s:
+        done["n"] += 1
+        if done["n"] == die_after:
+            import os
+            os._exit(42)
+
+rep = sc.run_matrix(spec, outdir=out, scheduler="sequential", log=log)
+print(f"MATRIX_DONE computed={rep.n_computed} resumed={rep.n_resumed} "
+      f"compiles={rep.compile_events_delta:.0f}", flush=True)
+"""
+
+
+def _child(outdir, die_after=-1):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               ATE_NO_COMPILE_CACHE="1")
+    return subprocess.run(
+        [sys.executable, "-c", _CHILD, outdir, str(die_after)],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=900,
+    )
+
+
+@pytest.mark.slow
+def test_killed_matrix_resumes_bit_identically(tmp_path):
+    """A matrix killed between batch commits resumes at CELL
+    granularity: surviving journal rows are untouched, completed
+    columns schedule zero refits, and the healed journal is
+    bit-identical to an uninterrupted reference run."""
+    out = str(tmp_path / "killed")
+    proc = _child(out, die_after=5)
+    assert proc.returncode == 42, proc.stderr[-2000:]
+
+    def rows(path):
+        got = {}
+        for line in open(path):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("method") != "__config__":
+                got[rec["method"]] = rec
+        return got
+
+    survivors = rows(os.path.join(out, "cells.jsonl"))
+    # 5 batches of 4 cells committed before the kill (2 columns + 1)
+    assert len(survivors) == 20, sorted(survivors)
+
+    proc2 = _child(out)
+    assert proc2.returncode == 0, proc2.stderr[-2000:]
+    assert "MATRIX_DONE" in proc2.stdout
+    final = rows(os.path.join(out, "cells.jsonl"))
+    assert len(final) == 6 * 8
+    for cell, rec in survivors.items():
+        assert final[cell] == rec, cell  # resumed rows byte-equal
+
+    ref_out = str(tmp_path / "ref")
+    proc3 = _child(ref_out)
+    assert proc3.returncode == 0, proc3.stderr[-2000:]
+    ref = rows(os.path.join(ref_out, "cells.jsonl"))
+    assert set(ref) == set(final)
+    payload = lambda r: {k: r[k] for k in
+                         ("ate", "se", "lower_ci", "upper_ci", "tau_true",
+                          "status")}
+    for cell in ref:
+        assert payload(final[cell]) == payload(ref[cell]), cell
+
+    # Fully-journaled rerun: zero computes, ~zero compiles in-process.
+    proc4 = _child(out)
+    assert proc4.returncode == 0, proc4.stderr[-2000:]
+    assert "computed=0 resumed=48" in proc4.stdout
+
+
+# ── committed SCENARIO_MATRIX.json + validator corruption matrix ──────
+
+
+def test_committed_scenario_matrix_record_validates():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from check_metrics_schema import validate_scenario_matrix_record
+
+    rec = json.load(open(os.path.join(REPO, "SCENARIO_MATRIX.json")))
+    assert validate_scenario_matrix_record(rec) == []
+    assert rec["columns"] >= 6 and rec["n_reps"] >= 32
+    assert rec["batched"]["executables"] == rec["columns"]
+    assert rec["resume"]["recomputed_cells"] == 0
+
+
+def test_scenario_matrix_cli_row():
+    """The check_metrics_schema CLI resolves SCENARIO_MATRIX*.json by
+    filename prefix (the table-driven evidence-validator row)."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from check_metrics_schema import main as cms_main
+
+    assert cms_main([os.path.join(REPO, "SCENARIO_MATRIX.json")]) == 0
+
+
+def test_scenario_matrix_validator_rejects_corruption():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from check_metrics_schema import validate_scenario_matrix_record
+
+    rec = json.load(open(os.path.join(REPO, "SCENARIO_MATRIX.json")))
+
+    def corrupt(**patch):
+        bad = json.loads(json.dumps(rec))
+        for path, value in patch.items():
+            parts = path.split(".")
+            node = bad
+            for p in parts[:-1]:
+                node = node[p]
+            node[parts[-1]] = value
+        return validate_scenario_matrix_record(bad)
+
+    assert corrupt(cells=rec["cells"] + 1)          # accounting broken
+    assert corrupt(**{"batched.executables": rec["columns"] + 3})
+    assert corrupt(**{"batched.compile_events": rec["columns"] * 1000})
+    assert corrupt(**{"sequential.dispatches": 1})
+    assert corrupt(**{"resume.recomputed_cells": 5})
+    assert corrupt(**{"resume.compile_events": 10_000})
+    assert corrupt(**{"resume.resumed_cells": 0})
+    # coverage faked out of the MC band must fail
+    col = next(iter(rec["coverage"]))
+    assert corrupt(**{f"coverage.{col}": 0.5})
+    # a column over its recorded ulp bound must fail
+    bcol = next(iter(rec["bit_identity"]["columns"]))
+    assert corrupt(**{f"bit_identity.columns.{bcol}":
+                      rec["bit_identity"]["bound_ulp"] + 1})
+    # an 'exact' column with nonzero ulp must fail
+    if rec["bit_identity"]["exact_columns"]:
+        ecol = rec["bit_identity"]["exact_columns"][0]
+        assert corrupt(**{f"bit_identity.columns.{ecol}": 1.0})
+    assert corrupt(vs_baseline=999.0)
