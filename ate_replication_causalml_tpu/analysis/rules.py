@@ -1,4 +1,4 @@
-"""The graftlint rule set (JGL001–JGL011).
+"""The graftlint rule set (JGL001–JGL012).
 
 Each rule targets a failure class that has actually bitten (or nearly
 bitten) this codebase on TPU — see ADVICE.md and the rule docstrings.
@@ -1330,3 +1330,75 @@ class PredictPathRowGather(Rule):
                         "one-hot/packed contraction or the Pallas row "
                         "kernels",
                     )
+
+
+# ---------------------------------------------------------------- JGL012
+
+#: Method names whose zero-argument call form blocks FOREVER on the
+#: stdlib's synchronization/queue/thread types. `.get()` is included
+#: because `queue.Queue().get()` is the classic unbounded consumer;
+#: `dict.get()` always takes arguments, so the zero-arg restriction
+#: keeps it out of scope.
+_BLOCKING_ATTRS = ("acquire", "wait", "join", "get")
+
+
+@register
+class UnboundedBlockingCall(Rule):
+    """ISSUE 14's liveness contract: the watchdog can only see a lane
+    that keeps stamping heartbeats, and a lane blocked forever in
+    ``Lock.acquire()`` / ``Condition.wait()`` / ``Queue.get()`` /
+    ``Thread.join()`` *between* its stamped sites is exactly the silent
+    wedge the watchdog exists to kill — PR 4's collective-rendezvous
+    deadlock sat behind one of these. Every blocking call in the
+    long-lived lanes (``serving/``, ``scheduler/``, and the watchdog
+    itself) must carry a timeout so the enclosing loop re-checks state
+    and re-stamps its heartbeat.
+
+    Precision is deliberate and syntactic (the ISSUE's wording: "no
+    timeout argument"): only ZERO-argument calls of the four names are
+    flagged — ``cond.wait(w)`` passes even if ``w`` can be None, and
+    ``lock.acquire(True)`` passes; the rule catches the idiomatic
+    unbounded form, not every reachable one."""
+
+    id = "JGL012"
+    name = "unbounded-blocking-call"
+    description = (
+        "zero-argument Lock.acquire()/Condition.wait()/Queue.get()/"
+        "Thread.join() in serving/, scheduler/ or resilience/watchdog.py "
+        "— blocks forever outside the watchdog's stamped sites; pass a "
+        "timeout and loop"
+    )
+
+    def _in_scope(self, relpath: str) -> bool:
+        rel = relpath.replace("\\", "/")
+        return (
+            "serving/" in rel
+            or "scheduler/" in rel
+            or rel.endswith("resilience/watchdog.py")
+        )
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if not self._in_scope(module.relpath):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            if func.attr not in _BLOCKING_ATTRS:
+                continue
+            if node.args or node.keywords:
+                # Any argument form passes: a timeout bounds the block,
+                # and blocking=False / block=False forms never block at
+                # all — the rule targets the idiomatic ZERO-argument
+                # wait-forever call only (per its docstring).
+                continue
+            yield self.finding(
+                module,
+                node,
+                f".{func.attr}() with no timeout blocks forever — the "
+                "heartbeat watchdog cannot see a lane wedged here; pass "
+                "a timeout and re-check in a loop "
+                "(resilience/watchdog.py is the liveness contract)",
+            )

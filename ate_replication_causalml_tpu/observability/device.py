@@ -184,6 +184,17 @@ def install_jax_monitoring() -> bool:
         "masked fraction of fused-bucket dispatches (exact zeros)",
         bounds=PAD_FRACTION_BOUNDS,
     )
+    # Deadline/watchdog/drain families (ISSUE 14): "no lane ever
+    # stalled", "no deadline ever expired" and "no drain ever ran" are
+    # recorded zeros on every instrumented run — a nonzero
+    # watchdog_stalls_total after a serving session is the wedge that
+    # used to be silent.
+    counter("watchdog_stalls_total",
+            "watchdog-detected lane stall episodes").inc(0)
+    counter("serving_deadline_exceeded_total",
+            "requests rejected typed for an expired deadline, by phase"
+            ).inc(0)
+    counter("drain_total", "graceful-drain outcomes").inc(0)
     # Scenario-matrix families (ISSUE 13): cell outcomes by column, the
     # batch dispatch meter (vmapped vs sequential — the O(columns)
     # executables contract's denominator), and the per-column AOT

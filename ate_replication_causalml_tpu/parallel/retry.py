@@ -45,6 +45,7 @@ from ate_replication_causalml_tpu.resilience.backoff import (
     BACKOFF_CAP_MULT,
     jittered_backoff_delay,
 )
+from ate_replication_causalml_tpu.resilience.deadline import Budget
 from ate_replication_causalml_tpu.resilience.errors import (
     ChaosFault,
     DeadlineExceeded,
@@ -171,7 +172,10 @@ def run_shards(
         # raise one of the caller's listed types), so it walks the same
         # retry path instead of escaping the pool on attempt 1.
         catch = tuple(catch) + (ChaosFault,)
-    deadline = None if deadline_s is None else time.monotonic() + deadline_s
+    # The pool deadline is the shared resilience Budget type (ISSUE
+    # 14): the same arithmetic the serving deadline plane and the drain
+    # bound use, so sweep and serving speak one deadline vocabulary.
+    budget = None if deadline_s is None else Budget.after(deadline_s)
     device_failures = 0
     deadline_shards = 0
 
@@ -179,7 +183,7 @@ def run_shards(
     for out in outcomes:
         cut = False
         while out.attempts < max_attempts and not out.ok:
-            if deadline is not None and time.monotonic() >= deadline:
+            if budget is not None and budget.expired():
                 cut = True
                 break
             out.attempts += 1
@@ -215,7 +219,7 @@ def run_shards(
                                 shard_fn = inj.wrap_shard(shard_fn, pool=pool)
                 if out.attempts < max_attempts:
                     delay = backoff_delay(pool, out.index, out.attempts, backoff_s)
-                    if deadline is not None and time.monotonic() + delay >= deadline:
+                    if budget is not None and not budget.affords(delay):
                         # The backoff recovery needs does not fit before
                         # the deadline: cut the shard now instead of
                         # spin-retrying with no backoff at all. No retry

@@ -12,15 +12,30 @@ shares:
 * :mod:`.errors` — the fatal-vs-transient classification the hardened
   shard runner retries by, and the typed failures
   (:class:`CheckpointCorrupt`, :class:`DeadlineExceeded`,
-  :class:`NonFiniteResult`, the :class:`ChaosFault` family).
+  :class:`NonFiniteResult`, the :class:`ChaosFault` family);
+* :mod:`.deadline` — the ONE wall-clock :class:`Budget` type the wire
+  ``deadline_ms``, the shard runner's ``deadline_s`` and the drain
+  bound all speak (ISSUE 14);
+* :mod:`.watchdog` — heartbeat-stamped liveness: per-lane staleness
+  bounds (``ATE_TPU_WATCHDOG_*``), stall episodes as events +
+  ``watchdog_stalls_total``, injectable clock (ISSUE 14).
 
 Consumers: ``parallel/retry.py`` (classified retry, deadline, re-probe),
 ``pipeline.py`` (stage isolation + graceful degradation),
-``utils/checkpoint.py`` (verified checkpoints). README "Resilience &
-fault injection" documents the operator surface.
+``utils/checkpoint.py`` (verified checkpoints), ``serving/`` (deadline
+plane, dispatcher watchdog, graceful drain), ``scheduler/engine.py``
+(worker/mesh-lane heartbeats + stall diagnostics). README "Resilience &
+fault injection" and "Deadlines, watchdog & drain" document the
+operator surface.
 """
 
 from ate_replication_causalml_tpu.resilience import chaos
+from ate_replication_causalml_tpu.resilience.deadline import Budget
+from ate_replication_causalml_tpu.resilience.watchdog import (
+    HeartbeatRegistry,
+    Watchdog,
+    lane_bound_s,
+)
 from ate_replication_causalml_tpu.resilience.errors import (
     FATAL_ERRORS,
     ChaosFault,
@@ -35,6 +50,7 @@ from ate_replication_causalml_tpu.resilience.errors import (
 )
 
 __all__ = [
+    "Budget",
     "FATAL_ERRORS",
     "ChaosFault",
     "ChaosShardFault",
@@ -42,8 +58,11 @@ __all__ = [
     "ChaosStageFault",
     "CheckpointCorrupt",
     "DeadlineExceeded",
+    "HeartbeatRegistry",
     "NonFiniteResult",
+    "Watchdog",
     "chaos",
     "classify",
+    "lane_bound_s",
     "transient_errors",
 ]

@@ -32,6 +32,18 @@ item is ``key=value`` or a bare flag. Scopes and their keys:
   the CLIENT-supplied request id, so with a client that retries under
   the same id the planned reject set is identical run to run and a
   chaos-free rerun of the same stream is bit-identical.
+* ``hang`` — deterministic stalls at the heartbeat-stamped sites
+  (ISSUE 14): ``hang:scope=dispatch|worker|retrain,ms=..,p=..,seed=..,
+  times=..``. The named lane's work units consult
+  :meth:`ChaosInjector.hang_delay_s` before running; a selected site's
+  first ``times`` units sleep ``ms`` — a stall, not a fault: nothing
+  raises, the unit just stops making progress, which is exactly what
+  the heartbeat watchdog (``resilience/watchdog.py``) must detect
+  within its bound. Selection hashes the SITE (the daemon uses the
+  batch's first request id; the scheduler uses the node name; the
+  retrain supervisor its model id) with the same pure-hash discipline
+  as ``serve:``, so planned == observed stalls is assertable and a
+  stall-free rerun of the same stream is bit-identical.
 * ``rotate`` — the train-to-serve fleet's failure modes (ISSUE 11),
   each a bare flag budgeted by ``times``: ``retrain`` (the retrain
   supervisor's fit raises :class:`~.errors.ChaosRotateFault` —
@@ -82,9 +94,14 @@ _SCOPE_SCHEMA: dict[str, dict[str, type]] = {
     "device": {"drop": int, "times": int},
     "stage": {"fail": str, "times": int},
     "serve": {"p": float, "seed": int, "times": int},
+    "hang": {"scope": str, "ms": float, "p": float, "seed": int,
+             "times": int},
     "rotate": {"corrupt": bool, "mid_swap": bool, "retrain": bool,
                "verify_ms": float, "times": int},
 }
+
+#: lanes the ``hang`` scope may target — the heartbeat-stamped sites.
+HANG_SCOPES = ("dispatch", "worker", "retrain")
 
 _SCOPE_DEFAULTS: dict[str, dict[str, object]] = {
     "shard": {"p": 0.0, "seed": 0, "times": 1, "pool": ""},
@@ -92,6 +109,7 @@ _SCOPE_DEFAULTS: dict[str, dict[str, object]] = {
     "device": {"drop": 0, "times": 0},  # times=0: every probe
     "stage": {"fail": "", "times": 1},
     "serve": {"p": 0.0, "seed": 0, "times": 1},
+    "hang": {"scope": "", "ms": 0.0, "p": 0.0, "seed": 0, "times": 1},
     "rotate": {"corrupt": False, "mid_swap": False, "retrain": False,
                "verify_ms": 0.0, "times": 1},
 }
@@ -177,6 +195,15 @@ def parse_chaos(spec: str) -> ChaosConfig:
                         f"chaos key {name}:{key}={value!r} is not a "
                         f"{typ.__name__}"
                     ) from e
+        if name == "hang" and params["scope"] not in HANG_SCOPES:
+            # scope is REQUIRED: a hang spec that names no lane injects
+            # nothing, and an operator who believes stalls are flowing
+            # while nothing runs is the exact silent failure this
+            # config-time raise discipline exists to prevent.
+            raise ChaosSpecError(
+                f"hang:scope={params['scope']!r} is not a stamped lane "
+                f"(scope is required; known: {', '.join(HANG_SCOPES)})"
+            )
         scopes[name] = params
     return ChaosConfig(spec=spec, scopes=scopes)
 
@@ -211,6 +238,7 @@ class ChaosInjector:
         stage = config.scope("stage")
         self._stage_left = int(stage["times"]) if stage else 0
         self._serve_attempts: dict[str, int] = {}
+        self._hang_attempts: dict[str, int] = {}
         rot = config.scope("rotate") or _SCOPE_DEFAULTS["rotate"]
         self._rotate_left = {
             kind: (int(rot["times"]) if rot.get(kind) else 0)
@@ -392,6 +420,38 @@ class ChaosInjector:
         self._record("serve", f"req/{rid}", request_id=rid,
                      attempt=attempt)
         return True
+
+    # ── hang scope ────────────────────────────────────────────────────
+
+    def hang_delay_s(self, scope: str, site: str) -> float:
+        """Stall-injection point for the heartbeat-stamped lanes
+        (ISSUE 14): seconds THIS unit of work must sleep, or 0.0. Only
+        the configured ``scope`` lane is eligible; selection is the
+        pure ``(seed, "hang", scope, site)`` hash (per site, not per
+        arrival order — the serve-scope discipline), and a selected
+        site's first ``times`` units stall. The sleep happens INSIDE
+        the stamped work unit, so the lane's heartbeat age grows and
+        the watchdog's detection path is exactly what a real wedge
+        would walk. Nothing raises and no result changes — a stall-free
+        rerun of the same stream is bit-identical by construction."""
+        cfg = self.config.scope("hang")
+        if (
+            cfg is None or cfg["scope"] != scope
+            or float(cfg["p"]) <= 0.0 or float(cfg["ms"]) <= 0.0
+        ):
+            return 0.0
+        key = f"{scope}/{site}"
+        if _unit(int(cfg["seed"]), "hang", scope, str(site)) >= float(cfg["p"]):
+            return 0.0
+        with self._lock:
+            attempt = self._hang_attempts.get(key, 0) + 1
+            self._hang_attempts[key] = attempt
+        if attempt > int(cfg["times"]):
+            return 0.0
+        delay = float(cfg["ms"]) / 1e3
+        self._record("hang", key, lane=scope, delay_s=delay,
+                     attempt=attempt)
+        return delay
 
     # ── rotate scope ──────────────────────────────────────────────────
 

@@ -1,0 +1,89 @@
+"""THE shared wall-clock budget type (ISSUE 14 — no jax).
+
+Before this module the repo spoke three deadline dialects: ``run_shards``
+did raw ``time.monotonic() + deadline_s`` arithmetic, the retrain
+supervisor kept its own ``deadline`` local, and the serving plane had no
+deadline at all — a request that had already missed its caller's budget
+still got dispatched and burned device time. One type now carries the
+budget end to end:
+
+* the predict wire header's optional ``deadline_ms`` (the client stamps
+  its REMAINING budget at send time) becomes a :class:`Budget` at
+  admission and travels on the :class:`~..serving.coalescer.
+  PendingRequest`, checked at every hand-off — admission, batch close,
+  dispatch pickup — so an expired request is a typed retryable
+  ``deadline_exceeded`` reject *before* device dispatch;
+* ``run_shards``' per-pool ``deadline_s`` discipline is the same
+  arithmetic through the same type, so serving and sweep speak one
+  deadline vocabulary (and one set of edge-case semantics: a backoff
+  that does not fit the remaining budget cuts the work instead of
+  sleeping through the deadline).
+
+The clock is injectable — deadline math must be provable without
+sleeping (the coalescer/watchdog discipline) — and monotonic: wall-clock
+jumps must never expire (or resurrect) a budget (graftlint JGL009).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+
+class Budget:
+    """A monotonic wall-clock budget: "this work is worthless after
+    ``expires_mono``". Pure reads — no thread owns it, no lock needed
+    (the expiry instant is immutable; only the clock advances)."""
+
+    __slots__ = ("expires_mono", "total_s", "_clock")
+
+    def __init__(
+        self,
+        expires_mono: float,
+        total_s: float | None = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.expires_mono = float(expires_mono)
+        #: the originally granted span (reporting only; None when the
+        #: budget was built from a bare expiry instant).
+        self.total_s = total_s
+        self._clock = clock
+
+    @classmethod
+    def after(
+        cls, seconds: float, clock: Callable[[], float] = time.monotonic
+    ) -> "Budget":
+        """A budget expiring ``seconds`` from now (the ``run_shards``
+        / drain form)."""
+        seconds = float(seconds)
+        return cls(clock() + seconds, total_s=seconds, clock=clock)
+
+    @classmethod
+    def from_ms(
+        cls, ms: float, clock: Callable[[], float] = time.monotonic
+    ) -> "Budget":
+        """A budget from a wire ``deadline_ms`` field (the serving
+        form). Raises ``ValueError`` on non-numeric input so the
+        admission layer can reject it typed."""
+        return cls.after(float(ms) / 1e3, clock=clock)
+
+    def remaining_s(self) -> float:
+        """Seconds left (negative once expired — callers that want a
+        sleep/cap value clamp themselves)."""
+        return self.expires_mono - self._clock()
+
+    def remaining_ms(self) -> float:
+        return self.remaining_s() * 1e3
+
+    def expired(self) -> bool:
+        return self.remaining_s() <= 0.0
+
+    def affords(self, seconds: float) -> bool:
+        """Whether ``seconds`` of work/sleep fits strictly inside the
+        remaining budget — the ``run_shards`` backoff rule ("an
+        unaffordable backoff cuts the shard instead of sleeping through
+        the deadline")."""
+        return self.remaining_s() > float(seconds)
+
+    def __repr__(self) -> str:  # pragma: no cover — debugging aid
+        return f"Budget(remaining={self.remaining_s():.6f}s)"

@@ -359,6 +359,9 @@ class MatrixReport:
     compile_events_delta: float = 0.0
     n_columns: int = 0
     n_batches: int = 0
+    #: a SIGTERM drain (ISSUE 14) cut this run short: the journal holds
+    #: the committed prefix and a rerun resumes cell-exact.
+    drained: bool = False
 
 
 def _cells_counter():
@@ -427,10 +430,17 @@ def run_matrix(
     scheduler: str | None = None,
     prefetch: bool | None = None,
     log: Callable[[str], None] = print,
+    drain_on_sigterm: bool = False,
 ) -> MatrixReport:
     """Run the matrix through the real SweepEngine. See module
     docstring for the contracts; telemetry exports to ``outdir`` beside
-    ``cells.jsonl`` and ``matrix_report.json``."""
+    ``cells.jsonl`` and ``matrix_report.json``. With
+    ``drain_on_sigterm`` (the CLI default), SIGTERM gracefully drains
+    the engine (ISSUE 14): in-flight batch stages complete, their rows
+    commit in declared order through the checkpoint journal, the
+    process exits 0 — and a resumed run picks up cell-exact where the
+    drain stopped, exactly like the SIGKILL crash-resume contract but
+    without losing the in-flight batches."""
     import jax
 
     from ate_replication_causalml_tpu.pipeline import (
@@ -641,7 +651,38 @@ def run_matrix(
                     prefetch=prefetch,
                     span_parent=getattr(root_sp, "span_id", None),
                 )
-                engine.run()
+                prev_sigterm = None
+                if drain_on_sigterm:
+                    import signal
+
+                    def _drain(signum, frame, _engine=engine):
+                        # The ISSUE 14 drain contract: stop scheduling,
+                        # finish in-flight batch stages, commit the
+                        # declared-order prefix — run() then returns
+                        # and the journal resumes cell-exact.
+                        log("SIGTERM: draining scenario matrix "
+                            "(in-flight batches will commit)")
+                        _engine.request_drain()
+
+                    try:
+                        prev_sigterm = signal.signal(signal.SIGTERM, _drain)
+                    except ValueError:
+                        pass  # not the main thread — no signal wiring
+                try:
+                    engine.run()
+                finally:
+                    # Restore the caller's handler: a SIGTERM after this
+                    # run must kill the process again, not drain a
+                    # finished engine (and pin it in memory) forever.
+                    if prev_sigterm is not None:
+                        import signal
+
+                        try:
+                            signal.signal(signal.SIGTERM, prev_sigterm)
+                        except ValueError:
+                            pass
+                if engine.draining:
+                    report.drained = True
     finally:
         report.wall_s = time.monotonic() - t_start
         report.compile_events_delta = (
@@ -695,6 +736,7 @@ def _report_json(spec: MatrixSpec, report: MatrixReport) -> dict:
         "n_failed": report.n_failed,
         "wall_s": round(report.wall_s, 3),
         "compile_events_delta": report.compile_events_delta,
+        "drained": report.drained,
         "cells": report.cells,
     })
 
@@ -817,6 +859,7 @@ def main(argv: list[str] | None = None) -> MatrixReport:
         spec, outdir=args.out,
         scheduler="sequential" if args.sequential else None,
         workers=args.workers,
+        drain_on_sigterm=True,
     )
 
 

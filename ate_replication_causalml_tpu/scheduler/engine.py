@@ -46,6 +46,11 @@ import time
 from typing import Callable, Iterable
 
 from ate_replication_causalml_tpu import observability as obs
+from ate_replication_causalml_tpu.resilience import chaos
+from ate_replication_causalml_tpu.resilience.watchdog import (
+    HeartbeatRegistry,
+    lane_bound_s,
+)
 from ate_replication_causalml_tpu.scheduler.cache import NuisanceCache
 from ate_replication_causalml_tpu.scheduler.dag import (
     ArtifactSpec,
@@ -103,6 +108,8 @@ class SweepEngine:
         prefetch: bool | None = None,
         cache: NuisanceCache | None = None,
         span_parent: str | None = None,
+        stall_bound_s: float | None = None,
+        clock: Callable[[], float] = time.monotonic,
     ):
         arts = list(artifacts)
         self.dag = validate(arts, stages)
@@ -123,6 +130,7 @@ class SweepEngine:
         self._indegree: dict[str, int] = {}
         self._dependents: dict[str, list[str]] = {}
         self._started: set[str] = set()
+        self._finished: set[str] = set()
         self._inflight = 0
         self._remaining = 0
         self._results: dict[str, object] = {}
@@ -131,6 +139,25 @@ class SweepEngine:
         self._commit_busy = False
         self._abort: list[tuple[int, BaseException]] = []
         self._busy_lanes: set[str] = set()
+        # Liveness plane (ISSUE 14): workers and the mesh lane stamp
+        # heartbeats around every unit of work; a monitor thread (armed
+        # by stall_bound_s / ATE_TPU_WATCHDOG_SWEEP_S, 0 = off) watches
+        # for "ready or in-flight nodes but no COMPLETION within the
+        # bound" — the PR 4 collective-rendezvous deadlock shape — and
+        # dumps an attributed stall diagnostic instead of wedging
+        # silently. Graceful drain (request_drain) stops scheduling new
+        # nodes; in-flight nodes finish and their declared-order commit
+        # prefix flushes, so a drained sweep resumes exactly.
+        self._clock = clock
+        self.heartbeats = HeartbeatRegistry(clock=clock)
+        self.stall_bound_s = (
+            lane_bound_s("sweep", 0.0)
+            if stall_bound_s is None else float(stall_bound_s)
+        )
+        self._last_completion = clock()
+        self._stall_reported = False
+        self._draining = False
+        self._monitor_stop = threading.Event()
         self._nodes = self._build_nodes()
 
     # ── graph construction ────────────────────────────────────────────
@@ -202,6 +229,12 @@ class SweepEngine:
                 span_parent=self._span_parent,
             )
             prefetcher.start()
+        monitor = None
+        if self.stall_bound_s > 0:
+            monitor = threading.Thread(
+                target=self._monitor, name="sweep-watchdog", daemon=True
+            )
+            monitor.start()
         try:
             if self.workers == 1:
                 self._run_inline()
@@ -217,7 +250,10 @@ class SweepEngine:
                     t.start()
                 try:
                     for t in threads:
-                        t.join()
+                        # Bounded joins (JGL012): a wedged worker keeps
+                        # the wait visible to ^C and the monitor.
+                        while t.is_alive():
+                            t.join(0.5)
                 except BaseException as e:  # noqa: BLE001 — a real ^C
                     # lands HERE: CPython delivers SIGINT to the main
                     # thread (blocked in join), never to a worker. Flag
@@ -227,8 +263,12 @@ class SweepEngine:
                     # before index 0 — the best-effort-prefix contract).
                     self._operator_abort(e)
                     for t in threads:
-                        t.join()
+                        while t.is_alive():
+                            t.join(0.5)
         finally:
+            self._monitor_stop.set()
+            if monitor is not None:
+                monitor.join(5.0)
             if prefetcher is not None:
                 prefetcher.stop(timeout=60.0)
         self._flush_commits()
@@ -276,6 +316,10 @@ class SweepEngine:
             while True:
                 if self._remaining == 0:
                     return None
+                if self._draining:
+                    # Graceful drain: no NEW nodes; in-flight ones
+                    # finish and commit their declared-order prefix.
+                    return None
                 stop_at: int | None = None
                 if self._abort:
                     if any(
@@ -312,12 +356,20 @@ class SweepEngine:
                     # Aborted and nothing in flight can unlock an
                     # earlier-declared node — drain the pool.
                     return None
-                self._mu.wait()
+                # Bounded wait (JGL012): the loop re-checks state each
+                # pass, so a missed notify can delay a worker by at
+                # most the timeout, never wedge it invisibly.
+                self._mu.wait(0.5)
 
     def _finish(self, node: _Node, value, error: BaseException | None) -> None:
         with self._mu:
             self._remaining -= 1
             self._inflight -= 1
+            self._finished.add(node.name)
+            # Progress instant for the stall monitor: a completion ends
+            # any stall episode (the next one re-reports).
+            self._last_completion = self._clock()
+            self._stall_reported = False
             if node.exclusive is not None:
                 self._busy_lanes.discard(node.exclusive)
             for dep_name in self._dependents.get(node.name, ()):
@@ -347,6 +399,18 @@ class SweepEngine:
     def _exec(self, node: _Node) -> None:
         t0 = time.perf_counter()
         value, error = None, None
+        worker_lane = f"worker/{threading.current_thread().name}"
+        self.heartbeats.beat(worker_lane)
+        if node.exclusive is not None:
+            self.heartbeats.beat(f"lane/{node.exclusive}")
+        inj = chaos.active()
+        if inj is not None:
+            # hang: chaos (ISSUE 14) — a deterministic stall INSIDE the
+            # stamped unit of work, keyed on the node name. Nothing
+            # raises; results stay bit-identical to a stall-free run.
+            stall = inj.hang_delay_s("worker", node.name)
+            if stall > 0:
+                time.sleep(stall)
         # The node's execution interval, with lane/worker/dependency
         # attribution (ISSUE 5): the trace exporter renders these spans
         # as the per-worker timeline tracks, duplicates laned ones onto
@@ -391,6 +455,9 @@ class SweepEngine:
         obs.histogram(
             "scheduler_node_seconds", "per-node execution seconds"
         ).observe(time.perf_counter() - t0, kind=node.kind)
+        self.heartbeats.beat(worker_lane)
+        if node.exclusive is not None:
+            self.heartbeats.beat(f"lane/{node.exclusive}")
         self._finish(node, value, error)
         self._flush_commits()
 
@@ -406,6 +473,122 @@ class SweepEngine:
         calling thread — same graph, same commit ordering, zero threads
         (the ``--sequential`` debugging contract)."""
         self._worker()
+
+    # ── liveness & drain (ISSUE 14) ───────────────────────────────────
+
+    def request_drain(self) -> None:
+        """Graceful drain: stop scheduling NEW nodes; in-flight nodes
+        complete, the declared-order commit prefix flushes, and
+        ``run()`` returns the partial results WITHOUT raising — the
+        checkpoint journal then holds exactly the prefix a sequential
+        run stopped at the same point would, so a resumed run is
+        cell-exact (the scenario-matrix SIGTERM contract)."""
+        with self._mu:
+            if self._draining:
+                return
+            self._draining = True
+            self._mu.notify_all()
+        obs.emit("scheduler_drain", status="ok")
+
+    @property
+    def draining(self) -> bool:
+        with self._mu:
+            return self._draining
+
+    def _remaining_critical_path(self) -> list[str]:
+        """The would-be critical path through the UNFINISHED nodes:
+        the longest dependency chain (by node count — durations are
+        unknowable for work that never ran) over declared ``needs``
+        edges. Pure and deterministic; the stall diagnostic's "what is
+        this run waiting on" line."""
+        with self._mu:
+            done = set(self._finished)
+        remaining = [n for n in self._nodes if n not in done]
+        depth: dict[str, tuple[int, tuple[str, ...]]] = {}
+
+        def chain(name: str) -> tuple[int, tuple[str, ...]]:
+            got = depth.get(name)
+            if got is not None:
+                return got
+            best = (1, (name,))
+            for dep in self._nodes[name].deps:
+                if dep in done or dep not in self._nodes:
+                    continue
+                d, path = chain(dep)
+                if d + 1 > best[0]:
+                    best = (d + 1, path + (name,))
+            depth[name] = best
+            return best
+
+        best_path: tuple[str, ...] = ()
+        for name in remaining:
+            _, path = chain(name)
+            if len(path) > len(best_path):
+                best_path = path
+        return list(best_path)
+
+    def stall_diagnostic(self, now: float | None = None) -> dict:
+        """The attributed artifact a detected stall dumps: would-be
+        critical path through the unfinished nodes, per-lane
+        last-heartbeat ages, held lanes ("locks"), and the in-flight /
+        ready node sets. Pure read — callable any time."""
+        now = self._clock() if now is None else now
+        with self._mu:
+            ready = sorted(name for _, name in self._ready)
+            inflight = sorted(self._started - self._finished)
+            held = sorted(self._busy_lanes)
+            since = now - self._last_completion
+        return {
+            "seconds_since_completion": round(since, 6),
+            "ready": ready,
+            "started_unfinished": inflight,
+            "held_lanes": held,
+            "heartbeat_ages": {
+                lane: round(age, 6)
+                for lane, age in self.heartbeats.ages(now).items()
+            },
+            "critical_path": self._remaining_critical_path(),
+        }
+
+    def _check_stall(self, now: float | None = None) -> bool:
+        """One monitor pass: ready-or-inflight nodes but no completion
+        within the bound ⇒ dump the diagnostic (event log +
+        ``watchdog_stalls_total{lane=sweep}``), once per episode."""
+        now = self._clock() if now is None else now
+        with self._mu:
+            busy = self._remaining > 0 and (
+                self._inflight > 0 or bool(self._ready)
+            )
+            since = now - self._last_completion
+            due = (
+                busy and not self._stall_reported
+                and since > self.stall_bound_s
+            )
+            if due:
+                self._stall_reported = True
+        if not due:
+            return False
+        diag = self.stall_diagnostic(now)
+        obs.counter(
+            "watchdog_stalls_total",
+            "watchdog-detected lane stall episodes",
+        ).inc(1, lane="sweep")
+        obs.emit("scheduler_stall", status="error", **{
+            "since_s": diag["seconds_since_completion"],
+            "bound_s": self.stall_bound_s,
+            "critical_path": ",".join(diag["critical_path"]),
+            "held_lanes": ",".join(diag["held_lanes"]),
+            "started_unfinished": ",".join(diag["started_unfinished"]),
+            "heartbeat_ages": ",".join(
+                f"{k}={v:.3f}" for k, v in diag["heartbeat_ages"].items()
+            ),
+        })
+        return True
+
+    def _monitor(self) -> None:
+        poll = max(0.01, min(0.25, self.stall_bound_s / 4.0))
+        while not self._monitor_stop.wait(poll):
+            self._check_stall()
 
     # ── ordered commit ────────────────────────────────────────────────
 

@@ -7,9 +7,14 @@ the binary serving protocol:
 * ``/metrics`` — the registry in Prometheus text exposition format
   (``observability/promtext.py``), scrape-ready;
 * ``/healthz`` — liveness: 200 with a JSON body (lifecycle state,
-  no-compile window term, SLO burn rates) unless the daemon is
-  stopped; a DEGRADED daemon is alive — it is recovering — so healthz
-  stays 200 while the body says so;
+  no-compile window term, per-lane heartbeat ages, SLO burn rates)
+  unless the daemon is stopped OR its dispatcher heartbeat has gone
+  stale past the watchdog bound (ISSUE 14): a process whose one
+  device-owning thread is wedged is NOT alive, however healthy the
+  HTTP thread answering this probe feels — the pre-watchdog 200 was
+  exactly the black-hole failure mode. A DEGRADED daemon with a
+  beating dispatcher is alive — it is recovering — so healthz stays
+  200 while the body says so;
 * ``/readyz`` — readiness: 200 only while the lifecycle is SERVING.
   Degraded/starting/stopped ⇒ 503, which is how a chaos-degraded
   window becomes visible to a load balancer (the acceptance test pins
@@ -64,12 +69,24 @@ def handle_admin_path(server, path: str) -> tuple[int, str, bytes]:
         return 200, "text/plain; version=0.0.4", render_prom_text().encode()
     if path == "/healthz":
         state = server.lifecycle.state
+        # Liveness detail (ISSUE 14): per-lane heartbeat ages + the
+        # watchdog's stall verdict. Duck-typed with defaults so pre-
+        # watchdog stubs (and the tier-1 admin stubs) keep working.
+        ages = getattr(server, "heartbeat_ages", dict)()
+        stalled = tuple(getattr(server, "stalled_lanes", tuple)())
         payload = {
             "state": state,
             "compile_events_in_window": server.compile_events_in_window(),
+            "heartbeats": {k: round(v, 6) for k, v in ages.items()},
+            "stalled_lanes": list(stalled),
             "slo": server.slo.health(),
         }
-        code = 200 if state != "stopped" else 503
+        # A wedged dispatcher is a liveness failure even though the
+        # process (and this probe thread) are up: the daemon cannot
+        # serve and will not recover by itself — restart-worthy, which
+        # is exactly what a 503 on healthz tells the orchestrator.
+        alive = state != "stopped" and "dispatch" not in stalled
+        code = 200 if alive else 503
         return code, "application/json", _json_bytes(payload)
     if path == "/readyz":
         state = server.lifecycle.state
@@ -102,6 +119,11 @@ class AdminRequestHandler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:  # noqa: N802 — stdlib handler contract
         try:
+            hb = getattr(self.server.cate_server, "heartbeats", None)
+            if hb is not None:
+                # The admin lane's own liveness stamp (ISSUE 14): a
+                # probe that answers IS a heartbeat.
+                hb.beat("admin")
             code, ctype, body = handle_admin_path(
                 self.server.cate_server, self.path.split("?", 1)[0]
             )

@@ -10,9 +10,13 @@ Three small, separately testable pieces of the serving core:
   on. Depth is requests, not rows — the row budget is the coalescer's
   bucket plan.
 * :class:`ServingLifecycle` — the ``starting → serving ⇄ degraded →
-  stopped`` state machine. Transitions are explicit and invalid ones
-  raise: a daemon that silently serves from the wrong state is the
-  failure mode this class exists to make impossible.
+  draining → stopped`` state machine. Transitions are explicit and
+  invalid ones raise: a daemon that silently serves from the wrong
+  state is the failure mode this class exists to make impossible.
+  ``draining`` (ISSUE 14) is the graceful-shutdown window: admission
+  rejects new work typed (``draining`` + retry-after), in-flight
+  batches complete, artifacts dump, and the process exits within the
+  configured bound.
 * :class:`ReloadSupervisor` — degraded-mode recovery. Concurrent fault
   reports coalesce into ONE reload attempt (first reporter wins, the
   rest see False), the reload re-verifies the checkpoint before any
@@ -35,6 +39,7 @@ from ate_replication_causalml_tpu.observability import registry as _registry
 STARTING = "starting"
 SERVING = "serving"
 DEGRADED = "degraded"
+DRAINING = "draining"
 STOPPED = "stopped"
 
 
@@ -131,6 +136,19 @@ class ServingLifecycle:
         self._transition(SERVING, (DEGRADED,))  # raises before counting
         with self._lock:
             self._reload_count += 1
+
+    def mark_draining(self) -> bool:
+        """Begin graceful drain (ISSUE 14): legal from any live state
+        (a degraded or still-starting daemon can be told to go away
+        too). Returns True to exactly one caller — the one that moved
+        the lifecycle into DRAINING and therefore owns the drain;
+        concurrent calls (and calls once stopped) get False."""
+        with self._lock:
+            if self._state in (DRAINING, STOPPED):
+                return False
+            frm, self._state = self._state, DRAINING
+        _events.emit("serving_state", status="ok", frm=frm, to=DRAINING)
+        return True
 
     def mark_stopped(self) -> None:
         """Terminal from any state (idempotent — a double stop is not

@@ -38,6 +38,7 @@ from ate_replication_causalml_tpu.resilience.backoff import (
     BACKOFF_CAP_MULT,
     jittered_backoff_delay,
 )
+from ate_replication_causalml_tpu.resilience.deadline import Budget
 from ate_replication_causalml_tpu.serving import protocol
 
 __all__ = ["BACKOFF_CAP_MULT", "CateClient", "ServingError",
@@ -77,9 +78,14 @@ class ServingUnavailable(ServingError):
 #: Reject codes worth retrying after the server's hint. The fleet
 #: codes (ISSUE 11): ``model_degraded`` is one tenant's recovery
 #: window, ``shed`` is SLO-burn backpressure — both clear; unknown or
-#: retired model ids are terminal and raise.
+#: retired model ids are terminal and raise. ``deadline_exceeded``
+#: (ISSUE 14) is retryable ONLY while the caller still has budget —
+#: the retry stamps the smaller remaining deadline and the backoff is
+#: capped by it; ``draining`` is terminal on THIS connection (the
+#: daemon behind it is going away; in a balanced fleet the caller's
+#: next connection lands elsewhere).
 RETRYABLE = ("overloaded", "serve_fault", "degraded", "starting",
-             "model_degraded", "shed")
+             "model_degraded", "shed", "deadline_exceeded")
 
 
 class CateClient:
@@ -145,21 +151,38 @@ class CateClient:
         request_id: str | None = None,
         max_retries: int = 16,
         model: str | None = None,
+        deadline_ms: float | None = None,
     ) -> tuple[np.ndarray, np.ndarray, dict]:
         """``(cate, variance, reply_header)`` for the rows of ``x`` —
         the header carries the ``model`` / ``model_version`` that
         actually served the request (the bit-identity partition key
         across a hot-swap). ``model`` routes to a fleet entry (None =
-        the daemon's default model). Retryable rejects back off on the
-        server's retry-after hint with deterministic crc32 jitter
-        (:func:`retry_backoff_delay`) under the same id; anything else
-        raises :class:`ServingError` typed with the wire code."""
+        the daemon's default model). ``deadline_ms`` (ISSUE 14) arms
+        the end-to-end deadline: the client stamps its REMAINING
+        budget into every attempt's header (the server checks it at
+        admission, batch close and dispatch pickup), backoff sleeps
+        are capped by what is left, and an exhausted budget raises
+        ``ServingUnavailable("deadline_exceeded", ...)``. Retryable
+        rejects back off on the server's retry-after hint with
+        deterministic crc32 jitter (:func:`retry_backoff_delay`) under
+        the same id; anything else raises :class:`ServingError` typed
+        with the wire code."""
         rid = str(request_id) if request_id is not None else f"c{next(self._seq)}"
         x = np.ascontiguousarray(x, dtype=np.float32)
+        budget = Budget.from_ms(deadline_ms) if deadline_ms is not None else None
         request: dict = {"op": "predict", "id": rid}
         if model is not None:
             request["model"] = model
         for attempt in range(1, max_retries + 2):
+            if budget is not None:
+                remaining = budget.remaining_ms()
+                if remaining <= 0.0:
+                    raise ServingUnavailable(
+                        "deadline_exceeded",
+                        f"client deadline of {deadline_ms}ms exhausted",
+                        attempt - 1,
+                    )
+                request["deadline_ms"] = round(remaining, 3)
             header, arrays = self._roundtrip(request, {"x": x})
             if header.get("ok"):
                 return arrays["cate"], arrays["variance"], header
@@ -171,10 +194,16 @@ class CateClient:
                     )
                 raise ServingError(code, header.get("message", ""))
             self.retry_counts[code] = self.retry_counts.get(code, 0) + 1
+            cap_s = self.max_backoff_s
+            if budget is not None:
+                # Never sleep past the caller's deadline: the remaining
+                # budget is the backoff cap (PR 3's "an unaffordable
+                # backoff cuts the work" rule, client-side).
+                cap_s = min(cap_s, max(0.0, budget.remaining_s()))
             delay = retry_backoff_delay(
                 rid, code, attempt,
                 float(header.get("retry_after_s", 0.05)),
-                cap_s=self.max_backoff_s,
+                cap_s=cap_s,
             )
             self.backoff_s_total += delay
             time.sleep(delay)
@@ -186,10 +215,12 @@ class CateClient:
         request_id: str | None = None,
         max_retries: int = 16,
         model: str | None = None,
+        deadline_ms: float | None = None,
     ) -> tuple[np.ndarray, np.ndarray]:
         """:meth:`predict_full` without the reply header."""
         cate, var, _ = self.predict_full(
-            x, request_id=request_id, max_retries=max_retries, model=model
+            x, request_id=request_id, max_retries=max_retries, model=model,
+            deadline_ms=deadline_ms,
         )
         return cate, var
 
@@ -237,6 +268,36 @@ class CateClient:
         """Retire a fleet model; returns whether the id existed."""
         header, _ = self._roundtrip({"op": "retire", "model": model})
         return bool(header.get("ok"))
+
+    def drain(self, timeout_s: float | None = None) -> str:
+        """Ask the daemon for a graceful drain (ISSUE 14): in-flight
+        work completes, artifacts dump, the daemon exits. Blocks until
+        the drain finishes; returns the outcome (``"drained"`` = zero
+        in-flight requests dropped, ``"timeout"`` = the bound cut
+        it). The reply only arrives AFTER the drain, so the socket's
+        regular 10 s read timeout is widened to cover the drain bound
+        (the server default is 30 s) for this one round-trip."""
+        request: dict = {"op": "drain"}
+        if timeout_s is not None:
+            request["timeout_s"] = float(timeout_s)
+        wait_s = (30.0 if timeout_s is None else float(timeout_s)) + 30.0
+        prev = None
+        if self._sock is not None:
+            prev = self._sock.gettimeout()
+            if prev is not None and prev < wait_s:
+                self._sock.settimeout(wait_s)
+        try:
+            header, _ = self._roundtrip(request)
+        finally:
+            if self._sock is not None and prev is not None:
+                try:
+                    self._sock.settimeout(prev)
+                except OSError:
+                    pass  # the daemon closed the connection behind us
+        if "outcome" not in header:
+            raise ServingError(header.get("error", "error"),
+                               header.get("message", ""))
+        return str(header["outcome"])
 
     def shutdown(self) -> None:
         """Ask the daemon to exit (acknowledged before it stops)."""

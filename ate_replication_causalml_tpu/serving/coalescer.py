@@ -166,15 +166,20 @@ class PendingRequest:
         "request_id", "x", "rows", "enqueued_mono", "resolved_mono",
         "batch_closed_mono", "picked_mono", "device_start_mono",
         "device_end_mono", "batch_seq", "batch_bucket", "batch_fill",
-        "model", "model_version", "result", "error", "_done",
+        "model", "model_version", "budget", "result", "error", "_done",
     )
 
     def __init__(self, request_id: str, x, rows: int, enqueued_mono: float,
-                 model: str = ""):
+                 model: str = "", budget=None):
         self.request_id = request_id
         self.x = x
         self.rows = rows
         self.enqueued_mono = enqueued_mono
+        #: the caller's remaining wall-clock budget (a resilience
+        #: ``Budget``, ISSUE 14), or None for deadline-less requests.
+        #: Checked at every hand-off: an expired request is a typed
+        #: ``deadline_exceeded`` reject, never a device dispatch.
+        self.budget = budget
         #: fleet routing (ISSUE 11): the model id the request bound at
         #: admission, and the model VERSION the dispatcher actually
         #: served it with — the bit-identity partition key across a
@@ -259,12 +264,21 @@ class Coalescer:
         plan: BucketPlan,
         window_s: float,
         clock: Callable[[], float] = time.monotonic,
+        on_expired: Callable[[tuple[PendingRequest, ...], float], None]
+        | None = None,
     ):
         if window_s < 0:
             raise ValueError(f"window_s must be >= 0, got {window_s}")
         self.plan = plan
         self.window_s = float(window_s)
         self._clock = clock
+        #: deadline hand-off (ISSUE 14): waiters whose Budget expired
+        #: are REMOVED before any batch math — an expired waiter must
+        #: neither dispatch nor hold a fusing batch open via the
+        #: oldest-waiter window — and handed to this callback (the
+        #: daemon rejects them typed, phase="queue"). The callback runs
+        #: with the condition held and must not re-enter the coalescer.
+        self._on_expired = on_expired
         self._cond = threading.Condition()
         self._pending: list[PendingRequest] = []
         self._closed = False
@@ -298,6 +312,26 @@ class Coalescer:
             return len(self._pending)
 
     # ── batch math ───────────────────────────────────────────────────
+
+    def _harvest_expired(self, now: float) -> tuple[PendingRequest, ...]:
+        """Remove (and report) every waiter whose deadline Budget has
+        expired. Called with the condition held, at the top of every
+        :meth:`next_batch` pass — BEFORE the batch math and before the
+        oldest-waiter window computation, so an expired head-of-line
+        waiter can neither ride a batch nor force one closed."""
+        with self._cond:  # re-entrant — safe under next_batch's hold
+            expired = tuple(
+                r for r in self._pending
+                if r.budget is not None and r.budget.expired()
+            )
+            if expired:
+                gone = set(map(id, expired))
+                self._pending = [
+                    r for r in self._pending if id(r) not in gone
+                ]
+        if expired and self._on_expired is not None:
+            self._on_expired(expired, now)
+        return expired
 
     def _pack_due(self, now: float) -> Batch | None:
         """Close a batch if one is due. Batches are MODEL-PURE (fleet
@@ -373,6 +407,12 @@ class Coalescer:
             for req in self._pending:
                 if req.model != model:
                     continue
+                if req.budget is not None and req.budget.expired():
+                    # Never back-fill an expired waiter onto the device;
+                    # it stays queued for the next harvest's typed
+                    # reject (skipping it does not reorder live work —
+                    # it was never going to dispatch).
+                    continue
                 if total + req.rows > capacity:
                     break
                 take.append(req)
@@ -396,6 +436,7 @@ class Coalescer:
         with self._cond:
             while True:
                 now = self._clock()
+                self._harvest_expired(now)
                 batch = self._pack_due(now)
                 if batch is not None:
                     return batch
@@ -406,6 +447,12 @@ class Coalescer:
                 wait = None
                 if self._pending:
                     wait = self._pending[0].enqueued_mono + self.window_s - now
+                    # Wake for the earliest deadline expiry too, so an
+                    # expiring waiter's typed reject is not delayed by
+                    # a longer coalescing window.
+                    for r in self._pending:
+                        if r.budget is not None:
+                            wait = min(wait, r.budget.expires_mono - now)
                 if deadline is not None:
                     remaining = deadline - now
                     if remaining <= 0:

@@ -77,6 +77,33 @@ The train-to-serve fleet layer (ISSUE 11) rides on top:
 
 The ``rotate:`` chaos scope (corrupt candidate, fault mid-swap,
 retrain failure, slow verify) proves every refusal path in tier-1.
+
+The deadline-and-liveness plane (ISSUE 14) rides through everything
+above:
+
+* **end-to-end deadlines** — the predict header's optional
+  ``deadline_ms`` becomes a shared :class:`~..resilience.deadline.
+  Budget` at admission, checked at every hand-off (admission, batch
+  close, dispatch pickup); an expired request is a typed retryable
+  ``deadline_exceeded`` reject *before* device dispatch, metered per
+  phase in ``serving_deadline_exceeded_total{phase}`` so the report
+  says where the budget died, and a batch containing only expired
+  requests is never dispatched;
+* **heartbeat watchdog** — the dispatcher thread stamps a monotonic
+  heartbeat around every unit of work; a watchdog thread
+  (``resilience/watchdog.py``, ``ATE_TPU_WATCHDOG_DISPATCH_S``) flips
+  the daemon to degraded when the heartbeat goes stale — readyz AND
+  healthz 503, typed rejects — instead of queueing into a black hole,
+  and recovery (heartbeat resumes → verified reload) returns to
+  serving. The ``hang:scope=dispatch`` chaos scope injects
+  deterministic stalls at the stamped site to prove the whole path;
+* **graceful drain** — SIGTERM (``scripts/serve.py``) or the ``drain``
+  wire op moves the lifecycle through ``draining``: admission rejects
+  new work typed with retry-after, in-flight batches complete,
+  artifacts dump, and :meth:`CateServer.drain` returns within
+  ``ATE_TPU_SERVE_DRAIN_S`` (``drain_total{outcome}``; a bound
+  overrun is a recorded ``drain_timeout`` event and a forced exit in
+  the CLI).
 """
 
 from __future__ import annotations
@@ -97,8 +124,16 @@ from ate_replication_causalml_tpu.observability.slo import (
     fleet_slos,
 )
 from ate_replication_causalml_tpu.resilience import chaos
+from ate_replication_causalml_tpu.resilience.deadline import Budget
+from ate_replication_causalml_tpu.resilience.watchdog import (
+    HeartbeatRegistry,
+    Watchdog,
+    lane_bound_s,
+    poll_s_from_env,
+)
 from ate_replication_causalml_tpu.serving import protocol
 from ate_replication_causalml_tpu.serving.admission import (
+    STOPPED,
     AdmissionController,
     ReloadSupervisor,
     ServingLifecycle,
@@ -125,12 +160,22 @@ ENV_SLO_MS = "ATE_TPU_SERVE_SLO_MS"
 ENV_FLEET = "ATE_TPU_SERVE_FLEET"
 ENV_SHED_BURN = "ATE_TPU_SERVE_FLEET_SHED_BURN"
 ENV_FUSE = "ATE_TPU_SERVE_FUSE"
+ENV_DRAIN_S = "ATE_TPU_SERVE_DRAIN_S"
 
 DEFAULT_BUCKETS = "1,8,64,256"
 DEFAULT_WINDOW_MS = 2.0
 DEFAULT_DEPTH = 64
 DEFAULT_RETRY_AFTER_MS = 50.0
 DEFAULT_SLO_LATENCY_MS = 250.0
+#: graceful-drain bound: in-flight work must complete (and the process
+#: be ready to exit 0) within this many seconds of SIGTERM/`drain`.
+DEFAULT_DRAIN_S = 30.0
+#: dispatcher heartbeat staleness bound — far above any sane batch
+#: dispatch, far below "an operator notices the wedge". 0 disables.
+DEFAULT_WATCHDOG_DISPATCH_S = 30.0
+
+#: the dispatcher's watchdog lane name.
+DISPATCH_LANE = "dispatch"
 
 #: the model id requests without a ``model`` header route to — the
 #: ``--checkpoint`` model every pre-fleet client already speaks to.
@@ -196,6 +241,16 @@ class ServeConfig:
     #: per-bucket signature ``compiled(forest, x, None)`` is the
     #: documented pre-fusion contract.
     fuse_buckets: bool = False
+    #: graceful-drain bound (ISSUE 14): seconds in-flight work gets to
+    #: complete after SIGTERM/`drain` before the drain is recorded as a
+    #: timeout (and the CLI force-exits).
+    drain_timeout_s: float = DEFAULT_DRAIN_S
+    #: dispatcher heartbeat staleness bound (seconds; <= 0 disables the
+    #: watchdog). A stalled dispatcher flips the daemon to degraded —
+    #: readyz AND healthz 503 — instead of queueing into a black hole.
+    watchdog_dispatch_s: float = DEFAULT_WATCHDOG_DISPATCH_S
+    #: watchdog poll cadence (detection latency, not age resolution).
+    watchdog_poll_s: float = 0.25
 
     @classmethod
     def from_env(cls, checkpoint: str, **overrides) -> "ServeConfig":
@@ -214,6 +269,11 @@ class ServeConfig:
             shed_burn_threshold=float(env.get(ENV_SHED_BURN, 0.0)),
             fuse_buckets=env.get(ENV_FUSE, "0").strip().lower()
             in ("1", "true", "on"),
+            drain_timeout_s=float(env.get(ENV_DRAIN_S, DEFAULT_DRAIN_S)),
+            watchdog_dispatch_s=lane_bound_s(
+                DISPATCH_LANE, DEFAULT_WATCHDOG_DISPATCH_S
+            ),
+            watchdog_poll_s=poll_s_from_env(),
         )
         if env.get(ENV_ADMIN_PORT):
             base["admin_port"] = int(env[ENV_ADMIN_PORT])
@@ -247,7 +307,29 @@ class CateServer:
         self.config = config
         self.lifecycle = ServingLifecycle()
         self.admission = AdmissionController(config.max_depth)
-        self.coalescer = Coalescer(config.buckets, config.window_s)
+        self.coalescer = Coalescer(
+            config.buckets, config.window_s,
+            on_expired=self._on_expired_waiters,
+        )
+        #: liveness plane (ISSUE 14): the dispatcher stamps this lane's
+        #: heartbeat around every unit of work; the watchdog (started
+        #: with the dispatcher) flips the daemon to degraded on a stale
+        #: heartbeat. The retrain supervisor stamps its own lane here
+        #: too, so /healthz reports every lane's age in one place.
+        self.heartbeats = HeartbeatRegistry()
+        self._watchdog: Watchdog | None = None
+        self._stopped = False
+        #: drain rendezvous: set (with the outcome recorded) only after
+        #: the OWNING drain has fully finished, so concurrent drain
+        #: callers — e.g. SIGTERM landing while a wire `drain` op is in
+        #: flight — block for the real outcome instead of being told
+        #: "drained" mid-drain.
+        self._drain_done = threading.Event()
+        self._drain_outcome: str | None = None
+        #: the OWNING drain's bound — what non-owning waiters must ride
+        #: out (their own bound may be shorter; exiting on it would drop
+        #: the owner's still-budgeted in-flight work).
+        self._drain_bound: float | None = None
         #: bucket-fusion plan (ISSUE 12): None = per-bucket executables
         #: (the pre-fusion contract); a plan = one masked executable per
         #: group of adjacent buckets.
@@ -367,6 +449,16 @@ class CateServer:
         self._fleet_requests = obs.counter(
             "serving_fleet_requests_total",
             "fleet-routed serving requests by model and terminal status",
+        )
+        # Deadline plane (ISSUE 14): expired requests rejected typed
+        # BEFORE device dispatch, by the phase their budget died in
+        # (admission / queue / dispatch) — and drain outcomes.
+        self._deadline_rejects = obs.counter(
+            "serving_deadline_exceeded_total",
+            "requests rejected typed for an expired deadline, by phase",
+        )
+        self._drains = obs.counter(
+            "drain_total", "graceful-drain outcomes"
         )
 
     # ── startup ──────────────────────────────────────────────────────
@@ -662,7 +754,60 @@ class CateServer:
             self._compile_mark = obs.compile_event_count()
         self.lifecycle.mark_ready()
         self._start_dispatcher()
+        self._start_watchdog()
         return phases
+
+    def _start_watchdog(self) -> None:
+        """Arm the dispatcher-liveness watchdog (ISSUE 14). jax-free —
+        starting it inside the no-compile window is the point."""
+        if self.config.watchdog_dispatch_s <= 0:
+            return
+        wd = Watchdog(
+            self.heartbeats,
+            {DISPATCH_LANE: self.config.watchdog_dispatch_s},
+            poll_s=self.config.watchdog_poll_s,
+            on_stall=self._on_lane_stall,
+            on_recover=self._on_lane_recover,
+        )
+        with self._lock:
+            self._watchdog = wd
+        wd.start()
+
+    def _on_lane_stall(self, lane: str, age_s: float) -> None:
+        """A stalled dispatcher flips the daemon to DEGRADED — readyz
+        (and healthz) 503, new admissions shed typed with retry-after —
+        instead of queueing into a black hole behind a wedged device
+        call. Deliberately NO reload here: the model was never suspect
+        and a reload cannot unwedge a thread — the daemon STAYS
+        degraded for the whole stall (the load-balancer-visible
+        window), and recovery waits for the heartbeat itself."""
+        if lane != DISPATCH_LANE:
+            return
+        self.lifecycle.mark_fault(
+            f"watchdog:{lane} heartbeat stale {age_s:.3f}s"
+        )
+
+    def _on_lane_recover(self, lane: str, stalled_s: float) -> None:
+        """The heartbeat resumed: run the verified-reload recovery
+        (retry() — the reload re-verifies the last-good checkpoint and
+        DEGRADED → SERVING only on success), unless some concurrent
+        recovery already brought the daemon back."""
+        if lane != DISPATCH_LANE:
+            return
+        self._reloader.retry()
+
+    def heartbeat_ages(self) -> dict[str, float]:
+        """Per-lane heartbeat ages — the /healthz body's liveness
+        detail."""
+        return self.heartbeats.ages()
+
+    def stalled_lanes(self) -> tuple[str, ...]:
+        """Lanes currently inside a watchdog stall episode ((), when
+        the watchdog is off). /healthz answers 503 while the dispatcher
+        lane is in here."""
+        with self._lock:
+            wd = self._watchdog
+        return wd.stalled() if wd is not None else ()
 
     def _start_observability_plane(self) -> None:
         """The ISSUE 7 plane: background counter sampling for the
@@ -728,13 +873,19 @@ class CateServer:
         return RejectedRequest(code, message, retry_after_s)
 
     def submit(self, request_id: str, x: np.ndarray,
-               model: str | None = None) -> PendingRequest:
+               model: str | None = None,
+               deadline_ms: float | None = None) -> PendingRequest:
         """Admission + routing + chaos + coalesce. ``model`` selects
         the fleet entry (None/"" routes to DEFAULT_MODEL — the
-        pre-fleet wire contract). Returns the pending handle the
-        caller waits on; raises :class:`RejectedRequest` for every typed
-        refusal (the protocol layer converts those to reject frames).
-        The admission slot is released by the dispatcher on resolve."""
+        pre-fleet wire contract). ``deadline_ms`` is the caller's
+        REMAINING budget (the wire header field, ISSUE 14): it becomes
+        a shared :class:`Budget` checked at every hand-off, and a
+        request that expires anywhere before device dispatch is a
+        typed retryable ``deadline_exceeded`` reject. Returns the
+        pending handle the caller waits on; raises
+        :class:`RejectedRequest` for every typed refusal (the protocol
+        layer converts those to reject frames). The admission slot is
+        released by the dispatcher on resolve."""
         model_id = model if model else DEFAULT_MODEL
         try:
             x = np.ascontiguousarray(x, dtype=np.float32)
@@ -783,6 +934,28 @@ class CateServer:
                 f"got {rows} (chunk larger queries client-side)",
                 request_id=request_id, model=model_id,
             )
+        budget = None
+        if deadline_ms is not None:
+            try:
+                budget = Budget.from_ms(deadline_ms)
+            except (TypeError, ValueError) as e:
+                raise self._reject(
+                    "bad_request",
+                    f"deadline_ms {deadline_ms!r} is not a number ({e})",
+                    request_id=request_id, model=model_id,
+                ) from e
+            if budget.expired():
+                # The admission hand-off check: a request that arrives
+                # already past its caller's deadline never takes a
+                # queue slot, never holds a batch open, never touches
+                # the device.
+                self._deadline_rejects.inc(1, phase="admission")
+                raise self._reject(
+                    "deadline_exceeded",
+                    f"deadline of {deadline_ms}ms expired at admission",
+                    self.config.retry_after_s, request_id=request_id,
+                    model=model_id,
+                )
         inj = chaos.active()
         if inj is not None and inj.take_serve_fault(request_id):
             # The injected fault walks the REAL degraded path: recovery
@@ -833,7 +1006,8 @@ class CateServer:
                 model=model_id,
             )
         req = PendingRequest(
-            str(request_id), x, rows, time.monotonic(), model=model_id
+            str(request_id), x, rows, time.monotonic(), model=model_id,
+            budget=budget,
         )
         try:
             self.coalescer.submit(req)
@@ -842,9 +1016,34 @@ class CateServer:
             raise
         return req
 
+    # ── deadline plane (ISSUE 14) ────────────────────────────────────
+
+    def _expire_requests(self, requests, phase: str, now: float) -> None:
+        """Fail ``requests`` with the typed retryable
+        ``deadline_exceeded`` reject (metered by the phase their budget
+        died in) and release their admission slots — the one reject
+        recipe every post-admission expiry path shares."""
+        for req in requests:
+            self._deadline_rejects.inc(1, phase=phase)
+            rej = self._reject(
+                "deadline_exceeded",
+                f"deadline expired in {phase} "
+                f"(waited {now - req.enqueued_mono:.6f}s)",
+                self.config.retry_after_s,
+                request_id=req.request_id, model=req.model,
+            )
+            req.fail(rej, now)
+            self.admission.release()
+
+    def _on_expired_waiters(self, requests, now: float) -> None:
+        """Coalescer hand-off (batch close / window math): waiters the
+        harvest removed because their budget expired in queue."""
+        self._expire_requests(requests, "queue", now)
+
     def serve_request(
         self, request_id: str, x: np.ndarray,
         timeout: float | None = 30.0, model: str | None = None,
+        deadline_ms: float | None = None,
     ) -> PendingRequest:
         """Blocking request path: submit, wait, return the resolved
         :class:`PendingRequest` (result + the model version it was
@@ -857,7 +1056,8 @@ class CateServer:
                       model=model or DEFAULT_MODEL,
                       ) as sp:
             try:
-                req = self.submit(request_id, x, model=model)
+                req = self.submit(request_id, x, model=model,
+                                  deadline_ms=deadline_ms)
             except RejectedRequest as rej:
                 sp.set_status("rejected")
                 sp.set_attr("reject", rej.code)
@@ -874,6 +1074,14 @@ class CateServer:
                     f"request {request_id!r} not served in {timeout}s"
                 )
             if req.error is not None:
+                if isinstance(req.error, RejectedRequest):
+                    # A post-admission typed reject (deadline expired in
+                    # queue / at dispatch pickup): already metered by
+                    # _reject when it was minted — re-raising it here
+                    # must not double-count a terminal.
+                    sp.set_status("rejected")
+                    sp.set_attr("reject", req.error.code)
+                    raise req.error
                 sp.set_status("error")
                 self._requests.inc(1, status="error")
                 self._latency.observe(
@@ -905,28 +1113,80 @@ class CateServer:
     def serve_one(
         self, request_id: str, x: np.ndarray,
         timeout: float | None = 30.0, model: str | None = None,
+        deadline_ms: float | None = None,
     ) -> tuple[np.ndarray, np.ndarray]:
         """:meth:`serve_request`, returning just ``(cate, variance)``
         for exactly the submitted rows."""
         return self.serve_request(
-            request_id, x, timeout=timeout, model=model
+            request_id, x, timeout=timeout, model=model,
+            deadline_ms=deadline_ms,
         ).result
 
     # ── dispatch (the single device-owning thread) ───────────────────
 
     def _dispatch_loop(self) -> None:
+        # The idle block must stay well under the watchdog bound, or an
+        # IDLE dispatcher would read as stalled (the heartbeat is
+        # stamped once per loop pass).
+        idle_s = 0.25
+        if self.config.watchdog_dispatch_s > 0:
+            idle_s = min(
+                idle_s, max(0.005, self.config.watchdog_dispatch_s / 4.0)
+            )
         while True:
-            batch = self.coalescer.next_batch(timeout=0.25)
+            # The liveness stamp (ISSUE 14): one beat per loop pass —
+            # a healthy dispatcher never lets the age past the idle
+            # block + one dispatch; a wedged device call lets it grow
+            # past the watchdog bound.
+            self.heartbeats.beat(DISPATCH_LANE)
+            batch = self.coalescer.next_batch(timeout=idle_s)
             if batch is None:
                 if self.lifecycle.state == "stopped":
+                    self.heartbeats.clear(DISPATCH_LANE)
                     return
                 continue
             self._dispatch(batch)
+            self.heartbeats.beat(DISPATCH_LANE)
 
     def _dispatch(self, batch: Batch) -> None:
         import jax
 
         picked = time.monotonic()
+        # Dispatch-pickup deadline check (ISSUE 14): requests whose
+        # budget died between batch close and pickup are rejected typed
+        # HERE — and a batch left with only expired requests is never
+        # dispatched (no device time for answers nobody can use).
+        expired = tuple(
+            r for r in batch.requests
+            if r.budget is not None and r.budget.expired()
+        )
+        if expired:
+            self._expire_requests(expired, "dispatch", picked)
+            gone = set(map(id, expired))
+            live = tuple(
+                r for r in batch.requests if id(r) not in gone
+            )
+            if not live:
+                obs.emit("serving_batch_all_expired", status="error",
+                         seq=batch.seq, requests=len(batch.requests),
+                         model=batch.model)
+                return
+            rows = sum(r.rows for r in live)
+            batch = batch._replace(
+                requests=live, rows=rows, fill=rows / batch.bucket
+            )
+            for req in live:
+                req.batch_fill = batch.fill
+        inj = chaos.active()
+        if inj is not None:
+            # hang: chaos (ISSUE 14) — a deterministic stall INSIDE the
+            # heartbeat-stamped unit of work, keyed on the batch's
+            # first request id (client-stable, like serve: selection).
+            stall = inj.hang_delay_s(
+                DISPATCH_LANE, batch.requests[0].request_id
+            )
+            if stall > 0:
+                time.sleep(stall)
         # The bind instant (ISSUE 11): ONE consistent (forest, version)
         # read per batch. A hot-swap landing after this keeps the old
         # reference alive until the batch resolves — in-flight batches
@@ -1187,6 +1447,7 @@ class CateServer:
                 model_id, path, reason="retrain"
             ),
             start_version=entry.version + 1,
+            heartbeats=self.heartbeats,
             **kwargs,
         )
 
@@ -1273,6 +1534,19 @@ class CateServer:
         n = sum(s["count"] for s in counts.values())
         return sum(s["sum"] for s in counts.values()) / n if n else 0.0
 
+    def deadline_exceeded_counts(self) -> dict[str, int]:
+        """Typed deadline rejects by the phase the budget died in
+        (admission / queue / dispatch) — the split ``stats`` and the
+        loadgen record report, reconciling with the serving report's
+        reject-by-reason count."""
+        samples = obs.REGISTRY.peek("serving_deadline_exceeded_total") or {}
+        out: dict[str, int] = {}
+        for key, v in sorted(samples.items()):
+            phase = self._label_value(key, "phase")
+            if phase is not None and v:
+                out[phase] = int(v)
+        return out
+
     def pad_fraction_mean(self) -> float:
         """Mean TRUE-waste pad fraction across per-bucket dispatches
         (fused dispatches report masked, not pad — ISSUE 12)."""
@@ -1306,6 +1580,13 @@ class CateServer:
                 else [list(g) for g in self._fusion.groups]
             ),
             "admin_port": admin.port if admin is not None else None,
+            # Deadline & liveness plane (ISSUE 14).
+            "deadline_exceeded": self.deadline_exceeded_counts(),
+            "heartbeats": {
+                lane: round(age, 6)
+                for lane, age in self.heartbeat_ages().items()
+            },
+            "stalled_lanes": list(self.stalled_lanes()),
             "slo": self.slo.health(),
             # Fleet state (ISSUE 11): per-model version/lifecycle plus
             # the shedder's cached per-model burn rates.
@@ -1367,12 +1648,89 @@ class CateServer:
         paths.append(spath)
         return paths
 
+    def drain(self, timeout_s: float | None = None,
+              clock=time.monotonic, sleep=time.sleep) -> str:
+        """Graceful drain (ISSUE 14): move through the ``draining``
+        lifecycle state — new admissions get typed ``draining`` rejects
+        with retry-after, queued and in-flight requests COMPLETE (the
+        coalescer flushes immediately instead of waiting out windows),
+        artifacts dump, and the daemon stops — all within
+        ``timeout_s`` (default ``ATE_TPU_SERVE_DRAIN_S``). Returns
+        ``"drained"`` (zero in-flight requests dropped) or
+        ``"timeout"`` (bound exceeded with work still in flight — a
+        recorded ``serving_drain_timeout`` event; the CLI's SIGTERM
+        handler force-exits nonzero on it). Exactly one caller owns the
+        drain; concurrent and repeat callers BLOCK until the owning
+        drain finishes and report its real outcome (a SIGTERM handler
+        that was told "drained" while a wire-op drain was still in
+        flight would ``os._exit(0)`` mid-drain and drop its work). The
+        clock and sleep are injectable so the state machine is provable
+        without wall-clock sleeping."""
+        bound = (
+            self.config.drain_timeout_s if timeout_s is None
+            else float(timeout_s)
+        )
+        if not self.lifecycle.mark_draining():
+            if self._drain_done.is_set():
+                return self._drain_outcome or "timeout"
+            if self.lifecycle.state == STOPPED:
+                # Stopped without any drain (plain stop()) — terminal;
+                # honest about whether work was still in flight.
+                return ("drained" if self.admission.depth == 0
+                        else "timeout")
+            # The owning drain is still in flight: ride out ITS bound
+            # (not ours — a SIGTERM arriving with the config default
+            # must not cut short a wire drain that asked for longer),
+            # padded for the owner's post-drain stop/export work.
+            wait_cap = max(bound, self._drain_bound or 0.0,
+                           self.config.drain_timeout_s) + 30.0
+            if self._drain_done.wait(wait_cap):
+                return self._drain_outcome or "timeout"
+            return "timeout"
+        self._drain_bound = bound
+        budget = Budget.after(bound, clock=clock)
+        obs.emit("serving_drain", status="started", bound_s=bound,
+                 in_flight=self.admission.depth)
+        # Flush the coalescer: every remaining next_batch call packs
+        # immediately (close semantics), so queued waiters ride out on
+        # the dispatcher without waiting for windows to expire.
+        self.coalescer.close()
+        while self.admission.depth > 0 and not budget.expired():
+            sleep(min(0.005, max(1e-4, budget.remaining_s())))
+        dropped = self.admission.depth
+        outcome = "drained" if dropped == 0 else "timeout"
+        self._drains.inc(1, outcome=outcome)
+        if outcome == "drained":
+            obs.emit("serving_drained", status="ok", bound_s=bound)
+        else:
+            obs.emit("serving_drain_timeout", status="error",
+                     bound_s=bound, in_flight=dropped)
+        self._drain_outcome = outcome
+        try:
+            self.stop(timeout=max(1.0, budget.remaining_s()))
+        finally:
+            # Release waiters even if stop() raises (the no-compile
+            # enforcement can) — a non-owning SIGTERM handler spinning
+            # forever on a dead owner is its own wedge.
+            self._drain_done.set()
+        return outcome
+
     def stop(self, timeout: float = 10.0) -> None:
         """Drain, stop the dispatcher and the observability plane,
         export telemetry (when ``$ATE_TPU_METRICS_DIR`` is set) and
         ENFORCE the no-compile guarantee: any compile event inside the
         serving window raises (``strict_no_compile=False`` downgrades
-        to an error event for diagnostics runs)."""
+        to an error event for diagnostics runs). Idempotent — the
+        drain path stops the daemon itself, and a later teardown
+        stop() must be a no-op, not a second export."""
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+            wd = self._watchdog
+            self._watchdog = None
+        if wd is not None:
+            wd.stop()
         self._reloader.join(timeout)
         self.coalescer.close()
         self.lifecycle.mark_stopped()
@@ -1425,7 +1783,10 @@ def _handle_op(server: CateServer, header: dict, arrays: dict):
                     "message": "predict needs an 'x' array"}, {}, False
         model = header.get("model")
         try:
-            req = server.serve_request(rid, x, model=model)
+            req = server.serve_request(
+                rid, x, model=model,
+                deadline_ms=header.get("deadline_ms"),
+            )
         except RejectedRequest as rej:
             reply = {"ok": False, "id": rid, "error": rej.code,
                      "message": rej.message}
@@ -1491,6 +1852,21 @@ def _handle_op(server: CateServer, header: dict, arrays: dict):
             return {"ok": False, "id": rid, "error": "error",
                     "message": f"{type(e).__name__}: {e}"}, {}, False
         return {"ok": True, "op": "dump", "paths": paths}, {}, False
+    if op == "drain":
+        # Graceful shutdown over the wire (ISSUE 14): in-flight work
+        # from EVERY connection completes, then the daemon stops and
+        # the serve loop exits. The reply is sent after the drain so
+        # the caller knows the outcome ("drained" = zero dropped).
+        timeout = header.get("timeout_s")
+        try:
+            timeout = None if timeout is None else float(timeout)
+        except (TypeError, ValueError):
+            return {"ok": False, "error": "bad_request",
+                    "message": f"timeout_s {timeout!r} is not a number"
+                    }, {}, False
+        outcome = server.drain(timeout)
+        return {"ok": outcome == "drained", "op": "drain",
+                "outcome": outcome}, {}, True
     if op == "shutdown":
         return {"ok": True, "op": "shutdown"}, {}, True
     return {"ok": False, "error": "bad_request",
@@ -1553,7 +1929,10 @@ def serve_socket(server: CateServer, host: str = "127.0.0.1",
 
         threads: list[threading.Thread] = []
         conn_seq = 0
-        while not stop_evt.is_set():
+        # The accept loop also exits when the daemon stops underneath
+        # it — a SIGTERM-driven drain() (scripts/serve.py) ends serving
+        # without any connection sending a shutdown op.
+        while not stop_evt.is_set() and server.lifecycle.state != "stopped":
             # Prune finished connections each pass — a long-lived daemon
             # accepts millions of short connections and must not retain
             # one dead Thread object per connection.

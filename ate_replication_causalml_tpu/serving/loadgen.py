@@ -37,6 +37,8 @@ from typing import Callable, Sequence
 
 import numpy as np
 
+from ate_replication_causalml_tpu.resilience.deadline import Budget
+
 #: default offered rate — fast enough to exercise coalescing at micro
 #: scale without turning the bench into a sleep festival.
 DEFAULT_RATE_HZ = 2000.0
@@ -191,6 +193,7 @@ def run_inprocess(
     max_attempts: int = 500,
     clock: Callable[[], float] = time.monotonic,
     sleep: Callable[[float], None] = time.sleep,
+    deadline_ms: float | None = None,
 ) -> dict:
     """Replay ``schedule`` open-loop against an in-process
     :class:`~.daemon.CateServer` via :meth:`submit` — submissions are
@@ -198,21 +201,41 @@ def run_inprocess(
     (overload backpressure, chaos faults, degraded windows) are retried
     under the SAME id after the server's hint, exactly like a polite
     production client; ``bad_request`` raises (a schedule that offends
-    the daemon's contract is a harness bug, not load)."""
+    the daemon's contract is a harness bug, not load). ``deadline_ms``
+    (ISSUE 14) stamps every submission with that remaining budget;
+    requests the server expires are counted into the record's
+    ``deadline_expired`` (typed, pre-dispatch — never raised as
+    harness failures: that rejection IS the overload contract under
+    test)."""
     from ate_replication_causalml_tpu.serving.daemon import RejectedRequest
 
     t0 = clock()
     pending = []
     retries: dict[str, int] = {}
+    expired = 0
     for sched, q in zip(schedule, queries):
         delay = t0 + sched.t_s - clock()
         if delay > 0:
             sleep(delay)
+        # ONE end-to-end budget per request (the wire client's
+        # discipline): every retry stamps the REMAINING budget, never a
+        # fresh per-attempt deadline, so in-process and wire records
+        # agree under identical load.
+        req_budget = (
+            None if deadline_ms is None
+            else Budget.from_ms(deadline_ms, clock=clock)
+        )
         for _ in range(max_attempts):
+            if req_budget is not None and req_budget.expired():
+                expired += 1
+                break
             try:
                 pending.append(
                     server.submit(sched.request_id, q,
-                                  model=sched.model or None)
+                                  model=sched.model or None,
+                                  deadline_ms=(
+                                      None if req_budget is None
+                                      else req_budget.remaining_ms()))
                 )
                 break
             except RejectedRequest as rej:
@@ -223,6 +246,12 @@ def run_inprocess(
                     # bug, not load — retrying 500 times would only
                     # bury the real cause.
                     raise
+                if rej.code == "deadline_exceeded":
+                    # The caller's budget is gone; re-stamping a fresh
+                    # one would misstate the deadline. Count and move
+                    # on — the open-loop schedule never blocks on it.
+                    expired += 1
+                    break
                 retries[rej.code] = retries.get(rej.code, 0) + 1
                 sleep(rej.retry_after_s or 0.002)
         else:
@@ -235,11 +264,22 @@ def run_inprocess(
         if not req.wait(timeout_s):
             raise TimeoutError(f"request {req.request_id} never served")
         if req.error is not None:
+            if (
+                isinstance(req.error, RejectedRequest)
+                and req.error.code == "deadline_exceeded"
+            ):
+                expired += 1
+                continue
             raise req.error
         latencies.append(req.resolved_mono - req.enqueued_mono)
     duration = clock() - t0
     offered = len(schedule) / schedule[-1].t_s if schedule[-1].t_s > 0 else 0.0
-    return _record(schedule, latencies, duration, retries, round(offered, 3))
+    record = _record(schedule, latencies, duration, retries,
+                     round(offered, 3))
+    if deadline_ms is not None:
+        record["deadline_ms"] = deadline_ms
+        record["deadline_expired"] = expired
+    return record
 
 
 def run_wire(
@@ -249,6 +289,7 @@ def run_wire(
     concurrency: int = 8,
     max_retries: int = 64,
     close_clients: bool = True,
+    deadline_ms: float | None = None,
 ) -> dict:
     """Replay ``schedule`` against a live daemon over the wire.
     ``concurrency`` connections (one :class:`CateClient` each — the
@@ -259,11 +300,16 @@ def run_wire(
     ``close_clients=False`` when the factory hands out a borrowed
     client the caller still needs (the stdio transport's single
     pipe)."""
+    from ate_replication_causalml_tpu.serving.client import (
+        ServingUnavailable,
+    )
+
     lock = threading.Lock()
     next_idx = [0]
     latencies: list[float] = []
     errors: list[BaseException] = []
     retries: dict[str, int] = {}
+    expired = [0]
     t0 = time.monotonic()
 
     def worker() -> None:
@@ -285,7 +331,19 @@ def run_wire(
                         queries[i], request_id=sched.request_id,
                         max_retries=max_retries,
                         model=sched.model or None,
+                        deadline_ms=deadline_ms,
                     )
+                except ServingUnavailable as e:
+                    if e.code == "deadline_exceeded":
+                        # Typed pre-dispatch expiry under a finite
+                        # deadline — the contract under test, not a
+                        # harness failure.
+                        with lock:
+                            expired[0] += 1
+                        continue
+                    with lock:
+                        errors.append(e)
+                    return
                 except BaseException as e:
                     with lock:
                         errors.append(e)
@@ -314,9 +372,18 @@ def run_wire(
     for t in threads:
         t.start()
     for t in threads:
-        t.join()
+        # Bounded joins (graftlint JGL012): a wedged worker must show
+        # up as a visible wait loop, never an unbounded block a
+        # watchdog cannot see past.
+        while t.is_alive():
+            t.join(1.0)
     if errors:
         raise errors[0]
     duration = time.monotonic() - t0
     offered = len(schedule) / schedule[-1].t_s if schedule[-1].t_s > 0 else 0.0
-    return _record(schedule, latencies, duration, retries, round(offered, 3))
+    record = _record(schedule, latencies, duration, retries,
+                     round(offered, 3))
+    if deadline_ms is not None:
+        record["deadline_ms"] = deadline_ms
+        record["deadline_expired"] = expired[0]
+    return record

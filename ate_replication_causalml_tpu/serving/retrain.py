@@ -57,9 +57,13 @@ from ate_replication_causalml_tpu.resilience.backoff import (
     BACKOFF_CAP_MULT,
     jittered_backoff_delay,
 )
+from ate_replication_causalml_tpu.resilience.deadline import Budget
 from ate_replication_causalml_tpu.resilience.errors import (
     ChaosRotateFault,
     classify,
+)
+from ate_replication_causalml_tpu.resilience.watchdog import (
+    HeartbeatRegistry,
 )
 
 __all__ = ["BACKOFF_CAP_MULT", "RetrainConfig", "RetrainOutcome",
@@ -124,6 +128,7 @@ class RetrainSupervisor:
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
         start_version: int = 2,
+        heartbeats: HeartbeatRegistry | None = None,
     ):
         self.model_id = model_id
         self._fit_fn = fit_fn
@@ -133,6 +138,15 @@ class RetrainSupervisor:
         self._publish_fn = publish_fn
         self._clock = clock
         self._sleep = sleep
+        #: the watchdog lane (ISSUE 14): the supervisor stamps a
+        #: heartbeat around every attempt so a wedged fit is a detected
+        #: stall, not a silent never-returning run_once. The lane is
+        #: MODEL-scoped (``retrain/<model_id>``) — in a fleet, one
+        #: model's wedged fit must not be masked by another model's
+        #: beats (watchdog ``bound_for`` prefix matching lets one
+        #: ``retrain`` bound cover them all). The daemon's
+        #: retrain_supervisor() wires its own registry in.
+        self._heartbeats = heartbeats
         self._version = itertools.count(start_version)
         self._runs = _registry.counter(
             "serving_retrain_total",
@@ -169,6 +183,13 @@ class RetrainSupervisor:
         checkpoint_path)``. Raises on failure (classified upstream)."""
         inj = chaos.active()
         with _events.span("retrain_fit", model=self.model_id):
+            if inj is not None:
+                # The hang: injection site — INSIDE the stamped unit of
+                # work, so the retrain lane's heartbeat age grows and
+                # the watchdog's detection path is exercised.
+                delay = inj.hang_delay_s("retrain", self.model_id)
+                if delay > 0:
+                    time.sleep(delay)
             if inj is not None and inj.take_rotate_fault(
                 "retrain", site=f"retrain/{self.model_id}"
             ):
@@ -193,15 +214,17 @@ class RetrainSupervisor:
         transient trouble — the outcome record carries the terminal
         status; programming errors (fatal classification) re-raise."""
         cfg = self.config
-        deadline = (
+        budget = (
             None if cfg.deadline_s is None
-            else self._clock() + cfg.deadline_s
+            else Budget.after(cfg.deadline_s, clock=self._clock)
         )
         out = RetrainOutcome(self.model_id, "failed")
         candidate: str | None = None
         with _events.span("retrain_run", model=self.model_id) as sp:
             while out.attempts < cfg.max_attempts:
-                if deadline is not None and self._clock() >= deadline:
+                if self._heartbeats is not None:
+                    self._heartbeats.beat(f"retrain/{self.model_id}")
+                if budget is not None and budget.expired():
                     out.status = "deadline"
                     break
                 out.attempts += 1
@@ -249,9 +272,7 @@ class RetrainSupervisor:
                 delay = retrain_backoff_delay(
                     self.model_id, out.attempts, cfg.backoff_s
                 )
-                if deadline is not None and (
-                    self._clock() + delay >= deadline
-                ):
+                if budget is not None and not budget.affords(delay):
                     out.status = "deadline"
                     break
                 self._retries.inc(1, model=self.model_id)
@@ -269,5 +290,7 @@ class RetrainSupervisor:
                         model=self.model_id, attempts=out.attempts,
                         deadline_s=cfg.deadline_s,
                     )
+        if self._heartbeats is not None:
+            self._heartbeats.beat(f"retrain/{self.model_id}")
         self._runs.inc(1, model=self.model_id, status=out.status)
         return out

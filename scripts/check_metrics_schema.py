@@ -121,6 +121,13 @@ REQUIRED_COUNTERS = (
     "scenario_cells_total",
     "scenario_batch_dispatch_total",
     "scenario_column_compile_total",
+    # Deadline plane & hang watchdog (ISSUE 14): stall episodes per
+    # lane, typed deadline rejects by the phase the budget died in, and
+    # graceful-drain outcomes — "nothing ever stalled/expired/drained"
+    # is a recorded 0 on every instrumented run.
+    "watchdog_stalls_total",
+    "serving_deadline_exceeded_total",
+    "drain_total",
 )
 
 _EVENT_FIELDS = (

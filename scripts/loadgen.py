@@ -62,6 +62,12 @@ def main(argv: list[str] | None = None) -> int:
                     help="comma-separated fleet model ids to spread the "
                          "stream across (deterministic per-request "
                          "assignment; default: the daemon's default model)")
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="per-request end-to-end deadline: stamped into "
+                         "every predict header (the server rejects "
+                         "expired requests typed, pre-dispatch), used "
+                         "as the client's retry-backoff cap; expiries "
+                         "are counted, not errors")
     ap.add_argument("--concurrency", type=int, default=8,
                     help="TCP connections for --connect (stdio is one pipe)")
     ap.add_argument("--buckets", default=None,
@@ -97,7 +103,7 @@ def main(argv: list[str] | None = None) -> int:
         try:
             record = loadgen.run_wire(
                 lambda: client, schedule, queries, concurrency=1,
-                close_clients=False,
+                close_clients=False, deadline_ms=args.deadline_ms,
             )
             record["transport"] = "stdio"
             _attach_server_stats(client, record, args.dump_dir)
@@ -114,6 +120,7 @@ def main(argv: list[str] | None = None) -> int:
 
         record = loadgen.run_wire(
             factory, schedule, queries, concurrency=args.concurrency,
+            deadline_ms=args.deadline_ms,
         )
         record["transport"] = "tcp"
         stats_client = factory()
@@ -139,6 +146,10 @@ def _attach_server_stats(client: CateClient, record: dict,
         "close_reasons": stats.get("close_reasons", {}),
         "pad_fraction_mean": stats.get("pad_fraction_mean", 0.0),
         "compile_events_in_window": stats.get("compile_events_in_window"),
+        # The deadline-reject split (ISSUE 14): where — admission /
+        # queue / dispatch — expired budgets died on the server side.
+        "deadline_exceeded": stats.get("deadline_exceeded", {}),
+        "heartbeats": stats.get("heartbeats", {}),
         "slo": stats.get("slo", {}),
         "fleet": stats.get("fleet", {}),
         "shed_burns": stats.get("shed_burns", {}),

@@ -65,6 +65,12 @@ def main(argv: list[str] | None = None) -> int:
                          "$ATE_TPU_SERVE_FUSE or off) — fewer "
                          "executables, masked rows exact zeros, queued "
                          "requests back-fill the masked region")
+    ap.add_argument("--drain-s", type=float, default=None,
+                    help="graceful-drain bound after SIGTERM/`drain` op "
+                         "(default $ATE_TPU_SERVE_DRAIN_S or 30): "
+                         "in-flight work completes and the process "
+                         "exits 0 within the bound; exceeded = forced "
+                         "exit with a drain-timeout event")
     args = ap.parse_args(argv)
 
     from ate_replication_causalml_tpu.serving.coalescer import BucketPlan
@@ -95,10 +101,38 @@ def main(argv: list[str] | None = None) -> int:
         overrides["shed_burn_threshold"] = args.shed_burn
     if args.fuse:
         overrides["fuse_buckets"] = True
+    if args.drain_s is not None:
+        overrides["drain_timeout_s"] = args.drain_s
     config = ServeConfig.from_env(args.checkpoint, **overrides)
 
     server = CateServer(config)
     phases = server.startup()
+
+    # SIGTERM = graceful drain (ISSUE 14): admission rejects new work
+    # typed with retry-after, in-flight batches complete, artifacts
+    # dump, and the process exits 0 — all within --drain-s. A drain
+    # that cannot finish in the bound is a recorded drain-timeout event
+    # and a forced nonzero exit (an orchestrator's SIGKILL should never
+    # be the first signal that the drain wedged).
+    import signal
+    import threading
+
+    def _sigterm(signum, frame):
+        # The handler interrupts the MAIN thread mid-bytecode — which
+        # may be holding lifecycle's (non-reentrant) lock inside the
+        # accept loop's state poll. drain() needs that lock, so running
+        # it here can self-deadlock; hand it to a helper thread.
+        def _do_drain():
+            outcome = server.drain()
+            os._exit(0 if outcome == "drained" else 78)
+
+        threading.Thread(target=_do_drain, name="sigterm-drain",
+                         daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _sigterm)
+    except ValueError:
+        pass  # not the main thread (embedded use) — no signal wiring
     print(
         "# startup: " + " ".join(
             f"{k}={v:.2f}s" for k, v in phases.items()

@@ -1,0 +1,928 @@
+"""Deadline plane, hang watchdog & graceful drain (ISSUE 14).
+
+Three layers, matched to the tier-1 budget:
+
+* the no-jax core — the shared :class:`Budget` type, heartbeat
+  registry + watchdog stall episodes (injectable clock: detection
+  within the bound is asserted against a hand-advanced clock, not
+  sleeps), the ``hang:`` chaos grammar, coalescer expired-waiter
+  semantics, the ``draining`` lifecycle state, and the drain state
+  machine's timeout path driven by an injected clock;
+* the SweepEngine's liveness surface (no jax: fake stages) — graceful
+  drain commits exactly the declared-order prefix, the stall monitor
+  dumps an attributed diagnostic, and ``hang:scope=worker`` stalls are
+  planned == observed with bit-identical results;
+* ONE module-scoped in-process daemon over a synthetic micro forest
+  proving the acceptance criteria end to end: expired requests
+  rejected typed *before* device dispatch in every phase, no
+  expired-only batch ever dispatched, the reject split reconciling
+  with the serving report, an injected dispatcher hang detected by the
+  watchdog (readyz AND healthz flip 503, recovery returns to serving,
+  answers bit-identical to the stall-free reference), and a drain that
+  loses zero in-flight requests — with the module-teardown
+  zero-compile window enforced over all of it.
+
+The @slow subprocess test SIGTERMs a real TCP daemon mid-replay and
+asserts exit 0 within the bound with schema-valid dumped artifacts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ate_replication_causalml_tpu import observability as obs
+from ate_replication_causalml_tpu.resilience import chaos
+from ate_replication_causalml_tpu.resilience.deadline import Budget
+from ate_replication_causalml_tpu.resilience.errors import ChaosSpecError
+from ate_replication_causalml_tpu.resilience.watchdog import (
+    HeartbeatRegistry,
+    Watchdog,
+    lane_bound_s,
+)
+from ate_replication_causalml_tpu.serving.admission import (
+    InvalidTransition,
+    ServingLifecycle,
+)
+from ate_replication_causalml_tpu.serving.coalescer import (
+    BucketPlan,
+    Coalescer,
+    PendingRequest,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "scripts"))
+import check_metrics_schema as cms  # noqa: E402
+
+
+def _counter_delta(family: str, snapshot: dict, label: str | None = None):
+    """Current peek() minus a prior snapshot, optionally filtered to
+    samples containing the ``k=v`` label pair."""
+    now = obs.REGISTRY.peek(family) or {}
+    out: dict[str, float] = {}
+    for k in set(now) | set(snapshot):
+        if label is not None and label not in k.split(","):
+            continue
+        d = now.get(k, 0) - snapshot.get(k, 0)
+        if d:
+            out[k] = d
+    return out
+
+
+def _deadline_phase_counts() -> dict[str, int]:
+    samples = obs.REGISTRY.peek("serving_deadline_exceeded_total") or {}
+    out: dict[str, int] = {}
+    for key, v in samples.items():
+        for pair in key.split(","):
+            if pair.startswith("phase=") and v:
+                out[pair[len("phase="):]] = int(v)
+    return out
+
+
+# ── Budget: the one deadline vocabulary ────────────────────────────────
+
+
+def test_budget_arithmetic_with_injected_clock():
+    t = [0.0]
+    b = Budget.after(2.0, clock=lambda: t[0])
+    assert b.total_s == 2.0
+    assert b.remaining_s() == 2.0 and not b.expired()
+    assert b.affords(1.9) and not b.affords(2.0)  # strict: 2.0 does not fit
+    t[0] = 1.5
+    assert abs(b.remaining_ms() - 500.0) < 1e-9
+    t[0] = 2.0
+    assert b.expired()  # <= 0 remaining IS expired (run_shards edge)
+    t[0] = 3.0
+    assert b.remaining_s() == -1.0
+
+
+def test_budget_from_ms_and_bad_input():
+    t = [10.0]
+    b = Budget.from_ms(250, clock=lambda: t[0])
+    assert abs(b.remaining_s() - 0.25) < 1e-12
+    with pytest.raises(ValueError):
+        Budget.from_ms("soon")
+
+
+# ── watchdog: stall episodes against an injected clock ─────────────────
+
+
+def test_watchdog_detects_within_bound_and_recovers():
+    """THE detection contract, clock-driven: age > bound starts exactly
+    one episode (counter + on_stall), the next beat ends it
+    (on_recover), and a later stall is a NEW episode."""
+    t = [0.0]
+    clock = lambda: t[0]  # noqa: E731
+    hb = HeartbeatRegistry(clock=clock)
+    stalls: list[tuple[str, float]] = []
+    recovers: list[tuple[str, float]] = []
+    wd = Watchdog(
+        hb, {"dispatch": 1.0, "idlelane": 0.0}, clock=clock, poll_s=999.0,
+        on_stall=lambda lane, age: stalls.append((lane, age)),
+        on_recover=lambda lane, s: recovers.append((lane, s)),
+    )
+    before = obs.REGISTRY.peek("watchdog_stalls_total") or {}
+    hb.beat("dispatch")
+    hb.beat("idlelane")  # bound <= 0: unwatched forever
+    t[0] = 1.0
+    assert wd.check() == [] and wd.stalled() == ()  # age == bound: alive
+    t[0] = 1.25
+    assert wd.check() == ["dispatch"]
+    assert wd.is_stalled("dispatch") and not wd.is_stalled("idlelane")
+    assert stalls == [("dispatch", 1.25)]
+    assert wd.check() == [] and stalls == [("dispatch", 1.25)]  # one episode
+    hb.beat("dispatch")  # the lane came back at t=1.25
+    t[0] = 1.5
+    assert wd.check() == [] and wd.stalled() == ()
+    assert recovers == [("dispatch", 0.25)]  # stalled 1.25 -> 1.5... episode
+    t[0] = 3.0
+    assert wd.check() == ["dispatch"]  # a NEW episode
+    delta = _counter_delta("watchdog_stalls_total", before,
+                           label="lane=dispatch")
+    assert sum(delta.values()) == 2
+
+
+def test_watchdog_lane_bound_prefix_and_cleared_lane():
+    t = [0.0]
+    hb = HeartbeatRegistry(clock=lambda: t[0])
+    wd = Watchdog(hb, {"worker": 0.5}, clock=lambda: t[0], poll_s=999.0)
+    hb.beat("worker/sweep-worker-3")  # prefix match: worker/* -> worker
+    t[0] = 1.0
+    assert wd.check() == ["worker/sweep-worker-3"]
+    hb.clear("worker/sweep-worker-3")  # retired lane: episode ends quietly
+    assert wd.check() == [] and wd.stalled() == ()
+
+
+def test_lane_bound_env_parsing(monkeypatch):
+    monkeypatch.setenv("ATE_TPU_WATCHDOG_DISPATCH_S", "2.5")
+    assert lane_bound_s("dispatch", 30.0) == 2.5
+    monkeypatch.delenv("ATE_TPU_WATCHDOG_DISPATCH_S")
+    assert lane_bound_s("dispatch", 30.0) == 30.0
+    monkeypatch.setenv("ATE_TPU_WATCHDOG_LANE_MESH_S", "0")
+    assert lane_bound_s("lane/mesh", 5.0) == 0.0  # /-sanitized env name
+    monkeypatch.setenv("ATE_TPU_WATCHDOG_DISPATCH_S", "soonish")
+    with pytest.raises(ValueError, match="DISPATCH"):
+        lane_bound_s("dispatch", 30.0)
+
+
+# ── hang: chaos scope ──────────────────────────────────────────────────
+
+
+def test_hang_grammar_and_budget():
+    with chaos.override("hang:scope=dispatch,ms=50,p=1.0,seed=3,times=2"
+                        ) as inj:
+        assert inj.hang_delay_s("dispatch", "a") == 0.05
+        assert inj.hang_delay_s("dispatch", "a") == 0.05
+        assert inj.hang_delay_s("dispatch", "a") == 0.0   # times spent
+        assert inj.hang_delay_s("worker", "a") == 0.0     # other lane
+        assert inj.hang_delay_s("dispatch", "b") == 0.05  # own budget
+
+
+def test_hang_selection_is_pure_site_hash():
+    """Planned == observed: selection must match the documented pure
+    hash for every site, independent of call order."""
+    sites = [f"r{i}" for i in range(40)]
+    with chaos.override("hang:scope=worker,ms=10,p=0.3,seed=11") as inj:
+        observed = {s for s in sites if inj.hang_delay_s("worker", s) > 0}
+    planned = {
+        s for s in sites if chaos._unit(11, "hang", "worker", s) < 0.3
+    }
+    assert observed == planned and 0 < len(planned) < len(sites)
+
+
+def test_hang_bad_scope_fails_at_config_time():
+    with pytest.raises(ChaosSpecError, match="hang:scope"):
+        chaos.parse_chaos("hang:scope=bogus,ms=10,p=1")
+    # scope is required: a hang spec that names no lane would inject
+    # nothing while the operator believes stalls are flowing.
+    with pytest.raises(ChaosSpecError, match="required"):
+        chaos.parse_chaos("hang:ms=10,p=1")
+
+
+# ── coalescer: expired waiters ─────────────────────────────────────────
+
+
+def test_expired_waiter_is_harvested_not_batched():
+    t = [0.0]
+    clock = lambda: t[0]  # noqa: E731
+    harvested: list[PendingRequest] = []
+    co = Coalescer(BucketPlan((4,)), window_s=10.0, clock=clock,
+                   on_expired=lambda reqs, now: harvested.extend(reqs))
+    doomed = PendingRequest("doomed", None, 1, 0.0,
+                            budget=Budget(1.0, clock=clock))
+    live = PendingRequest("live", None, 1, 0.0)
+    co.submit(doomed)
+    co.submit(live)
+    t[0] = 2.0
+    assert co.next_batch(timeout=0) is None  # nothing due yet
+    assert harvested == [doomed]
+    assert co.pending_depth() == 1  # live stays queued
+
+
+def test_expired_waiter_does_not_hold_window_open():
+    """The oldest-waiter window must key off the oldest LIVE waiter:
+    an expired head of line is removed before the window math, so it
+    neither forces an early close nor delays the next waiter's own
+    window."""
+    t = [3.2]
+    clock = lambda: t[0]  # noqa: E731
+    harvested: list[PendingRequest] = []
+    co = Coalescer(BucketPlan((4,)), window_s=0.5, clock=clock,
+                   on_expired=lambda reqs, now: harvested.extend(reqs))
+    # enqueued at 0.0 with a budget that died at 1.0 — long expired.
+    stale = PendingRequest("stale", None, 1, 0.0,
+                           budget=Budget(1.0, clock=clock))
+    fresh = PendingRequest("fresh", None, 1, 3.0)
+    co.submit(stale)
+    co.submit(fresh)
+    # At 3.2 the stale waiter's WINDOW (0.0 + 0.5) is long expired; if
+    # it were still consulted the batch would close now and carry it.
+    assert co.next_batch(timeout=0) is None
+    assert harvested == [stale]
+    t[0] = 3.6  # now the fresh waiter's own window (3.0 + 0.5) expires
+    batch = co.next_batch(timeout=0)
+    assert batch is not None and batch.close_reason == "window_expired"
+    assert [r.request_id for r in batch.requests] == ["fresh"]
+
+
+def test_take_fill_skips_expired_waiters():
+    t = [0.0]
+    clock = lambda: t[0]  # noqa: E731
+    co = Coalescer(BucketPlan((4, 16)), window_s=10.0, clock=clock)
+    dead = PendingRequest("dead", None, 2, 0.0, model="m",
+                          budget=Budget(1.0, clock=clock))
+    alive = PendingRequest("alive", None, 2, 0.0, model="m")
+    co.submit(dead)
+    co.submit(alive)
+    t[0] = 2.0
+    got = co.take_fill("m", 10, t[0])
+    assert [r.request_id for r in got] == ["alive"]
+    assert co.pending_depth() == 1  # dead awaits its typed harvest
+
+
+# ── lifecycle: draining ────────────────────────────────────────────────
+
+
+def test_lifecycle_draining_transitions():
+    lc = ServingLifecycle()
+    assert lc.mark_draining()          # legal straight from starting
+    assert not lc.mark_draining()      # one owner
+    assert not lc.can_serve()
+    assert not lc.mark_fault("late")   # faults no longer degrade
+    with pytest.raises(InvalidTransition):
+        lc.mark_ready()                # no way back to serving
+    lc.mark_stopped()
+    assert lc.state == "stopped" and not lc.mark_draining()
+
+    lc2 = ServingLifecycle()
+    lc2.mark_ready()
+    lc2.mark_fault("x")
+    assert lc2.mark_draining()         # degraded daemons drain too
+    assert lc2.state == "draining"
+
+
+def test_drain_state_machine_with_injected_clock():
+    """The tier-1 in-process drive of the drain state machine: clean
+    drain when nothing is in flight; a never-resolving in-flight
+    request trips the bound — recorded outcome, event, stopped state —
+    all without one wall-clock sleep."""
+    from ate_replication_causalml_tpu.serving.daemon import (
+        CateServer,
+        ServeConfig,
+    )
+
+    before = obs.REGISTRY.peek("drain_total") or {}
+    t = [0.0]
+
+    def clock():
+        return t[0]
+
+    def fake_sleep(dt):
+        t[0] += dt
+
+    # Clean path: no in-flight work, drains immediately.
+    srv = CateServer(ServeConfig(checkpoint="unused.npz",
+                                 watchdog_dispatch_s=0.0))
+    assert srv.drain(timeout_s=0.5, clock=clock, sleep=fake_sleep) == \
+        "drained"
+    assert srv.lifecycle.state == "stopped"
+    assert srv.drain() == "drained"  # idempotent
+
+    # Timeout path: one admitted request that never resolves.
+    srv2 = CateServer(ServeConfig(checkpoint="unused.npz",
+                                  watchdog_dispatch_s=0.0))
+    assert srv2.admission.try_admit()
+    t[0] = 0.0
+    assert srv2.drain(timeout_s=0.25, clock=clock, sleep=fake_sleep) == \
+        "timeout"
+    assert srv2.lifecycle.state == "stopped"
+    delta = _counter_delta("drain_total", before)
+    assert delta.get("outcome=drained") == 1
+    assert delta.get("outcome=timeout") == 1
+    names = [r["name"] for r in obs.EVENTS.records()]
+    assert "serving_drain_timeout" in names
+
+
+def test_concurrent_drain_waits_for_owner_outcome():
+    """A second drain caller (SIGTERM landing while a wire `drain` op
+    is in flight) must BLOCK for the owning drain's real outcome —
+    being told "drained" mid-drain would let the signal handler
+    os._exit(0) and drop the in-flight work."""
+    from ate_replication_causalml_tpu.serving.daemon import (
+        CateServer,
+        ServeConfig,
+    )
+
+    srv = CateServer(ServeConfig(checkpoint="unused.npz",
+                                 watchdog_dispatch_s=0.0))
+    assert srv.admission.try_admit()  # in-flight work that never resolves
+    outcome: dict = {}
+    owner = threading.Thread(
+        target=lambda: outcome.update(owner=srv.drain(timeout_s=0.3))
+    )
+    owner.start()
+    deadline = time.monotonic() + 2.0
+    while srv.lifecycle.state != "draining":
+        assert time.monotonic() < deadline, "owner never started draining"
+        time.sleep(0.002)
+    t0 = time.monotonic()
+    follower = srv.drain(timeout_s=0.3)
+    owner.join(5)
+    assert outcome["owner"] == "timeout"
+    assert follower == "timeout"  # the OWNER's outcome, not "drained"
+    assert time.monotonic() - t0 > 0.05  # it actually waited
+    # ...and once the drain has fully finished, repeat callers get the
+    # recorded outcome immediately.
+    assert srv.drain() == "timeout"
+
+
+# ── SweepEngine: drain, stall diagnostic, hang:worker ──────────────────
+
+
+def _fake_stages(track, gates=None, n=5):
+    from ate_replication_causalml_tpu.scheduler import StageSpec
+
+    def mk(i):
+        def run(c):
+            track.append(f"enter s{i}")
+            if gates is not None and f"s{i}" in gates:
+                gates[f"s{i}"].wait(timeout=30)
+            return i
+
+        return StageSpec(f"s{i}", run=run, needs=())
+
+    return [mk(i) for i in range(n)]
+
+
+def test_engine_drain_commits_declared_prefix_and_returns():
+    """request_drain(): in-flight nodes FINISH and commit in declared
+    order; nothing new starts; run() returns the partial results
+    without raising — the journal prefix a cell-exact resume needs."""
+    from ate_replication_causalml_tpu.scheduler import SweepEngine
+
+    track: list[str] = []
+    gates = {"s0": threading.Event(), "s1": threading.Event()}
+    stages = _fake_stages(track, gates)
+    committed: list[str] = []
+    engine = SweepEngine(
+        [], stages, commit=lambda s, v: committed.append(s.name),
+        workers=2, prefetch=False,
+    )
+    out: dict = {}
+    runner = threading.Thread(
+        target=lambda: out.update(results=engine.run())
+    )
+    runner.start()
+    deadline = time.monotonic() + 10
+    while len(track) < 2 and time.monotonic() < deadline:
+        time.sleep(0.002)
+    assert track[:2] == ["enter s0", "enter s1"]
+    engine.request_drain()
+    assert engine.draining
+    gates["s0"].set()
+    gates["s1"].set()
+    runner.join(10)
+    assert not runner.is_alive()
+    # Only the two in-flight stages ran; their commits flushed in
+    # declared order; s2..s4 never started.
+    assert out["results"] == {"s0": 0, "s1": 1}
+    assert committed == ["s0", "s1"]
+    assert track == ["enter s0", "enter s1"]
+    names = [r["name"] for r in obs.EVENTS.records()]
+    assert "scheduler_drain" in names
+
+
+def test_engine_stall_diagnostic_is_attributed():
+    """Ready nodes + no completion within the bound ⇒ ONE
+    scheduler_stall event carrying the would-be critical path, held
+    lanes and per-lane heartbeat ages, plus a watchdog_stalls_total
+    sample — then the run completes normally once unwedged."""
+    from ate_replication_causalml_tpu.scheduler import (
+        ArtifactSpec,
+        StageSpec,
+        SweepEngine,
+    )
+
+    before = obs.REGISTRY.peek("watchdog_stalls_total") or {}
+    track: list[str] = []
+    stages = _fake_stages(track, None, n=3)
+    # The wedge sits in an ARTIFACT s0 consumes, so the diagnostic's
+    # critical path must walk the dependency chain (a0 -> s0), not
+    # just name the stuck node.
+    gate_a0 = threading.Event()
+
+    def fit_a0(c):
+        gate_a0.wait(timeout=30)
+        return 0
+
+    stages[0] = StageSpec("s0", run=stages[0].run, needs=("a0",))
+    engine = SweepEngine([ArtifactSpec("a0", fit=fit_a0)], stages,
+                         workers=1, prefetch=False, stall_bound_s=0.05)
+    out: dict = {}
+    runner = threading.Thread(
+        target=lambda: out.update(results=engine.run())
+    )
+    runner.start()
+    deadline = time.monotonic() + 10
+    stalled = False
+    while time.monotonic() < deadline and not stalled:
+        stalled = any(
+            r["name"] == "scheduler_stall" for r in obs.EVENTS.records()
+        )
+        time.sleep(0.005)
+    # Inspect the live diagnostic while wedged, then release.
+    diag = engine.stall_diagnostic()
+    gate_a0.set()
+    runner.join(10)
+    assert not runner.is_alive()
+    assert stalled, "stall monitor never fired"
+    assert out["results"] == {"s0": 0, "s1": 1, "s2": 2}
+    assert diag["started_unfinished"] == ["a0"]
+    # The would-be critical path walks the dependency chain through
+    # the wedged artifact to its consumer.
+    assert diag["critical_path"] == ["a0", "s0"]
+    assert any(
+        lane.startswith("worker/") for lane in diag["heartbeat_ages"]
+    )
+    ev = [r for r in obs.EVENTS.records()
+          if r["name"] == "scheduler_stall"]
+    assert len(ev) == 1  # once per episode
+    attrs = ev[-1]["attrs"]
+    assert "a0" in attrs["started_unfinished"]
+    assert attrs["critical_path"] == "a0,s0"
+    assert float(attrs["since_s"]) > 0.05
+    delta = _counter_delta("watchdog_stalls_total", before,
+                           label="lane=sweep")
+    assert sum(delta.values()) == 1
+
+
+def test_engine_hang_chaos_planned_equals_observed():
+    """hang:scope=worker stalls the selected nodes' bodies — nothing
+    raises, results identical to the stall-free run, injections
+    audited as chaos_inject events."""
+    from ate_replication_causalml_tpu.scheduler import SweepEngine
+
+    track: list[str] = []
+    stages = _fake_stages(track, n=4)
+    with chaos.override("hang:scope=worker,ms=20,p=0.5,seed=7") as inj:
+        assert inj is not None
+        results = SweepEngine([], stages, workers=2, prefetch=False).run()
+    assert results == {f"s{i}": i for i in range(4)}
+    planned = {
+        f"s{i}" for i in range(4)
+        if chaos._unit(7, "hang", "worker", f"s{i}") < 0.5
+    }
+    observed = {
+        r["attrs"]["site"].split("/", 1)[1]
+        for r in obs.EVENTS.records()
+        if r["name"] == "chaos_inject" and r["attrs"].get("scope") == "hang"
+        and r["attrs"]["site"].startswith("worker/s")
+    }
+    assert planned == observed and planned  # seed 7 selects some of 4
+
+
+# ── the in-process daemon rig (micro synthetic forest) ─────────────────
+
+
+def _synthetic_forest(rng):
+    import jax.numpy as jnp
+
+    from ate_replication_causalml_tpu.models.causal_forest import CausalForest
+
+    T, D, n, p, nb = 8, 3, 50, 4, 8
+    return CausalForest(
+        split_feat=jnp.asarray(
+            rng.integers(0, p, size=(T, D, 1 << D)).astype(np.int32)
+        ),
+        split_bin=jnp.asarray(
+            rng.integers(0, nb - 1, size=(T, D, 1 << D)).astype(np.int32)
+        ),
+        leaf_stats=jnp.asarray(
+            (np.abs(rng.normal(size=(T, 1 << D, 5))) + 0.5).astype(np.float32)
+        ),
+        in_sample=jnp.asarray(rng.uniform(size=(T, n)) < 0.5),
+        bin_edges=jnp.asarray(
+            np.sort(rng.normal(size=(p, nb - 1)), axis=1).astype(np.float32)
+        ),
+        ci_group_size=2,
+    )
+
+
+@pytest.fixture(scope="module")
+def deadline_rig(tmp_path_factory):
+    """ONE daemon with the full ISSUE 14 plane armed: tight watchdog
+    bound (80 ms; the dispatcher's idle block auto-shrinks under it),
+    fast poll, small coalescing window. The offline reference is traced
+    BEFORE startup so the no-compile window stays clean; teardown
+    stop() enforces it over every stall, recovery and drain this module
+    performs."""
+    import jax.numpy as jnp
+
+    from ate_replication_causalml_tpu.models.causal_forest import predict_cate
+    from ate_replication_causalml_tpu.serving.daemon import (
+        CateServer,
+        ServeConfig,
+    )
+    from ate_replication_causalml_tpu.utils.checkpoint import save_fitted
+
+    rng = np.random.default_rng(14)
+    forest = _synthetic_forest(rng)
+    ckpt = str(tmp_path_factory.mktemp("deadline") / "forest.npz")
+    save_fitted(ckpt, forest)
+
+    sizes = [1, 2, 3, 4]
+    xs = [
+        rng.normal(size=(sizes[i % len(sizes)], 4)).astype(np.float32)
+        for i in range(24)
+    ]
+    off = predict_cate(
+        forest, jnp.asarray(np.concatenate(xs)), oob=False,
+        row_backend="matmul",
+    )
+    offline = (np.asarray(off.cate), np.asarray(off.variance))
+
+    server = CateServer(ServeConfig(
+        checkpoint=ckpt,
+        buckets=BucketPlan.parse("4,16"),
+        window_s=0.004,
+        max_depth=32,
+        retry_after_s=0.002,
+        watchdog_dispatch_s=0.08,
+        watchdog_poll_s=0.01,
+        drain_timeout_s=10.0,
+    ))
+    server.startup()
+    yield dict(server=server, xs=xs, offline=offline, ckpt=ckpt)
+    # Idempotent after the drain test; still the zero-compile proof for
+    # everything this module did when reached first.
+    server.stop()
+
+
+def _offline_slice(rig, i):
+    offc, offv = rig["offline"]
+    start = sum(x.shape[0] for x in rig["xs"][:i])
+    rows = rig["xs"][i].shape[0]
+    return offc[start:start + rows], offv[start:start + rows]
+
+
+def _wait_for(predicate, timeout_s=5.0, step=0.005):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(step)
+    return predicate()
+
+
+def test_deadline_expired_at_admission_rejected_typed(deadline_rig):
+    from ate_replication_causalml_tpu.serving.daemon import RejectedRequest
+
+    server = deadline_rig["server"]
+    before = dict(_deadline_phase_counts())
+    with pytest.raises(RejectedRequest, match="deadline_exceeded") as ei:
+        server.serve_one("adm0", deadline_rig["xs"][0], deadline_ms=0.0)
+    assert ei.value.retry_after_s is not None  # retryable, typed
+    after = _deadline_phase_counts()
+    assert after.get("admission", 0) == before.get("admission", 0) + 1
+    # ...and a well-budgeted request on the same rig still serves,
+    # bit-identical to the offline reference.
+    cate, var = server.serve_one("adm1", deadline_rig["xs"][1],
+                                 deadline_ms=5000.0)
+    offc, offv = _offline_slice(deadline_rig, 1)
+    assert np.array_equal(cate, offc) and np.array_equal(var, offv)
+
+
+def test_deadline_expires_in_queue_before_any_dispatch(deadline_rig):
+    """A budget smaller than the coalescing window dies IN QUEUE: the
+    harvest rejects it typed (phase=queue) and no batch is ever
+    dispatched for it."""
+    from ate_replication_causalml_tpu.serving.daemon import RejectedRequest
+
+    server = deadline_rig["server"]
+    before_phases = dict(_deadline_phase_counts())
+    before_batches = obs.REGISTRY.peek("serving_batches_total") or {}
+    req = server.submit("q0", deadline_rig["xs"][2], deadline_ms=1.0)
+    assert req.wait(5.0)
+    assert isinstance(req.error, RejectedRequest)
+    assert req.error.code == "deadline_exceeded"
+    after = _deadline_phase_counts()
+    assert after.get("queue", 0) == before_phases.get("queue", 0) + 1
+    assert (obs.REGISTRY.peek("serving_batches_total") or {}) == \
+        before_batches  # nothing dispatched
+    # serve_request surfaces the SAME typed reject (no double count).
+    with pytest.raises(RejectedRequest, match="deadline_exceeded"):
+        server.serve_request("q1", deadline_rig["xs"][2], deadline_ms=1.0)
+
+
+def test_dispatcher_hang_detected_degraded_recovered(deadline_rig):
+    """THE watchdog acceptance: an injected dispatcher stall is
+    detected within the bound, readyz AND healthz flip 503, the stalled
+    request still serves bit-identically once the stall ends, and the
+    daemon returns to serving."""
+    from ate_replication_causalml_tpu.serving.admin import handle_admin_path
+
+    server = deadline_rig["server"]
+    stall_before = obs.REGISTRY.peek("watchdog_stalls_total") or {}
+    with chaos.override("hang:scope=dispatch,ms=500,p=1.0,times=1"):
+        req = server.submit("hang0", deadline_rig["xs"][3])
+        # Detection: the dispatcher heartbeat goes stale inside the
+        # hang; the watchdog (bound 80 ms, poll 10 ms) flips the daemon
+        # to degraded — visible on BOTH probes.
+        assert _wait_for(
+            lambda: handle_admin_path(server, "/readyz")[0] == 503,
+            timeout_s=3.0,
+        ), "readyz never flipped during the injected stall"
+        assert handle_admin_path(server, "/healthz")[0] == 503
+        assert "dispatch" in server.stalled_lanes()
+        body = json.loads(handle_admin_path(server, "/healthz")[2])
+        assert body["stalled_lanes"] == ["dispatch"]
+        assert body["heartbeats"]["dispatch"] > 0.08
+        # The stalled batch completes after the hang; the answer is
+        # bit-identical — a stall delays, it never corrupts.
+        assert req.wait(10.0) and req.error is None
+        offc, offv = _offline_slice(deadline_rig, 3)
+        assert np.array_equal(req.result[0], offc)
+        assert np.array_equal(req.result[1], offv)
+    # Recovery: heartbeat resumed + verified reload => serving again,
+    # probes green, stall episode closed.
+    assert _wait_for(lambda: server.lifecycle.state == "serving",
+                     timeout_s=5.0)
+    assert _wait_for(lambda: not server.stalled_lanes(), timeout_s=5.0)
+    assert handle_admin_path(server, "/readyz")[0] == 200
+    assert handle_admin_path(server, "/healthz")[0] == 200
+    delta = _counter_delta("watchdog_stalls_total", stall_before,
+                           label="lane=dispatch")
+    assert sum(delta.values()) == 1  # planned == observed episodes
+    # Post-recovery service is bit-identical (zero-compile is enforced
+    # by the module teardown over all of this).
+    cate, var = server.serve_one("hang1", deadline_rig["xs"][4],
+                                 deadline_ms=5000.0)
+    offc, offv = _offline_slice(deadline_rig, 4)
+    assert np.array_equal(cate, offc) and np.array_equal(var, offv)
+
+
+def test_overload_expires_every_budgeted_request_predispatch(
+        deadline_rig, tmp_path):
+    """The overload acceptance: with the dispatcher wedged and finite
+    deadlines, EVERY budgeted request is rejected typed before device
+    dispatch, no expired-only batch dispatches, and the phase counters
+    reconcile with the serving report's reject split."""
+    from ate_replication_causalml_tpu.serving.daemon import RejectedRequest
+
+    server = deadline_rig["server"]
+    assert _wait_for(lambda: server.lifecycle.state == "serving", 5.0)
+    before_phases = dict(_deadline_phase_counts())
+    before_batches = sum(
+        (obs.REGISTRY.peek("serving_batches_total") or {}).values()
+    )
+    with chaos.override("hang:scope=dispatch,ms=400,p=1.0,times=1"):
+        blocker = server.submit("ovl_block", deadline_rig["xs"][5])
+        # Let the blocker's batch CLOSE (and the dispatcher pick it up
+        # into the injected hang) before offering the budgeted load —
+        # otherwise they would coalesce into the same pre-hang batch.
+        assert _wait_for(
+            lambda: blocker.batch_closed_mono is not None, 5.0
+        )
+        time.sleep(0.02)  # close -> pickup -> hang entry is microseconds
+        # While the blocker's batch hangs on the device, budgeted
+        # requests pile into the queue and die there.
+        doomed = [
+            server.submit(f"ovl{i}", deadline_rig["xs"][6 + i],
+                          deadline_ms=60.0)
+            for i in range(5)
+        ]
+        assert blocker.wait(10.0) and blocker.error is None
+        for req in doomed:
+            assert req.wait(10.0)
+            assert isinstance(req.error, RejectedRequest), req.error
+            assert req.error.code == "deadline_exceeded"
+    assert _wait_for(lambda: server.lifecycle.state == "serving", 5.0)
+    after_phases = _deadline_phase_counts()
+    expired_delta = {
+        ph: after_phases.get(ph, 0) - before_phases.get(ph, 0)
+        for ph in set(after_phases) | set(before_phases)
+    }
+    assert sum(expired_delta.values()) == 5
+    assert set(k for k, v in expired_delta.items() if v) <= \
+        {"queue", "dispatch"}
+    # Exactly ONE batch (the blocker's) dispatched — never one made
+    # only of expired requests.
+    after_batches = sum(
+        (obs.REGISTRY.peek("serving_batches_total") or {}).values()
+    )
+    assert after_batches == before_batches + 1
+    # Reconciliation: the serving report's reject-by-reason count for
+    # deadline_exceeded equals the counter's phase sum (both cover the
+    # daemon's whole window).
+    outdir = str(tmp_path / "dump")
+    paths = server.dump_artifacts(outdir)
+    report_path = os.path.join(outdir, "serving_report.json")
+    assert report_path in paths
+    with open(report_path) as f:
+        report = json.load(f)
+    assert report["rejects"]["by_reason"].get("deadline_exceeded", 0) == \
+        sum(_deadline_phase_counts().values())
+
+
+def test_client_stamps_and_enforces_deadline_over_wire(deadline_rig):
+    """The client side of the contract: ``deadline_ms`` rides the
+    predict header (server checks it), ``deadline_exceeded`` is
+    retried only while budget remains, and an exhausted budget raises
+    typed — all over the real wire protocol."""
+    import socket as socketlib
+
+    from ate_replication_causalml_tpu.serving.client import (
+        CateClient,
+        ServingUnavailable,
+    )
+    from ate_replication_causalml_tpu.serving.daemon import serve_stream
+
+    server = deadline_rig["server"]
+    assert _wait_for(lambda: server.lifecycle.state == "serving", 5.0)
+    a, b = socketlib.socketpair()
+    rw_server = a.makefile("rwb")
+    t = threading.Thread(
+        target=lambda: serve_stream(server, rw_server, rw_server),
+        daemon=True,
+    )
+    t.start()
+    rw = b.makefile("rwb")
+    client = CateClient(rw, rw)
+    try:
+        # A generous budget serves bit-identically, header stamped.
+        cate, var, header = client.predict_full(
+            deadline_rig["xs"][18], request_id="wire_ok",
+            deadline_ms=10_000.0,
+        )
+        offc, offv = _offline_slice(deadline_rig, 18)
+        assert np.array_equal(cate, offc) and np.array_equal(var, offv)
+        assert header["ok"]
+        # A budget smaller than the coalescing window dies server-side
+        # (typed, retryable); the client's retries exhaust the budget
+        # and surface the typed terminal. xs[16] is a 1-row query, so
+        # its batch can only close via the (longer) window — the
+        # budget reliably dies in queue first.
+        with pytest.raises(ServingUnavailable, match="deadline_exceeded"):
+            client.predict(
+                deadline_rig["xs"][16], request_id="wire_dead",
+                deadline_ms=1.0, max_retries=4,
+            )
+        assert client.retry_counts.get("deadline_exceeded", 0) >= 1
+    finally:
+        try:
+            rw.close()
+        except OSError:
+            pass
+        b.close()
+        a.close()
+        t.join(5)
+
+
+def test_drain_under_load_loses_zero_inflight(deadline_rig):
+    """LAST on the rig (drain is terminal): requests already admitted
+    when the drain starts ALL complete bit-identically, new admissions
+    are rejected typed, artifacts would dump, and the daemon stops
+    clean within the bound."""
+    from ate_replication_causalml_tpu.serving.daemon import RejectedRequest
+
+    server = deadline_rig["server"]
+    assert _wait_for(lambda: server.lifecycle.state == "serving", 5.0)
+    assert server.compile_events_in_window() == 0.0
+    before = obs.REGISTRY.peek("drain_total") or {}
+    inflight = [
+        server.submit(f"dr{i}", deadline_rig["xs"][12 + i])
+        for i in range(6)
+    ]
+    outcome = server.drain()
+    assert outcome == "drained"
+    assert server.lifecycle.state == "stopped"
+    for i, req in enumerate(inflight):
+        assert req.wait(1.0) and req.error is None, req.error
+        offc, offv = _offline_slice(deadline_rig, 12 + i)
+        assert np.array_equal(req.result[0], offc)
+        assert np.array_equal(req.result[1], offv)
+    delta = _counter_delta("drain_total", before)
+    assert delta.get("outcome=drained") == 1 and "outcome=timeout" not in delta
+    with pytest.raises(RejectedRequest, match="stopped"):
+        server.submit("late", deadline_rig["xs"][0])
+    names = [r["name"] for r in obs.EVENTS.records()]
+    assert "serving_drained" in names
+
+
+# ── subprocess drain-under-load (@slow) ────────────────────────────────
+
+
+@pytest.mark.slow
+def test_sigterm_drains_tcp_daemon_cleanly(tmp_path):
+    """SIGTERM a real TCP daemon mid-replay: exit code 0 within the
+    bound, every accepted request answered (ok or typed draining
+    reject — never a torn reply), and the dumped artifact set is
+    schema-valid including the drain counter."""
+    from ate_replication_causalml_tpu.serving.client import (
+        CateClient,
+        ServingError,
+    )
+    from ate_replication_causalml_tpu.utils.checkpoint import save_fitted
+
+    rng = np.random.default_rng(7)
+    forest = _synthetic_forest(rng)
+    ckpt = str(tmp_path / "forest.npz")
+    save_fitted(ckpt, forest)
+    outdir = str(tmp_path / "artifacts")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               ATE_TPU_METRICS_DIR=outdir)
+    env.pop("ATE_TPU_CHAOS", None)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(_REPO, "scripts", "serve.py"),
+         "--checkpoint", ckpt, "--port", "0", "--buckets", "2,4",
+         "--window-ms", "2", "--drain-s", "20"],
+        stderr=subprocess.PIPE, env=env, text=True,
+    )
+    try:
+        port = None
+        for line in proc.stderr:
+            if line.startswith("# serving on"):
+                port = int(line.rsplit(":", 1)[1])
+                break
+        assert port, "daemon never announced its port"
+        # Drain stderr in the background so the child never blocks on a
+        # full pipe.
+        drainer = threading.Thread(
+            target=lambda: proc.stderr.read(), daemon=True)
+        drainer.start()
+
+        served: list[str] = []
+        rejected: list[str] = []
+        torn: list[str] = []
+
+        def replay():
+            client = CateClient.connect("127.0.0.1", port)
+            for i in range(200):
+                x = rng.normal(size=(2, 4)).astype(np.float32)
+                try:
+                    client.predict(x, request_id=f"w{i}", max_retries=2)
+                    served.append(f"w{i}")
+                except ServingError as e:
+                    if e.code in ("draining", "stopped", "closed"):
+                        rejected.append(f"w{i}")
+                        return  # daemon is going away — stop offering
+                    torn.append(f"{e.code}: {e}")
+                    return
+                except Exception as e:  # noqa: BLE001
+                    torn.append(repr(e))
+                    return
+                time.sleep(0.005)
+
+        t = threading.Thread(target=replay)
+        t.start()
+        deadline = time.monotonic() + 10
+        while len(served) < 10 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(served) >= 10, "replay never got going"
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30)
+        t.join(15)
+        assert rc == 0, f"drain exit code {rc}"
+        # Every request either served or got a TYPED going-away answer;
+        # none died mid-frame with a garbled reply.
+        assert torn == [], torn
+        # The artifact set dumped on the way out and validates.
+        mpath = os.path.join(outdir, "metrics.json")
+        assert os.path.exists(mpath)
+        with open(mpath) as f:
+            snap = json.load(f)
+        assert cms.validate_metrics(snap) == []
+        drains = snap["counters"]["drain_total"]
+        assert drains.get("outcome=drained") == 1
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(10)

@@ -1052,6 +1052,64 @@ def test_jgl011_quiet_on_sanctioned_forms_and_grow_fns():
     assert [f.line for f in res.suppressed] == [5]
 
 
+# --------------------------------------------------------------- JGL012
+
+
+JGL012_BAD = """\
+import queue
+import threading
+
+def dispatcher_loop(lock, cond, q, t):
+    lock.acquire()                          # line 5: unbounded acquire
+    cond.wait()                             # line 6: unbounded wait
+    item = q.get()                          # line 7: unbounded get
+    t.join()                                # line 8: unbounded join
+    return item
+"""
+
+JGL012_GOOD = """\
+import queue
+
+def dispatcher_loop(lock, cond, q, t, opts):
+    lock.acquire(True, 0.5)        # bounded: positional timeout
+    cond.wait(0.5)                 # bounded: positional timeout
+    item = q.get(timeout=0.25)     # bounded: timeout kwarg
+    t.join(1.0)                    # bounded join
+    lock.acquire(blocking=False)   # non-blocking kwarg form: never waits
+    q.get(block=False)             # non-blocking kwarg form: never waits
+    v = opts.get("k")              # dict.get has args: out of scope
+    return item, v
+"""
+
+
+def test_jgl012_fires_in_liveness_lanes_only():
+    """ISSUE 14: a lane blocked forever outside its heartbeat-stamped
+    sites is invisible to the watchdog — the rule bans the zero-arg
+    blocking forms in serving/, scheduler/ and the watchdog itself."""
+    for rel in ("pkg/serving/daemon.py", "pkg/scheduler/engine.py",
+                "pkg/resilience/watchdog.py"):
+        assert _lines(JGL012_BAD, "JGL012", relpath=rel) == [5, 6, 7, 8]
+    # outside the liveness lanes the rule is silent
+    assert _lines(JGL012_BAD, "JGL012", relpath="pkg/pipeline.py") == []
+    assert _lines(
+        JGL012_BAD, "JGL012", relpath="pkg/resilience/chaos.py"
+    ) == []
+
+
+def test_jgl012_quiet_on_bounded_forms_and_suppression():
+    assert _lines(
+        JGL012_GOOD, "JGL012", relpath="pkg/serving/coalescer.py"
+    ) == []
+    src = JGL012_BAD.replace(
+        "    cond.wait()                             # line 6: unbounded wait",
+        "    cond.wait()  # graftlint: disable=JGL012",
+    )
+    res = lint_source(src, relpath="pkg/serving/daemon.py",
+                      select=["JGL012"])
+    assert [f.line for f in res.findings] == [5, 7, 8]
+    assert [f.line for f in res.suppressed] == [6]
+
+
 # ----------------------------------------------------- suppressions etc.
 
 

@@ -402,6 +402,16 @@ class _StubServer:
     def __init__(self):
         self.lifecycle = ServingLifecycle()
         self.slo = _StubSLO()
+        #: liveness surface (ISSUE 14): per-lane heartbeat ages + the
+        #: watchdog's stall verdict, both reflected in /healthz.
+        self.ages = {"dispatch": 0.01}
+        self.stalled = ()
+
+    def heartbeat_ages(self):
+        return dict(self.ages)
+
+    def stalled_lanes(self):
+        return tuple(self.stalled)
 
     def compile_events_in_window(self):
         return 0.0
@@ -461,6 +471,20 @@ def test_admin_handlers_flip_with_lifecycle():
     payload = jsonlib.loads(body)
     assert status == 200 and payload["state"] == "degraded"
     assert "slo" in payload
+    # Liveness detail (ISSUE 14): the body carries per-lane heartbeat
+    # ages, and a STALLED dispatcher flips healthz to 503 even though
+    # the process (and its lifecycle) look alive — the pre-watchdog
+    # 200-while-wedged was the black-hole failure mode. readyz keeps
+    # its lifecycle-only semantics throughout.
+    assert payload["heartbeats"] == {"dispatch": 0.01}
+    assert payload["stalled_lanes"] == []
+    stub.stalled = ("dispatch",)
+    status, body = _admin_http_get(stub, "/healthz")
+    assert status == 503
+    assert jsonlib.loads(body)["stalled_lanes"] == ["dispatch"]
+    assert _admin_http_get(stub, "/readyz")[0] == 503  # still lifecycle
+    stub.stalled = ()
+    assert _admin_http_get(stub, "/healthz")[0] == 200
 
     stub.lifecycle.mark_recovered()
     assert _admin_http_get(stub, "/readyz")[0] == 200
