@@ -1402,3 +1402,117 @@ class UnboundedBlockingCall(Rule):
                 "a timeout and re-check in a loop "
                 "(resilience/watchdog.py is the liveness contract)",
             )
+
+
+# ---------------------------------------------------------------- JGL013
+
+#: chaos-injection entry points and WHICH of their arguments is a site
+#: id: {method attr: ((positional index, keyword name), ...)}. The
+#: indexes are post-self (call-site view). ``attempt`` counters passed
+#: as non-site args (shard_should_fail's third parameter) are the
+#: CONSUMER of per-attempt state, not a site — only the listed args
+#: must be stable.
+_CHAOS_SITE_ARGS: dict[str, tuple[tuple[int, str], ...]] = {
+    "shard_should_fail": ((0, "pool"), (1, "shard")),
+    "take_serve_fault": ((0, "request_id"),),
+    "take_stage_fault": ((0, "method"),),
+    "maybe_fail_stage": ((0, "method"),),
+    "hang_delay_s": ((1, "site"),),
+    "take_rotate_fault": ((1, "site"),),
+    "rotate_verify_delay_s": ((0, "site"),),
+    "torn_line": ((1, "site"),),
+    "truncate_npz": ((1, "site"),),
+    "tamper_line": ((1, "site"),),
+}
+
+#: calls whose value differs every invocation — a site id derived from
+#: one can never reproduce, so planned == observed breaks silently.
+_UNSTABLE_SITE_CALLS = {
+    "id",
+    "time.time", "time.monotonic", "time.perf_counter",
+    "time.time_ns", "time.monotonic_ns", "time.perf_counter_ns",
+    "uuid.uuid4", "uuid.uuid1",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+}
+
+#: names that smell like per-attempt / per-call counters. Deliberately
+#: narrow — ``request_id`` / ``node_id`` style SITE names must never
+#: match.
+_ATTEMPTISH_NAME_RE = re.compile(
+    r"^(attempt|attempts|attempt_no|n_attempts|counter|counters|"
+    r"tries|n_tries|retry_count|retries|seq|seqno|seq_no|nonce)$"
+    r"|_(attempt|attempts|counter|seqno|nonce)$"
+)
+
+
+@register
+class UnstableChaosSite(Rule):
+    """ISSUE 15's composability contract (the PR 14 gotcha as code, not
+    prose): chaos selection is the pure hash ``(seed, scope, site)``,
+    so *planned == observed* — the property every chaos test and the
+    whole campaign engine (``resilience/campaign.py``) asserts — holds
+    ONLY while site ids are stable across runs and retries. A site id
+    derived from the wall clock, an object identity (``id(batch)``), or
+    a per-attempt counter gives every invocation a fresh hash: the
+    ``times`` budget never converges, a retrying client never gets
+    served, and the campaign's fault accounting silently diverges from
+    the plan. The injector methods' site arguments must be
+    client-stable names (request ids, node names, model ids, paths)."""
+
+    id = "JGL013"
+    name = "unstable-chaos-site"
+    description = (
+        "chaos-injection site id derived from wall clock, object id or "
+        "a per-attempt counter — selection hashes the site, so "
+        "planned == observed breaks"
+    )
+
+    def _site_args(self, node: ast.Call,
+                   spec: tuple[tuple[int, str], ...]) -> list[ast.expr]:
+        out = []
+        for pos, kw in spec:
+            if len(node.args) > pos:
+                out.append(node.args[pos])
+            for k in node.keywords:
+                if k.arg == kw:
+                    out.append(k.value)
+        return out
+
+    def _unstable_part(self, module: ModuleInfo,
+                       expr: ast.expr) -> str | None:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                name = module.resolve(sub.func)
+                if name in _UNSTABLE_SITE_CALLS:
+                    return f"{name}()"
+            elif isinstance(sub, ast.Name):
+                if _ATTEMPTISH_NAME_RE.search(sub.id):
+                    return sub.id
+            elif isinstance(sub, ast.Attribute):
+                if _ATTEMPTISH_NAME_RE.search(sub.attr):
+                    return sub.attr
+        return None
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            spec = _CHAOS_SITE_ARGS.get(func.attr)
+            if spec is None:
+                continue
+            for arg in self._site_args(node, spec):
+                culprit = self._unstable_part(module, arg)
+                if culprit is not None:
+                    yield self.finding(
+                        module,
+                        node,
+                        f"chaos site id for .{func.attr}() derives from "
+                        f"{culprit} — selection is a pure hash of the "
+                        "site, so an unstable id breaks planned == "
+                        "observed and the times-budget convergence; use "
+                        "a client-stable id (request id, node name, "
+                        "model id, path)",
+                    )

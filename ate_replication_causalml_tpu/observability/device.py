@@ -209,6 +209,16 @@ def install_jax_monitoring() -> bool:
     counter("scenario_column_compile_total",
             "scenario column executables AOT-compiled, by column and kind"
             ).inc(0)
+    # Chaos campaign families (ISSUE 15): episode outcomes per workload
+    # and invariant verdicts — "no campaign ever ran" is a recorded 0,
+    # and a nonzero {status=violated} after a campaign is the
+    # machine-checkable headline the report's repro line expands.
+    counter("chaos_campaign_episodes_total",
+            "chaos-campaign episodes by workload and green/violated status"
+            ).inc(0)
+    counter("chaos_invariant_checks_total",
+            "campaign invariant evaluations by invariant and verdict"
+            ).inc(0)
     if _installed:
         return True
     try:

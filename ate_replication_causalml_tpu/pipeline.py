@@ -276,12 +276,25 @@ class _Checkpoint:
                 line = json.dumps(rec) + "\n"
                 inj = chaos.active()
                 if inj is not None:
-                    # fs:torn_write chaos: persist this row torn, the
-                    # way a kill mid-append would. The in-memory copy
-                    # above keeps the CURRENT run correct; the reader's
-                    # torn-line skip + recompute-on-resume is the path
-                    # under test.
-                    line = inj.torn_line(line, site=self.path)
+                    # tamper:journal chaos first (ISSUE 15): persist a
+                    # VALID line carrying a silently wrong ate — the
+                    # corruption only the campaign's bit-identity
+                    # invariant can catch. A tampered row is NEVER also
+                    # torn: tearing it would drop the row the reader
+                    # skips anyway, erasing the planted corruption while
+                    # its injection stays recorded — a tamper the
+                    # registry can no longer detect. The torn_write
+                    # budget keeps for the next (untampered) append.
+                    # fs:torn_write otherwise persists this row torn,
+                    # the way a kill mid-append would. The in-memory
+                    # copy above keeps the CURRENT run correct; the
+                    # reader's torn-line skip + recompute-on-resume is
+                    # the path under test.
+                    tampered = inj.tamper_line(line, site=self.path)
+                    if tampered == line:
+                        line = inj.torn_line(line, site=self.path)
+                    else:
+                        line = tampered
                 with open(self.path, "a") as f:
                     f.write(line)
 

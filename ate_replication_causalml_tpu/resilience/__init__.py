@@ -18,7 +18,14 @@ shares:
   bound all speak (ISSUE 14);
 * :mod:`.watchdog` — heartbeat-stamped liveness: per-lane staleness
   bounds (``ATE_TPU_WATCHDOG_*``), stall episodes as events +
-  ``watchdog_stalls_total``, injectable clock (ISSUE 14).
+  ``watchdog_stalls_total``, injectable clock (ISSUE 14);
+* :mod:`.invariants` — the system-wide invariant registry: named
+  guarantees evaluated as pure functions of a run's committed
+  artifacts (ISSUE 15);
+* :mod:`.campaign` — the chaos campaign engine: seeded multi-scope
+  fault storms across the four real workloads, judged by the
+  invariant registry, with a deterministic failure shrinker
+  (ISSUE 15).
 
 Consumers: ``parallel/retry.py`` (classified retry, deadline, re-probe),
 ``pipeline.py`` (stage isolation + graceful degradation),

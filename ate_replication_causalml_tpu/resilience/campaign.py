@@ -1,0 +1,864 @@
+"""Chaos campaign engine (ISSUE 15, tentpole).
+
+Seven chaos scopes exist (``shard``/``fs``/``device``/``stage`` PR 3,
+``serve`` PR 6, ``rotate`` PR 11, ``hang`` PR 14) but until now each
+was only ever armed in isolation, proving one hand-picked invariant in
+its own test file. Real incidents are composed — a rotation lands
+during a dispatcher stall while a shard retries and a journal line
+tears — and this module searches that product space:
+
+* **Campaign generator** — from one root seed, deterministically
+  compose multi-scope ``ATE_TPU_CHAOS`` specs (seeded parameters drawn
+  from declared per-scope ranges) crossed with the four real workloads
+  (quick sweep, scenario matrix, serving daemon + seeded loadgen-style
+  replay, fleet rotation under load). Every draw is a pure sha256 hash
+  of ``(root_seed, path)`` — no global RNG — so the same seed plans
+  the identical campaign forever.
+* **Reference discipline** — every episode runs against a fault-free
+  reference of the SAME workload seed (cached per ``(workload,
+  seed)``), and the :mod:`~.invariants` registry judges the episode
+  from the two runs' committed artifacts alone.
+* **Deterministic shrinker** — on any invariant violation, delta-debug
+  the episode's composed fault set (chaos plans are pure functions of
+  seed, so re-runs are exact) down to a minimal failing subset and
+  emit a one-line repro (``ATE_TPU_CHAOS=<minimal spec>`` + workload +
+  seed) as the report's headline; the minimal spec is re-run once more
+  to confirm it re-fails.
+
+``campaign_report.json`` is byte-identical for the same root seed: it
+carries no wall-clock and no load-dependent numbers (those live in the
+per-episode artifact dirs and the bench record). Schema validated by
+``scripts/check_metrics_schema.py``.
+
+Module top is jax-free; workload runners import jax lazily.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Callable, Sequence
+
+import numpy as np
+
+from ate_replication_causalml_tpu import observability as obs
+from ate_replication_causalml_tpu.resilience import chaos
+from ate_replication_causalml_tpu.resilience import invariants as inv
+
+ENV_SEED = "ATE_TPU_CAMPAIGN_SEED"
+ENV_EPISODES = "ATE_TPU_CAMPAIGN_EPISODES"
+ENV_REQUESTS = "ATE_TPU_CAMPAIGN_REQUESTS"
+ENV_REPS = "ATE_TPU_CAMPAIGN_REPS"
+
+SCHEMA_VERSION = 1
+
+#: scopes whose observed injection SITES are load-dependent (the
+#: daemon's hang sites are batch-composition ids): excluded from the
+#: summary fault list so reports and invariants stay deterministic. A
+#: stall changes no answer, so nothing is lost by not judging it.
+NONDETERMINISTIC_SCOPES = ("hang",)
+
+#: canonical scope order inside a composed spec (stable spec strings).
+_SCOPE_ORDER = ("shard", "fs", "device", "stage", "serve", "hang",
+                "rotate", "tamper")
+
+
+def _env_int(name: str, default: int) -> int:
+    """Config-time raise on a bad knob (the repo-wide env discipline)."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        value = int(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r}: expected a positive integer") \
+            from None
+    if value < 1:
+        raise ValueError(f"{name}={value}: expected a positive integer")
+    return value
+
+
+def default_seed() -> int:
+    """``ATE_TPU_CAMPAIGN_SEED`` (0 allowed — it is a seed, not a
+    budget), validated at config time."""
+    raw = os.environ.get(ENV_SEED, "").strip()
+    if not raw:
+        return 0
+    try:
+        value = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{ENV_SEED}={raw!r}: expected an integer"
+        ) from None
+    if value < 0:
+        raise ValueError(f"{ENV_SEED}={value}: expected >= 0")
+    return value
+
+
+# ── seeded pure draws ─────────────────────────────────────────────────
+
+
+class Draw:
+    """Stateless seeded draw source: every value is the pure hash
+    ``_unit(root, "campaign", *path, name)`` — independent of call
+    order, so adding a draw can never reshuffle existing ones."""
+
+    def __init__(self, root: int, *path: object):
+        self.root = int(root)
+        self.path = tuple(str(p) for p in path)
+
+    def sub(self, *path: object) -> "Draw":
+        return Draw(self.root, *self.path, *path)
+
+    def unit(self, name: str, lo: float = 0.0, hi: float = 1.0) -> float:
+        u = chaos._unit(self.root, "campaign", *self.path, name)
+        return lo + u * (hi - lo)
+
+    def int(self, name: str, lo: int = 1, hi: int = 999_983) -> int:
+        u = chaos._unit(self.root, "campaign", *self.path, name)
+        return lo + min(int(u * (hi - lo + 1)), hi - lo)
+
+    def choice(self, name: str, options: Sequence):
+        return options[self.int(name, 0, len(options) - 1)]
+
+
+# ── episode budget (scale) ────────────────────────────────────────────
+
+
+@dataclasses.dataclass(frozen=True)
+class CampaignScale:
+    """Episode budget knobs. ``micro`` matches the tier-1 rig's MICRO
+    sweep shapes (tests/test_pipeline_driver.py) so in-suite campaigns
+    share warm executables; ``quick`` is the @slow/bench heavy tier."""
+
+    name: str
+    sweep_n_obs: int
+    sweep_pool: int
+    sweep_trees: int
+    sweep_depth: int
+    sweep_balance_iters: int
+    matrix_n: int
+    matrix_reps: int
+    matrix_width: int
+    serve_requests: int
+    serve_rate_hz: float
+
+
+MICRO = CampaignScale(
+    name="micro", sweep_n_obs=1200, sweep_pool=3000, sweep_trees=16,
+    sweep_depth=4, sweep_balance_iters=600, matrix_n=128, matrix_reps=8,
+    matrix_width=4, serve_requests=24, serve_rate_hz=800.0,
+)
+QUICK = CampaignScale(
+    name="quick", sweep_n_obs=2000, sweep_pool=4000, sweep_trees=32,
+    sweep_depth=5, sweep_balance_iters=1200, matrix_n=256,
+    matrix_reps=24, matrix_width=8, serve_requests=80,
+    serve_rate_hz=1500.0,
+)
+SCALES = {s.name: s for s in (MICRO, QUICK)}
+
+
+def resolve_scale(scale: "str | CampaignScale") -> CampaignScale:
+    """Named scale + env budget overrides, validated at config time."""
+    if isinstance(scale, CampaignScale):
+        base = scale
+    else:
+        if scale not in SCALES:
+            raise ValueError(
+                f"unknown campaign scale {scale!r} (known: "
+                f"{sorted(SCALES)})"
+            )
+        base = SCALES[scale]
+    return dataclasses.replace(
+        base,
+        matrix_reps=_env_int(ENV_REPS, base.matrix_reps),
+        serve_requests=_env_int(ENV_REQUESTS, base.serve_requests),
+    )
+
+
+# ── workloads & per-scope parameter ranges ────────────────────────────
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadSpec:
+    """One campaign workload: its runner and the chaos scopes that are
+    meaningful against it (the generator only composes these)."""
+
+    name: str
+    scopes: tuple[str, ...]
+    run: Callable  # (outdir, seed, scale) -> None; commits artifacts
+
+
+def draw_atom(workload: str, scope: str, d: Draw) -> str:
+    """One seeded scope fragment from the scope's declared parameter
+    range for this workload — the campaign's per-scope range table."""
+    if scope == "shard":
+        return (f"shard:p={d.unit('p', 0.15, 0.45):.3f},"
+                f"seed={d.int('seed')},times={d.int('times', 1, 2)}")
+    if scope == "fs":
+        return f"fs:torn_write,times={d.int('times', 1, 2)}"
+    if scope == "stage":
+        fail = (
+            d.choice("fail", ("residual_balancing",
+                              "Propensity_Weighting", "Usual"))
+            if workload == "sweep"
+            else d.choice("fail", ("naive#b0", "ipw_logit#b0"))
+        )
+        return f"stage:fail={fail},times=1"
+    if scope == "serve":
+        return (f"serve:p={d.unit('p', 0.08, 0.25):.3f},"
+                f"seed={d.int('seed')},times={d.int('times', 1, 2)}")
+    if scope == "hang":
+        lane = "dispatch" if workload in ("serving", "rotation") else "worker"
+        return (f"hang:scope={lane},ms={d.unit('ms', 10, 50):.1f},"
+                f"p={d.unit('p', 0.2, 0.7):.3f},seed={d.int('seed')},"
+                f"times=1")
+    if scope == "rotate":
+        kind = d.choice("kind", ("corrupt", "mid_swap", "verify_ms"))
+        if kind == "verify_ms":
+            return f"rotate:verify_ms={d.unit('ms', 30, 90):.0f},times=1"
+        return f"rotate:{kind},times=1"
+    raise ValueError(f"no campaign range declared for scope {scope!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class Episode:
+    """One planned chaos episode: a workload seed plus the composed
+    scope atoms. Everything downstream (the spec string, the shrinker's
+    subsets, the repro line) derives from these fields alone."""
+
+    index: int
+    workload: str
+    seed: int
+    atoms: tuple[tuple[str, str], ...]  # (scope, spec fragment)
+
+    @property
+    def spec(self) -> str:
+        return compose(self.atoms)
+
+
+def compose(atoms: Sequence[tuple[str, str]]) -> str:
+    return ";".join(spec for _, spec in atoms)
+
+
+def plan_campaign(
+    root_seed: int, n_episodes: int,
+    workloads: Sequence[str] | None = None,
+) -> list[Episode]:
+    """The deterministic plan: workload round-robin, a drawn subset of
+    ≥2 applicable scopes per episode, seeded params per scope. Pure
+    function of ``(root_seed, n_episodes, workloads)``."""
+    names = tuple(workloads) if workloads else WORKLOAD_ORDER
+    for w in names:
+        if w not in WORKLOADS:
+            raise ValueError(
+                f"unknown campaign workload {w!r} (known: "
+                f"{sorted(WORKLOADS)})"
+            )
+    episodes: list[Episode] = []
+    for i in range(n_episodes):
+        w = names[i % len(names)]
+        d = Draw(root_seed, "ep", i)
+        scopes = WORKLOADS[w].scopes
+        k = d.int("nscopes", min(2, len(scopes)), len(scopes))
+        ranked = sorted(scopes, key=lambda s: d.unit(f"pick.{s}"))
+        chosen = sorted(ranked[:k], key=_SCOPE_ORDER.index)
+        atoms = tuple(
+            (s, draw_atom(w, s, d.sub("scope", s))) for s in chosen
+        )
+        episodes.append(Episode(i, w, d.int("seed", 1, 1_000_000), atoms))
+    return episodes
+
+
+# ── fault-window capture ──────────────────────────────────────────────
+
+
+class _FaultWindow:
+    """Collects the ``chaos_inject`` events a workload run emitted (by
+    monotonic window over the process-global ring), excluding the
+    nondeterministic scopes — the summary's committed fault record the
+    invariants judge against."""
+
+    def __enter__(self):
+        self.t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def collect(self) -> list[dict]:
+        out = []
+        for r in obs.EVENTS.records():
+            if r.get("name") != "chaos_inject":
+                continue
+            if r.get("start_mono_s", 0.0) < self.t0:
+                continue
+            at = r.get("attrs", {})
+            if at.get("scope") in NONDETERMINISTIC_SCOPES:
+                continue
+            f = {"scope": at.get("scope"), "site": at.get("site")}
+            if "kind" in at:
+                f["kind"] = at["kind"]
+            out.append(f)
+        return sorted(
+            out, key=lambda f: (f["scope"], f["site"], f.get("kind", ""))
+        )
+
+
+def _write_summary(outdir: str, summary: dict) -> None:
+    summary = dict(summary)
+    summary["chaos_spec"] = os.environ.get(chaos.ENV_VAR, "").strip()
+    obs.atomic_write_json(
+        os.path.join(outdir, inv.SUMMARY_BASENAME), summary
+    )
+
+
+# ── the four workload runners ─────────────────────────────────────────
+
+
+def _silent(_msg: str) -> None:
+    pass
+
+
+def _run_sweep_workload(outdir: str, seed: int, scale: CampaignScale):
+    from ate_replication_causalml_tpu.data.pipeline import PrepConfig
+    from ate_replication_causalml_tpu.pipeline import (
+        SWEEP_METHODS,
+        SweepConfig,
+        run_sweep,
+    )
+
+    cfg = dataclasses.replace(
+        SweepConfig().quick(),
+        prep=PrepConfig(n_obs=scale.sweep_n_obs),
+        synthetic_pool=scale.sweep_pool,
+        synthetic_seed=seed,
+        seed=seed,
+        dr_trees=scale.sweep_trees, dml_trees=scale.sweep_trees,
+        cf_trees=scale.sweep_trees,
+        cf_nuisance_trees=scale.sweep_trees,
+        forest_depth=scale.sweep_depth,
+        balance_iters=scale.sweep_balance_iters,
+    )
+    with _FaultWindow() as win:
+        run_sweep(cfg, outdir=outdir, plots=False, log=_silent)
+    _write_summary(outdir, {
+        "workload": "sweep",
+        "seed": seed,
+        "journal": "results.jsonl",
+        "expected_rows": ["oracle"] + list(SWEEP_METHODS),
+        "faults": win.collect(),
+    })
+
+
+def _run_matrix_workload(outdir: str, seed: int, scale: CampaignScale):
+    from ate_replication_causalml_tpu.scenarios.dgp import STOCK_DGPS
+    from ate_replication_causalml_tpu.scenarios.matrix import (
+        MatrixSpec,
+        cell_row_id,
+        plan_columns,
+        run_matrix,
+    )
+
+    calib = dataclasses.replace(STOCK_DGPS["calibration"],
+                                n=scale.matrix_n)
+    spec = MatrixSpec(
+        dgps=(calib,), estimators=("naive", "ipw_logit"),
+        n_reps=scale.matrix_reps, batch_width=scale.matrix_width,
+        seed=seed, shard=False,
+    )
+    plans, _skipped = plan_columns(spec)
+    batches = {
+        f"{p.name}#b{bi}": [
+            cell_row_id(p.dgp.name, p.estimator, r) for r in batch
+        ]
+        for p in plans for bi, batch in enumerate(p.batches)
+    }
+    expected = [
+        cell_row_id(p.dgp.name, p.estimator, r)
+        for p in plans for r in range(spec.n_reps)
+    ]
+    with _FaultWindow() as win:
+        run_matrix(spec, outdir=outdir, log=_silent)
+    _write_summary(outdir, {
+        "workload": "matrix",
+        "seed": seed,
+        "journal": "cells.jsonl",
+        "expected_rows": expected,
+        "batches": batches,
+        "faults": win.collect(),
+    })
+
+
+def _synthetic_serving_forest(rng):
+    """Same micro geometry as the serving/fleet rigs — small enough
+    that per-episode AOT startup is cheap, real enough that the full
+    predict path (routing, leaf stats, variance) runs."""
+    import jax.numpy as jnp
+
+    from ate_replication_causalml_tpu.models.causal_forest import (
+        CausalForest,
+    )
+
+    T, D, n, p, nb = 8, 3, 50, 4, 8
+    return CausalForest(
+        split_feat=jnp.asarray(
+            rng.integers(0, p, size=(T, D, 1 << D)).astype(np.int32)
+        ),
+        split_bin=jnp.asarray(
+            rng.integers(0, nb - 1, size=(T, D, 1 << D)).astype(np.int32)
+        ),
+        leaf_stats=jnp.asarray(
+            (np.abs(rng.normal(size=(T, 1 << D, 5))) + 0.5)
+            .astype(np.float32)
+        ),
+        in_sample=jnp.asarray(rng.uniform(size=(T, n)) < 0.5),
+        bin_edges=jnp.asarray(
+            np.sort(rng.normal(size=(p, nb - 1)), axis=1)
+            .astype(np.float32)
+        ),
+        ci_group_size=2,
+    )
+
+
+def _counter_sum(name: str) -> float:
+    return float(sum((obs.REGISTRY.peek(name) or {}).values()))
+
+
+def _serve_retry(server, rid: str, x, max_attempts: int = 500):
+    """Blocking serve with the polite-client retry discipline. The
+    SPAN path (``serve_request``), deliberately: raw ``submit()``
+    requests never enter the serving report's phase section, and the
+    reconciliation invariant would report them as silent drops — the
+    exact gotcha PR 11 turned into a checked number."""
+    from ate_replication_causalml_tpu.serving.daemon import RejectedRequest
+
+    for _ in range(max_attempts):
+        try:
+            return server.serve_request(rid, x, timeout=60.0)
+        except RejectedRequest as rej:
+            if rej.code in ("bad_request", "unknown_model",
+                            "retired_model"):
+                raise
+            time.sleep(rej.retry_after_s or 0.002)
+    raise RuntimeError(f"no progress on request {rid}")
+
+
+def _serving_workload(rotate: bool):
+    def run(outdir: str, seed: int, scale: CampaignScale):
+        import jax.numpy as jnp
+
+        from ate_replication_causalml_tpu.models.causal_forest import (
+            predict_cate,
+        )
+        from ate_replication_causalml_tpu.serving import loadgen
+        from ate_replication_causalml_tpu.serving.coalescer import (
+            BucketPlan,
+        )
+        from ate_replication_causalml_tpu.serving.daemon import (
+            CateServer,
+            ServeConfig,
+        )
+        from ate_replication_causalml_tpu.utils.checkpoint import (
+            save_fitted,
+        )
+
+        rng = np.random.default_rng(seed)
+        forests = {1: _synthetic_serving_forest(rng)}
+        if rotate:
+            forests[2] = _synthetic_serving_forest(rng)
+        ckpt = os.path.join(outdir, "model-v1.npz")
+        save_fitted(ckpt, forests[1])
+
+        schedule = loadgen.build_schedule(
+            seed, scale.serve_requests, rate_hz=scale.serve_rate_hz,
+            mix="1:2,3:2,4:1", id_prefix=f"c{seed}x",
+        )
+        queries = loadgen.build_queries(seed, schedule, features=4)
+        # Offline per-version references BEFORE startup — the
+        # process-global no-compile-window gotcha (README "Serving
+        # gotchas"); committed as refs.npz, the bit-identity
+        # invariant's comparison base.
+        cat = jnp.asarray(np.concatenate(queries))
+        refs = {}
+        for v, forest in forests.items():
+            out = predict_cate(forest, cat, oob=False,
+                               row_backend="matmul")
+            refs[f"cate_v{v}"] = np.asarray(out.cate)
+            refs[f"var_v{v}"] = np.asarray(out.variance)
+        np.savez(os.path.join(outdir, "refs.npz"), **refs)
+
+        rejected_before = _counter_sum("serving_rejected_total")
+        rotation_status = None
+        with _FaultWindow() as win:
+            server = CateServer(ServeConfig(
+                checkpoint=ckpt,
+                buckets=BucketPlan.parse("4"),
+                window_s=0.002,
+                max_depth=32,
+                retry_after_s=0.002,
+                # The campaign's zero_compile_window invariant does the
+                # judging (a strict stop() would crash the episode
+                # instead of recording the verdict).
+                strict_no_compile=False,
+            ))
+            server.startup()
+            try:
+                half = len(schedule) // 2 if rotate else len(schedule)
+                reqs = []
+                for i, sched in enumerate(schedule[:half]):
+                    reqs.append(_serve_retry(
+                        server, sched.request_id, queries[i]
+                    ))
+                if rotate:
+                    # Fleet rotation under load: publish a candidate
+                    # through the retrain supervisor (the path the
+                    # rotate: scope faults) between the two replay
+                    # halves, so which version each request binds is
+                    # deterministic whatever the rotation outcome.
+                    sup = server.retrain_supervisor(
+                        "default", lambda: forests[2],
+                        publish_dir=outdir,
+                    )
+                    rotation_status = sup.run_once().status
+                    for i, sched in enumerate(schedule[half:], half):
+                        reqs.append(_serve_retry(
+                            server, sched.request_id, queries[i]
+                        ))
+                compile_delta = server.compile_events_in_window()
+                server.dump_artifacts(outdir)
+                rejected_delta = (
+                    _counter_sum("serving_rejected_total")
+                    - rejected_before
+                )
+                drain_outcome = server.drain(timeout_s=60.0)
+            finally:
+                server.stop()  # idempotent after a clean drain
+
+        rows = np.asarray([q.shape[0] for q in queries], np.int64)
+        versions = np.asarray(
+            [int(r.model_version or 1) for r in reqs], np.int64
+        )
+        np.savez(
+            os.path.join(outdir, "answers.npz"),
+            rows=rows,
+            versions=versions,
+            cate=np.concatenate([np.asarray(r.result[0]) for r in reqs]),
+            var=np.concatenate([np.asarray(r.result[1]) for r in reqs]),
+        )
+        _write_summary(outdir, {
+            "workload": "rotation" if rotate else "serving",
+            "seed": seed,
+            "n_requests": len(schedule),
+            "request_ids": [s.request_id for s in schedule],
+            "faults": win.collect(),
+            "serving": {
+                "compile_events_in_window": compile_delta,
+                "drain_outcome": drain_outcome,
+                "served": sum(1 for r in reqs if r.error is None),
+                "rejected_metered_delta": rejected_delta,
+                "rotation_status": rotation_status,
+            },
+        })
+
+    return run
+
+
+WORKLOADS: dict[str, WorkloadSpec] = {
+    "sweep": WorkloadSpec(
+        "sweep", ("shard", "fs", "stage", "hang"), _run_sweep_workload
+    ),
+    "matrix": WorkloadSpec(
+        "matrix", ("fs", "stage", "hang"), _run_matrix_workload
+    ),
+    "serving": WorkloadSpec(
+        "serving", ("serve", "hang"), _serving_workload(rotate=False)
+    ),
+    "rotation": WorkloadSpec(
+        "rotation", ("serve", "hang", "rotate"),
+        _serving_workload(rotate=True)
+    ),
+}
+WORKLOAD_ORDER = ("sweep", "matrix", "serving", "rotation")
+
+
+# ── episode execution ─────────────────────────────────────────────────
+
+
+def _require_telemetry() -> None:
+    """The campaign's entire fault accounting (summary fault lists,
+    journal torn-line reconciliation, counter metering) reads the
+    telemetry plane — with ``ATE_TPU_TELEMETRY=0`` every injection
+    would be invisible and green episodes would report as spurious
+    violations. Refuse at config time, the env-knob discipline."""
+    if not obs.enabled():
+        raise RuntimeError(
+            "chaos campaigns need telemetry: ATE_TPU_TELEMETRY=0 hides "
+            "every chaos_inject event the invariant registry accounts "
+            "against — unset it to run a campaign"
+        )
+
+
+def _run_workload(workload: str, outdir: str, seed: int,
+                  scale: CampaignScale) -> inv.RunArtifacts:
+    if os.path.isdir(outdir) and os.listdir(outdir):
+        # A reused outdir would RESUME the old journal — recorded torn
+        # lines from the previous run break fault accounting silently.
+        raise ValueError(
+            f"campaign run dir {outdir!r} is not empty; every "
+            "episode/reference run needs a fresh directory"
+        )
+    os.makedirs(outdir, exist_ok=True)
+    WORKLOADS[workload].run(outdir, seed, scale)
+    return inv.RunArtifacts(outdir)
+
+
+def _episode_run(workload: str, seed: int, spec: str, outdir: str,
+                 scale: CampaignScale) -> inv.RunArtifacts:
+    """Run one (possibly chaos-armed) workload with fresh fault
+    budgets; the env var is restored afterwards whatever happens."""
+    with chaos.override(spec or None):
+        return _run_workload(workload, outdir, seed, scale)
+
+
+def run_repro(workload: str, seed: int, spec: str, outdir: str,
+              scale: "str | CampaignScale" = "micro",
+              log: Callable[[str], None] = print) -> list[inv.Verdict]:
+    """One episode + its fault-free reference + the full invariant
+    registry — the unit the shrinker's one-line repro re-runs. Returns
+    the verdicts; the CLI exits nonzero when any fail (that exit IS
+    the 're-fails' contract)."""
+    _require_telemetry()
+    scale = resolve_scale(scale)
+    obs.install_jax_monitoring()
+    ref = _episode_run(workload, seed, "", os.path.join(outdir, "ref"),
+                       scale)
+    log(f"[repro] reference done; running {workload} under {spec!r}")
+    run = _episode_run(workload, seed, spec,
+                       os.path.join(outdir, "episode"), scale)
+    return inv.evaluate_all(run, ref)
+
+
+# ── the shrinker ──────────────────────────────────────────────────────
+
+
+def _ddmin(atoms: list, fails: Callable[[list], bool]) -> list:
+    """Classic delta debugging over the episode's atom list: returns a
+    1-minimal failing subset (removing any single tested chunk makes
+    the failure disappear). ``fails`` must be deterministic — chaos
+    plans are pure functions of seed, so it is."""
+    cur = list(atoms)
+    n = 2
+    while len(cur) >= 2:
+        chunk = max(1, len(cur) // n)
+        subsets = [cur[i:i + chunk] for i in range(0, len(cur), chunk)]
+        reduced = False
+        for s in subsets:
+            if len(s) < len(cur) and fails(s):
+                cur, n, reduced = s, 2, True
+                break
+        if not reduced:
+            for s in subsets:
+                comp = [a for a in cur if a not in s]
+                if comp and len(comp) < len(cur) and fails(comp):
+                    cur, n, reduced = comp, max(n - 1, 2), True
+                    break
+        if not reduced:
+            if n >= len(cur):
+                break
+            n = min(len(cur), n * 2)
+    return cur
+
+
+def shrink_episode(
+    episode: Episode, failing: Sequence[str], ref: inv.RunArtifacts,
+    outdir: str, scale: CampaignScale,
+    log: Callable[[str], None] = print,
+) -> dict:
+    """Delta-debug ``episode.atoms`` down to a minimal subset that
+    still fails at least one of ``failing``, then CONFIRM with one
+    fresh (uncached) run of the minimal spec. Every probe is a full
+    workload re-run against the shared reference — exact, because the
+    chaos plan is a pure function of (spec, seed)."""
+    cache: dict[str, bool] = {}
+    runs = [0]
+
+    def fails(atoms: list) -> bool:
+        spec = compose(atoms)
+        if spec in cache:
+            return cache[spec]
+        runs[0] += 1
+        d = os.path.join(
+            outdir, f"shrink-ep{episode.index:03d}-{runs[0]:02d}"
+        )
+        log(f"[shrink] ep{episode.index}: probing {spec!r}")
+        run = _episode_run(episode.workload, episode.seed, spec, d, scale)
+        verdicts = inv.evaluate_all(run, ref)
+        bad = any(
+            v.invariant in failing and v.verdict == "fail"
+            for v in verdicts
+        )
+        cache[spec] = bad
+        return bad
+
+    minimal = _ddmin(list(episode.atoms), fails)
+    spec_min = compose(minimal)
+    # Fresh confirmation run — the repro must re-fail on a clean
+    # directory, not merely have failed once during the search.
+    cache.pop(spec_min, None)
+    confirmed = fails(minimal)
+    repro = (
+        f"ATE_TPU_CHAOS='{spec_min}' python scripts/chaos_campaign.py "
+        f"--repro --workload {episode.workload} --seed {episode.seed} "
+        f"--scale {scale.name}"
+    )
+    return {
+        "episode": episode.index,
+        "workload": episode.workload,
+        "seed": episode.seed,
+        "failing": sorted(failing),
+        "minimal_atoms": [
+            {"scope": sc, "spec": sp} for sc, sp in minimal
+        ],
+        "repro": repro,
+        "confirmed": confirmed,
+        "n_probe_runs": runs[0],
+    }
+
+
+# ── the campaign driver ───────────────────────────────────────────────
+
+
+def run_campaign(
+    outdir: str,
+    root_seed: int | None = None,
+    n_episodes: int | None = None,
+    workloads: Sequence[str] | None = None,
+    scale: "str | CampaignScale" = "micro",
+    shrink: bool = True,
+    episodes: Sequence[Episode] | None = None,
+    log: Callable[[str], None] = print,
+) -> dict:
+    """Run a full campaign and write ``campaign_report.json`` into
+    ``outdir``. ``episodes`` overrides the generator (tests plant
+    hand-built episodes — e.g. a ``tamper:journal`` violation — through
+    the same engine). Returns the report dict; same root seed ⇒
+    byte-identical report file."""
+    _require_telemetry()
+    obs.install_jax_monitoring()
+    scale = resolve_scale(scale)
+    if root_seed is None:
+        root_seed = default_seed()
+    if episodes is None:
+        n = n_episodes if n_episodes is not None else _env_int(
+            ENV_EPISODES, 4
+        )
+        episodes = plan_campaign(root_seed, n, workloads)
+    os.makedirs(outdir, exist_ok=True)
+
+    ep_counter = obs.counter(
+        "chaos_campaign_episodes_total",
+        "chaos-campaign episodes by workload and green/violated status",
+    )
+    check_counter = obs.counter(
+        "chaos_invariant_checks_total",
+        "campaign invariant evaluations by invariant and verdict",
+    )
+
+    refs: dict[tuple[str, int], inv.RunArtifacts] = {}
+    report_eps: list[dict] = []
+    violations: list[int] = []
+    shrink_entries: list[dict] = []
+    walls: list[float] = []
+    for ep in episodes:
+        key = (ep.workload, ep.seed)
+        if key not in refs:
+            log(f"[campaign] reference: {ep.workload} seed={ep.seed}")
+            refs[key] = _episode_run(
+                ep.workload, ep.seed, "",
+                os.path.join(outdir, f"ref-{ep.workload}-{ep.seed}"),
+                scale,
+            )
+        t0 = time.monotonic()
+        log(f"[campaign] ep{ep.index}: {ep.workload} under {ep.spec!r}")
+        run = _episode_run(
+            ep.workload, ep.seed, ep.spec,
+            os.path.join(outdir, f"ep{ep.index:03d}"), scale,
+        )
+        walls.append(time.monotonic() - t0)
+        verdicts = inv.evaluate_all(run, refs[key])
+        for v in verdicts:
+            check_counter.inc(1, invariant=v.invariant, verdict=v.verdict)
+        failing = sorted(
+            v.invariant for v in verdicts if v.verdict == "fail"
+        )
+        status = "violated" if failing else "green"
+        ep_counter.inc(1, workload=ep.workload, status=status)
+        obs.emit("chaos_campaign_episode", status=status,
+                 workload=ep.workload, episode=ep.index, spec=ep.spec)
+        report_eps.append({
+            "index": ep.index,
+            "workload": ep.workload,
+            "seed": ep.seed,
+            "spec": ep.spec,
+            "atoms": [{"scope": sc, "spec": sp} for sc, sp in ep.atoms],
+            "status": status,
+            "invariants": [v.as_json() for v in verdicts],
+        })
+        if failing:
+            violations.append(ep.index)
+            log(f"[campaign] ep{ep.index} VIOLATED: {failing}")
+            if shrink:
+                shrink_entries.append(shrink_episode(
+                    ep, failing, refs[key], outdir, scale, log
+                ))
+
+    by_workload: dict[str, dict[str, int]] = {}
+    for rec in report_eps:
+        w = by_workload.setdefault(
+            rec["workload"], {"green": 0, "violated": 0}
+        )
+        w[rec["status"]] += 1
+    if shrink_entries:
+        headline = shrink_entries[0]["repro"]
+    elif violations:
+        headline = (
+            f"VIOLATED (unshrunk): episodes {violations}"
+        )
+    else:
+        headline = (
+            f"all green: {len(report_eps)} episodes x "
+            f"{len(inv.registered_names())} invariants"
+        )
+    report = {
+        "schema_version": SCHEMA_VERSION,
+        "root_seed": root_seed,
+        "scale": scale.name,
+        "invariant_registry": list(inv.registered_names()),
+        "n_episodes": len(report_eps),
+        "episodes": report_eps,
+        "by_workload": by_workload,
+        "violations": violations,
+        "shrink": shrink_entries,
+        "headline": headline,
+    }
+    # Canonical dump: sorted keys, no wall-clock anywhere — same root
+    # seed must produce a byte-identical file (asserted in tier-1).
+    obs.atomic_write_text(
+        os.path.join(outdir, "campaign_report.json"),
+        json.dumps(report, indent=2, sort_keys=True) + "\n",
+    )
+    # Wall-clock lives BESIDE the canonical report, never in it — the
+    # bench record reads this sidecar for its per-episode walls.
+    obs.atomic_write_json(
+        os.path.join(outdir, "campaign_walls.json"),
+        {"episode_wall_s": [round(w, 3) for w in walls]},
+    )
+    obs.gauge(
+        "chaos_campaign_episode_seconds",
+        "wall seconds per chaos-campaign episode (last run)",
+    ).set(max(walls) if walls else 0.0)
+    log(f"[campaign] {headline}")
+    return report

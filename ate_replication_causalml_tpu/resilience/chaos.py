@@ -44,6 +44,17 @@ item is ``key=value`` or a bare flag. Scopes and their keys:
   retrain supervisor its model id) with the same pure-hash discipline
   as ``serve:``, so planned == observed stalls is assertable and a
   stall-free rerun of the same stream is bit-identical.
+* ``tamper`` — SILENT corruption the system is NOT expected to
+  tolerate (ISSUE 15): ``tamper:journal,delta=..,times=..`` perturbs
+  the ``ate`` field of the next journaled result row by ``delta``
+  AFTER the in-memory copy was taken — a valid JSON line with a wrong
+  number, the artifact a bit flip or a buggy serializer would leave.
+  No reader can reject it (it parses, it resumes); only the campaign
+  invariant registry's bit-identity check against a fault-free
+  reference (``resilience/invariants.py``) can catch it. The scope
+  exists to prove the campaign's DETECTION power and to give the
+  failure shrinker a deterministic violation to minimize — arming it
+  in production is arming data corruption.
 * ``rotate`` — the train-to-serve fleet's failure modes (ISSUE 11),
   each a bare flag budgeted by ``times``: ``retrain`` (the retrain
   supervisor's fit raises :class:`~.errors.ChaosRotateFault` —
@@ -73,6 +84,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import hashlib
+import json
 import os
 import threading
 from typing import Callable, Iterator, Sequence
@@ -98,6 +110,7 @@ _SCOPE_SCHEMA: dict[str, dict[str, type]] = {
              "times": int},
     "rotate": {"corrupt": bool, "mid_swap": bool, "retrain": bool,
                "verify_ms": float, "times": int},
+    "tamper": {"journal": bool, "delta": float, "times": int},
 }
 
 #: lanes the ``hang`` scope may target — the heartbeat-stamped sites.
@@ -112,6 +125,7 @@ _SCOPE_DEFAULTS: dict[str, dict[str, object]] = {
     "hang": {"scope": "", "ms": 0.0, "p": 0.0, "seed": 0, "times": 1},
     "rotate": {"corrupt": False, "mid_swap": False, "retrain": False,
                "verify_ms": 0.0, "times": 1},
+    "tamper": {"journal": False, "delta": 1e-3, "times": 1},
 }
 
 
@@ -247,6 +261,8 @@ class ChaosInjector:
         self._rotate_verify_left = (
             int(rot["times"]) if float(rot["verify_ms"]) > 0 else 0
         )
+        tam = config.scope("tamper") or _SCOPE_DEFAULTS["tamper"]
+        self._tamper_left = int(tam["times"]) if tam.get("journal") else 0
 
     # ── bookkeeping ───────────────────────────────────────────────────
 
@@ -330,6 +346,38 @@ class ChaosInjector:
         cut = max(1, (nbytes * 3) // 5)
         self._record("fs", site, kind="corrupt_npz", dropped_bytes=nbytes - cut)
         return cut
+
+    # ── tamper scope ──────────────────────────────────────────────────
+
+    def tamper_line(self, line: str, site: str) -> str:
+        """Silent-corruption injection point (ISSUE 15): perturb the
+        ``ate`` field of a serialized journal row by ``delta`` while the
+        budget lasts. The returned line PARSES — no torn-line skip, no
+        digest mismatch, no typed error: the corruption is invisible to
+        every reader the system owns, which is exactly what the
+        campaign's bit-identity invariant (and nothing else) must
+        catch. Rows without a finite numeric ``ate`` (the journal's
+        ``__config__`` header, already-failed rows) pass through
+        without consuming budget, so the first REAL result row is the
+        deterministic victim."""
+        cfg = self.config.scope("tamper")
+        if cfg is None or not cfg.get("journal"):
+            return line
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            return line
+        ate = rec.get("ate") if isinstance(rec, dict) else None
+        if isinstance(ate, bool) or not isinstance(ate, (int, float)):
+            return line
+        with self._lock:
+            if self._tamper_left <= 0:
+                return line
+            self._tamper_left -= 1
+        rec["ate"] = ate + float(cfg["delta"])
+        self._record("tamper", site, kind="journal",
+                     delta=float(cfg["delta"]), method=str(rec.get("method")))
+        return json.dumps(rec) + "\n"
 
     # ── device scope ──────────────────────────────────────────────────
 

@@ -1,0 +1,508 @@
+"""System-wide invariant registry (ISSUE 15, tentpole part 2).
+
+Every guarantee the repo has proven one scope at a time — computed rows
+bit-identical to a fault-free reference (PR 3), journal integrity under
+torn appends (PR 3), the serving report's silent-drop reconciliation
+(PR 11), the steady-state zero-compile window (PR 6), typed-reject
+accounting (PR 7), drain-loses-nothing (PR 14), degrade-exactly-where-
+faulted (PR 3/4) — promoted to named, reusable :class:`Invariant`
+objects with ONE contract: an invariant is a pure function of a run's
+**committed artifacts** (journals, ``answers.npz``/``refs.npz``,
+``serving_report.json``, ``metrics.json``, the workload's
+``campaign_summary.json``) for a chaos episode and its fault-free
+reference of the same seed. Nothing here re-runs anything or reads
+process state — a verdict can be recomputed from the artifact
+directories alone, which is what makes ``campaign_report.json``
+reproducible and the failure shrinker's re-runs comparable.
+
+Verdicts are ``pass`` / ``fail`` / ``skip`` (not applicable to the
+workload). Pass-verdict details are deliberately DETERMINISTIC —
+no wall-clock, no load-dependent counts — so a campaign report is
+byte-identical across reruns of the same seed; failure details carry
+whatever diagnosis needs.
+
+jax-free (numpy only, for the committed answer arrays) so the registry
+is importable from the validator and the CLI without a backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+from typing import Callable
+
+import numpy as np
+
+from ate_replication_causalml_tpu.resilience import chaos as _chaos
+
+#: the journal basename per journaled workload.
+SUMMARY_BASENAME = "campaign_summary.json"
+
+#: statistical payload compared for journal bit-identity; ``seconds``
+#: and attempt bookkeeping are run-local and deliberately excluded.
+_PAYLOAD_KEYS = ("ate", "se", "lower_ci", "upper_ci", "tau_true")
+
+
+@dataclasses.dataclass(frozen=True)
+class Verdict:
+    """One invariant's structured outcome for one episode."""
+
+    invariant: str
+    verdict: str                    # "pass" | "fail" | "skip"
+    detail: str = ""
+    data: dict = dataclasses.field(default_factory=dict)
+
+    def as_json(self) -> dict:
+        return {
+            "invariant": self.invariant,
+            "verdict": self.verdict,
+            "detail": self.detail,
+            "data": self.data,
+        }
+
+
+class RunArtifacts:
+    """Read-side handle on one committed run directory (an episode or
+    its reference): the workload summary plus lazy, cached parses of
+    the journal, the served-answer arrays and the serving report."""
+
+    def __init__(self, outdir: str):
+        self.outdir = outdir
+        with open(os.path.join(outdir, SUMMARY_BASENAME)) as f:
+            self.summary = json.load(f)
+        self.workload = self.summary["workload"]
+        self._journal = None
+        self._answers = None
+        self._refs = None
+
+    def path(self, name: str) -> str:
+        return os.path.join(self.outdir, name)
+
+    def has(self, name: str) -> bool:
+        return os.path.exists(self.path(name))
+
+    def load_json(self, name: str) -> dict | None:
+        if not self.has(name):
+            return None
+        with open(self.path(name)) as f:
+            return json.load(f)
+
+    def journal(self) -> tuple[dict[str, dict], int]:
+        """``(rows keyed by method, torn line count)`` — the same
+        torn-tolerant parse the resume path applies."""
+        if self._journal is None:
+            rows: dict[str, dict] = {}
+            torn = 0
+            name = self.summary.get("journal")
+            if name and self.has(name):
+                with open(self.path(name)) as f:
+                    for line in f:
+                        if not line.strip():
+                            continue
+                        try:
+                            rec = json.loads(line)
+                        except json.JSONDecodeError:
+                            torn += 1
+                            continue
+                        if rec.get("method") != "__config__":
+                            rows[rec["method"]] = rec
+            self._journal = (rows, torn)
+        return self._journal
+
+    def answers(self):
+        if self._answers is None and self.has("answers.npz"):
+            self._answers = np.load(self.path("answers.npz"))
+        return self._answers
+
+    def refs(self):
+        if self._refs is None and self.has("refs.npz"):
+            self._refs = np.load(self.path("refs.npz"))
+        return self._refs
+
+    def faults(self, scope: str | None = None) -> list[dict]:
+        """Observed chaos injections the workload recorded (the summary
+        mirrors the run's ``chaos_inject`` events for the DETERMINISTIC
+        scopes; ``hang:`` stalls are deliberately absent — a stall
+        changes no answer, and the daemon's stall sites are
+        batch-composition-dependent)."""
+        out = self.summary.get("faults", [])
+        if scope is not None:
+            out = [f for f in out if f.get("scope") == scope]
+        return out
+
+
+def _values_equal(a, b) -> bool:
+    """Bit-equality on the JSON round-trip with NaN == NaN (the no-SE
+    LASSO rows serialize se as null; json round-trips floats via repr
+    exactly, so == IS bit-identity here)."""
+    if a is None and b is None:
+        return True
+    if isinstance(a, float) and isinstance(b, float):
+        if math.isnan(a) and math.isnan(b):
+            return True
+    return a == b
+
+
+def _payload(rec: dict) -> dict:
+    return {k: rec.get(k) for k in _PAYLOAD_KEYS if k in rec}
+
+
+# ── registry ──────────────────────────────────────────────────────────
+
+
+@dataclasses.dataclass(frozen=True)
+class Invariant:
+    """One named guarantee. ``workloads=None`` applies everywhere;
+    otherwise the listed workload names (anything else → ``skip``)."""
+
+    name: str
+    description: str
+    fn: Callable[[RunArtifacts, RunArtifacts], Verdict]
+    workloads: tuple[str, ...] | None = None
+
+
+REGISTRY: dict[str, Invariant] = {}
+
+
+def register(name: str, description: str,
+             workloads: tuple[str, ...] | None = None):
+    def deco(fn):
+        if name in REGISTRY:
+            raise ValueError(f"duplicate invariant {name!r}")
+        REGISTRY[name] = Invariant(name, description, fn, workloads)
+        return fn
+
+    return deco
+
+
+def registered_names() -> tuple[str, ...]:
+    """Declaration order — the canonical verdict order in
+    ``campaign_report.json`` (the validator checks the set)."""
+    return tuple(REGISTRY)
+
+
+def evaluate_all(episode: RunArtifacts,
+                 reference: RunArtifacts) -> list[Verdict]:
+    """Every registered invariant, in declaration order — skips are
+    explicit verdicts, so a campaign report always carries the FULL
+    registry per episode (the validator's "every verdict present"
+    check)."""
+    out: list[Verdict] = []
+    for inv in REGISTRY.values():
+        if inv.workloads is not None and episode.workload not in inv.workloads:
+            out.append(Verdict(inv.name, "skip",
+                               f"not applicable to {episode.workload}"))
+            continue
+        try:
+            out.append(inv.fn(episode, reference))
+        except Exception as e:  # noqa: BLE001 — an invariant that cannot
+            # be evaluated (missing artifact, torn file) is a FAILURE of
+            # the system's artifact contract, not a crash of the judge.
+            out.append(Verdict(
+                inv.name, "fail",
+                f"evaluation error: {type(e).__name__}: {e}",
+            ))
+    return out
+
+
+# ── journaled workloads (sweep / matrix) ──────────────────────────────
+
+
+_JOURNALED = ("sweep", "matrix")
+_SERVING = ("serving", "rotation")
+
+
+@register(
+    "bit_identity",
+    "every computed row / served answer is bit-identical to the "
+    "fault-free reference of the same seed",
+)
+def _bit_identity(ep: RunArtifacts, ref: RunArtifacts) -> Verdict:
+    if ep.workload in _JOURNALED:
+        rows, _ = ep.journal()
+        ref_rows, ref_torn = ref.journal()
+        mismatched = []
+        compared = 0
+        for key, rec in sorted(rows.items()):
+            if rec.get("status", "ok") != "ok":
+                continue  # degraded rows are the degrade invariant's job
+            ref_rec = ref_rows.get(key)
+            if ref_rec is None:
+                # The reference lost this row only to its own torn
+                # append (references run fault-free, so only a crashed
+                # reference could); treat as incomparable.
+                continue
+            compared += 1
+            if _payload(rec) != _payload(ref_rec) and not all(
+                _values_equal(rec.get(k), ref_rec.get(k))
+                for k in _PAYLOAD_KEYS
+            ):
+                mismatched.append(key)
+        if mismatched:
+            return Verdict(
+                "bit_identity", "fail",
+                f"{len(mismatched)} computed row(s) diverge from the "
+                f"fault-free reference",
+                {"mismatched": mismatched, "compared": compared},
+            )
+        if compared == 0:
+            return Verdict("bit_identity", "fail",
+                           "no comparable computed rows")
+        return Verdict("bit_identity", "pass",
+                       f"{compared} computed rows bit-identical",
+                       {"compared": compared})
+    # Serving: every served answer equals the REFERENCE run's offline
+    # per-version prediction for the rows and model version this
+    # request actually bound.
+    ans = ep.answers()
+    refs = ref.refs()
+    if ans is None or refs is None:
+        return Verdict("bit_identity", "fail",
+                       "answers.npz / reference refs.npz missing")
+    rows = ans["rows"]
+    versions = ans["versions"]
+    cate, var = ans["cate"], ans["var"]
+    off = 0
+    bad = []
+    for i in range(len(rows)):
+        n = int(rows[i])
+        v = int(versions[i])
+        rc = refs[f"cate_v{v}"][off:off + n]
+        rv = refs[f"var_v{v}"][off:off + n]
+        if not (np.array_equal(cate[off:off + n], rc)
+                and np.array_equal(var[off:off + n], rv)):
+            bad.append(i)
+        off += n
+    if bad:
+        return Verdict(
+            "bit_identity", "fail",
+            f"{len(bad)} served answer(s) diverge from the reference's "
+            "offline prediction at their bound version",
+            {"mismatched_indices": bad, "compared": int(len(rows))},
+        )
+    return Verdict("bit_identity", "pass",
+                   f"{int(len(rows))} served answers bit-identical",
+                   {"compared": int(len(rows))})
+
+
+@register(
+    "journal_integrity",
+    "the journal parses after torn appends: every expected row is "
+    "present or accounted to a recorded torn line, the config header "
+    "survived, and torn lines == recorded fs injections",
+    workloads=_JOURNALED,
+)
+def _journal_integrity(ep: RunArtifacts, ref: RunArtifacts) -> Verdict:
+    rows, torn = ep.journal()
+    expected = list(ep.summary.get("expected_rows", []))
+    jpath = ep.path(ep.summary["journal"])
+    with open(jpath) as f:
+        first = f.readline()
+    try:
+        header = json.loads(first)
+        header_ok = header.get("method") == "__config__" and bool(
+            header.get("fingerprint")
+        )
+    except json.JSONDecodeError:
+        header_ok = False
+    torn_recorded = len(ep.faults("fs"))
+    missing = [k for k in expected if k not in rows]
+    problems = []
+    if not header_ok:
+        problems.append("config header missing or torn")
+    if torn != torn_recorded:
+        problems.append(
+            f"{torn} torn line(s) on disk vs {torn_recorded} recorded "
+            "fs injections"
+        )
+    if len(missing) != torn:
+        problems.append(
+            f"{len(missing)} expected row(s) absent vs {torn} torn "
+            f"line(s): {missing[:8]}"
+        )
+    if problems:
+        return Verdict("journal_integrity", "fail", "; ".join(problems),
+                       {"torn": torn, "missing": missing})
+    return Verdict(
+        "journal_integrity", "pass",
+        f"{len(rows)} rows parsed, {torn} torn line(s) all accounted",
+        {"rows": len(rows), "torn": torn},
+    )
+
+
+@register(
+    "degraded_where_faulted",
+    "degraded rows / faulted requests sit exactly where the chaos "
+    "harness recorded an injection — no silent extra damage, no "
+    "unrecorded fault",
+)
+def _degraded_where_faulted(ep: RunArtifacts, ref: RunArtifacts) -> Verdict:
+    if ep.workload in _JOURNALED:
+        rows, _ = ep.journal()
+        failed = {k for k, r in rows.items()
+                  if r.get("status", "ok") != "ok"}
+        sites = {f["site"] for f in ep.faults("stage")}
+        if ep.workload == "sweep":
+            expected_failed = set(sites)
+        else:
+            batches = ep.summary.get("batches", {})
+            expected_failed = set()
+            for site in sites:
+                expected_failed |= set(batches.get(site, []))
+        # Only rows the journal still carries are judged here — a row
+        # LOST to a torn append is journal_integrity's accounting, not
+        # an unexplained degradation.
+        expected_failed &= set(rows)
+        if failed != expected_failed:
+            return Verdict(
+                "degraded_where_faulted", "fail",
+                "failed rows do not match recorded stage faults",
+                {"failed": sorted(failed),
+                 "expected": sorted(expected_failed)},
+            )
+        return Verdict(
+            "degraded_where_faulted", "pass",
+            f"{len(failed)} degraded row(s), all at recorded fault sites",
+            {"failed": sorted(failed)},
+        )
+    # Serving: the serve-scope fault set must equal the pure-hash plan
+    # over the replayed request ids, and every rotate-kind fault must
+    # be consistent with the recorded rotation outcome.
+    spec = ep.summary.get("chaos_spec", "")
+    cfg = _chaos.parse_chaos(spec) if spec else None
+    serve = cfg.scope("serve") if cfg else None
+    ids = ep.summary.get("request_ids", [])
+    planned = set()
+    if serve and float(serve["p"]) > 0:
+        planned = {
+            rid for rid in ids
+            if _chaos._unit(int(serve["seed"]), "serve", rid)
+            < float(serve["p"])
+        }
+    observed = {
+        f["site"].removeprefix("req/") for f in ep.faults("serve")
+    }
+    problems = []
+    if observed != planned:
+        problems.append(
+            f"serve faults observed != planned "
+            f"({sorted(observed ^ planned)[:8]})"
+        )
+    rotate_kinds = {f.get("kind") for f in ep.faults("rotate")}
+    status = (ep.summary.get("serving") or {}).get("rotation_status")
+    # corrupt AND mid_swap both end in an atomic refusal (the last good
+    # model keeps serving); slow_verify and a retried retrain-fit fault
+    # still rotate. A refusal with no recorded refusing fault — or a
+    # refusing fault that somehow rotated — is exactly the silent
+    # inconsistency this invariant exists to catch.
+    refusing = {"corrupt", "mid_swap"} & rotate_kinds
+    if refusing and status != "refused":
+        problems.append(
+            f"{sorted(refusing)} fault recorded but "
+            f"rotation_status={status!r}"
+        )
+    if status == "refused" and not refusing:
+        problems.append("rotation refused without a recorded "
+                        "corrupt/mid_swap fault")
+    if problems:
+        return Verdict("degraded_where_faulted", "fail",
+                       "; ".join(problems),
+                       {"planned": sorted(planned),
+                        "observed": sorted(observed)})
+    return Verdict(
+        "degraded_where_faulted", "pass",
+        f"{len(planned)} planned serve fault(s) all observed; rotation "
+        "outcome consistent",
+        {"planned_serve_faults": len(planned)},
+    )
+
+
+# ── serving workloads ─────────────────────────────────────────────────
+
+
+@register(
+    "serving_reconciliation",
+    "the serving report's request reconciliation closes: "
+    "silent_drops == 0",
+    workloads=_SERVING,
+)
+def _serving_reconciliation(ep: RunArtifacts, ref: RunArtifacts) -> Verdict:
+    report = ep.load_json("serving_report.json")
+    if report is None:
+        return Verdict("serving_reconciliation", "fail",
+                       "serving_report.json missing")
+    rec = report.get("reconciliation") or {}
+    drops = rec.get("silent_drops")
+    if drops != 0:
+        return Verdict("serving_reconciliation", "fail",
+                       f"silent_drops={drops!r}", {"reconciliation": rec})
+    return Verdict("serving_reconciliation", "pass", "silent_drops == 0")
+
+
+@register(
+    "zero_compile_window",
+    "the serving window recorded zero jax compile/trace events "
+    "(the steady state provably never compiles)",
+    workloads=_SERVING,
+)
+def _zero_compile_window(ep: RunArtifacts, ref: RunArtifacts) -> Verdict:
+    delta = (ep.summary.get("serving") or {}).get("compile_events_in_window")
+    if delta != 0:
+        return Verdict("zero_compile_window", "fail",
+                       f"compile events in window: {delta!r}")
+    return Verdict("zero_compile_window", "pass",
+                   "0 compile events in the serving window")
+
+
+@register(
+    "typed_rejects_accounted",
+    "every rejection is typed and accounted: the serving report's "
+    "reject timeline count == Σ by-reason == the metered "
+    "serving_rejected_total delta",
+    workloads=_SERVING,
+)
+def _typed_rejects_accounted(ep: RunArtifacts, ref: RunArtifacts) -> Verdict:
+    report = ep.load_json("serving_report.json")
+    if report is None:
+        return Verdict("typed_rejects_accounted", "fail",
+                       "serving_report.json missing")
+    rej = report.get("rejects") or {}
+    count = rej.get("count", 0)
+    by_reason = rej.get("by_reason") or {}
+    metered = (ep.summary.get("serving") or {}).get(
+        "rejected_metered_delta", 0
+    )
+    if not (count == sum(by_reason.values()) == metered):
+        return Verdict(
+            "typed_rejects_accounted", "fail",
+            "reject accounting does not close",
+            {"report_count": count, "by_reason_sum": sum(by_reason.values()),
+             "metered_delta": metered},
+        )
+    return Verdict("typed_rejects_accounted", "pass",
+                   "reject accounting closes (timeline == Σ reasons == "
+                   "metered)")
+
+
+@register(
+    "drain_no_loss",
+    "graceful drain completed with zero in-flight work lost: every "
+    "replayed request was served before the drain reported 'drained'",
+    workloads=_SERVING,
+)
+def _drain_no_loss(ep: RunArtifacts, ref: RunArtifacts) -> Verdict:
+    serving = ep.summary.get("serving") or {}
+    outcome = serving.get("drain_outcome")
+    served = serving.get("served")
+    n = ep.summary.get("n_requests")
+    if outcome != "drained":
+        return Verdict("drain_no_loss", "fail",
+                       f"drain outcome {outcome!r}")
+    if served != n:
+        return Verdict("drain_no_loss", "fail",
+                       f"served {served!r} of {n!r} replayed requests",
+                       {"served": served, "requests": n})
+    return Verdict("drain_no_loss", "pass",
+                   "drained with every replayed request served")

@@ -50,6 +50,8 @@ from typing import Callable, Iterable
 import numpy as np
 
 from ate_replication_causalml_tpu import observability as obs
+from ate_replication_causalml_tpu.resilience import chaos
+from ate_replication_causalml_tpu.resilience.errors import ChaosStageFault
 from ate_replication_causalml_tpu.scenarios.batched import (
     SCENARIO_ESTIMATORS,
     SCHEMA_TAG,
@@ -614,6 +616,31 @@ def run_matrix(
 
         return dataclasses.replace(spec_stage, run=run)
 
+    # Chaos stage faults (ISSUE 15): plan against the declared batch
+    # order up front — the pipeline's PR 4 discipline, so worker
+    # completion order can never race the ``times`` budget — and inject
+    # INSIDE the degrade wrapper, so a faulted batch becomes failed
+    # rows for exactly its cells instead of aborting the matrix.
+    inj = chaos.active()
+    stage_faults: frozenset[str] = frozenset()
+    if inj is not None:
+        stage_faults = inj.plan_stage_faults([
+            f"{p.name}#b{bi}"
+            for p in plans for bi in range(len(p.batches))
+        ])
+
+    def wrap_stage_fault(spec_stage: StageSpec) -> StageSpec:
+        def run(cache, _name=spec_stage.name):
+            # Recorded when RAISED (record_stage_fault), never at plan
+            # time — a drained/aborted matrix must not report a fault
+            # injected on a batch that was skipped.
+            inj.record_stage_fault(_name)
+            raise ChaosStageFault(
+                f"chaos: injected stage fault on {_name!r}"
+            )
+
+        return dataclasses.replace(spec_stage, run=run)
+
     for plan in plans:
         if not plan.batches:
             continue
@@ -626,6 +653,8 @@ def run_matrix(
                 if plan.mode == "vmapped"
                 else sequential_stage(plan, bi, batch)
             )
+            if st.name in stage_faults:
+                st = wrap_stage_fault(st)
             stages.append(wrap_degrade(st, plan, batch))
             report.n_batches += 1
 

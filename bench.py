@@ -56,6 +56,9 @@ PREDICT_AB_ROWS = int(os.environ.get("ATE_BENCH_PREDICT_AB_ROWS", 16_384))
 SCENARIO_REPS = int(os.environ.get("ATE_BENCH_SCENARIO_REPS", 32))
 SCENARIO_ROWS = int(os.environ.get("ATE_BENCH_SCENARIO_ROWS", 384))
 
+# --chaos-campaign scale (ISSUE 15; smoke override).
+CAMPAIGN_EPISODES = int(os.environ.get("ATE_BENCH_CAMPAIGN_EPISODES", 4))
+
 # Set when this process re-execs a CPU child that runs the real bench —
 # the child then owns the $ATE_TPU_METRICS_DIR export (see main()).
 _delegated_to_child = False
@@ -560,6 +563,68 @@ def scenario_matrix_record(n_reps=SCENARIO_REPS, n_rows=SCENARIO_ROWS):
         json.dump(record, f, indent=1, sort_keys=True)
     os.replace(out_path + ".tmp", out_path)
     print(f"# scenario-matrix record: {out_path}", file=sys.stderr)
+    return record
+
+
+def chaos_campaign_record(episodes=CAMPAIGN_EPISODES,
+                          out_path="CHAOS_CAMPAIGN.json"):
+    """``--chaos-campaign`` (ISSUE 15): a micro seeded chaos campaign —
+    composed multi-scope ``ATE_TPU_CHAOS`` storms round-robined over
+    the four real workloads (quick sweep, scenario matrix, serving
+    replay, fleet rotation), every episode judged by the full invariant
+    registry against a fault-free reference of the same seed. Commits
+    the schema-validated ``CHAOS_CAMPAIGN.json``
+    (``scripts/check_metrics_schema.py CHAOS_CAMPAIGN.json``): episode
+    statuses, wall per episode, and the invariant-check tally. The
+    canonical ``campaign_report.json`` (byte-identical per seed) stays
+    in the run dir; this record carries the wall-clock the report
+    deliberately excludes."""
+    import shutil
+    import tempfile
+
+    from ate_replication_causalml_tpu.resilience import campaign as cp
+
+    obs.install_jax_monitoring()
+    outdir = tempfile.mkdtemp(prefix="chaos_campaign_")
+    try:
+        report = cp.run_campaign(
+            outdir, root_seed=7, n_episodes=episodes, scale="micro",
+            log=lambda s: print(s, file=sys.stderr),
+        )
+        with open(os.path.join(outdir, "campaign_walls.json")) as f:
+            walls = json.load(f)["episode_wall_s"]
+    finally:
+        shutil.rmtree(outdir, ignore_errors=True)
+    checks = {"pass": 0, "fail": 0, "skip": 0}
+    eps = []
+    for ep, wall in zip(report["episodes"], walls):
+        for v in ep["invariants"]:
+            checks[v["verdict"]] += 1
+        eps.append({
+            "workload": ep["workload"],
+            "spec": ep["spec"],
+            "status": ep["status"],
+            "wall_s": wall,
+        })
+    record = obs.bench_record(
+        metric="chaos_campaign",
+        value=round(sum(walls), 3),
+        unit="s",
+        n_episodes=len(eps),
+        root_seed=report["root_seed"],
+        scale=report["scale"],
+        workloads=sorted({e["workload"] for e in eps}),
+        all_green=not report["violations"],
+        episodes=eps,
+        invariant_checks=checks,
+        headline=report["headline"],
+    )
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            out_path)
+    with open(out_path + ".tmp", "w") as f:
+        json.dump(record, f, indent=1, sort_keys=True)
+    os.replace(out_path + ".tmp", out_path)
+    print(f"# chaos-campaign record: {out_path}", file=sys.stderr)
     return record
 
 
@@ -1774,6 +1839,12 @@ def _main():
         if "--reps" in sys.argv:
             reps = int(sys.argv[sys.argv.index("--reps") + 1])
         print(json.dumps(scenario_matrix_record(reps)))
+        return None
+    if "--chaos-campaign" in sys.argv:
+        episodes = CAMPAIGN_EPISODES
+        if "--episodes" in sys.argv:
+            episodes = int(sys.argv[sys.argv.index("--episodes") + 1])
+        print(json.dumps(chaos_campaign_record(episodes)))
         return None
     if "--mesh-scaling" in sys.argv:
         return bench_mesh_scaling()

@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Chaos campaign CLI (ISSUE 15).
+
+Usage::
+
+    # a seeded campaign: composed multi-scope fault storms across the
+    # four real workloads, judged by the invariant registry
+    python scripts/chaos_campaign.py --out results/campaign \
+        --seed 7 --episodes 4 --scale micro
+
+    # restrict the workload mix
+    python scripts/chaos_campaign.py --out results/campaign \
+        --workloads sweep,matrix,serving
+
+    # re-run a shrinker-emitted one-line repro (exits nonzero when the
+    # violation re-fails — that exit IS the repro contract)
+    ATE_TPU_CHAOS='tamper:journal,times=1' \
+        python scripts/chaos_campaign.py --repro --workload matrix \
+        --seed 17 --scale micro --out /tmp/repro
+
+Writes ``campaign_report.json`` (byte-identical for the same root
+seed; schema validated by ``scripts/check_metrics_schema.py``) plus
+per-episode artifact directories into ``--out``. Exit status: 0 when
+every invariant is green, 1 on any violation (campaign mode) or when
+the repro re-fails (``--repro`` mode), 2 on a malformed invocation.
+
+Env: ``ATE_TPU_CAMPAIGN_SEED`` (default ``--seed``),
+``ATE_TPU_CAMPAIGN_EPISODES``, and the episode budget knobs
+``ATE_TPU_CAMPAIGN_REPS`` / ``ATE_TPU_CAMPAIGN_REQUESTS``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from ate_replication_causalml_tpu.resilience import campaign  # noqa: E402
+from ate_replication_causalml_tpu.resilience import chaos  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Composed chaos campaigns over the real workloads "
+        "(ISSUE 15)"
+    )
+    ap.add_argument("--out", default=None,
+                    help="output dir (campaign_report.json + episode "
+                    "artifact dirs); required for campaign mode, "
+                    "defaults to a fresh temp dir under --repro so the "
+                    "shrinker's one-line repro runs verbatim")
+    ap.add_argument("--seed", type=int, default=None,
+                    help=f"root seed (default ${campaign.ENV_SEED} or 0)")
+    ap.add_argument("--episodes", type=int, default=None,
+                    help="episode count (default "
+                    f"${campaign.ENV_EPISODES} or 4)")
+    ap.add_argument("--workloads", default=None,
+                    help="comma list from "
+                    f"{','.join(campaign.WORKLOAD_ORDER)}")
+    ap.add_argument("--scale", default="micro",
+                    choices=sorted(campaign.SCALES))
+    ap.add_argument("--no-shrink", action="store_true",
+                    help="report violations without delta-debugging "
+                    "them to a minimal repro")
+    ap.add_argument("--repro", action="store_true",
+                    help="single-episode repro mode: run --workload "
+                    "--seed under $ATE_TPU_CHAOS (or --chaos) against "
+                    "a fault-free reference and exit 1 if any "
+                    "invariant fails")
+    ap.add_argument("--workload", default=None,
+                    help="(--repro) the workload to replay")
+    ap.add_argument("--chaos", default=None,
+                    help="(--repro) chaos spec; default $ATE_TPU_CHAOS")
+    args = ap.parse_args(argv)
+
+    if args.repro:
+        if not args.workload or args.seed is None:
+            ap.error("--repro needs --workload and --seed")
+        spec = (args.chaos if args.chaos is not None
+                else os.environ.get(chaos.ENV_VAR, "").strip())
+        if not spec:
+            ap.error("--repro needs --chaos or $ATE_TPU_CHAOS")
+        out = args.out
+        if out is None:
+            import tempfile
+
+            out = tempfile.mkdtemp(prefix="chaos_repro_")
+            print(f"# repro artifacts: {out}")
+        verdicts = campaign.run_repro(
+            args.workload, args.seed, spec, out, args.scale
+        )
+        failed = [v for v in verdicts if v.verdict == "fail"]
+        for v in verdicts:
+            print(f"  {v.invariant:<26} {v.verdict:<5} {v.detail}")
+        if failed:
+            print(f"REPRO RE-FAILS: {sorted(v.invariant for v in failed)}")
+            return 1
+        print("repro did not fail (all invariants green)")
+        return 0
+
+    if args.out is None:
+        ap.error("campaign mode needs --out")
+    workloads = None
+    if args.workloads:
+        workloads = tuple(
+            w.strip() for w in args.workloads.split(",") if w.strip()
+        )
+    report = campaign.run_campaign(
+        args.out,
+        root_seed=args.seed,
+        n_episodes=args.episodes,
+        workloads=workloads,
+        scale=args.scale,
+        shrink=not args.no_shrink,
+    )
+    print(report["headline"])
+    return 1 if report["violations"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

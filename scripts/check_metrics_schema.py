@@ -10,6 +10,8 @@ Usage::
     python scripts/check_metrics_schema.py HIST_AB.json         # ISSUE 10
     python scripts/check_metrics_schema.py PREDICT_AB.json      # ISSUE 12
     python scripts/check_metrics_schema.py SCENARIO_MATRIX.json # ISSUE 13
+    python scripts/check_metrics_schema.py CHAOS_CAMPAIGN.json  # ISSUE 15
+    python scripts/check_metrics_schema.py .../campaign_report.json
 
 Checks ``metrics.json`` (schema version, section shapes, the counter
 families every instrumented run must carry — shard retry, compile
@@ -128,6 +130,11 @@ REQUIRED_COUNTERS = (
     "watchdog_stalls_total",
     "serving_deadline_exceeded_total",
     "drain_total",
+    # Chaos campaign engine (ISSUE 15): episode outcomes by workload
+    # and invariant verdicts — "no campaign ever ran" is a recorded 0
+    # on every instrumented run.
+    "chaos_campaign_episodes_total",
+    "chaos_invariant_checks_total",
 )
 
 _EVENT_FIELDS = (
@@ -1019,6 +1026,210 @@ def validate_scenario_matrix_record(record: dict, tol: float = 1e-9) -> list[str
     return errors
 
 
+def validate_campaign_report(report: dict) -> list[str]:
+    """``campaign_report.json`` (ISSUE 15): episode accounting closes,
+    every registered invariant verdict is present per episode, the
+    shrinker's minimal fault set is a subset of the episode's planned
+    atoms, and the minimal repro was confirmed to re-fail."""
+    from ate_replication_causalml_tpu.resilience.invariants import (
+        registered_names,
+    )
+
+    errors: list[str] = []
+    if report.get("schema_version") != 1:
+        errors.append(
+            f"campaign: schema_version {report.get('schema_version')!r} "
+            "!= 1"
+        )
+    registry = list(report.get("invariant_registry") or [])
+    if set(registry) != set(registered_names()):
+        errors.append(
+            "campaign: invariant_registry does not match the code's "
+            f"registry (report: {sorted(registry)}, code: "
+            f"{sorted(registered_names())})"
+        )
+    episodes = report.get("episodes")
+    if not isinstance(episodes, list) or not episodes:
+        return errors + ["campaign: episodes missing or empty"]
+    if report.get("n_episodes") != len(episodes):
+        errors.append(
+            f"campaign: n_episodes {report.get('n_episodes')} != "
+            f"{len(episodes)} episodes"
+        )
+    by_workload: dict = {}
+    violated: list[int] = []
+    for pos, ep in enumerate(episodes):
+        tag = f"campaign: episode[{pos}]"
+        if ep.get("index") != pos:
+            errors.append(f"{tag}: index {ep.get('index')} != {pos}")
+        atoms = ep.get("atoms") or []
+        spec = ";".join(a.get("spec", "") for a in atoms)
+        if ep.get("spec") != spec:
+            errors.append(f"{tag}: spec does not equal its composed atoms")
+        verdicts = ep.get("invariants") or []
+        names = [v.get("invariant") for v in verdicts]
+        if names != registry:
+            errors.append(
+                f"{tag}: invariant verdicts {names} != registry order"
+            )
+        bad_verdicts = [
+            v for v in verdicts
+            if v.get("verdict") not in ("pass", "fail", "skip")
+        ]
+        if bad_verdicts:
+            errors.append(f"{tag}: malformed verdict values")
+        failing = [v["invariant"] for v in verdicts
+                   if v.get("verdict") == "fail"]
+        want_status = "violated" if failing else "green"
+        if ep.get("status") != want_status:
+            errors.append(
+                f"{tag}: status {ep.get('status')!r} but "
+                f"{len(failing)} failing verdict(s)"
+            )
+        if want_status == "violated":
+            violated.append(pos)
+        w = by_workload.setdefault(
+            ep.get("workload"), {"green": 0, "violated": 0}
+        )
+        w[want_status] += 1
+    if report.get("by_workload") != by_workload:
+        errors.append("campaign: by_workload accounting does not close")
+    if list(report.get("violations") or []) != violated:
+        errors.append(
+            f"campaign: violations {report.get('violations')} != "
+            f"episodes with failing verdicts {violated}"
+        )
+    shrink = report.get("shrink")
+    if not isinstance(shrink, list):
+        errors.append("campaign: shrink missing (must be a list)")
+        shrink = []
+    for si, entry in enumerate(shrink):
+        tag = f"campaign: shrink[{si}]"
+        idx = entry.get("episode")
+        if idx not in violated:
+            errors.append(f"{tag}: episode {idx} is not a violation")
+            continue
+        ep = episodes[idx]
+        ep_atoms = {(a.get("scope"), a.get("spec"))
+                    for a in ep.get("atoms") or []}
+        minimal = entry.get("minimal_atoms") or []
+        extra = [
+            a for a in minimal
+            if (a.get("scope"), a.get("spec")) not in ep_atoms
+        ]
+        if extra or not minimal:
+            errors.append(
+                f"{tag}: minimal_atoms is empty or not a subset of the "
+                f"episode's planned atoms ({extra})"
+            )
+        failing = entry.get("failing") or []
+        if not failing or not set(failing) <= set(registry):
+            errors.append(f"{tag}: failing {failing} not in the registry")
+        if entry.get("confirmed") is not True:
+            errors.append(
+                f"{tag}: minimal repro was not confirmed to re-fail"
+            )
+        repro = entry.get("repro", "")
+        min_spec = ";".join(a.get("spec", "") for a in minimal)
+        for needle in (min_spec,
+                       f"--workload {ep.get('workload')}",
+                       f"--seed {ep.get('seed')}"):
+            if needle and needle not in repro:
+                errors.append(
+                    f"{tag}: repro line is missing {needle!r}"
+                )
+    headline = report.get("headline", "")
+    if shrink:
+        if headline != shrink[0].get("repro"):
+            errors.append(
+                "campaign: headline is not the first shrink repro"
+            )
+    elif violated:
+        if not headline.startswith("VIOLATED"):
+            errors.append(
+                "campaign: violated without shrink must headline "
+                "VIOLATED"
+            )
+    elif not headline.startswith("all green"):
+        errors.append("campaign: green campaign must headline 'all green'")
+    return errors
+
+
+def validate_chaos_campaign_record(record: dict) -> list[str]:
+    """Committed ``CHAOS_CAMPAIGN.json`` (``bench.py --chaos-campaign``):
+    episode accounting closes, walls are sane, and the green claim is
+    consistent with both the per-episode statuses and the invariant
+    check tally."""
+    from ate_replication_causalml_tpu.resilience.invariants import (
+        registered_names,
+    )
+
+    errors: list[str] = []
+    if record.get("metric") != "chaos_campaign":
+        errors.append(
+            f"chaos_campaign: metric {record.get('metric')!r} != "
+            "'chaos_campaign'"
+        )
+    episodes = record.get("episodes")
+    if not isinstance(episodes, list) or not episodes:
+        return errors + ["chaos_campaign: episodes missing or empty"]
+    if record.get("n_episodes") != len(episodes):
+        errors.append(
+            f"chaos_campaign: n_episodes {record.get('n_episodes')} != "
+            f"{len(episodes)}"
+        )
+    total = 0.0
+    statuses = []
+    for pos, ep in enumerate(episodes):
+        tag = f"chaos_campaign: episode[{pos}]"
+        for key in ("workload", "spec", "status", "wall_s"):
+            if key not in ep:
+                errors.append(f"{tag}: missing {key}")
+        wall = ep.get("wall_s", -1.0)
+        if not isinstance(wall, (int, float)) or wall < 0:
+            errors.append(f"{tag}: wall_s {wall!r} invalid")
+        else:
+            total += wall
+        if ep.get("status") not in ("green", "violated"):
+            errors.append(f"{tag}: status {ep.get('status')!r} invalid")
+        statuses.append(ep.get("status"))
+    value = record.get("value")
+    if not isinstance(value, (int, float)) or abs(value - total) > 0.01:
+        errors.append(
+            f"chaos_campaign: value {value!r} != Σ episode walls "
+            f"{round(total, 3)}"
+        )
+    if record.get("unit") != "s":
+        errors.append(f"chaos_campaign: unit {record.get('unit')!r} != 's'")
+    all_green = all(s == "green" for s in statuses)
+    if record.get("all_green") is not all_green:
+        errors.append(
+            f"chaos_campaign: all_green {record.get('all_green')!r} "
+            f"inconsistent with episode statuses"
+        )
+    workloads = sorted({ep.get("workload") for ep in episodes})
+    if list(record.get("workloads") or []) != workloads:
+        errors.append(
+            f"chaos_campaign: workloads {record.get('workloads')} != "
+            f"{workloads}"
+        )
+    checks = record.get("invariant_checks") or {}
+    want_total = len(episodes) * len(registered_names())
+    got_total = sum(
+        checks.get(k, 0) for k in ("pass", "fail", "skip")
+    )
+    if got_total != want_total:
+        errors.append(
+            f"chaos_campaign: invariant_checks total {got_total} != "
+            f"episodes × registry {want_total}"
+        )
+    if all_green and checks.get("fail", 0) != 0:
+        errors.append(
+            "chaos_campaign: all_green with nonzero failing checks"
+        )
+    return errors
+
+
 def validate_trace_files(outdir: str) -> list[str]:
     """Validate trace.json / overlap_report.json / serving_report.json
     / slo_report.json in ``outdir`` when present (tracing and serving
@@ -1137,6 +1348,9 @@ def main(argv: list[str] | None = None) -> int:
         ("PREDICT_AB", "predict_ab", validate_predict_ab_record),
         ("SCENARIO_MATRIX", "scenario_matrix",
          validate_scenario_matrix_record),
+        ("CHAOS_CAMPAIGN", "chaos_campaign",
+         validate_chaos_campaign_record),
+        ("campaign_report", "campaign", validate_campaign_report),
     )
     if len(args.paths) == 1:
         base = os.path.basename(args.paths[0])

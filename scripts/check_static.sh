@@ -16,7 +16,7 @@ PY="${PYTHON:-$(command -v python || command -v python3)}"
 
 fail=0
 
-echo "== graftlint (JAX-aware rules JGL001-012) =="
+echo "== graftlint (JAX-aware rules JGL001-013) =="
 "$PY" scripts/graftlint.py ate_replication_causalml_tpu scripts || fail=1
 
 echo "== compileall (syntax gate) =="

@@ -163,6 +163,101 @@ def test_serving_quick_record_schema_stubbed(monkeypatch):
     assert rec["close_reasons"] == {"bucket_full": 10, "window_expired": 25}
 
 
+def test_chaos_campaign_record_schema_stubbed(monkeypatch):
+    """The `chaos_campaign` record schema (ISSUE 15), pinned WITHOUT
+    running real workloads (tier-1 budget): run_campaign is stubbed to
+    a canned green report + walls sidecar. The record must validate
+    under the SAME gate as the committed CHAOS_CAMPAIGN.json; the
+    executable end-to-end proof is tests/test_campaign.py's live rig
+    and the @slow heavy campaign there."""
+    import bench
+    from ate_replication_causalml_tpu.resilience import campaign as cp
+    from ate_replication_causalml_tpu.resilience.invariants import (
+        registered_names,
+    )
+
+    def canned_report(workload, index, seed, atoms):
+        return {
+            "index": index, "workload": workload, "seed": seed,
+            "spec": ";".join(s for _, s in atoms),
+            "atoms": [{"scope": sc, "spec": sp} for sc, sp in atoms],
+            "status": "green",
+            "invariants": [
+                {"invariant": n, "verdict": "pass", "detail": "", "data": {}}
+                for n in registered_names()
+            ],
+        }
+
+    eps = [
+        canned_report("sweep", 0, 11, (("fs", "fs:torn_write,times=1"),)),
+        canned_report("serving", 1, 12,
+                      (("serve", "serve:p=0.1,seed=1,times=1"),)),
+    ]
+
+    def fake_run_campaign(outdir, root_seed=None, n_episodes=None,
+                          scale="micro", log=print, **kw):
+        import json as _json
+        import os as _os
+
+        with open(_os.path.join(outdir, "campaign_walls.json"), "w") as f:
+            _json.dump({"episode_wall_s": [1.25, 0.5]}, f)
+        return {
+            "schema_version": 1, "root_seed": 7, "scale": "micro",
+            "invariant_registry": list(registered_names()),
+            "n_episodes": 2, "episodes": eps,
+            "by_workload": {"sweep": {"green": 1, "violated": 0},
+                            "serving": {"green": 1, "violated": 0}},
+            "violations": [], "shrink": [],
+            "headline": "all green: 2 episodes x "
+                        f"{len(registered_names())} invariants",
+        }
+
+    monkeypatch.setattr(cp, "run_campaign", fake_run_campaign)
+    out_path = "CHAOS_CAMPAIGN.test.json"
+    rec = bench.chaos_campaign_record(episodes=2, out_path=out_path)
+    try:
+        for field in ("metric", "value", "unit", "n_episodes",
+                      "root_seed", "scale", "workloads", "all_green",
+                      "episodes", "invariant_checks", "headline"):
+            assert field in rec, field
+        assert rec["metric"] == "chaos_campaign"
+        assert rec["value"] == 1.75 and rec["unit"] == "s"
+        assert rec["all_green"] is True
+        assert rec["workloads"] == ["serving", "sweep"]
+        assert rec["invariant_checks"] == {
+            "pass": 2 * len(registered_names()), "fail": 0, "skip": 0,
+        }
+        sys.path.insert(0, os.path.join(_REPO, "scripts"))
+        from check_metrics_schema import validate_chaos_campaign_record
+
+        assert validate_chaos_campaign_record(rec) == []
+        # The validator actually rejects a broken record (not a rubber
+        # stamp): flip the green claim.
+        broken = dict(rec, all_green=False)
+        assert validate_chaos_campaign_record(broken)
+    finally:
+        path = os.path.join(_REPO, out_path)
+        if os.path.exists(path):
+            os.remove(path)
+
+
+def test_committed_chaos_campaign_record_is_schema_clean():
+    """The CHAOS_CAMPAIGN.json committed at the repo root validates,
+    is all green, and covers multiple workloads — the bench evidence
+    the campaign engine's acceptance is anchored to."""
+    sys.path.insert(0, os.path.join(_REPO, "scripts"))
+    from check_metrics_schema import validate_chaos_campaign_record
+
+    with open(os.path.join(_REPO, "CHAOS_CAMPAIGN.json")) as f:
+        rec = json.load(f)
+    assert validate_chaos_campaign_record(rec) == []
+    assert rec["all_green"] is True
+    assert len(rec["workloads"]) >= 3
+    # Every episode composed at least two chaos scopes.
+    for ep in rec["episodes"]:
+        assert ep["spec"].count(";") >= 1, ep
+
+
 @pytest.mark.slow
 def test_default_bench_emits_six_records_cpu_smoke():
     """`python bench.py` must print one JSON record per metric (quick

@@ -1009,6 +1009,95 @@ def test_mid_swap_chaos_refused_atomically(fleet_rig):
                           refs["b_v1"][0][:xs[0].shape[0]])
 
 
+def test_composed_serve_rotate_hang_chaos_bit_identity(fleet_rig):
+    """ISSUE 15 satellite: the three serving-side chaos scopes ARMED
+    TOGETHER — ``serve:`` typed faults with degraded recovery,
+    ``rotate:corrupt`` refusing a torn published candidate, and
+    ``hang:dispatch`` stalls — on the live fleet rig. PR 11's
+    regression covered only serve:+rotate:; real incidents compose.
+    Asserts per-version bit-identity for BOTH tenants, planned ==
+    observed serve faults, the atomic refusal, and that the composed
+    storm put ZERO compiles inside the serving window (re-checked here,
+    not just at module teardown)."""
+    from ate_replication_causalml_tpu.serving.daemon import RejectedRequest
+    from ate_replication_causalml_tpu.utils.checkpoint import load_fitted
+
+    server = fleet_rig["server"]
+    xs = fleet_rig["xs"]
+    refs = fleet_rig["refs"]
+    offs = _offsets(xs)
+    compile_mark = server.compile_events_in_window()
+    b_version = server.fleet.get("b").version
+    default_version = server.fleet.get("default").version
+
+    ids = [f"cmp{i}" for i in range(24)]
+    spec = (
+        "serve:p=0.3,seed=23,times=1;"
+        "rotate:corrupt,times=1;"
+        "hang:scope=dispatch,ms=30,p=0.5,seed=4,times=1"
+    )
+    faulted: list[str] = []
+    results: dict[str, tuple] = {}
+    models: dict[str, str] = {}
+    sup = server.retrain_supervisor(
+        "b",
+        lambda: load_fitted(fleet_rig["ckpts"]["b_v1"], verify=True),
+        fleet_rig["publish_dir"],
+        config=RetrainConfig(max_attempts=1, backoff_s=0.001),
+    )
+    with chaos.override(spec):
+        for i, rid in enumerate(ids):
+            if i == len(ids) // 2:
+                # The corrupt-candidate rotation lands mid-stream,
+                # while serve faults and dispatcher stalls are flowing.
+                out = sup.run_once()
+                assert out.status == "refused"
+            models[rid] = "b" if i % 3 == 0 else ""
+            for _ in range(300):
+                try:
+                    req = server.serve_request(
+                        rid, xs[i], model=models[rid] or None
+                    )
+                    break
+                except RejectedRequest as rej:
+                    if rej.code == "serve_fault":
+                        faulted.append(rid)
+                    else:
+                        assert rej.code in ("overloaded", "degraded",
+                                            "model_degraded"), rej.code
+                    time.sleep(rej.retry_after_s or 0.002)
+            else:
+                raise AssertionError(f"no progress on {rid}")
+            results[rid] = (req.result, req.model_version)
+
+    # Planned == observed: selection is the pure (seed, "serve", id)
+    # hash, composed scopes or not.
+    expected = [rid for rid in ids if chaos._unit(23, "serve", rid) < 0.3]
+    assert faulted == expected and len(expected) > 0
+    # The refused rotation changed nothing: versions stable, daemon
+    # recovered to serving.
+    assert server.fleet.get("b").version == b_version
+    assert server.fleet.get("default").version == default_version
+    assert server.lifecycle.state == "serving"
+    # Per-version bit-identity for both tenants under the full storm.
+    # Tenant b only ever rotates same-bytes in this module, so its
+    # CONTENT is b_v1 whatever its version counter says; default's
+    # version number tracks which checkpoint's bytes it serves.
+    for i, rid in enumerate(ids):
+        (cate, var), version = results[rid]
+        key = "b_v1" if models[rid] == "b" else (
+            f"default_v{default_version}"
+        )
+        assert version == (b_version if models[rid] == "b"
+                           else default_version)
+        refc, refv = refs[key]
+        lo, hi = offs[i], offs[i] + xs[i].shape[0]
+        assert np.array_equal(cate, refc[lo:hi]), rid
+        assert np.array_equal(var, refv[lo:hi]), rid
+    # The composed storm compiled NOTHING inside the serving window.
+    assert server.compile_events_in_window() == compile_mark
+
+
 def test_per_model_degradation_never_503s_another(fleet_rig):
     """A model-scoped fault degrades ONLY that tenant: its requests get
     typed retryable rejects while recovery re-verifies its last good

@@ -1110,6 +1110,60 @@ def test_jgl012_quiet_on_bounded_forms_and_suppression():
     assert [f.line for f in res.suppressed] == [6]
 
 
+# --------------------------------------------------------------- JGL013
+
+
+JGL013_BAD = """\
+import time
+import uuid
+
+def dispatch(inj, batch, rid, attempt):
+    inj.take_serve_fault(f"r{time.time()}")          # line 5: wall clock
+    inj.hang_delay_s("dispatch", str(id(batch)))     # line 6: object id
+    inj.take_serve_fault(f"{rid}/{attempt}")         # line 7: per-attempt
+    inj.take_rotate_fault("corrupt", site=uuid.uuid4().hex)  # line 8
+    inj.torn_line("x", site=f"j-{time.monotonic()}")  # line 9
+"""
+
+JGL013_GOOD = """\
+import time
+
+def dispatch(inj, batch, node, request_id, attempts, i, path):
+    inj.take_serve_fault(request_id)                # client-stable id
+    inj.hang_delay_s("worker", node.name)           # declared node name
+    inj.hang_delay_s("dispatch", batch.requests[0].request_id)
+    inj.shard_should_fail("forest", i, attempts[i])  # attempt is NOT a
+    inj.torn_line("x", site=path)                    # site argument
+    inj.take_rotate_fault("corrupt", site=f"rotate/{node.model_id}")
+    t0 = time.monotonic()                            # timing outside the
+    return t0                                        # site args is fine
+"""
+
+
+def test_jgl013_fires_on_unstable_site_ids():
+    """ISSUE 15 / the PR 14 gotcha as code: chaos selection hashes the
+    SITE, so a wall-clock-, id()- or attempt-derived site id breaks
+    planned == observed and the times-budget convergence."""
+    assert _lines(JGL013_BAD, "JGL013") == [5, 6, 7, 8, 9]
+    msgs = _messages(JGL013_BAD, "JGL013")
+    assert "time.time()" in msgs[0]
+    assert "id()" in msgs[1]
+    assert "attempt" in msgs[2]
+
+
+def test_jgl013_quiet_on_stable_sites_and_suppression():
+    assert _lines(JGL013_GOOD, "JGL013") == []
+    src = JGL013_BAD.replace(
+        '    inj.hang_delay_s("dispatch", str(id(batch)))     '
+        "# line 6: object id",
+        '    inj.hang_delay_s("dispatch", str(id(batch)))  '
+        "# graftlint: disable=JGL013",
+    )
+    res = lint_source(src, relpath="pkg/mod.py", select=["JGL013"])
+    assert [f.line for f in res.findings] == [5, 7, 8, 9]
+    assert [f.line for f in res.suppressed] == [6]
+
+
 # ----------------------------------------------------- suppressions etc.
 
 

@@ -112,6 +112,7 @@ MICRO = dataclasses.replace(
 )
 
 
+@pytest.mark.slow
 def test_changed_config_invalidates_checkpoint(tmp_path):
     """One MICRO sweep writes a real checkpoint; the invalidation
     mechanics are then asserted directly on ``_Checkpoint`` with the
@@ -120,7 +121,18 @@ def test_changed_config_invalidates_checkpoint(tmp_path):
     sweep only re-exercised the estimator stages the first one already
     covered, at ~2 min of XLA compiles (suite wall-clock, VERDICT r2
     #8). The resume-on-match leg runs end-to-end in
-    ``test_full_sweep_and_resume``."""
+    ``test_full_sweep_and_resume``.
+
+    @slow since ISSUE 15 (the documented tier-1 budget swap): the
+    chaos-campaign acceptance rig (tests/test_campaign.py) runs TWO
+    micro sweeps at exactly these MICRO shapes (a fault-free reference
+    and a 4-scope chaos episode) and displaced this test's single
+    sweep from the tier-1 budget. The _Checkpoint fingerprint/stale
+    mechanics this test pins directly stay covered in tier-1 by the
+    campaign's journal-integrity invariant plus the no-jax checkpoint
+    units in tests/test_resilience.py; the sequential-scheduler escape
+    hatch stays covered by the traced sequential micro sweep in
+    tests/test_trace.py."""
     from ate_replication_causalml_tpu.pipeline import _Checkpoint
 
     out = str(tmp_path / "sweep")
@@ -172,7 +184,9 @@ def test_sweep_no_outdir_runs_in_memory():
     # ~35 s) displaced this ~40 s run. What this test added over the
     # rest of tier-1 was thin by then — the sequential escape hatch is
     # exercised by test_changed_config_invalidates_checkpoint's MICRO
-    # sweep (which also pays these shapes' compiles) and by the traced
+    # sweep (itself @slow since ISSUE 15; the MICRO shapes' compiles
+    # are now paid in tier-1 by the campaign rig's sweep episodes in
+    # tests/test_campaign.py) and by the traced
     # sequential micro sweep in tests/test_trace.py; only the
     # outdir=None plumbing branch (checkpoint + exports disabled) is
     # unique here, and it keeps end-to-end coverage in this tier.

@@ -89,6 +89,85 @@ def test_chaos_active_is_env_driven_and_budgeted():
     assert chaos.active() is None
 
 
+def test_tamper_scope_perturbs_first_ate_row_only(tmp_path):
+    """The ISSUE 15 detection-power scope: ``tamper:journal`` rewrites
+    the next journaled row's ate by delta — a VALID line, invisible to
+    the torn-line reader — skipping rows without a finite numeric ate
+    (the header) without spending budget, and stopping at ``times``."""
+    import json as _json
+
+    with chaos.override("tamper:journal,delta=0.5,times=1") as inj:
+        hdr = '{"method": "__config__", "fingerprint": "f"}\n'
+        assert inj.tamper_line(hdr, site="j") == hdr  # no ate: no spend
+        nan_row = '{"method": "m0", "ate": NaN}\n'
+        out = inj.tamper_line('{"method": "m1", "ate": 1.25}\n', site="j")
+        rec = _json.loads(out)
+        assert rec["ate"] == 1.75 and out.endswith("\n")
+        # budget spent: later rows (and the NaN row) pass untouched
+        assert inj.tamper_line(nan_row, site="j") == nan_row
+        again = '{"method": "m2", "ate": 3.0}\n'
+        assert inj.tamper_line(again, site="j") == again
+        counts = obs.REGISTRY.peek("chaos_injections_total")
+        assert counts.get("scope=tamper") == 1
+
+
+def test_tampered_row_is_never_also_torn(tmp_path):
+    """Composition regression (review find): with tamper:journal AND
+    fs:torn_write armed together, the first finite-ate row takes the
+    tamper and the tear budget keeps for the NEXT append — a tampered
+    row that was then torn would be skipped by the reader, erasing the
+    planted corruption while its injection stayed recorded (a tamper
+    the invariant registry could no longer detect)."""
+    import json as _json
+
+    from ate_replication_causalml_tpu.pipeline import _Checkpoint
+
+    path = str(tmp_path / "results.jsonl")
+    with chaos.override("tamper:journal,delta=1.0,times=1;"
+                        "fs:torn_write,times=1"):
+        ck = _Checkpoint(path, "fp", log=lambda s: None)
+        for i in range(3):
+            ck.put({"method": f"m{i}", "ate": float(i), "se": 0.1,
+                    "lower_ci": -1.0, "upper_ci": 1.0, "status": "ok"})
+    lines = [l for l in open(path).read().splitlines() if l.strip()]
+    parsed, torn = {}, 0
+    for l in lines:
+        try:
+            rec = _json.loads(l)
+        except _json.JSONDecodeError:
+            torn += 1
+            continue
+        if rec["method"] != "__config__":
+            parsed[rec["method"]] = rec
+    # The tampered row SURVIVED (detectably wrong), the tear landed on
+    # the next append instead.
+    assert parsed["m0"]["ate"] == 1.0
+    assert torn == 1 and "m1" not in parsed
+    assert parsed["m2"]["ate"] == 2.0
+
+
+def test_tamper_scope_grammar_and_checkpoint_injection(tmp_path):
+    cfg = chaos.parse_chaos("tamper:journal,delta=0.01,times=3")
+    assert cfg.scope("tamper") == {"journal": True, "delta": 0.01,
+                                   "times": 3}
+    # Through the real journal writer: the on-disk ate diverges from
+    # the in-memory copy (the current run stays correct — exactly the
+    # silent-corruption shape only a reference comparison catches).
+    import json as _json
+
+    from ate_replication_causalml_tpu.pipeline import _Checkpoint
+
+    path = str(tmp_path / "results.jsonl")
+    with chaos.override("tamper:journal,delta=1.0,times=1"):
+        ck = _Checkpoint(path, "fp", log=lambda s: None)
+        ck.put({"method": "m", "ate": 2.0, "se": 0.1,
+                "lower_ci": 1.8, "upper_ci": 2.2, "status": "ok"})
+        assert ck.get("m")["ate"] == 2.0  # in-memory copy untouched
+    rows = [_json.loads(l) for l in open(path) if l.strip()]
+    on_disk = next(r for r in rows if r["method"] == "m")
+    assert on_disk["ate"] == 3.0  # the file lies — and parses
+
+
 # ── shard scope through run_shards ──────────────────────────────────────
 
 
