@@ -1,4 +1,4 @@
-"""The graftlint rule set (JGL001–JGL012).
+"""The graftlint rule set (JGL001–JGL014).
 
 Each rule targets a failure class that has actually bitten (or nearly
 bitten) this codebase on TPU — see ADVICE.md and the rule docstrings.
@@ -1515,4 +1515,106 @@ class UnstableChaosSite(Rule):
                         "observed and the times-budget convergence; use "
                         "a client-stable id (request id, node name, "
                         "model id, path)",
+                    )
+
+
+# ---------------------------------------------------------------- JGL014
+
+#: metric mutator method names (registry.py's Counter.inc /
+#: Histogram.observe / Gauge.set) whose KEYWORD arguments are label
+#: values — the per-label-key time series the registry materializes.
+_METRIC_MUTATOR_ATTRS = ("inc", "observe", "set")
+
+#: names that smell like a per-request / per-connection identifier —
+#: unbounded over a daemon's lifetime, so one of these as a label value
+#: mints a fresh time series per request. Terminal-word match only:
+#: ``model_id`` / ``node_id`` style BOUNDED identifiers must not match.
+_REQUEST_SCOPED_NAME_RE = re.compile(
+    r"(^|_)(request_id|req_id|rid|trace_id|span_id|session_id|"
+    r"client_id|conn_id|uuid|nonce|token|remote_addr|peer|addr)$"
+)
+
+#: the sanctioned escape hatch: a label value passed through a
+#: fold/sanitize call (``registry.sanitize_label``, the daemon's
+#: unknown-model fold) is bounded by construction.
+_LABEL_FOLD_CALL_RE = re.compile(r"(sanitize|fold)", re.IGNORECASE)
+
+
+@register
+class UnboundedMetricLabelCardinality(Rule):
+    """ISSUE 16's observability-budget contract: the metrics registry
+    keeps one monotonic time series per distinct label key, forever —
+    ``peek()`` snapshots, ``/varz``, the Prometheus exposition and the
+    schema validator all walk every series. A per-request identifier
+    (request id, trace id, peer address, nonce) — or any
+    fresh-every-call value (``uuid4()``, ``time.time()``) — used as a
+    label VALUE turns a bounded family into an unbounded one: memory
+    grows with traffic, scrapes slow down linearly, and the statistical
+    SLO engine's ``peek`` per tick degrades with it. Label values in
+    the serving and observability tiers must come from closed sets
+    (model ids, buckets, phases, typed statuses); per-request detail
+    belongs in the trace, not the registry. Folding through a
+    ``sanitize``/``fold`` helper (``registry.sanitize_label``, the
+    dispatcher's unknown-model fold) is the sanctioned escape hatch."""
+
+    id = "JGL014"
+    name = "unbounded-metric-label-cardinality"
+    description = (
+        "per-request identifier or fresh-every-call value used as a "
+        "metric label value in serving/ or observability/ — one time "
+        "series per request; fold to a closed set (sanitize_label) or "
+        "put it in the trace"
+    )
+
+    def _in_scope(self, relpath: str) -> bool:
+        rel = relpath.replace("\\", "/")
+        return "serving/" in rel or "observability/" in rel
+
+    def _culprit(self, module: ModuleInfo, expr: ast.expr) -> str | None:
+        # Sanctioned-fold scan first: a sanitize/fold call ANYWHERE in
+        # the value expression bounds it, whatever fed the fold.
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                name = module.resolve(sub.func) or ""
+                attr = (sub.func.attr if isinstance(sub.func, ast.Attribute)
+                        else name)
+                if attr and _LABEL_FOLD_CALL_RE.search(attr):
+                    return None
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                name = module.resolve(sub.func)
+                if name in _UNSTABLE_SITE_CALLS:
+                    return f"{name}()"
+            elif isinstance(sub, ast.Name):
+                if _REQUEST_SCOPED_NAME_RE.search(sub.id):
+                    return sub.id
+            elif isinstance(sub, ast.Attribute):
+                if _REQUEST_SCOPED_NAME_RE.search(sub.attr):
+                    return sub.attr
+        return None
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if not self._in_scope(module.relpath):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            if func.attr not in _METRIC_MUTATOR_ATTRS:
+                continue
+            for kw in node.keywords:
+                if kw.arg is None:
+                    continue  # **labels: the caller's names, not ours
+                culprit = self._culprit(module, kw.value)
+                if culprit is not None:
+                    yield self.finding(
+                        module,
+                        node,
+                        f"label {kw.arg}={culprit} on .{func.attr}() mints "
+                        "one time series per request — the registry keeps "
+                        "every label key forever; fold to a closed set "
+                        "(registry.sanitize_label) or record it in the "
+                        "trace instead",
                     )

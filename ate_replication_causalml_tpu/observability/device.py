@@ -219,6 +219,19 @@ def install_jax_monitoring() -> bool:
     counter("chaos_invariant_checks_total",
             "campaign invariant evaluations by invariant and verdict"
             ).inc(0)
+    # Statistical-health families (ISSUE 16): rows folded into the
+    # per-model sketches, sealed drift-window verdicts (the family the
+    # stat_drift/stat_calibration SLOs read), and fired drift
+    # detectors. "The monitor never saw a row" is a recorded 0.
+    counter("serving_stat_rows_total",
+            "rows folded into the statistical-health sketches, by model"
+            ).inc(0)
+    counter("serving_stat_windows_total",
+            "sealed statistical-health windows by model, channel and "
+            "ok/drift/miscal/sparse status").inc(0)
+    counter("stat_drift_events_total",
+            "statistical drift detections by model, channel and "
+            "psi/ks/calibration detector").inc(0)
     if _installed:
         return True
     try:

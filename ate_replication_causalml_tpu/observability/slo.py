@@ -75,8 +75,11 @@ class SLO:
     #: the per-model scope (``model=tenantA``). Empty = every sample.
     scope_match: str = ""
     #: availability only: alternatives (separated by ``|``) of ``k=v``
-    #: pair groups DISQUALIFYING a sample from the totals — a sample
-    #: matching ANY alternative is excluded. The shedder's SLOs ignore
+    #: pair groups DISQUALIFYING a sample entirely — a sample matching
+    #: ANY alternative counts toward neither the totals nor the good
+    #: side (a disqualified sample must not bank budget either, e.g. a
+    #: ``status=ok`` sample on an ignored channel). The shedder's SLOs
+    #: ignore
     #: ``status=rejected_shed`` (shedding must not feed back into the
     #: burn rate that triggered it) and the client-error rejects (a
     #: malformed-request spammer must not burn a tenant's budget and
@@ -148,6 +151,49 @@ def fleet_slos(
     )
 
 
+def stat_health_slos(
+    models: tuple[str, ...] = ("default",),
+    objective: float = 0.9,
+    windows_s: tuple[float, ...] = DEFAULT_WINDOWS,
+    metric: str = "serving_stat_windows_total",
+) -> tuple[SLO, ...]:
+    """Statistical-health objectives (ISSUE 16) over the sealed-window
+    counter the :class:`~.stathealth.StatHealthMonitor` emits — the
+    ROADMAP item 3 shape: the burn-rate machinery applied to
+    statistical health, not just latency. Two per model:
+
+    * ``stat_drift:<model>`` — the fraction of sealed distribution
+      windows (cate/covariate/propensity) whose window-pair PSI/KS
+      stayed under the drift thresholds. ``sparse`` windows (either
+      side under the minimum count) are excluded from the totals
+      outright — thin evidence must neither spend nor bank budget —
+      and so is the calibration channel, which has its own objective.
+    * ``stat_calibration:<model>`` — the fraction of sealed calibration
+      windows whose reliability error stayed under threshold; empty
+      while the calibration feed is unarmed (an empty window is zero
+      burn, the engine's existing contract).
+
+    The default objective tolerates 1 drifted window in 10 before
+    burning (``ATE_TPU_STAT_DRIFT_BURN`` overrides) — drift detectors
+    are screens, not proofs, and a single boundary-straddling window
+    should page nobody."""
+    out = []
+    for m in models:
+        out.append(
+            SLO(name=f"stat_drift:{m}", kind="availability",
+                objective=objective, metric=metric, windows_s=windows_s,
+                scope_match=f"model={m}", good_match="status=ok",
+                ignore_match="channel=calibration|status=sparse")
+        )
+        out.append(
+            SLO(name=f"stat_calibration:{m}", kind="availability",
+                objective=objective, metric=metric, windows_s=windows_s,
+                scope_match=f"channel=calibration,model={m}",
+                good_match="status=ok", ignore_match="status=sparse")
+        )
+    return tuple(out)
+
+
 def _pairs(spec: str) -> tuple[str, ...]:
     return tuple(p for p in spec.split(",") if p)
 
@@ -216,7 +262,9 @@ class SLOEngine:
             and not any(_match(k, alt) for alt in ignore_alts)
         ))
         good = float(sum(
-            v for k, v in samples.items() if _match(k, good_pairs)
+            v for k, v in samples.items()
+            if _match(k, good_pairs)
+            and not any(_match(k, alt) for alt in ignore_alts)
         ))
         return good, total
 

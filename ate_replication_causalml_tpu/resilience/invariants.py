@@ -35,6 +35,7 @@ from typing import Callable
 
 import numpy as np
 
+from ate_replication_causalml_tpu.observability import stathealth as _stathealth
 from ate_replication_causalml_tpu.resilience import chaos as _chaos
 
 #: the journal basename per journaled workload.
@@ -506,3 +507,78 @@ def _drain_no_loss(ep: RunArtifacts, ref: RunArtifacts) -> Verdict:
                        {"served": served, "requests": n})
     return Verdict("drain_no_loss", "pass",
                    "drained with every replayed request served")
+
+
+@register(
+    "stat_drift",
+    "the exported statistical-health report is a pure function of its "
+    "embedded sketch state (recompute == artifact, bit-for-bit), its "
+    "sketch mass is conserved, and drift series values are in range",
+    workloads=_SERVING,
+)
+def _stat_drift(ep: RunArtifacts, ref: RunArtifacts) -> Verdict:
+    report = ep.load_json(_stathealth.STAT_HEALTH_BASENAME)
+    if report is None:
+        # Pre-stathealth artifact directories (and workloads that never
+        # dumped) simply have nothing to judge — explicit skip, so the
+        # campaign report's verdict set stays complete.
+        return Verdict("stat_drift", "skip",
+                       f"{_stathealth.STAT_HEALTH_BASENAME} not exported")
+    recomputed = _stathealth.stat_health_report(report["state"])
+    if recomputed != report:
+        return Verdict(
+            "stat_drift", "fail",
+            "stat_health report is not the pure function of its own "
+            "embedded state (recompute diverges from the artifact)",
+        )
+    problems = []
+    for model, mstate in (report["state"].get("models") or {}).items():
+        for ch, cstate in (mstate.get("channels") or {}).items():
+            where = f"{model}/{ch}"
+            total = _stathealth_cells(cstate.get("total"))
+            acc = _stathealth_cells(cstate.get("current", {}).get("sketch"))
+            if total is None or acc is None:
+                problems.append(f"{where}: malformed sketch")
+                continue
+            for w in cstate.get("windows") or ():
+                cells = _stathealth_cells(w.get("sketch"))
+                if cells is None:
+                    problems.append(f"{where}: malformed window sketch")
+                    break
+                acc = [a + c for a, c in zip(acc, cells)]
+            else:
+                if acc != total:
+                    problems.append(f"{where}: sketch mass not conserved "
+                                    "(current + windows != total)")
+            for entry in cstate.get("series") or ():
+                psi_v, ks_v = entry.get("psi"), entry.get("ks")
+                if psi_v is not None and psi_v < 0:
+                    problems.append(f"{where}: negative PSI {psi_v}")
+                if ks_v is not None and not 0.0 <= ks_v <= 1.0:
+                    problems.append(f"{where}: KS {ks_v} outside [0, 1]")
+    if problems:
+        return Verdict("stat_drift", "fail", "; ".join(problems[:4]),
+                       {"problems": problems})
+    models = sorted((report["state"].get("models") or {}))
+    return Verdict(
+        "stat_drift", "pass",
+        "stat_health report reproduces bit-for-bit from its state; "
+        "sketch mass conserved and drift values in range",
+        {"models": models},
+    )
+
+
+def _stathealth_cells(sketch: dict | None) -> list[int] | None:
+    """Flat integer cell list of a serialized sketch, or ``None`` when
+    the dict is not a well-formed fixed-bin sketch."""
+    if not isinstance(sketch, dict) or sketch.get("kind") != "fixed_bin":
+        return None
+    counts = sketch.get("counts")
+    tails = [sketch.get("underflow"), sketch.get("overflow"),
+             sketch.get("nan")]
+    if not isinstance(counts, list):
+        return None
+    cells = list(counts) + tails
+    if any(not isinstance(c, int) or c < 0 for c in cells):
+        return None
+    return cells

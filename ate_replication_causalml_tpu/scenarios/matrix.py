@@ -50,6 +50,10 @@ from typing import Callable, Iterable
 import numpy as np
 
 from ate_replication_causalml_tpu import observability as obs
+from ate_replication_causalml_tpu.observability.sketch import (
+    CalibrationSketch,
+    FixedBinSketch,
+)
 from ate_replication_causalml_tpu.resilience import chaos
 from ate_replication_causalml_tpu.resilience.errors import ChaosStageFault
 from ate_replication_causalml_tpu.scenarios.batched import (
@@ -249,6 +253,14 @@ def plan_columns(
 
 # ── aggregates ────────────────────────────────────────────────────────
 
+#: Shape of the per-column error sketch (ISSUE 16). Estimation errors
+#: ``ate - tau_true`` live well inside ±8 for every DGP in the matrix;
+#: anything outside lands in the sketch's explicit tails, so mass is
+#: conserved either way. 8 bins matches the serving stat-health plane's
+#: default, so offline and served sketches stay merge-compatible.
+_ERROR_SKETCH_RANGE = (-8.0, 8.0)
+_ERROR_SKETCH_BINS = 8
+
 
 def column_aggregates(rows: Iterable[dict], nominal: float = 0.95) -> dict:
     """Per-column Monte-Carlo summaries from cell rows (pure, jax-free,
@@ -301,6 +313,26 @@ def column_aggregates(rows: Iterable[dict], nominal: float = 0.95) -> dict:
         # validator's band is nominal ± z·this (using the nominal p
         # keeps the band honest when the observed rate is degenerate).
         out["coverage_mc_se"] = math.sqrt(nominal * (1.0 - nominal) / n)
+    # Shared-sketch aggregates (ISSUE 16): the per-column error
+    # distribution and CI-coverage reliability expressed through the
+    # SAME mergeable sketch types the serving statistical-health plane
+    # streams, so offline matrix columns and served traffic report one
+    # schema — and sketches from sharded matrix runs merge
+    # associatively, exactly like fleet-wide serving sketches.
+    err_sketch = FixedBinSketch(*_ERROR_SKETCH_RANGE, _ERROR_SKETCH_BINS)
+    if ok:
+        err_sketch.update(errs)
+    cov_sketch = CalibrationSketch()
+    if with_se:
+        cov_sketch.update(
+            [nominal] * len(with_se),
+            [r["lower_ci"] <= r["tau_true"] <= r["upper_ci"]
+             for r in with_se],
+        )
+    out["sketches"] = {
+        "error": err_sketch.to_dict(),
+        "coverage": cov_sketch.to_dict(),
+    }
     return out
 
 

@@ -74,12 +74,16 @@ def handle_admin_path(server, path: str) -> tuple[int, str, bytes]:
         # watchdog stubs (and the tier-1 admin stubs) keep working.
         ages = getattr(server, "heartbeat_ages", dict)()
         stalled = tuple(getattr(server, "stalled_lanes", tuple)())
+        # Statistical health (ISSUE 16) — duck-typed like the watchdog
+        # fields, so the pre-stathealth stubs keep working.
+        stat = getattr(server, "stat", None)
         payload = {
             "state": state,
             "compile_events_in_window": server.compile_events_in_window(),
             "heartbeats": {k: round(v, 6) for k, v in ages.items()},
             "stalled_lanes": list(stalled),
             "slo": server.slo.health(),
+            "stat_health": stat.health() if stat is not None else {},
         }
         # A wedged dispatcher is a liveness failure even though the
         # process (and this probe thread) are up: the daemon cannot

@@ -117,11 +117,13 @@ import time
 import numpy as np
 
 from ate_replication_causalml_tpu import observability as obs
+from ate_replication_causalml_tpu.observability import stathealth
 from ate_replication_causalml_tpu.observability.slo import (
     DEFAULT_WINDOWS,
     SLOEngine,
     default_serving_slos,
     fleet_slos,
+    stat_health_slos,
 )
 from ate_replication_causalml_tpu.resilience import chaos
 from ate_replication_causalml_tpu.resilience.deadline import Budget
@@ -161,6 +163,9 @@ ENV_FLEET = "ATE_TPU_SERVE_FLEET"
 ENV_SHED_BURN = "ATE_TPU_SERVE_FLEET_SHED_BURN"
 ENV_FUSE = "ATE_TPU_SERVE_FUSE"
 ENV_DRAIN_S = "ATE_TPU_SERVE_DRAIN_S"
+ENV_STAT_WINDOW = "ATE_TPU_STAT_WINDOW"
+ENV_STAT_DRIFT_BURN = "ATE_TPU_STAT_DRIFT_BURN"
+ENV_STAT_CALIBRATION = "ATE_TPU_STAT_CALIBRATION"
 
 DEFAULT_BUCKETS = "1,8,64,256"
 DEFAULT_WINDOW_MS = 2.0
@@ -184,6 +189,24 @@ DEFAULT_MODEL = "default"
 #: how often the dispatcher refreshes the shedder's burn cache (full
 #: SLO evaluation — throttled off the per-batch path).
 SHED_REFRESH_S = 0.25
+
+
+def _parse_calibration_cols(spec: str) -> tuple[int, int] | None:
+    """``"pcol:tcol"`` → (propensity column, treatment column); empty =
+    unarmed. Malformed values raise at config time, like every other
+    serve knob."""
+    spec = spec.strip()
+    if not spec:
+        return None
+    pcol_s, sep, tcol_s = spec.partition(":")
+    try:
+        if not sep:
+            raise ValueError(spec)
+        return int(pcol_s), int(tcol_s)
+    except ValueError as e:
+        raise ValueError(
+            f"{ENV_STAT_CALIBRATION} wants 'pcol:tcol' ints, got {spec!r}"
+        ) from e
 
 
 class RejectedRequest(RuntimeError):
@@ -251,6 +274,17 @@ class ServeConfig:
     watchdog_dispatch_s: float = DEFAULT_WATCHDOG_DISPATCH_S
     #: watchdog poll cadence (detection latency, not age resolution).
     watchdog_poll_s: float = 0.25
+    #: statistical-health plane (ISSUE 16): the drift-evaluation window
+    #: width — per-model CATE/covariate/propensity sketches seal on
+    #: this clock grid and sealed pairs are PSI/KS-compared.
+    stat_window_s: float = stathealth.DEFAULT_WINDOW_S
+    #: objective of the per-model ``stat_drift``/``stat_calibration``
+    #: SLOs — the tolerated good fraction of sealed windows.
+    stat_drift_objective: float = 0.9
+    #: optional calibration feed, ``(propensity_col, treatment_col)``
+    #: feature indices (``ATE_TPU_STAT_CALIBRATION=pcol:tcol``); None
+    #: leaves the calibration channel unarmed (zero burn).
+    stat_calibration_cols: tuple[int, int] | None = None
 
     @classmethod
     def from_env(cls, checkpoint: str, **overrides) -> "ServeConfig":
@@ -274,6 +308,13 @@ class ServeConfig:
                 DISPATCH_LANE, DEFAULT_WATCHDOG_DISPATCH_S
             ),
             watchdog_poll_s=poll_s_from_env(),
+            stat_window_s=float(
+                env.get(ENV_STAT_WINDOW, stathealth.DEFAULT_WINDOW_S)
+            ),
+            stat_drift_objective=float(env.get(ENV_STAT_DRIFT_BURN, 0.9)),
+            stat_calibration_cols=_parse_calibration_cols(
+                env.get(ENV_STAT_CALIBRATION, "")
+            ),
         )
         if env.get(ENV_ADMIN_PORT):
             base["admin_port"] = int(env[ENV_ADMIN_PORT])
@@ -381,6 +422,22 @@ class CateServer:
                 windows_s=config.slo_windows_s,
             )
             + fleet_slos(config.model_ids, windows_s=config.slo_windows_s)
+            + stat_health_slos(
+                config.model_ids,
+                objective=config.stat_drift_objective,
+                windows_s=config.slo_windows_s,
+            )
+        )
+        #: statistical-health plane (ISSUE 16): per-model streaming
+        #: sketches over served CATE / covariate / propensity channels,
+        #: window-pair drift detectors, optional calibration feed. Fed
+        #: host-side by the dispatcher AFTER device results are already
+        #: materialized numpy — nothing here can trace.
+        self.stat = stathealth.StatHealthMonitor(
+            config.model_ids,
+            window_s=config.stat_window_s,
+            registry=obs.REGISTRY,
+            calibration_cols=config.stat_calibration_cols,
         )
         self._shedder = BurnShedder(
             self.slo, threshold=config.shed_burn_threshold
@@ -1281,6 +1338,11 @@ class CateServer:
                 off += req.rows
                 self._fleet_requests.inc(1, model=batch.model, status="ok")
                 self.admission.release()
+        # Statistical-health feed (ISSUE 16): the served CATE values and
+        # the real request rows of this batch, already materialized
+        # host-side numpy above — pure-python sketch updates, nothing
+        # traced, so the zero-compile window cannot see this plane.
+        self.stat.observe(batch.model, cate[:rows], padded[:rows])
         self._batches.inc(1, bucket=width)
         fill = rows / width
         self._fill.observe(fill, bucket=width)
@@ -1593,13 +1655,17 @@ class CateServer:
             "fleet": self.fleet.describe(),
             "shed_burn_threshold": self._shedder.threshold,
             "shed_burns": self._shedder.burns(),
+            # Statistical health (ISSUE 16): per-model sketch counts and
+            # last window-pair verdicts.
+            "stat_health": self.stat.health(),
         }
 
     def dump_artifacts(self, outdir: str) -> list[str]:
         """Export the serving window's full artifact set into
         ``outdir``: metrics.json / events.jsonl / metrics.prom, the
-        serving ``trace.json`` + ``serving_report.json`` pair, and
-        ``slo_report.json``. Live-safe (the ``dump`` op calls this on a
+        serving ``trace.json`` + ``serving_report.json`` pair,
+        ``slo_report.json`` and ``stat_health.json``. Live-safe (the
+        ``dump`` op calls this on a
         serving daemon) and called by :meth:`stop` when
         ``$ATE_TPU_METRICS_DIR`` is set. Returns the paths written."""
         from ate_replication_causalml_tpu.observability import (
@@ -1646,6 +1712,11 @@ class CateServer:
         spath = os.path.join(outdir, _sreport.SLO_REPORT_BASENAME)
         obs.atomic_write_json(spath, self.slo.evaluate())
         paths.append(spath)
+        # stat_health.json rides the same one-write-recipe discipline as
+        # serving_report.json: the analyzer recomputes the identical
+        # bytes from the embedded raw state (ISSUE 16).
+        stathealth.write_stat_health(outdir, self.stat.state_dict())
+        paths.append(os.path.join(outdir, stathealth.STAT_HEALTH_BASENAME))
         return paths
 
     def drain(self, timeout_s: float | None = None,

@@ -139,6 +139,63 @@ def build_queries(
     ]
 
 
+#: mid-stream distribution shifts :func:`apply_shift` knows how to
+#: stage (ISSUE 16 — the statistical-health plane's drift proof).
+SHIFT_KINDS = ("covariate", "checkpoint")
+
+
+def apply_shift(
+    schedule: Sequence[ScheduledRequest],
+    queries: Sequence[np.ndarray],
+    *,
+    shift_at: int,
+    shift_kind: str = "covariate",
+    shift_model: str | None = None,
+    shift_delta: float = 2.5,
+) -> tuple[list[ScheduledRequest], list[np.ndarray]]:
+    """Stage a deterministic mid-stream distribution shift: a pure
+    post-transform of an already-built ``(schedule, queries)`` pair
+    that leaves every request BEFORE ``shift_at`` byte-identical to the
+    unshifted build of the same seed — which is exactly what lets a
+    shifted and an unshifted replay share a prefix, so the drift
+    detector's flip is attributable to the shift and nothing else
+    (ISSUE 16's acceptance pair).
+
+    ``covariate``
+        adds ``shift_delta`` to feature column 0 of every query from
+        ``shift_at`` on (copies; the inputs are never mutated) — moves
+        the covariate-mean AND, through the propensity column, the
+        propensity channel.
+    ``checkpoint``
+        rebinds every request from ``shift_at`` on to ``shift_model``
+        (a different served model id) — the served-CATE channel of the
+        TARGET model sees a different query population, the
+        traffic-shape analogue of a checkpoint swap.
+    """
+    if shift_kind not in SHIFT_KINDS:
+        raise ValueError(
+            f"shift_kind must be one of {SHIFT_KINDS}, got {shift_kind!r}"
+        )
+    if not 0 <= shift_at <= len(schedule):
+        raise ValueError(
+            f"shift_at must be in [0, {len(schedule)}], got {shift_at}"
+        )
+    if shift_kind == "checkpoint" and not shift_model:
+        raise ValueError("shift_kind='checkpoint' needs shift_model")
+    out_sched = list(schedule)
+    out_queries = list(queries)
+    for i in range(shift_at, len(schedule)):
+        if shift_kind == "covariate":
+            q = out_queries[i].copy()
+            q[:, 0] += np.float32(shift_delta)
+            out_queries[i] = q
+        else:
+            out_sched[i] = dataclasses.replace(
+                out_sched[i], model=shift_model
+            )
+    return out_sched, out_queries
+
+
 def _percentiles(latencies_s: list[float]) -> dict:
     from ate_replication_causalml_tpu.observability.serving_report import (
         index_quantile,

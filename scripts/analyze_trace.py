@@ -53,6 +53,9 @@ from ate_replication_causalml_tpu.observability import (  # noqa: E402
 from ate_replication_causalml_tpu.observability import (  # noqa: E402
     serving_report as sreport,
 )
+from ate_replication_causalml_tpu.observability import (  # noqa: E402
+    stathealth,
+)
 from ate_replication_causalml_tpu.observability.export import (  # noqa: E402
     atomic_write_json,
 )
@@ -162,6 +165,28 @@ def main(argv: list[str] | None = None) -> int:
         else:
             print(sreport.render_summary(serving))
         print(f"# wrote {sout}", file=sys.stderr)
+    # stat_health.json (ISSUE 16): the dumped report embeds the raw
+    # monitor state, and the report is a pure function of that state —
+    # recompute + rewrite through the SAME recipe the daemon used, so
+    # the reproduction is bit-for-bit (the serving_report discipline).
+    tdir = os.path.dirname(tpath) or "."
+    shpath = os.path.join(tdir, stathealth.STAT_HEALTH_BASENAME)
+    if os.path.exists(shpath):
+        try:
+            with open(shpath) as f:
+                dumped = json.load(f)
+            stat = stathealth.write_stat_health(tdir, dumped["state"])
+        except (OSError, json.JSONDecodeError, KeyError, TypeError,
+                ValueError) as e:
+            print(f"analyze_trace: {shpath} is not a valid stat_health "
+                  f"report ({type(e).__name__}: {e}) — validate with "
+                  f"scripts/check_metrics_schema.py", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(stat, indent=1))
+        else:
+            print(stathealth.render_summary(stat))
+        print(f"# wrote {shpath}", file=sys.stderr)
     return 0
 
 

@@ -135,6 +135,13 @@ REQUIRED_COUNTERS = (
     # on every instrumented run.
     "chaos_campaign_episodes_total",
     "chaos_invariant_checks_total",
+    # Statistical-health plane (ISSUE 16): sketch row intake, sealed
+    # drift-window verdicts (the stat_drift/stat_calibration SLO
+    # source), and fired detectors — "the monitor never saw a row" is
+    # a recorded 0 on every instrumented run.
+    "serving_stat_rows_total",
+    "serving_stat_windows_total",
+    "stat_drift_events_total",
 )
 
 _EVENT_FIELDS = (
@@ -514,6 +521,147 @@ def validate_slo_report(report: dict, tol: float = 1e-9) -> list[str]:
             )
         if bool(s.get("burning")) != (s.get("worst_burn_rate", 0.0) > 1.0):
             errors.append(f"slo: {name} burning flag inconsistent")
+    return errors
+
+
+_STAT_CHANNELS = ("cate", "covariate", "propensity")
+_STAT_STATUSES = ("ok", "drift", "sparse")
+_STAT_CAL_STATUSES = ("ok", "miscal", "sparse")
+
+
+def _stat_cells(sketch: dict) -> list | None:
+    """A sketch dict's full integer state as one flat vector (bins +
+    tails), or None when the shape is off."""
+    counts = sketch.get("counts")
+    if not isinstance(counts, list):
+        return None
+    if sketch.get("kind") == "fixed_bin":
+        tails = (sketch.get("underflow"), sketch.get("overflow"),
+                 sketch.get("nan"))
+    elif sketch.get("kind") == "calibration":
+        positives = sketch.get("positives")
+        if not isinstance(positives, list):
+            return None
+        counts = counts + positives
+        tails = (sketch.get("nan"),)
+    else:
+        return None
+    if any(not isinstance(c, int) or c < 0 for c in counts) or any(
+        not isinstance(t, int) or t < 0 for t in tails
+    ):
+        return None
+    return counts + list(tails)
+
+
+def _stat_check_channel(errors: list, where: str, ch: dict,
+                        statuses: tuple, value_checks) -> None:
+    """Shared per-channel checks: cell-wise mass conservation (total ==
+    Σ sealed windows + current), window/series monotonicity, statistic
+    ranges."""
+    total = _stat_cells(ch.get("total", {}))
+    current = _stat_cells(ch.get("current", {}).get("sketch", {}))
+    windows = ch.get("windows")
+    series = ch.get("series")
+    if total is None or current is None or not isinstance(windows, list) \
+            or not isinstance(series, list):
+        errors.append(f"stat: {where} malformed channel state")
+        return
+    acc = list(current)
+    indices = []
+    for w in windows:
+        cells = _stat_cells(w.get("sketch", {}))
+        if cells is None or len(cells) != len(acc):
+            errors.append(f"stat: {where} malformed sealed window")
+            return
+        acc = [a + c for a, c in zip(acc, cells)]
+        indices.append(w.get("index"))
+    if acc != total:
+        errors.append(
+            f"stat: {where} sketch mass not conserved — total != "
+            f"sum(sealed windows) + current"
+        )
+    if any(not isinstance(i, int) for i in indices) or any(
+        b <= a for a, b in zip(indices, indices[1:])
+    ):
+        errors.append(f"stat: {where} window indices not ascending")
+    cur_idx = ch.get("current", {}).get("index")
+    if indices and isinstance(cur_idx, int) and cur_idx <= indices[-1]:
+        errors.append(f"stat: {where} current window index not past the "
+                      f"sealed ones")
+    s_indices = [e.get("index") for e in series]
+    if any(not isinstance(i, int) for i in s_indices) or any(
+        b <= a for a, b in zip(s_indices, s_indices[1:])
+    ):
+        errors.append(f"stat: {where} series indices not ascending")
+    for e in series:
+        if e.get("status") not in statuses:
+            errors.append(f"stat: {where} unknown window status "
+                          f"{e.get('status')!r}")
+        value_checks(errors, where, e)
+
+
+def _stat_drift_values(errors: list, where: str, entry: dict) -> None:
+    psi_v, ks_v = entry.get("psi"), entry.get("ks")
+    if psi_v is not None and (
+        not isinstance(psi_v, (int, float)) or psi_v < 0.0
+    ):
+        errors.append(f"stat: {where} PSI out of range")
+    if ks_v is not None and (
+        not isinstance(ks_v, (int, float)) or not 0.0 <= ks_v <= 1.0
+    ):
+        errors.append(f"stat: {where} KS out of [0,1]")
+
+
+def _stat_calibration_values(errors: list, where: str, entry: dict) -> None:
+    err = entry.get("error")
+    if err is not None and (
+        not isinstance(err, (int, float)) or not 0.0 <= err <= 1.0
+    ):
+        errors.append(f"stat: {where} calibration error out of [0,1]")
+
+
+def validate_stat_health(report: dict) -> list[str]:
+    """Internal-consistency checks on ``stat_health.json`` (ISSUE 16):
+    per-channel sketch mass conservation (the all-time total is exactly
+    the cell-wise sum of the sealed windows plus the current one — an
+    edited or torn window shows up as lost/invented mass), window and
+    series index monotonicity, PSI/KS/calibration-error ranges, and
+    calibration positives bounded by bucket counts."""
+    errors: list[str] = []
+    state = report.get("state")
+    if report.get("schema_version") is None or not isinstance(state, dict):
+        return ["stat: missing schema_version or state"]
+    models = state.get("models")
+    if not isinstance(models, dict):
+        return ["stat: state.models missing"]
+    for m, ms in models.items():
+        chans = ms.get("channels")
+        if not isinstance(chans, dict) or set(chans) != set(_STAT_CHANNELS):
+            errors.append(f"stat: model {m} channels != {_STAT_CHANNELS}")
+            continue
+        for ch_name in _STAT_CHANNELS:
+            _stat_check_channel(errors, f"{m}/{ch_name}", chans[ch_name],
+                                _STAT_STATUSES, _stat_drift_values)
+        cal = ms.get("calibration")
+        if not isinstance(cal, dict):
+            errors.append(f"stat: model {m} calibration section missing")
+            continue
+        _stat_check_channel(errors, f"{m}/calibration", cal,
+                            _STAT_CAL_STATUSES, _stat_calibration_values)
+        for scope in [cal.get("total", {})] + [
+            w.get("sketch", {}) for w in cal.get("windows", [])
+        ]:
+            counts = scope.get("counts", [])
+            positives = scope.get("positives", [])
+            if isinstance(counts, list) and isinstance(positives, list) \
+                    and any(p > c for c, p in zip(counts, positives)):
+                errors.append(
+                    f"stat: model {m} calibration positives exceed "
+                    f"bucket counts"
+                )
+        rows = ms.get("rows")
+        if not isinstance(rows, int) or rows < 0:
+            errors.append(f"stat: model {m} rows must be an int >= 0")
     return errors
 
 
@@ -1307,6 +1455,13 @@ def validate_trace_files(outdir: str) -> list[str]:
                 errors += validate_slo_report(json.load(f))
         except (OSError, json.JSONDecodeError) as e:
             errors.append(f"slo: cannot read {lpath}: {e}")
+    shpath = os.path.join(outdir, "stat_health.json")
+    if os.path.exists(shpath):
+        try:
+            with open(shpath) as f:
+                errors += validate_stat_health(json.load(f))
+        except (OSError, json.JSONDecodeError) as e:
+            errors.append(f"stat: cannot read {shpath}: {e}")
     return errors
 
 
@@ -1351,6 +1506,7 @@ def main(argv: list[str] | None = None) -> int:
         ("CHAOS_CAMPAIGN", "chaos_campaign",
          validate_chaos_campaign_record),
         ("campaign_report", "campaign", validate_campaign_report),
+        ("stat_health", "stat", validate_stat_health),
     )
     if len(args.paths) == 1:
         base = os.path.basename(args.paths[0])

@@ -77,6 +77,22 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--dump-dir", default=None,
                     help="ask the daemon to dump its observability "
                          "artifacts here after the replay")
+    ap.add_argument("--shift-at", type=int, default=None,
+                    help="stage a deterministic mid-stream distribution "
+                         "shift at this request index (ISSUE 16): "
+                         "requests before the index stay byte-identical "
+                         "to the unshifted build of the same seed, so a "
+                         "shifted/unshifted pair isolates the drift "
+                         "detector's flip")
+    ap.add_argument("--shift-kind", default="covariate",
+                    choices=loadgen.SHIFT_KINDS,
+                    help="covariate: +delta on feature col 0 from "
+                         "--shift-at on; checkpoint: rebind the tail of "
+                         "the stream to --shift-model")
+    ap.add_argument("--shift-model", default=None,
+                    help="target model id for --shift-kind checkpoint")
+    ap.add_argument("--shift-delta", type=float, default=2.5,
+                    help="covariate shift magnitude (feature col 0)")
     args = ap.parse_args(argv)
 
     models = (
@@ -88,6 +104,12 @@ def main(argv: list[str] | None = None) -> int:
         id_prefix=args.id_prefix, models=models,
     )
     queries = loadgen.build_queries(args.seed, schedule, args.features)
+    if args.shift_at is not None:
+        schedule, queries = loadgen.apply_shift(
+            schedule, queries, shift_at=args.shift_at,
+            shift_kind=args.shift_kind, shift_model=args.shift_model,
+            shift_delta=args.shift_delta,
+        )
 
     if args.spawn:
         if not args.checkpoint:
@@ -131,6 +153,9 @@ def main(argv: list[str] | None = None) -> int:
 
     record["seed"] = args.seed
     record["mix"] = args.mix
+    if args.shift_at is not None:
+        record["shift"] = {"at": args.shift_at, "kind": args.shift_kind,
+                           "delta": args.shift_delta}
     print(json.dumps(record))
     return 0
 
@@ -151,6 +176,7 @@ def _attach_server_stats(client: CateClient, record: dict,
         "deadline_exceeded": stats.get("deadline_exceeded", {}),
         "heartbeats": stats.get("heartbeats", {}),
         "slo": stats.get("slo", {}),
+        "stat_health": stats.get("stat_health", {}),
         "fleet": stats.get("fleet", {}),
         "shed_burns": stats.get("shed_burns", {}),
     }

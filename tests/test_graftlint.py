@@ -1164,6 +1164,62 @@ def test_jgl013_quiet_on_stable_sites_and_suppression():
     assert [f.line for f in res.suppressed] == [6]
 
 
+# --------------------------------------------------------------- JGL014
+
+
+JGL014_BAD = """\
+import time
+import uuid
+
+def reply(metrics, request_id, batch, peer_addr):
+    metrics.inc(1, request=request_id)               # line 5: request id
+    metrics.observe(0.1, trace_id=batch.trace_id)    # line 6: trace id
+    metrics.set(1.0, peer=peer_addr)                 # line 7: peer addr
+    metrics.inc(1, stamp=str(time.time()))           # line 8: wall clock
+    metrics.inc(1, req=f"u{uuid.uuid4().hex}")       # line 9: uuid
+"""
+
+JGL014_GOOD = """\
+from pkg.observability.registry import sanitize_label
+
+def reply(metrics, request_id, batch, model_id, width, peer_addr):
+    metrics.inc(1, model=model_id)                 # bounded identifier
+    metrics.inc(1, status="ok", bucket=width)      # closed sets
+    metrics.observe(0.1, phase="dispatch")         # literal
+    metrics.inc(1, model=sanitize_label(batch.model))   # sanctioned fold
+    metrics.inc(1, peer=_fold_peer(peer_addr))     # sanctioned fold
+    trace.add_slice(request_id=request_id)         # not a metric mutator
+"""
+
+
+def test_jgl014_fires_on_request_scoped_labels():
+    """ISSUE 16: the registry keeps one time series per label key
+    forever — a per-request identifier or fresh-every-call value as a
+    label value makes a family unbounded."""
+    for rel in ("pkg/serving/daemon.py", "pkg/observability/stathealth.py"):
+        assert _lines(JGL014_BAD, "JGL014", relpath=rel) == [5, 6, 7, 8, 9]
+    msgs = _messages(JGL014_BAD, "JGL014", relpath="pkg/serving/daemon.py")
+    assert "request_id" in msgs[0]
+    assert "time.time()" in msgs[3]
+    # outside serving/ + observability/ the rule is silent
+    assert _lines(JGL014_BAD, "JGL014", relpath="pkg/scenarios/matrix.py") == []
+
+
+def test_jgl014_quiet_on_bounded_labels_and_folds():
+    assert _lines(
+        JGL014_GOOD, "JGL014", relpath="pkg/serving/daemon.py"
+    ) == []
+    src = JGL014_BAD.replace(
+        "    metrics.set(1.0, peer=peer_addr)                 "
+        "# line 7: peer addr",
+        "    metrics.set(1.0, peer=peer_addr)  # graftlint: disable=JGL014",
+    )
+    res = lint_source(src, relpath="pkg/serving/daemon.py",
+                      select=["JGL014"])
+    assert [f.line for f in res.findings] == [5, 6, 8, 9]
+    assert [f.line for f in res.suppressed] == [7]
+
+
 # ----------------------------------------------------- suppressions etc.
 
 

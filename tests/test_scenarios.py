@@ -216,6 +216,42 @@ def test_column_aggregates_known_answers():
     assert sc.column_aggregates([])["n_cells"] == 0
 
 
+def test_column_aggregates_shared_sketches():
+    """ISSUE 16: the per-column error distribution and CI-coverage
+    reliability ride the SAME mergeable sketch types the serving
+    statistical-health plane streams — one report schema offline and
+    online, and shard-level sketches merge associatively."""
+    from ate_replication_causalml_tpu.observability.sketch import (
+        CalibrationSketch,
+        FixedBinSketch,
+    )
+
+    rows = [
+        _row(0.5, 0.1, 0.5),      # err 0.0, covered
+        _row(0.5, 0.1, 0.8),      # err -0.3, NOT covered
+        _row(0.05, 0.1, 0.1),     # err -0.05, covered
+        _row(float("nan"), float("nan"), 0.5, status="failed"),
+    ]
+    agg = sc.column_aggregates(rows)
+    err = FixedBinSketch.from_dict(agg["sketches"]["error"])
+    assert err.total() == agg["n_ok"] == 3
+    assert err.underflow == 0 and err.overflow == 0
+    cov = CalibrationSketch.from_dict(agg["sketches"]["coverage"])
+    # every with-SE cell lands in the nominal-0.95 reliability bucket;
+    # positives == covered count, so the sketch carries coverage.
+    assert sum(cov.counts) == 3 and sum(cov.positives) == 2
+    # shard-merge: two halves merge to the whole, cell for cell
+    a = sc.column_aggregates(rows[:2])
+    b = sc.column_aggregates(rows[2:])
+    merged = FixedBinSketch.from_dict(
+        a["sketches"]["error"]
+    ).merge(FixedBinSketch.from_dict(b["sketches"]["error"]))
+    assert merged.to_json() == err.to_json()
+    # empty input still emits (empty) sketches — schema stability
+    empty = sc.column_aggregates([])
+    assert FixedBinSketch.from_dict(empty["sketches"]["error"]).total() == 0
+
+
 def test_compare_cells_ulp_and_missing():
     a = [dict(_row(0.5, 0.1, 0.5), method="c:e:0", column="c:e"),
          dict(_row(float("nan"), float("nan"), 0.5, status="failed"),
