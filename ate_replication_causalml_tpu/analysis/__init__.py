@@ -5,7 +5,10 @@ keyed on ambient backend state (ADVICE.md r5: ``quantile_bins``), PRNG
 keys spent twice, dtype drift against the x64 policy, torn artifact
 writes, unlocked telemetry state — enforced mechanically instead of by
 review. Run ``python scripts/graftlint.py <paths>`` or call
-:func:`lint_paths` / :func:`lint_source` directly.
+:func:`lint_paths` / :func:`lint_source` directly. The whole-program
+concurrency pass (graftrace, JGL015–JGL019) lives in
+:mod:`.concurrency`; its committed artifact is built by
+``scripts/graftrace.py``.
 
 The analysis modules themselves import no jax (stdlib ``ast`` +
 ``tokenize`` only). Note that a plain ``import
@@ -18,31 +21,46 @@ accelerator stack.
 
 from ate_replication_causalml_tpu.analysis.core import (
     PARSE_ERROR_ID,
+    PROGRAM_RULES,
     RULES,
     Finding,
     LintResult,
+    ProgramRule,
     Rule,
+    all_rules,
     lint_paths,
     lint_source,
+    lint_sources,
     register,
+    register_program,
 )
-from ate_replication_causalml_tpu.analysis import rules as _rules  # noqa: F401 — registers JGL001-007
+from ate_replication_causalml_tpu.analysis import rules as _rules  # noqa: F401 — registers JGL001-014
+from ate_replication_causalml_tpu.analysis import concurrency as _concurrency  # noqa: F401 — registers JGL015-019
+from ate_replication_causalml_tpu.analysis.cache import ResultCache
 from ate_replication_causalml_tpu.analysis.reporters import (
     render_human,
     render_json,
     render_rule_table,
+    render_sarif,
 )
 
 __all__ = [
     "Finding",
     "LintResult",
     "PARSE_ERROR_ID",
+    "PROGRAM_RULES",
     "RULES",
+    "ProgramRule",
+    "ResultCache",
     "Rule",
+    "all_rules",
     "lint_paths",
     "lint_source",
+    "lint_sources",
     "register",
+    "register_program",
     "render_human",
     "render_json",
     "render_rule_table",
+    "render_sarif",
 ]

@@ -1,0 +1,39 @@
+"""graftrace: the whole-program concurrency analyzer (JGL015–JGL019).
+
+Stdlib-only like the rest of the linter — importing this package never
+imports jax. Importing it registers the program rules; the model
+builder backs the committed ``CONCURRENCY_MODEL.json`` artifact.
+"""
+
+from ate_replication_causalml_tpu.analysis.concurrency.extract import (
+    LOCK_FACTORIES,
+    LockDef,
+    ModuleConc,
+    extract,
+)
+from ate_replication_causalml_tpu.analysis.concurrency.flow import (
+    Analysis,
+    analyze,
+    is_lane_lock,
+)
+from ate_replication_causalml_tpu.analysis.concurrency.model import (
+    MODEL_SCHEMA_VERSION,
+    build_model,
+    render_markdown,
+    to_json,
+)
+from ate_replication_causalml_tpu.analysis.concurrency import rules as _rules  # noqa: F401  (registers JGL015–JGL019)
+
+__all__ = [
+    "Analysis",
+    "LOCK_FACTORIES",
+    "LockDef",
+    "MODEL_SCHEMA_VERSION",
+    "ModuleConc",
+    "analyze",
+    "build_model",
+    "extract",
+    "is_lane_lock",
+    "render_markdown",
+    "to_json",
+]

@@ -1,0 +1,232 @@
+"""graftrace rules JGL015–JGL019: whole-program concurrency findings.
+
+These are :class:`~..core.ProgramRule` subclasses — they see every
+module of the run at once and share one memoized :class:`~.flow.Analysis`
+per program. Findings anchor to real file:line sites so the ordinary
+``# graftlint: disable=`` machinery applies; a suppression here is a
+design statement ("this dispatch deliberately happens under the entry
+lock") and the gate requires each one to carry a justification.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from ate_replication_causalml_tpu.analysis.core import (
+    Finding,
+    Program,
+    ProgramRule,
+    register_program,
+)
+from ate_replication_causalml_tpu.analysis.concurrency.flow import (
+    Analysis,
+    analyze,
+    is_lane_lock,
+)
+
+#: Attribute types JGL019 never treats as guarded shared data: Events
+#: are one-way flags with their own memory semantics, thread-locals are
+#: unshared by construction.
+_JGL019_EXEMPT_TYPES = {"threading.Event", "threading.local"}
+
+
+def _site_finding(rule_id: str, rel: str, line: int, message: str) -> Finding:
+    return Finding(rule=rule_id, path=rel, line=line, col=1, message=message)
+
+
+@register_program
+class LockOrderInversion(ProgramRule):
+    id = "JGL015"
+    name = "lock-order-inversion"
+    description = (
+        "Two or more locks are acquired in conflicting orders on "
+        "different call paths (ABBA): a cycle in the acquisition-order "
+        "graph is a latent deadlock between the threads that run those "
+        "paths."
+    )
+
+    def check(self, program: Program) -> Iterable[Finding]:
+        an = analyze(program)
+        for locks, sites in an.lock_cycles():
+            # Anchor on the first witness edge's source line.
+            rel, line = _parse_site(sites[0]) if sites else ("<program>", 1)
+            yield _site_finding(
+                self.id, rel, line,
+                "lock-order inversion across {%s}; conflicting edges: %s"
+                % (", ".join(locks), "; ".join(sites[:4])),
+            )
+
+
+@register_program
+class BlockingUnderLock(ProgramRule):
+    id = "JGL016"
+    name = "blocking-under-lock"
+    description = (
+        "A blocking operation (join/recv/accept, untimed queue.get or "
+        "Condition.wait, device dispatch) runs while a non-lane lock is "
+        "held — every other thread needing that lock stalls for the "
+        "full blocking duration."
+    )
+
+    def check(self, program: Program) -> Iterable[Finding]:
+        an = analyze(program)
+        seen: set[tuple[str, int]] = set()
+        for key in sorted(an.summaries, key=lambda k: k.id):
+            s = an.summaries[key]
+            for b in s.blocking:
+                held = _non_exempt(b.held)
+                if held and (key.rel, b.line) not in seen:
+                    seen.add((key.rel, b.line))
+                    yield _site_finding(
+                        self.id, key.rel, b.line,
+                        f"blocking operation ({b.what}) while holding "
+                        f"{_fmt_locks(held)} in {key.qual}",
+                    )
+            for w in s.waits:
+                held = _non_exempt(w.held_other)
+                if not w.has_timeout and held and (key.rel, w.line) not in seen:
+                    seen.add((key.rel, w.line))
+                    yield _site_finding(
+                        self.id, key.rel, w.line,
+                        f"untimed Condition.wait on {w.lock_id} while also "
+                        f"holding {_fmt_locks(held)} in {key.qual}",
+                    )
+            for c in an.edges.get(key, ()):
+                held = _non_exempt(c.held)
+                if not held or (key.rel, c.line) in seen:
+                    continue
+                witness = an.may_block.get(c.callee)
+                if witness is not None:
+                    seen.add((key.rel, c.line))
+                    yield _site_finding(
+                        self.id, key.rel, c.line,
+                        f"call to {c.name} may block ({witness}) while "
+                        f"holding {_fmt_locks(held)} in {key.qual}",
+                    )
+
+
+@register_program
+class CondWaitOutsidePredicateLoop(ProgramRule):
+    id = "JGL017"
+    name = "cond-wait-outside-loop"
+    description = (
+        "Condition.wait outside a predicate re-check loop: spurious "
+        "wakeups and notify_all races make a bare wait() return with "
+        "the predicate still false."
+    )
+
+    def check(self, program: Program) -> Iterable[Finding]:
+        an = analyze(program)
+        for key in sorted(an.summaries, key=lambda k: k.id):
+            for w in an.summaries[key].waits:
+                if not w.in_while:
+                    yield _site_finding(
+                        self.id, key.rel, w.line,
+                        f"Condition.wait on {w.lock_id} outside a while-"
+                        f"predicate loop in {key.qual}",
+                    )
+
+
+@register_program
+class CollectiveWithoutLaneLock(ProgramRule):
+    id = "JGL018"
+    name = "collective-without-lane-lock"
+    description = (
+        "A collective launcher (shard_map / shardio commit/reshard/"
+        "gather) is reachable without the mesh lane lock: two threads "
+        "enqueueing collectives concurrently deadlock the device mesh."
+    )
+
+    def check(self, program: Program) -> Iterable[Finding]:
+        an = analyze(program)
+        for key in sorted(an.summaries, key=lambda k: k.id):
+            ctx = an.guaranteed.get(key, set())
+            for held, name, line in an.summaries[key].collectives:
+                effective = set(held) | ctx
+                if not any(is_lane_lock(l) for l in effective):
+                    yield _site_finding(
+                        self.id, key.rel, line,
+                        f"collective launch via {name} in {key.qual} is "
+                        f"reachable without the mesh lane lock "
+                        f"(locks guaranteed here: {_fmt_locks(effective)})",
+                    )
+
+
+@register_program
+class UnguardedCrossThreadWrite(ProgramRule):
+    id = "JGL019"
+    name = "unguarded-cross-thread-write"
+    description = (
+        "An instance attribute is written from two or more thread "
+        "entrypoints with no lock common to all write sites — the "
+        "thread-reachability extension of JGL006/JGL008."
+    )
+
+    def check(self, program: Program) -> Iterable[Finding]:
+        an = analyze(program)
+        groups = _write_groups(an)
+        for (rel, cls, attr) in sorted(groups):
+            sites = groups[(rel, cls, attr)]
+            entries: set[str] = set()
+            for w, func in sites:
+                entries |= an.entry_reach.get(func, set())
+            if len(entries) < 2:
+                continue
+            common = None
+            for w, func in sites:
+                eff = set(w.held) | an.guaranteed.get(func, set())
+                common = eff if common is None else (common & eff)
+            if common:
+                continue
+            lines = sorted({w.line for w, _ in sites})
+            shown = ", ".join(str(l) for l in lines[:4])
+            yield _site_finding(
+                self.id, rel, lines[0],
+                f"{cls}.{attr} is written from {len(entries)} thread "
+                f"entrypoints ({_fmt_entries(entries)}) with no common "
+                f"lock across its write sites (lines {shown})",
+            )
+
+
+def _write_groups(an: Analysis):
+    """(rel, class, attr) -> [(WriteSite, FuncKey)] for attributes that
+    are real shared data on concurrency-owning classes."""
+    groups: dict = {}
+    for key in sorted(an.summaries, key=lambda k: k.id):
+        for w in an.summaries[key].writes:
+            func_name = w.qual.rsplit(".", 1)[-1]
+            if func_name in ("__init__", "__new__"):
+                continue
+            info = an.conc[key.rel].classes.get(w.cls)
+            if info is None or not info.owns_concurrency():
+                continue
+            if w.attr in info.attr_locks:
+                continue
+            if info.attr_types.get(w.attr) in _JGL019_EXEMPT_TYPES:
+                continue
+            groups.setdefault((key.rel, w.cls, w.attr), []).append((w, key))
+    return groups
+
+
+def _non_exempt(held) -> set:
+    return {l for l in held if not is_lane_lock(l)}
+
+
+def _fmt_locks(locks) -> str:
+    return "{" + ", ".join(sorted(locks)) + "}" if locks else "{}"
+
+
+def _fmt_entries(entries) -> str:
+    shown = sorted(entries)[:3]
+    extra = len(entries) - len(shown)
+    return ", ".join(shown) + (f", +{extra} more" if extra > 0 else "")
+
+
+def _parse_site(site: str) -> tuple[str, int]:
+    """Witness strings look like ``lockA -> lockB at rel:line``."""
+    at = site.rsplit(" at ", 1)[-1]
+    rel, _, line = at.partition(":")
+    try:
+        return rel, int(line.split()[0])
+    except ValueError:
+        return rel, 1

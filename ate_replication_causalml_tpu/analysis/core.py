@@ -166,15 +166,59 @@ class Rule:
         )
 
 
-#: Registered rule classes keyed by id (populated by @register).
+class Program:
+    """All modules of one lint run — the unit the whole-program rules
+    (JGL015+) analyze. Interprocedural passes memoize their derived
+    state on the instance (``_graftrace_*`` attributes)."""
+
+    def __init__(self, modules: Iterable[ModuleInfo]):
+        self.modules = list(modules)
+        self.by_relpath: dict[str, ModuleInfo] = {
+            m.relpath: m for m in self.modules
+        }
+
+
+class ProgramRule(Rule):
+    """A rule over the whole :class:`Program` instead of one module.
+
+    ``check`` receives the program; findings still carry a module
+    relpath (``self.finding(module, node, msg)``) so per-line
+    suppression comments keep working."""
+
+    def check(self, program: Program) -> Iterable[Finding]:  # type: ignore[override]
+        raise NotImplementedError
+
+
+#: Registered per-module rule classes keyed by id (populated by
+#: @register).
 RULES: dict[str, type[Rule]] = {}
+
+#: Registered whole-program rule classes keyed by id (populated by
+#: @register_program). Disjoint from RULES — one id, one registry.
+PROGRAM_RULES: dict[str, type[ProgramRule]] = {}
 
 
 def register(cls: type[Rule]) -> type[Rule]:
-    if not cls.id or cls.id in RULES:
+    if not cls.id or cls.id in RULES or cls.id in PROGRAM_RULES:
         raise ValueError(f"rule id {cls.id!r} missing or already registered")
     RULES[cls.id] = cls
     return cls
+
+
+def register_program(cls: type[ProgramRule]) -> type[ProgramRule]:
+    if not cls.id or cls.id in RULES or cls.id in PROGRAM_RULES:
+        raise ValueError(f"rule id {cls.id!r} missing or already registered")
+    PROGRAM_RULES[cls.id] = cls
+    return cls
+
+
+def all_rules() -> dict[str, type[Rule]]:
+    """Per-module and program rules in one id-sorted map (reporters and
+    ``--list-rules`` present a single table)."""
+    merged: dict[str, type[Rule]] = {}
+    merged.update(RULES)
+    merged.update(PROGRAM_RULES)
+    return dict(sorted(merged.items()))
 
 
 @dataclasses.dataclass
@@ -196,12 +240,60 @@ class LintResult:
         self.suppressed.sort(key=key)
 
 
+def _split_rules(
+    select: Iterable[str] | None,
+) -> tuple[list[Rule], list[ProgramRule]]:
+    if select is None:
+        ids = list(RULES) + list(PROGRAM_RULES)
+    else:
+        ids = list(select)
+        unknown = [i for i in ids if i not in RULES and i not in PROGRAM_RULES]
+        if unknown:
+            raise ValueError(f"unknown rule id(s): {', '.join(unknown)}")
+    return (
+        [RULES[i]() for i in ids if i in RULES],
+        [PROGRAM_RULES[i]() for i in ids if i in PROGRAM_RULES],
+    )
+
+
 def _active_rules(select: Iterable[str] | None) -> list[Rule]:
-    ids = list(RULES) if select is None else list(select)
-    unknown = [i for i in ids if i not in RULES]
-    if unknown:
-        raise ValueError(f"unknown rule id(s): {', '.join(unknown)}")
-    return [RULES[i]() for i in ids]
+    return _split_rules(select)[0]
+
+
+def _route(module: ModuleInfo | None, f: Finding, result: LintResult) -> None:
+    if module is not None and module.suppressions.covers(f.rule, f.line):
+        result.suppressed.append(f)
+    else:
+        result.findings.append(f)
+
+
+def _run_module_rules(
+    module: ModuleInfo, rules: list[Rule], result: LintResult
+) -> None:
+    for rule in rules:
+        for f in rule.check(module):
+            _route(module, f, result)
+
+
+def _run_program_rules(
+    modules: list[ModuleInfo], rules: list[ProgramRule], result: LintResult
+) -> None:
+    if not rules:
+        return
+    program = Program(modules)
+    for rule in rules:
+        for f in rule.check(program):
+            _route(program.by_relpath.get(f.path), f, result)
+
+
+def _parse_error(path: str, e: Exception) -> Finding:
+    return Finding(
+        rule=PARSE_ERROR_ID,
+        path=path,
+        line=getattr(e, "lineno", None) or 1,
+        col=(getattr(e, "offset", None) or 1),
+        message=f"file does not parse: {e.msg if isinstance(e, SyntaxError) else e}",
+    )
 
 
 def lint_source(
@@ -211,27 +303,41 @@ def lint_source(
     select: Iterable[str] | None = None,
 ) -> LintResult:
     """Lint one source string. ``relpath`` is what path-scoped rules
-    (JGL004/005/006) match against; defaults to ``path``."""
+    (JGL004/005/006) match against; defaults to ``path``. Program rules
+    (JGL015+) run over the single-module program — known-bad fixtures
+    exercise them exactly like the per-module rules."""
     result = LintResult(files=1)
+    mod_rules, prog_rules = _split_rules(select)
     try:
         module = ModuleInfo(path, relpath if relpath is not None else path, source)
     except (SyntaxError, ValueError) as e:
-        result.findings.append(
-            Finding(
-                rule=PARSE_ERROR_ID,
-                path=relpath if relpath is not None else path,
-                line=getattr(e, "lineno", None) or 1,
-                col=(getattr(e, "offset", None) or 1),
-                message=f"file does not parse: {e.msg if isinstance(e, SyntaxError) else e}",
-            )
-        )
+        result.findings.append(_parse_error(relpath if relpath is not None else path, e))
         return result
-    for rule in _active_rules(select):
-        for f in rule.check(module):
-            if module.suppressions.covers(f.rule, f.line):
-                result.suppressed.append(f)
-            else:
-                result.findings.append(f)
+    _run_module_rules(module, mod_rules, result)
+    _run_program_rules([module], prog_rules, result)
+    result.sort()
+    return result
+
+
+def lint_sources(
+    sources: Iterable[tuple[str, str]],
+    select: Iterable[str] | None = None,
+) -> LintResult:
+    """Lint ``(relpath, source)`` pairs as ONE program — the multi-module
+    fixture entry point for the interprocedural rules."""
+    result = LintResult()
+    mod_rules, prog_rules = _split_rules(select)
+    modules: list[ModuleInfo] = []
+    for relpath, source in sources:
+        result.files += 1
+        try:
+            module = ModuleInfo(relpath, relpath, source)
+        except (SyntaxError, ValueError) as e:
+            result.findings.append(_parse_error(relpath, e))
+            continue
+        modules.append(module)
+        _run_module_rules(module, mod_rules, result)
+    _run_program_rules(modules, prog_rules, result)
     result.sort()
     return result
 
@@ -256,11 +362,20 @@ def lint_paths(
     paths: Iterable[str],
     select: Iterable[str] | None = None,
     root: str | None = None,
+    cache=None,
 ) -> LintResult:
     """Lint files/directories. ``root`` anchors the relative paths used
-    both for reporting and for the path-scoped rules (default: CWD)."""
+    both for reporting and for the path-scoped rules (default: CWD).
+
+    Per-module rules run file by file; the program rules (JGL015+) run
+    once over every module that parsed. ``cache`` is an optional
+    :class:`ate_replication_causalml_tpu.analysis.cache.ResultCache`:
+    per-file results are keyed on content hashes and the program pass
+    on the whole tree's hash, so a warm run re-lints only what changed
+    (and a fully warm run parses nothing at all)."""
     root = os.path.abspath(root or os.getcwd())
     result = LintResult()
+    mod_rules, prog_rules = _split_rules(select)
     paths = list(paths)
     for p in paths:
         if not os.path.exists(p):
@@ -270,18 +385,61 @@ def lint_paths(
             result.findings.append(
                 Finding(PARSE_ERROR_ID, p, 1, 1, "path does not exist")
             )
+    entries: list[tuple[str, str, str | None]] = []  # (abspath, rel, source)
     for path in iter_py_files(paths):
         ap = os.path.abspath(path)
         rel = os.path.relpath(ap, root) if ap.startswith(root + os.sep) else path
         try:
             with open(path, encoding="utf-8") as f:
-                source = f.read()
+                entries.append((path, rel, f.read()))
         except OSError as e:
             result.findings.append(
                 Finding(PARSE_ERROR_ID, rel, 1, 1, f"unreadable file: {e}")
             )
             result.files += 1
             continue
-        result.extend(lint_source(source, path=path, relpath=rel, select=select))
+    program_cached = (
+        cache.get_program(entries) if cache is not None and prog_rules else None
+    )
+    need_parse_all = bool(prog_rules) and program_cached is None
+    modules: list[ModuleInfo] = []
+    for path, rel, source in entries:
+        result.files += 1
+        cached = cache.get_module(rel, source) if cache is not None else None
+        if cached is not None and not need_parse_all:
+            result.findings.extend(cached[0])
+            result.suppressed.extend(cached[1])
+            continue
+        try:
+            module = ModuleInfo(path, rel, source)
+        except (SyntaxError, ValueError) as e:
+            result.findings.append(_parse_error(rel, e))
+            continue
+        modules.append(module)
+        if cached is not None:
+            result.findings.extend(cached[0])
+            result.suppressed.extend(cached[1])
+            continue
+        per_file = LintResult()
+        _run_module_rules(module, mod_rules, per_file)
+        result.findings.extend(per_file.findings)
+        result.suppressed.extend(per_file.suppressed)
+        if cache is not None:
+            cache.put_module(rel, source, per_file.findings, per_file.suppressed)
+    if prog_rules:
+        if program_cached is not None:
+            result.findings.extend(program_cached[0])
+            result.suppressed.extend(program_cached[1])
+        else:
+            prog_result = LintResult()
+            _run_program_rules(modules, prog_rules, prog_result)
+            result.findings.extend(prog_result.findings)
+            result.suppressed.extend(prog_result.suppressed)
+            if cache is not None:
+                cache.put_program(
+                    entries, prog_result.findings, prog_result.suppressed
+                )
+    if cache is not None:
+        cache.save()
     result.sort()
     return result

@@ -18,6 +18,7 @@ from ate_replication_causalml_tpu.analysis.core import (
     Rule,
     register,
 )
+from ate_replication_causalml_tpu.analysis import scopes
 from ate_replication_causalml_tpu.analysis.jaxast import (
     MUTATOR_METHODS,
     FunctionRecord,
@@ -151,7 +152,7 @@ _KEY_ARRAY_PARAM_RE = re.compile(r"^(keys|\w*_keys)$")
 
 
 def _in_scenarios_scope(relpath: str) -> bool:
-    return "scenarios/" in relpath.replace("\\", "/")
+    return scopes.SCENARIOS.contains(relpath)
 
 
 def _branches_compatible(a: tuple, b: tuple) -> bool:
@@ -641,8 +642,7 @@ _JNP_PREFIXES = ("jax.numpy.", "jax.lax.")
 
 
 def _in_dtype_scope(relpath: str) -> bool:
-    parts = relpath.split("/")
-    return "ops" in parts or "estimators" in parts
+    return scopes.DTYPE.contains(relpath)
 
 
 @register
@@ -713,8 +713,6 @@ class DtypeDrift(Rule):
 
 # ---------------------------------------------------------------- JGL005
 
-_WRITE_ALLOWED_SUFFIX = "observability/export.py"
-
 
 @register
 class NonAtomicWrite(Rule):
@@ -732,7 +730,7 @@ class NonAtomicWrite(Rule):
     )
 
     def check(self, module: ModuleInfo) -> Iterator[Finding]:
-        if module.relpath.endswith(_WRITE_ALLOWED_SUFFIX):
+        if scopes.EXPORT_MODULE.contains(module.relpath):
             return
         for node in ast.walk(module.tree):
             if not isinstance(node, ast.Call):
@@ -810,9 +808,7 @@ class UnlockedSharedState(Rule):
         # observability/slo.py belongs to the SERVING plane's shared-
         # state rule (JGL008) — one rule per file, or every finding
         # there would be reported twice.
-        return "observability/" in relpath and not relpath.endswith(
-            "observability/slo.py"
-        )
+        return scopes.OBSERVABILITY_STATE.contains(relpath)
 
     def check(self, module: ModuleInfo) -> Iterator[Finding]:
         if not self._in_scope(module.relpath):
@@ -1011,33 +1007,22 @@ class UnlockedSchedulerState(UnlockedSharedState):
 
     def _in_scope(self, relpath: str) -> bool:
         # Only the top-level driver (<pkg>/pipeline.py) hosts
-        # _Checkpoint; a bare endswith would also rope in
-        # data/pipeline.py and any future nested pipeline.py.
-        parts = relpath.replace("\\", "/").split("/")
-        return (
-            "scheduler/" in relpath
-            or "serving/" in relpath
-            or relpath.endswith("observability/slo.py")
-            or (parts[-1] == "pipeline.py" and len(parts) <= 2)
-        )
+        # _Checkpoint — scopes.SCHEDULER_STATE's top_files matching
+        # keeps data/pipeline.py and any nested pipeline.py out (the
+        # PR 4 endswith bug this module used to carry).
+        return scopes.SCHEDULER_STATE.contains(relpath)
 
 
 # ---------------------------------------------------------------- JGL007
-
-#: Paths allowed to make blanket exception decisions: the resilience
-#: layer's whole job is classified handling, and the shard runner's
-#: probe/retry loops are the sanctioned swallow sites.
-_RESILIENCE_EXEMPT_SUFFIX = "parallel/retry.py"
-_RESILIENCE_EXEMPT_DIR = "resilience/"
 
 _BROAD_EXC = {"Exception", "BaseException"}
 
 
 def _in_resilience_scope(relpath: str) -> bool:
-    return (
-        relpath.endswith(_RESILIENCE_EXEMPT_SUFFIX)
-        or _RESILIENCE_EXEMPT_DIR in relpath
-    )
+    # Paths allowed to make blanket exception decisions: the resilience
+    # layer's whole job is classified handling, and the shard runner's
+    # probe/retry loops are the sanctioned swallow sites.
+    return scopes.RESILIENCE_EXEMPT.contains(relpath)
 
 
 @register
@@ -1111,12 +1096,6 @@ class SilentExceptionSwallow(Rule):
 
 # ---------------------------------------------------------------- JGL009
 
-#: The one module family allowed to read the wall clock: the telemetry
-#: layer records BOTH clocks deliberately (span records carry
-#: ``start_unix`` next to ``start_mono_s``; the trace header anchors
-#: the monotonic origin to wall time).
-_WALLCLOCK_EXEMPT_DIR = "observability/"
-
 _WALLCLOCK_CALL = "time.time"
 
 
@@ -1145,7 +1124,9 @@ class WallClockDuration(Rule):
         )
 
     def check(self, module: ModuleInfo) -> Iterator[Finding]:
-        if _WALLCLOCK_EXEMPT_DIR in module.relpath:
+        # The telemetry layer records BOTH clocks deliberately (span
+        # records carry ``start_unix`` next to ``start_mono_s``).
+        if scopes.WALLCLOCK_EXEMPT.contains(module.relpath):
             return
         # Names bound from time.time() anywhere in the module
         # (name-based, not scope-exact — the linter's stated precision).
@@ -1213,11 +1194,7 @@ class UnmeteredHostMaterialization(Rule):
         # Same scope shape as JGL008: the scheduler package plus the
         # top-level driver only — data/pipeline.py and any nested
         # pipeline.py do host I/O legitimately.
-        parts = relpath.replace("\\", "/").split("/")
-        return (
-            "scheduler/" in relpath
-            or (parts[-1] == "pipeline.py" and len(parts) <= 2)
-        )
+        return scopes.HOST_TRANSFER.contains(relpath)
 
     def check(self, module: ModuleInfo) -> Iterator[Finding]:
         if not self._in_scope(module.relpath):
@@ -1282,7 +1259,7 @@ class PredictPathRowGather(Rule):
     )
 
     def _in_scope(self, relpath: str) -> bool:
-        return "/models/" in f"/{relpath}"
+        return scopes.MODELS.contains(relpath)
 
     def _is_row_id_index(self, idx: ast.expr) -> bool:
         """A bare row-id Name, or a tuple index carrying one (slices,
@@ -1370,12 +1347,7 @@ class UnboundedBlockingCall(Rule):
     )
 
     def _in_scope(self, relpath: str) -> bool:
-        rel = relpath.replace("\\", "/")
-        return (
-            "serving/" in rel
-            or "scheduler/" in rel
-            or rel.endswith("resilience/watchdog.py")
-        )
+        return scopes.UNBOUNDED_JOIN.contains(relpath)
 
     def check(self, module: ModuleInfo) -> Iterator[Finding]:
         if not self._in_scope(module.relpath):
@@ -1567,8 +1539,7 @@ class UnboundedMetricLabelCardinality(Rule):
     )
 
     def _in_scope(self, relpath: str) -> bool:
-        rel = relpath.replace("\\", "/")
-        return "serving/" in rel or "observability/" in rel
+        return scopes.LABEL_CARDINALITY.contains(relpath)
 
     def _culprit(self, module: ModuleInfo, expr: ast.expr) -> str | None:
         # Sanctioned-fold scan first: a sanitize/fold call ANYWHERE in

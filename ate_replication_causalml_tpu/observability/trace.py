@@ -378,6 +378,11 @@ class MetricSampler:
         self.metrics = tuple(metrics) if metrics is not None else self.DEFAULT_METRICS
         self.interval_s = interval_s
         self._stop = threading.Event()
+        # Guards the handle: start() runs on the daemon's startup
+        # thread while stop() is reachable from per-connection drain
+        # threads — unguarded, a double start leaks a sampler and a
+        # racing stop can join a half-published handle (JGL019).
+        self._lock = threading.Lock()
         self._thread: threading.Thread | None = None
 
     def sample_once(self) -> None:
@@ -393,20 +398,25 @@ class MetricSampler:
             )
 
     def start(self) -> None:
-        if self._thread is not None or not _registry.enabled():
+        if not _registry.enabled():
             return
-        self._thread = threading.Thread(
-            target=self._run, name="trace-sampler", daemon=True
-        )
-        self._thread.start()
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._thread = threading.Thread(
+                target=self._run, name="trace-sampler", daemon=True
+            )
+            self._thread.start()
 
     def stop(self, timeout: float | None = 5.0) -> None:
         """Stop the loop and take one final sample so the counter
         tracks end at the run's closing values."""
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout)
+        with self._lock:
+            thread = self._thread
             self._thread = None
+        if thread is not None:  # join outside the lock: never block start()
+            thread.join(timeout)
         self.sample_once()
 
     def _run(self) -> None:

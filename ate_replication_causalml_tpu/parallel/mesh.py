@@ -49,6 +49,7 @@ def _resolve_shard_map():
             kwargs["check_rep"] = kwargs.pop("check_vma")
         elif "check_rep" in kwargs and "check_rep" not in params:
             kwargs["check_vma"] = kwargs.pop("check_rep")
+        # graftlint: disable=JGL018 — not a launch site: this shim IS the `shard_map` symbol shardio's laned launchers call under the lane lock
         return sm(*args, **kwargs)
 
     return compat

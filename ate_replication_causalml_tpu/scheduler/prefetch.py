@@ -74,6 +74,10 @@ class CompilePrefetcher:
         # thread, where the run's root span is not on the local stack.
         self._span_parent = span_parent
         self._stop = threading.Event()
+        # Guards the handle: start() is called from the engine's run
+        # thread and stop() from whichever thread finishes the sweep —
+        # unguarded, a double start leaks a prefetch lane (JGL019).
+        self._lock = threading.Lock()
         self._thread: threading.Thread | None = None
         self._counter = obs.counter(
             "scheduler_prefetch_total",
@@ -86,18 +90,24 @@ class CompilePrefetcher:
     def start(self) -> None:
         if not self._items:
             return
-        self._thread = threading.Thread(
-            target=self._run, name="compile-prefetch", daemon=True
-        )
-        self._thread.start()
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._thread = threading.Thread(
+                target=self._run, name="compile-prefetch", daemon=True
+            )
+            self._thread.start()
 
     def stop(self, timeout: float | None = None) -> None:
         """Signal the lane to stop after the current hook and join.
         Called when the sweep finishes — a leftover warm compile must
         not outlive the run's telemetry export."""
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout)
+        with self._lock:
+            thread = self._thread
+            self._thread = None
+        if thread is not None:  # join outside the lock: never block start()
+            thread.join(timeout)
 
     def _run(self) -> None:
         for name, warm in self._items:

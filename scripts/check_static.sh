@@ -16,8 +16,15 @@ PY="${PYTHON:-$(command -v python || command -v python3)}"
 
 fail=0
 
-echo "== graftlint (JAX-aware rules JGL001-013) =="
-"$PY" scripts/graftlint.py ate_replication_causalml_tpu scripts || fail=1
+echo "== graftlint (JAX-aware rules JGL001-014 + concurrency JGL015-019) =="
+# Content-hash result cache: warm gate runs re-lint only changed files.
+# Override the location with GRAFTLINT_CACHE; it is gitignored.
+"$PY" scripts/graftlint.py ate_replication_causalml_tpu scripts \
+    --cache "${GRAFTLINT_CACHE:-.graftlint_cache}" || fail=1
+
+echo "== graftrace (concurrency model: CONCURRENCY_MODEL.json) =="
+"$PY" scripts/graftrace.py --check || fail=1
+"$PY" scripts/check_concurrency_model.py || fail=1
 
 echo "== compileall (syntax gate) =="
 "$PY" -m compileall -q ate_replication_causalml_tpu scripts tests bench.py __graft_entry__.py || fail=1

@@ -2,12 +2,15 @@
 """graftlint CLI — JAX-aware static analysis for this repository.
 
 Usage:
-    python scripts/graftlint.py [paths...] [--json] [--select JGL001,...]
+    python scripts/graftlint.py [paths...] [--json | --format FORMAT]
+                                [--select JGL001,...] [--cache DIR]
                                 [--show-suppressed] [--list-rules]
 
 Default path: ``ate_replication_causalml_tpu/``. Exits 0 on a clean
 tree, 1 when findings remain (including files that do not parse), 2 on
-usage errors. Suppress individual findings with
+usage errors. ``--format sarif`` emits a SARIF 2.1.0 log for code
+scanners; ``--cache DIR`` keeps a content-hash result cache so warm
+runs only re-lint changed files. Suppress individual findings with
 ``# graftlint: disable=JGL00x`` (see README "Static analysis").
 """
 
@@ -47,9 +50,21 @@ def main(argv: list[str] | None = None) -> int:
     )
     ap.add_argument("--json", action="store_true", help="JSON report on stdout")
     ap.add_argument(
+        "--format",
+        choices=("human", "json", "sarif"),
+        default=None,
+        help="report format (default: human; --json is shorthand for json)",
+    )
+    ap.add_argument(
         "--select",
         default=None,
         help="comma-separated rule ids to run (default: all)",
+    )
+    ap.add_argument(
+        "--cache",
+        default=None,
+        metavar="DIR",
+        help="directory for the incremental result cache",
     )
     ap.add_argument(
         "--show-suppressed",
@@ -65,20 +80,26 @@ def main(argv: list[str] | None = None) -> int:
         print(analysis.render_rule_table())
         return 0
 
+    fmt = args.format or ("json" if args.json else "human")
     select = None
     if args.select:
         select = [s.strip() for s in args.select.split(",") if s.strip()]
     paths = args.paths or [
         os.path.join(_REPO_ROOT, "ate_replication_causalml_tpu")
     ]
+    cache = analysis.ResultCache(args.cache, select=select) if args.cache else None
     try:
-        result = analysis.lint_paths(paths, select=select, root=_REPO_ROOT)
+        result = analysis.lint_paths(
+            paths, select=select, root=_REPO_ROOT, cache=cache
+        )
     except ValueError as e:
         print(f"graftlint: {e}", file=sys.stderr)
         return 2
 
-    if args.json:
+    if fmt == "json":
         sys.stdout.write(analysis.render_json(result))
+    elif fmt == "sarif":
+        sys.stdout.write(analysis.render_sarif(result))
     else:
         print(analysis.render_human(result, show_suppressed=args.show_suppressed))
     return 1 if result.findings else 0

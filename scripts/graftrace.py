@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""graftrace CLI — build/check the committed concurrency model.
+
+Usage::
+
+    python scripts/graftrace.py              # (re)write CONCURRENCY_MODEL.json
+    python scripts/graftrace.py --check      # regenerate and byte-compare
+    python scripts/graftrace.py --markdown   # refresh CONCURRENCY.md's
+                                             # generated section in place
+
+The model (lock registry, acquisition-order DAG, thread-entry →
+lock-set table) is a deterministic projection of the graftrace
+analysis over the concurrency-scoped planes (scheduler/, serving/,
+parallel/, observability/, resilience/, pipeline.py). ``--check`` is
+what the static gate runs: a byte difference means the tree's
+concurrency shape changed without the committed model being
+regenerated. Exits 0 on success/match, 1 on mismatch, 2 on usage
+errors. Stdlib-only, jax-free (same package stub as graftlint).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import types
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+if "ate_replication_causalml_tpu" not in sys.modules:
+    _pkg = types.ModuleType("ate_replication_causalml_tpu")
+    _pkg.__path__ = [os.path.join(_REPO_ROOT, "ate_replication_causalml_tpu")]
+    sys.modules["ate_replication_causalml_tpu"] = _pkg
+
+from ate_replication_causalml_tpu.analysis.core import (  # noqa: E402
+    ModuleInfo,
+    Program,
+    iter_py_files,
+)
+from ate_replication_causalml_tpu.analysis import concurrency  # noqa: E402
+
+MODEL_PATH = os.path.join(_REPO_ROOT, "CONCURRENCY_MODEL.json")
+DOC_PATH = os.path.join(_REPO_ROOT, "CONCURRENCY.md")
+_GEN_BEGIN = "<!-- graftrace:begin -->"
+_GEN_END = "<!-- graftrace:end -->"
+
+
+def build_program() -> Program:
+    pkg = os.path.join(_REPO_ROOT, "ate_replication_causalml_tpu")
+    modules = []
+    for path in iter_py_files([pkg]):
+        rel = os.path.relpath(path, _REPO_ROOT).replace(os.sep, "/")
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        try:
+            modules.append(ModuleInfo(path, rel, source))
+        except SyntaxError:
+            pass  # graftlint reports JGL000; the model skips the file
+    return Program(modules)
+
+
+def _atomic_write(path: str, text: str) -> None:
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".", suffix=".tmp")
+    # Same load-bearing suppressions as the linter's result cache: this
+    # script must stay importable without jax, so it cannot use
+    # observability.export's atomic helpers — the tmp + os.replace pair
+    # here IS the atomic-write recipe those helpers implement.
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:  # graftlint: disable=JGL005 — tmp half of a tmp+os.replace atomic write; export helpers would pull jax into the linter toolchain
+            f.write(text)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def refresh_markdown(model: dict) -> int:
+    generated = concurrency.render_markdown(model)
+    try:
+        with open(DOC_PATH, encoding="utf-8") as f:
+            doc = f.read()
+    except OSError:
+        print(f"graftrace: {DOC_PATH} not found", file=sys.stderr)
+        return 2
+    begin = doc.find(_GEN_BEGIN)
+    end = doc.find(_GEN_END)
+    if begin < 0 or end < 0 or end < begin:
+        print(
+            f"graftrace: {_GEN_BEGIN}/{_GEN_END} markers missing in "
+            f"{DOC_PATH}", file=sys.stderr
+        )
+        return 2
+    updated = (
+        doc[: begin + len(_GEN_BEGIN)] + "\n" + generated + doc[end:]
+    )
+    if updated != doc:
+        _atomic_write(DOC_PATH, updated)
+        print(f"graftrace: refreshed generated section of {DOC_PATH}")
+    else:
+        print(f"graftrace: {DOC_PATH} already current")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftrace", description=__doc__.split("\n")[1]
+    )
+    ap.add_argument(
+        "--check",
+        action="store_true",
+        help="regenerate and byte-compare against the committed model",
+    )
+    ap.add_argument(
+        "--markdown",
+        action="store_true",
+        help="refresh CONCURRENCY.md's generated section",
+    )
+    args = ap.parse_args(argv)
+
+    model = concurrency.build_model(build_program())
+    text = concurrency.to_json(model)
+
+    if args.markdown:
+        return refresh_markdown(model)
+
+    if args.check:
+        try:
+            with open(MODEL_PATH, encoding="utf-8") as f:
+                committed = f.read()
+        except OSError:
+            print(
+                "graftrace: CONCURRENCY_MODEL.json missing — run "
+                "`python scripts/graftrace.py` and commit it",
+                file=sys.stderr,
+            )
+            return 1
+        if committed != text:
+            print(
+                "graftrace: CONCURRENCY_MODEL.json is stale — the tree's "
+                "concurrency shape changed; regenerate with "
+                "`python scripts/graftrace.py` and review the diff",
+                file=sys.stderr,
+            )
+            return 1
+        print(
+            f"graftrace: model current ({len(model['locks'])} locks, "
+            f"{len(model['lock_order'])} order edges, "
+            f"{len(model['thread_entries'])} thread entries)"
+        )
+        return 0
+
+    _atomic_write(MODEL_PATH, text)
+    print(
+        f"graftrace: wrote {os.path.relpath(MODEL_PATH, _REPO_ROOT)} "
+        f"({len(model['locks'])} locks, {len(model['lock_order'])} order "
+        f"edges, {len(model['thread_entries'])} thread entries)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,596 @@
+"""graftrace (JGL015–JGL019) analyzer tests: every concurrency rule
+must fire on a seeded known-bad fixture and stay quiet on the matching
+known-good twin; the committed CONCURRENCY_MODEL.json must be
+byte-identical to a fresh regeneration; the incremental cache must be
+an exact (cold == warm) optimization; and the SARIF reporter must emit
+a valid 2.1.0 log.
+
+Pure-AST tests — no device work, so the module runs in milliseconds
+inside tier-1.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+from ate_replication_causalml_tpu.analysis import (
+    ResultCache,
+    lint_paths,
+    lint_source,
+    lint_sources,
+    render_sarif,
+)
+from ate_replication_causalml_tpu.analysis.core import (
+    ModuleInfo,
+    Program,
+    iter_py_files,
+)
+from ate_replication_causalml_tpu.analysis import concurrency
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "ate_replication_causalml_tpu")
+MODEL = os.path.join(REPO, "CONCURRENCY_MODEL.json")
+
+
+def _lines(source, rule, relpath="pkg/scheduler/mod.py"):
+    res = lint_source(source, relpath=relpath, select=[rule])
+    return [f.line for f in res.findings]
+
+
+# --------------------------------------------------------------- JGL015
+
+
+JGL015_BAD_ABBA = """\
+import threading
+
+A = threading.Lock()
+B = threading.Lock()
+
+def one():
+    with A:
+        with B:          # A -> B
+            pass
+
+def two():
+    with B:
+        with A:          # B -> A: the inversion
+            pass
+"""
+
+JGL015_GOOD_ORDERED = """\
+import threading
+
+A = threading.Lock()
+B = threading.Lock()
+
+def one():
+    with A:
+        with B:
+            pass
+
+def two():
+    with A:
+        with B:
+            pass
+"""
+
+
+def test_jgl015_fires_on_single_module_abba():
+    assert _lines(JGL015_BAD_ABBA, "JGL015")
+
+
+def test_jgl015_quiet_on_consistent_order():
+    assert _lines(JGL015_GOOD_ORDERED, "JGL015") == []
+
+
+def test_jgl015_fires_on_cross_module_abba():
+    # The inversion only exists interprocedurally: module one takes
+    # A then calls into module two (which takes B); module two's other
+    # path takes B then calls back into a function taking A.
+    mod_a = (
+        "import threading\n"
+        "A = threading.Lock()\n"
+        "def path_one():\n"
+        "    with A:\n"
+        "        grab_second()\n"
+        "def grab_first():\n"
+        "    with A:\n"
+        "        pass\n"
+    )
+    mod_b = (
+        "import threading\n"
+        "B = threading.Lock()\n"
+        "def path_two():\n"
+        "    with B:\n"
+        "        grab_first()\n"
+        "def grab_second():\n"
+        "    with B:\n"
+        "        pass\n"
+    )
+    res = lint_sources(
+        [("pkg/scheduler/a.py", mod_a), ("pkg/scheduler/b.py", mod_b)],
+        select=["JGL015"],
+    )
+    assert len(res.findings) == 1
+    assert "lock-order inversion" in res.findings[0].message
+
+
+# --------------------------------------------------------------- JGL016
+
+
+JGL016_BAD_GET_UNDER_LOCK = """\
+import queue
+import threading
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+
+    def drain(self):
+        with self._lock:
+            return self._q.get()     # line 11: untimed get under _lock
+"""
+
+JGL016_GOOD_TIMED_OUTSIDE = """\
+import queue
+import threading
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+
+    def drain(self):
+        item = self._q.get()
+        with self._lock:
+            self.last = item
+        return item
+"""
+
+
+def test_jgl016_fires_on_untimed_get_under_lock():
+    assert _lines(JGL016_BAD_GET_UNDER_LOCK, "JGL016") == [11]
+
+
+def test_jgl016_quiet_when_blocking_happens_outside_the_lock():
+    assert _lines(JGL016_GOOD_TIMED_OUTSIDE, "JGL016") == []
+
+
+def test_jgl016_interprocedural_callee_blocks_under_callers_lock():
+    src = (
+        "import threading\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def outer(self):\n"
+        "        with self._lock:\n"
+        "            self.blocky_inner()\n"      # line 7
+        "    def blocky_inner(self):\n"
+        "        self.worker.join()\n"
+        "    def run(self):\n"
+        "        pass\n"
+    )
+    assert 7 in _lines(src, "JGL016")
+
+
+def test_jgl016_lane_locks_are_exempt():
+    src = (
+        "import threading\n"
+        "_lane_lock = threading.Lock()\n"
+        "def launch(q):\n"
+        "    with _lane_lock:\n"
+        "        return q.get()\n"
+    )
+    assert _lines(src, "JGL016") == []
+
+
+# --------------------------------------------------------------- JGL017
+
+
+JGL017_BAD_IF_WAIT = """\
+import threading
+
+class Box:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self.ready = False
+
+    def take(self):
+        with self._cond:
+            if not self.ready:
+                self._cond.wait(1.0)    # line 11: no predicate loop
+            return self.ready
+"""
+
+JGL017_GOOD_WHILE_WAIT = """\
+import threading
+
+class Box:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self.ready = False
+
+    def take(self):
+        with self._cond:
+            while not self.ready:
+                self._cond.wait(1.0)
+            return self.ready
+"""
+
+
+def test_jgl017_fires_on_wait_outside_while():
+    assert _lines(JGL017_BAD_IF_WAIT, "JGL017") == [11]
+
+
+def test_jgl017_quiet_on_predicate_loop():
+    assert _lines(JGL017_GOOD_WHILE_WAIT, "JGL017") == []
+
+
+# --------------------------------------------------------------- JGL018
+
+
+JGL018_BAD_BARE_COLLECTIVE = """\
+from jax.experimental.shard_map import shard_map
+
+def launch(f, mesh, specs):
+    return shard_map(f, mesh=mesh)      # line 4: no lane lock anywhere
+"""
+
+JGL018_GOOD_LANE_HELD = """\
+import threading
+from jax.experimental.shard_map import shard_map
+
+_lane_lock = threading.Lock()
+
+def launch(f, mesh, specs):
+    with _lane_lock:
+        return shard_map(f, mesh=mesh)
+"""
+
+
+def test_jgl018_fires_on_bare_collective_launch():
+    assert _lines(JGL018_BAD_BARE_COLLECTIVE, "JGL018") == [4]
+
+
+def test_jgl018_quiet_when_lane_lock_held():
+    assert _lines(JGL018_GOOD_LANE_HELD, "JGL018") == []
+
+
+def test_jgl018_guaranteed_held_through_callers_counts():
+    # The launcher itself takes no lock, but its ONLY caller holds the
+    # lane lock — meet-over-paths reachability must clear it.
+    src = (
+        "import threading\n"
+        "from jax.experimental.shard_map import shard_map\n"
+        "_lane_lock = threading.Lock()\n"
+        "def bare_launch(f, mesh):\n"
+        "    return shard_map(f, mesh=mesh)\n"
+        "def laned_entry(f, mesh):\n"
+        "    with _lane_lock:\n"
+        "        return bare_launch(f, mesh)\n"
+    )
+    assert _lines(src, "JGL018") == []
+
+
+# --------------------------------------------------------------- JGL019
+
+
+JGL019_BAD_UNGUARDED_HANDLE = """\
+import threading
+
+class Sampler:
+    def __init__(self):
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run)   # line 8
+        self._thread.start()
+
+    def stop(self):
+        self._thread = None                                 # line 12
+
+    def _run(self):
+        pass
+"""
+
+JGL019_GOOD_GUARDED_HANDLE = """\
+import threading
+
+class Sampler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = None
+
+    def start(self):
+        with self._lock:
+            self._thread = threading.Thread(target=self._run)
+            self._thread.start()
+
+    def stop(self):
+        with self._lock:
+            self._thread = None
+
+    def _run(self):
+        pass
+"""
+
+
+def test_jgl019_fires_on_unguarded_cross_thread_write():
+    lines = _lines(JGL019_BAD_UNGUARDED_HANDLE, "JGL019")
+    assert lines == [8]
+
+
+def test_jgl019_quiet_when_all_writes_share_a_lock():
+    assert _lines(JGL019_GOOD_GUARDED_HANDLE, "JGL019") == []
+
+
+def test_jgl019_suppression_comment_routes_to_suppressed():
+    suppressed = JGL019_BAD_UNGUARDED_HANDLE.replace(
+        "        self._thread = threading.Thread(target=self._run)   # line 8",
+        "        # graftlint: disable=JGL019 — single-threaded test double\n"
+        "        self._thread = threading.Thread(target=self._run)",
+    )
+    res = lint_source(
+        suppressed, relpath="pkg/scheduler/mod.py", select=["JGL019"]
+    )
+    assert res.findings == []
+    assert [f.rule for f in res.suppressed] == ["JGL019"]
+
+
+def test_concurrency_rules_only_apply_in_scope():
+    # models/ is outside the concurrency planes: even a blatant ABBA
+    # there is not this analyzer's business.
+    assert _lines(JGL015_BAD_ABBA, "JGL015", relpath="pkg/models/mod.py") == []
+
+
+# ------------------------------------------------- committed model
+
+
+def _fresh_model_text():
+    modules = []
+    for path in iter_py_files([PKG]):
+        rel = os.path.relpath(path, REPO).replace(os.sep, "/")
+        with open(path, encoding="utf-8") as f:
+            modules.append(ModuleInfo(path, rel, f.read()))
+    return concurrency.to_json(concurrency.build_model(Program(modules)))
+
+
+def test_concurrency_model_is_byte_identical_across_builds():
+    assert _fresh_model_text() == _fresh_model_text()
+
+
+def test_committed_concurrency_model_matches_tree():
+    with open(MODEL, encoding="utf-8") as f:
+        committed = f.read()
+    assert committed == _fresh_model_text(), (
+        "CONCURRENCY_MODEL.json is stale — regenerate with "
+        "`python scripts/graftrace.py` and commit the diff"
+    )
+
+
+def test_committed_model_contains_known_concurrency_surface():
+    with open(MODEL, encoding="utf-8") as f:
+        model = json.load(f)
+    lock_ids = {l["id"] for l in model["locks"]}
+    assert any(l.endswith("NuisanceCache.lane_lock()") for l in lock_ids)
+    assert any(l.endswith("Coalescer._cond") for l in lock_ids)
+    entries = {e["id"]: e for e in model["thread_entries"]}
+    sampler = [e for e in entries if e.endswith("MetricSampler._run")]
+    assert sampler, "the trace sampler thread must be a model entry"
+    # The dispatcher's transitive lock-set crosses at least the daemon
+    # lock and the coalescer condition.
+    dispatch = [
+        eid for eid in model["entry_locksets"]
+        if eid.endswith("CateServer._dispatch_loop")
+    ]
+    assert dispatch
+    locks = set(model["entry_locksets"][dispatch[0]])
+    assert any(l.endswith("CateServer._lock") for l in locks)
+    assert any(l.endswith("Coalescer._cond") for l in locks)
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_check_concurrency_model_accepts_committed_and_rejects_tampering():
+    checker = _load_script("check_concurrency_model")
+    with open(MODEL, encoding="utf-8") as f:
+        raw = f.read()
+    assert checker.validate_model(raw) == []
+
+    model = json.loads(raw)
+    bad_version = dict(model, schema_version=999)
+    errs = checker.validate_model(
+        json.dumps(bad_version, indent=2, sort_keys=True) + "\n"
+    )
+    assert any("schema_version" in e for e in errs)
+
+    bad_edge = json.loads(raw)
+    bad_edge["lock_order"].append(
+        {"from": "nowhere.py::GHOST", "to": "nowhere.py::GHOST2",
+         "sites": ["x:1"]}
+    )
+    errs = checker.validate_model(
+        json.dumps(bad_edge, indent=2, sort_keys=True) + "\n"
+    )
+    assert any("not in the registry" in e for e in errs)
+
+    # A committed ABBA cycle must be rejected even if the ids resolve.
+    cyclic = json.loads(raw)
+    ids = [l["id"] for l in cyclic["locks"]][:2]
+    cyclic["lock_order"] = [
+        {"from": ids[0], "to": ids[1], "sites": ["x:1"]},
+        {"from": ids[1], "to": ids[0], "sites": ["x:2"]},
+    ]
+    errs = checker.validate_model(
+        json.dumps(cyclic, indent=2, sort_keys=True) + "\n"
+    )
+    assert any("cycle" in e for e in errs)
+
+    # Hand-edited (non-canonical) serialization is not committable.
+    errs = checker.validate_model(json.dumps(model) + "\n")
+    assert any("canonical" in e for e in errs)
+
+
+def test_graftrace_check_cli_passes_on_shipped_tree():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "graftrace.py"),
+         "--check"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "model current" in proc.stdout
+
+
+def test_analyzer_imports_no_jax():
+    # The concurrency pass must stay importable in jax-free CI images:
+    # stub the parent package (as the CLIs do) and assert jax was never
+    # pulled in by the analysis import itself.
+    code = (
+        "import sys, types, os\n"
+        f"root = {REPO!r}\n"
+        "sys.path.insert(0, root)\n"
+        "pkg = types.ModuleType('ate_replication_causalml_tpu')\n"
+        "pkg.__path__ = [os.path.join(root, 'ate_replication_causalml_tpu')]\n"
+        "sys.modules['ate_replication_causalml_tpu'] = pkg\n"
+        "import ate_replication_causalml_tpu.analysis  # noqa\n"
+        "assert 'jax' not in sys.modules, 'analysis import pulled jax'\n"
+        "print('jax-free-ok')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "jax-free-ok" in proc.stdout
+
+
+# ------------------------------------------------- incremental cache
+
+
+def _write_fixture_tree(root):
+    pkg = os.path.join(root, "pkg", "scheduler")
+    os.makedirs(pkg)
+    with open(os.path.join(root, "pkg", "__init__.py"), "w") as f:
+        f.write("")
+    with open(os.path.join(pkg, "__init__.py"), "w") as f:
+        f.write("")
+    with open(os.path.join(pkg, "bad.py"), "w") as f:
+        f.write(JGL015_BAD_ABBA)
+    with open(os.path.join(pkg, "good.py"), "w") as f:
+        f.write(JGL017_GOOD_WHILE_WAIT)
+    return os.path.join(root, "pkg")
+
+
+def _as_tuples(result):
+    return [
+        (f.rule, f.path, f.line, f.col, f.message) for f in result.findings
+    ]
+
+
+def test_cache_cold_warm_parity_and_invalidation(tmp_path):
+    tree = _write_fixture_tree(str(tmp_path))
+    cache_dir = str(tmp_path / "cache")
+    root = str(tmp_path)
+
+    uncached = lint_paths([tree], root=root)
+    cold = lint_paths([tree], root=root, cache=ResultCache(cache_dir))
+    warm = lint_paths([tree], root=root, cache=ResultCache(cache_dir))
+    assert _as_tuples(cold) == _as_tuples(uncached)
+    assert _as_tuples(warm) == _as_tuples(uncached)
+    assert cold.files == warm.files == uncached.files
+
+    # Editing a file must invalidate exactly its results: fixing the
+    # ABBA removes the JGL015 finding on the warm path too.
+    with open(os.path.join(tree, "scheduler", "bad.py"), "w") as f:
+        f.write(JGL015_GOOD_ORDERED)
+    fixed_warm = lint_paths([tree], root=root, cache=ResultCache(cache_dir))
+    fixed_cold = lint_paths([tree], root=root)
+    assert _as_tuples(fixed_warm) == _as_tuples(fixed_cold)
+    assert all(f.rule != "JGL015" for f in fixed_warm.findings)
+
+
+def test_cache_select_change_invalidates(tmp_path):
+    tree = _write_fixture_tree(str(tmp_path))
+    cache_dir = str(tmp_path / "cache")
+    root = str(tmp_path)
+    all_rules_run = lint_paths(
+        [tree], root=root, cache=ResultCache(cache_dir)
+    )
+    only_15 = lint_paths(
+        [tree], root=root, select=["JGL015"],
+        cache=ResultCache(cache_dir, select=["JGL015"]),
+    )
+    assert {f.rule for f in only_15.findings} <= {"JGL015"}
+    assert len(all_rules_run.findings) >= len(only_15.findings)
+
+
+def test_graftlint_cli_cache_flag_round_trips(tmp_path):
+    cache_dir = str(tmp_path / "clicache")
+    cmd = [
+        sys.executable, os.path.join(REPO, "scripts", "graftlint.py"),
+        os.path.join(PKG, "analysis"), "--cache", cache_dir,
+    ]
+    first = subprocess.run(
+        cmd, capture_output=True, text=True, cwd=REPO, timeout=120
+    )
+    second = subprocess.run(
+        cmd, capture_output=True, text=True, cwd=REPO, timeout=120
+    )
+    assert first.returncode == second.returncode == 0, (
+        first.stdout + first.stderr
+    )
+    assert first.stdout == second.stdout
+    assert os.path.isfile(os.path.join(cache_dir, "graftlint-cache.json"))
+
+
+# ------------------------------------------------------------- SARIF
+
+
+def test_sarif_output_is_valid_2_1_0():
+    res = lint_source(
+        JGL016_BAD_GET_UNDER_LOCK, relpath="pkg/scheduler/mod.py"
+    )
+    log = json.loads(render_sarif(res))
+    assert log["version"] == "2.1.0"
+    run = log["runs"][0]
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"JGL001", "JGL015", "JGL019"} <= rule_ids
+    results = run["results"]
+    assert any(
+        r["ruleId"] == "JGL016"
+        and r["locations"][0]["physicalLocation"]["region"]["startLine"] == 11
+        for r in results
+    )
+
+
+def test_sarif_carries_suppressions_in_source():
+    src = (
+        "import threading\n"
+        "A = threading.Lock()\n"
+        "B = threading.Lock()\n"
+        "def one():\n"
+        "    with A:\n"
+        # JGL015 anchors at the first witness site (the inner acquire
+        # in the first-seen edge), so the shield goes there.
+        "        with B:  # graftlint: disable=JGL015 — fixture\n"
+        "            pass\n"
+        "def two():\n"
+        "    with B:\n"
+        "        with A:\n"
+        "            pass\n"
+    )
+    res = lint_source(src, relpath="pkg/scheduler/mod.py", select=["JGL015"])
+    log = json.loads(render_sarif(res))
+    results = log["runs"][0]["results"]
+    assert len(results) == 1
+    assert results[0]["suppressions"] == [{"kind": "inSource"}]

@@ -14,17 +14,29 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 GATE = os.path.join(REPO, "scripts", "check_static.sh")
 
 
-def test_check_static_gate_passes_on_shipped_tree():
+def test_check_static_gate_passes_on_shipped_tree(tmp_path):
     proc = subprocess.run(
         ["bash", GATE],
         capture_output=True,
         text=True,
         cwd=REPO,
         timeout=300,
-        env=dict(os.environ, PYTHON=sys.executable),
+        # Fresh cache dir: the gate must pass cold, not just on a warm
+        # .graftlint_cache left by a previous run.
+        env=dict(
+            os.environ,
+            PYTHON=sys.executable,
+            GRAFTLINT_CACHE=str(tmp_path / "graftlint_cache"),
+        ),
     )
     assert proc.returncode == 0, (
         f"static gate failed\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
     )
     assert "check_static: OK" in proc.stdout
     assert "graftlint" in proc.stdout
+    # ISSUE 17: the concurrency-model plane is part of the gate — the
+    # committed CONCURRENCY_MODEL.json must be regenerated, compared
+    # byte-for-byte, and schema-validated on every gate run.
+    assert "graftrace" in proc.stdout
+    assert "model current" in proc.stdout
+    assert "check_concurrency_model: OK" in proc.stdout
