@@ -1391,6 +1391,7 @@ _CHAOS_SITE_ARGS: dict[str, tuple[tuple[int, str], ...]] = {
     "maybe_fail_stage": ((0, "method"),),
     "hang_delay_s": ((1, "site"),),
     "take_rotate_fault": ((1, "site"),),
+    "record_daemon_kill": ((0, "name"),),
     "rotate_verify_delay_s": ((0, "site"),),
     "torn_line": ((1, "site"),),
     "truncate_npz": ((1, "site"),),

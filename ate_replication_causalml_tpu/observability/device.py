@@ -232,6 +232,17 @@ def install_jax_monitoring() -> bool:
     counter("stat_drift_events_total",
             "statistical drift detections by model, channel and "
             "psi/ks/calibration detector").inc(0)
+    # Fleet-router families (ISSUE 18): forward outcomes per backend,
+    # failovers to the next ring owner, and rotation-membership
+    # transitions. "The router never ran" is a recorded 0 on every
+    # instrumented run, and the fleet manifest's reconciliation reads
+    # these same families (scripts/check_metrics_schema.py).
+    counter("router_requests_total",
+            "router forward attempts by backend and outcome").inc(0)
+    counter("router_failover_total",
+            "forwards retried against the next ring owner").inc(0)
+    counter("router_backend_state",
+            "backend rotation-membership transitions").inc(0)
     if _installed:
         return True
     try:

@@ -11,7 +11,10 @@ tears — and this module searches that product space:
   compose multi-scope ``ATE_TPU_CHAOS`` specs (seeded parameters drawn
   from declared per-scope ranges) crossed with the four real workloads
   (quick sweep, scenario matrix, serving daemon + seeded loadgen-style
-  replay, fleet rotation under load). Every draw is a pure sha256 hash
+  replay, fleet rotation under load); a fifth, subprocess-heavy
+  ``fleet`` workload (ISSUE 18 — three daemons behind the serving
+  router, judged against ``daemon:`` SIGKILLs) is registered but opt-in
+  only, never part of the default plan. Every draw is a pure sha256 hash
   of ``(root_seed, path)`` — no global RNG — so the same seed plans
   the identical campaign forever.
 * **Reference discipline** — every episode runs against a fault-free
@@ -62,7 +65,7 @@ NONDETERMINISTIC_SCOPES = ("hang",)
 
 #: canonical scope order inside a composed spec (stable spec strings).
 _SCOPE_ORDER = ("shard", "fs", "device", "stage", "serve", "hang",
-                "rotate", "tamper")
+                "rotate", "tamper", "daemon")
 
 
 def _env_int(name: str, default: int) -> int:
@@ -220,6 +223,11 @@ def draw_atom(workload: str, scope: str, d: Draw) -> str:
         if kind == "verify_ms":
             return f"rotate:verify_ms={d.unit('ms', 30, 90):.0f},times=1"
         return f"rotate:{kind},times=1"
+    if scope == "daemon":
+        # One SIGKILLed backend per episode (ISSUE 18): the victim is
+        # the pure (seed, name) hash, so the invariant registry can
+        # recompute the plan from the spec alone.
+        return f"daemon:kill=1,seed={d.int('seed')}"
     raise ValueError(f"no campaign range declared for scope {scope!r}")
 
 
@@ -566,6 +574,247 @@ def _serving_workload(rotate: bool):
     return run
 
 
+# ── the horizontal-fleet workload (ISSUE 18) ──────────────────────────
+#
+# Three REAL serving daemons (subprocesses of scripts/serve.py, each
+# binding the same three models to the same published v1 checkpoint)
+# behind an in-process RouterServer, replayed through a CateClient
+# against the router port: first half of the seeded schedule, one
+# fleet-wide rolling rotation of "default" onto v2, second half, and —
+# when a ``daemon:`` chaos scope is armed — a SIGKILL of the planned
+# victim at the 3/4 mark. Every request must still be served (router
+# failover + the client's connection_lost resubmit), bit-identical per
+# bound model version to the offline refs. Subprocess-heavy, so it is
+# NOT in WORKLOAD_ORDER: campaign plans/reports for existing seeds are
+# unchanged, and the fleet episode runs via explicit ``workloads=`` /
+# ``run_repro`` (the @slow fleet test and the README runbook).
+
+
+def _spawn_fleet_daemon(name: str, ckpt: str, logdir: str):
+    """One scripts/serve.py subprocess serving default+m2+m3 from the
+    same checkpoint on ephemeral serving/admin ports. Returns
+    ``(proc, lines, stderr_thread)`` — ports are parsed later from the
+    captured stderr lines."""
+    import subprocess
+    import sys as _sys
+    import threading
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ)
+    for k in ("ATE_TPU_CHAOS", "ATE_TPU_METRICS_DIR",
+              "ATE_TPU_SERVE_FLEET", "ATE_TPU_SERVE_ADMIN_PORT"):
+        env.pop(k, None)  # daemons run fault-free; chaos lives up here
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [_sys.executable, os.path.join(root, "scripts", "serve.py"),
+         "--checkpoint", ckpt, "--port", "0", "--admin-port", "0",
+         "--fleet", f"m2={ckpt},m3={ckpt}",
+         "--buckets", "4", "--window-ms", "2"],
+        stderr=subprocess.PIPE, stdout=subprocess.DEVNULL,
+        env=env, text=True,
+    )
+    lines: list[str] = []
+
+    def _drain():
+        for line in proc.stderr:
+            lines.append(line)
+
+    t = threading.Thread(target=_drain, name=f"fleet-stderr-{name}",
+                         daemon=True)
+    t.start()
+    return proc, lines, t
+
+
+def _fleet_ports(proc, lines, deadline_s: float = 180.0) -> tuple[int, int]:
+    """Parse ``(serve_port, admin_port)`` from a daemon's stderr."""
+    import re
+
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        text = "".join(lines)
+        served = re.search(r"# serving on [^:]+:(\d+)", text)
+        admin = re.search(r"# admin endpoint on 127\.0\.0\.1:(\d+)", text)
+        if served and admin:
+            return int(served.group(1)), int(admin.group(1))
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"fleet daemon exited rc={proc.returncode} before "
+                f"binding: {text[-2000:]}"
+            )
+        time.sleep(0.05)
+    raise RuntimeError("fleet daemon did not bind within the deadline")
+
+
+def _peek_delta(name: str, before: dict) -> dict:
+    now = obs.REGISTRY.peek(name) or {}
+    return {k: v - before.get(k, 0.0) for k, v in now.items()
+            if v - before.get(k, 0.0)}
+
+
+def _run_fleet_workload(outdir: str, seed: int, scale: CampaignScale):
+    import signal
+
+    import jax.numpy as jnp
+
+    from ate_replication_causalml_tpu.models.causal_forest import (
+        predict_cate,
+    )
+    from ate_replication_causalml_tpu.serving import loadgen
+    from ate_replication_causalml_tpu.serving import router as rt
+    from ate_replication_causalml_tpu.serving.client import CateClient
+    from ate_replication_causalml_tpu.utils.checkpoint import save_fitted
+
+    rng = np.random.default_rng(seed)
+    forests = {1: _synthetic_serving_forest(rng),
+               2: _synthetic_serving_forest(rng)}
+    ckpt_v1 = os.path.join(outdir, "model-v1.npz")
+    ckpt_v2 = os.path.join(outdir, "model-v2.npz")
+    save_fitted(ckpt_v1, forests[1])
+    save_fitted(ckpt_v2, forests[2])
+
+    models = ("default", "m2", "m3")
+    schedule = loadgen.build_schedule(
+        seed, scale.serve_requests, rate_hz=scale.serve_rate_hz,
+        mix="1:2,3:2,4:1", id_prefix=f"f{seed}x", models=models,
+    )
+    queries = loadgen.build_queries(seed, schedule, features=4)
+    # Offline per-version references BEFORE any jax serving work — the
+    # committed comparison base for bit_identity. Every model id binds
+    # the same v1 checkpoint, and the rotation moves only "default" to
+    # v2, so refs keyed by version alone cover all three models.
+    cat = jnp.asarray(np.concatenate(queries))
+    refs = {}
+    for v, forest in forests.items():
+        out = predict_cate(forest, cat, oob=False, row_backend="matmul")
+        refs[f"cate_v{v}"] = np.asarray(out.cate)
+        refs[f"var_v{v}"] = np.asarray(out.variance)
+    np.savez(os.path.join(outdir, "refs.npz"), **refs)
+
+    names = ("b0", "b1", "b2")
+    inj = chaos.active()
+    victims = inj.daemon_kill_plan(names) if inj is not None else ()
+
+    req_before = dict(obs.REGISTRY.peek("router_requests_total") or {})
+    fo_before = dict(obs.REGISTRY.peek("router_failover_total") or {})
+    procs: dict[str, object] = {}
+    router = None
+    serve_thread = None
+    with _FaultWindow() as win:
+        try:
+            spawned = {n: _spawn_fleet_daemon(n, ckpt_v1, outdir)
+                       for n in names}
+            specs = []
+            for n in names:
+                proc, lines, _t = spawned[n]
+                procs[n] = proc
+                port, admin = _fleet_ports(proc, lines)
+                specs.append(rt.BackendSpec(n, "127.0.0.1", port, admin))
+            router = rt.RouterServer(rt.RouterConfig(
+                backends=tuple(specs), probe_interval_s=0.1,
+            ))
+            router.start()
+            supervisor = rt.FleetSupervisor(router)
+
+            import threading
+
+            bound: list[int] = []
+            ready = threading.Event()
+
+            def _on_bound(p: int) -> None:
+                bound.append(p)
+                ready.set()
+
+            serve_thread = threading.Thread(
+                target=rt.serve_socket, args=(router,),
+                kwargs={"on_bound": _on_bound}, name="fleet-router",
+                daemon=True,
+            )
+            serve_thread.start()
+            if not ready.wait(timeout=30.0):
+                raise RuntimeError("router did not bind")
+
+            client = CateClient.connect("127.0.0.1", bound[0],
+                                        timeout=60.0)
+            half = len(schedule) // 2
+            kill_at = (3 * len(schedule)) // 4
+            rotation = None
+            replies = []
+            try:
+                for i, sched in enumerate(schedule):
+                    if i == half:
+                        # Fleet-wide rolling rotation between the two
+                        # replay halves: every daemon drains through
+                        # cordon and swaps "default" onto the SAME
+                        # published v2 path, one at a time.
+                        rotation = supervisor.rotate_all(
+                            ckpt_v2, model="default", timeout_s=120.0,
+                        )
+                    if i == kill_at:
+                        for victim in victims:
+                            if inj.record_daemon_kill(victim):
+                                procs[victim].send_signal(signal.SIGKILL)
+                    replies.append(client.predict_full(
+                        queries[i], request_id=sched.request_id,
+                        model=sched.model, max_retries=64,
+                    ))
+                if rotation is None:  # degenerate 1-request schedules
+                    rotation = supervisor.rotate_all(
+                        ckpt_v2, model="default", timeout_s=120.0,
+                    )
+                router.dump_fleet(os.path.join(outdir, "fleet_dump"))
+                client_retries = dict(client.retry_counts)
+            finally:
+                client.close()
+        finally:
+            if router is not None:
+                router.stop()
+            survivors = [n for n in procs if n not in victims]
+            for n in survivors:
+                procs[n].send_signal(signal.SIGTERM)
+            for n, proc in procs.items():
+                try:
+                    proc.wait(timeout=60.0)
+                except Exception:  # noqa: BLE001 — a wedged daemon
+                    proc.kill()    # must not wedge the campaign
+                    proc.wait(timeout=10.0)
+            if serve_thread is not None:
+                serve_thread.join(timeout=10.0)
+
+    rows = np.asarray([q.shape[0] for q in queries], np.int64)
+    versions = np.asarray(
+        [int(h.get("model_version") or 1) for _, _, h in replies],
+        np.int64,
+    )
+    np.savez(
+        os.path.join(outdir, "answers.npz"),
+        rows=rows,
+        versions=versions,
+        cate=np.concatenate([np.asarray(c) for c, _, _ in replies]),
+        var=np.concatenate([np.asarray(v) for _, v, _ in replies]),
+    )
+    drains = [procs[n].returncode for n in procs if n not in victims]
+    _write_summary(outdir, {
+        "workload": "fleet",
+        "seed": seed,
+        "n_requests": len(schedule),
+        "request_ids": [s.request_id for s in schedule],
+        "faults": win.collect(),
+        "fleet": {
+            "backends": list(names),
+            "killed": sorted(victims),
+            "served": len(replies),
+            "rotation": rotation,
+            "survivor_exit_codes": drains,
+            "client_retries": client_retries,
+            "router_requests_delta": _peek_delta(
+                "router_requests_total", req_before),
+            "router_failover_delta": _peek_delta(
+                "router_failover_total", fo_before),
+        },
+    })
+
+
 WORKLOADS: dict[str, WorkloadSpec] = {
     "sweep": WorkloadSpec(
         "sweep", ("shard", "fs", "stage", "hang"), _run_sweep_workload
@@ -580,6 +829,12 @@ WORKLOADS: dict[str, WorkloadSpec] = {
         "rotation", ("serve", "hang", "rotate"),
         _serving_workload(rotate=True)
     ),
+    # The horizontal-fleet episode (ISSUE 18). Deliberately NOT in
+    # WORKLOAD_ORDER: it spawns three daemon subprocesses per run, and
+    # adding it to the default rotation would both blow the campaign's
+    # time budget and reshuffle every existing seed's plan. It runs via
+    # explicit ``workloads=("fleet",)`` or ``run_repro("fleet", ...)``.
+    "fleet": WorkloadSpec("fleet", ("daemon",), _run_fleet_workload),
 }
 WORKLOAD_ORDER = ("sweep", "matrix", "serving", "rotation")
 

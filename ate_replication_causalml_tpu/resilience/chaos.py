@@ -55,6 +55,16 @@ item is ``key=value`` or a bare flag. Scopes and their keys:
   exists to prove the campaign's DETECTION power and to give the
   failure shrinker a deterministic violation to minimize — arming it
   in production is arming data corruption.
+* ``daemon`` — horizontal-fleet process death (ISSUE 18):
+  ``daemon:kill=k,seed=..`` SIGKILLs ``k`` of the fleet's serving
+  daemons mid-replay. Selection ranks backend NAMES by the pure
+  ``(seed, "daemon", name)`` hash (:meth:`ChaosInjector.
+  daemon_kill_plan`) so the invariant registry recomputes the victim
+  set from the spec alone; ``k`` is capped at fleet size − 1 (killing
+  every backend makes zero-silent-drops unprovable by definition).
+  The kill is a real ``SIGKILL`` — no atexit, no drain, the wire dies
+  mid-frame — exercising the router's circuit-breaker/failover path
+  and the client's ``connection_lost`` reconnect-resubmit discipline.
 * ``rotate`` — the train-to-serve fleet's failure modes (ISSUE 11),
   each a bare flag budgeted by ``times``: ``retrain`` (the retrain
   supervisor's fit raises :class:`~.errors.ChaosRotateFault` —
@@ -111,6 +121,7 @@ _SCOPE_SCHEMA: dict[str, dict[str, type]] = {
     "rotate": {"corrupt": bool, "mid_swap": bool, "retrain": bool,
                "verify_ms": float, "times": int},
     "tamper": {"journal": bool, "delta": float, "times": int},
+    "daemon": {"kill": int, "seed": int},
 }
 
 #: lanes the ``hang`` scope may target — the heartbeat-stamped sites.
@@ -126,6 +137,7 @@ _SCOPE_DEFAULTS: dict[str, dict[str, object]] = {
     "rotate": {"corrupt": False, "mid_swap": False, "retrain": False,
                "verify_ms": 0.0, "times": 1},
     "tamper": {"journal": False, "delta": 1e-3, "times": 1},
+    "daemon": {"kill": 0, "seed": 0},
 }
 
 
@@ -209,6 +221,11 @@ def parse_chaos(spec: str) -> ChaosConfig:
                         f"chaos key {name}:{key}={value!r} is not a "
                         f"{typ.__name__}"
                     ) from e
+        if name == "daemon" and int(params["kill"]) < 0:
+            raise ChaosSpecError(
+                f"daemon:kill={params['kill']} must be >= 0 "
+                "(the number of fleet daemons to SIGKILL mid-replay)"
+            )
         if name == "hang" and params["scope"] not in HANG_SCOPES:
             # scope is REQUIRED: a hang spec that names no lane injects
             # nothing, and an operator who believes stalls are flowing
@@ -263,6 +280,9 @@ class ChaosInjector:
         )
         tam = config.scope("tamper") or _SCOPE_DEFAULTS["tamper"]
         self._tamper_left = int(tam["times"]) if tam.get("journal") else 0
+        # daemon scope: one kill per planned backend, ever (a SIGKILL
+        # is not repeatable); the set guards double-recording.
+        self._daemon_killed: set[str] = set()
 
     # ── bookkeeping ───────────────────────────────────────────────────
 
@@ -540,6 +560,41 @@ class ChaosInjector:
                 f"chaos: injected stage fault on {method!r} "
                 f"(fail={cfg['fail']!r})"
             )
+
+    # ── daemon scope ──────────────────────────────────────────────────
+
+    def daemon_kill_plan(self, names: Sequence[str]) -> tuple[str, ...]:
+        """Which fleet daemons a ``daemon:kill=k,seed=..`` spec SIGKILLs
+        (ISSUE 18): rank ``names`` by the pure ``(seed, "daemon",
+        name)`` hash and take the ``k`` lowest — per name, not per
+        process id or startup order, so the same fleet draws the same
+        victims in every run and the invariant registry can recompute
+        the plan from the spec alone. The plan never selects the WHOLE
+        fleet (``k`` is capped at ``len(names) - 1``): with every
+        backend dead, zero-silent-drops is unachievable by definition
+        and the episode would prove nothing. Selection only — recording
+        happens at :meth:`record_daemon_kill`, when the signal is
+        actually sent."""
+        cfg = self.config.scope("daemon")
+        if cfg is None or int(cfg["kill"]) < 1 or not names:
+            return ()
+        k = min(int(cfg["kill"]), len(names) - 1)
+        ranked = sorted(
+            names, key=lambda n: _unit(int(cfg["seed"]), "daemon", str(n))
+        )
+        return tuple(ranked[:k])
+
+    def record_daemon_kill(self, name: str) -> bool:
+        """Emit the injection event/counter for a planned daemon kill at
+        the moment SIGKILL is sent (the stage scope's plan/record
+        split). Returns False — and records nothing — on a repeat for
+        the same daemon: one SIGKILL per victim, ever."""
+        with self._lock:
+            if name in self._daemon_killed:
+                return False
+            self._daemon_killed.add(name)
+        self._record("daemon", f"daemon/{name}", kind="kill")
+        return True
 
 
 def plan_faults(

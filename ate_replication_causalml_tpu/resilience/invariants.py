@@ -582,3 +582,70 @@ def _stathealth_cells(sketch: dict | None) -> list[int] | None:
     if any(not isinstance(c, int) or c < 0 for c in cells):
         return None
     return cells
+
+
+@register(
+    "fleet_failover",
+    "a SIGKILLed backend costs nothing observable: every replayed "
+    "request was served (zero silent drops through failover + client "
+    "resubmit), each planned kill was recorded exactly once, and the "
+    "rolling rotation visited every backend exactly once with zero "
+    "downtime and zero post-swap compiles",
+    workloads=("fleet",),
+)
+def _fleet_failover(ep: RunArtifacts, ref: RunArtifacts) -> Verdict:
+    fleet = ep.summary.get("fleet")
+    if not isinstance(fleet, dict):
+        return Verdict("fleet_failover", "fail",
+                       "summary carries no fleet section")
+    problems = []
+    n = ep.summary.get("n_requests")
+    served = fleet.get("served")
+    if served != n:
+        problems.append(f"served {served!r} of {n!r} replayed requests")
+    killed = sorted(fleet.get("killed") or [])
+    recorded = sorted(
+        f["site"].split("/", 1)[1] for f in ep.faults("daemon")
+    )
+    if killed != recorded:
+        problems.append(
+            f"killed backends {killed} != recorded daemon injections "
+            f"{recorded}"
+        )
+    backends = sorted(fleet.get("backends") or [])
+    if killed and set(killed) >= set(backends):
+        problems.append("the whole fleet was killed — nothing proven")
+    rotation = fleet.get("rotation") or {}
+    statuses = rotation.get("statuses") or {}
+    if sorted(statuses) != backends:
+        problems.append(
+            f"rotation visited {sorted(statuses)}, fleet is {backends}"
+        )
+    bad = {b: s for b, s in statuses.items() if s != "rotated"}
+    if bad:
+        problems.append(f"rotation statuses not all 'rotated': {bad}")
+    if rotation.get("zero_downtime") is not True:
+        problems.append("rotation reported a downtime window")
+    compiles = rotation.get("post_swap_compiles") or {}
+    hot = {b: c for b, c in compiles.items() if c}
+    if hot:
+        problems.append(f"post-swap compiles observed: {hot}")
+    drains = fleet.get("survivor_exit_codes") or []
+    if any(rc != 0 for rc in drains):
+        problems.append(f"survivor drain exit codes {drains} not all 0")
+    # The reference runs the SAME workload fault-free — its fleet must
+    # have no kills at all, or the chaos plumbing leaked into it.
+    ref_fleet = ref.summary.get("fleet") or {}
+    if ref_fleet.get("killed"):
+        problems.append(
+            f"fault-free reference recorded kills: {ref_fleet['killed']}"
+        )
+    if problems:
+        return Verdict("fleet_failover", "fail", "; ".join(problems),
+                       {"killed": killed, "statuses": statuses})
+    return Verdict(
+        "fleet_failover", "pass",
+        f"{served} requests served across {len(backends)} backends with "
+        f"{len(killed)} kill(s); rotation green on every backend",
+        {"backends": backends, "killed": killed},
+    )

@@ -95,10 +95,14 @@ def handle_admin_path(server, path: str) -> tuple[int, str, bytes]:
     if path == "/readyz":
         state = server.lifecycle.state
         ready = state == "serving"
+        # Model-binding table (ISSUE 18): the router tier builds its
+        # routing view from this body alone. Duck-typed with a default
+        # so the pre-fleet stubs keep working.
+        models = getattr(server, "model_bindings", dict)()
         return (
             200 if ready else 503,
             "application/json",
-            _json_bytes({"ready": ready, "state": state}),
+            _json_bytes({"ready": ready, "state": state, "models": models}),
         )
     if path == "/varz":
         return 200, "application/json", _json_bytes(varz_payload())

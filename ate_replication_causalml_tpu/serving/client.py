@@ -41,8 +41,8 @@ from ate_replication_causalml_tpu.resilience.backoff import (
 from ate_replication_causalml_tpu.resilience.deadline import Budget
 from ate_replication_causalml_tpu.serving import protocol
 
-__all__ = ["BACKOFF_CAP_MULT", "CateClient", "ServingError",
-           "ServingUnavailable", "retry_backoff_delay"]
+__all__ = ["BACKOFF_CAP_MULT", "CONNECTION_LOST", "CateClient",
+           "ServingError", "ServingUnavailable", "retry_backoff_delay"]
 
 
 def retry_backoff_delay(request_id: str, code: str, attempt: int,
@@ -85,7 +85,16 @@ class ServingUnavailable(ServingError):
 #: daemon behind it is going away; in a balanced fleet the caller's
 #: next connection lands elsewhere).
 RETRYABLE = ("overloaded", "serve_fault", "degraded", "starting",
-             "model_degraded", "shed", "deadline_exceeded")
+             "model_degraded", "shed", "deadline_exceeded",
+             "backend_unavailable")
+
+#: wire codes that mean the TRANSPORT died, not that the server
+#: rejected anything (ISSUE 18): a TCP client reconnects and resubmits
+#: under the SAME request id (ids are the idempotency key — a daemon
+#: failover behind a router is invisible to a well-behaved client);
+#: over stdio there is nothing to reconnect to, so the loss is
+#: terminal and typed.
+CONNECTION_LOST = "connection_lost"
 
 
 class CateClient:
@@ -107,6 +116,11 @@ class CateClient:
         self.backoff_s_total: float = 0.0
         #: absolute backoff ceiling per sleep.
         self.max_backoff_s: float = 2.0
+        #: TCP origin (host, port, timeout) when built by
+        #: :meth:`connect` — the reconnect target after a mid-stream
+        #: connection loss (ISSUE 18). None for stdio/socketpair
+        #: transports, which cannot reconnect.
+        self._addr: tuple[str, int, float] | None = None
 
     @classmethod
     def connect(cls, host: str, port: int, timeout: float = 10.0
@@ -114,7 +128,9 @@ class CateClient:
         sock = socket.create_connection((host, port), timeout=timeout)
         sock.settimeout(timeout)
         rw = sock.makefile("rwb")
-        return cls(rw, rw, sock=sock)
+        client = cls(rw, rw, sock=sock)
+        client._addr = (host, port, timeout)
+        return client
 
     @classmethod
     def spawn_stdio(cls, argv: list[str], **popen_kw) -> "CateClient":
@@ -139,11 +155,41 @@ class CateClient:
     # ── ops ──────────────────────────────────────────────────────────
 
     def _roundtrip(self, header: dict, arrays=None):
-        protocol.write_frame(self._w, header, arrays)
-        frame = protocol.read_frame(self._r)
+        try:
+            protocol.write_frame(self._w, header, arrays)
+            frame = protocol.read_frame(self._r)
+        except (protocol.ProtocolError, OSError) as e:
+            # The transport died mid-frame (a kill -9'd daemon's wire
+            # signature) — typed, so predict() can reconnect-and-
+            # resubmit and every other op surfaces a classified error.
+            raise ServingError(
+                CONNECTION_LOST, f"{type(e).__name__}: {e}"
+            ) from e
         if frame is None:
-            raise ServingError("closed", "server closed the connection")
+            raise ServingError(
+                CONNECTION_LOST, "server closed the connection"
+            )
         return frame
+
+    def _reconnect(self) -> None:
+        """Dial a fresh TCP connection to the original :meth:`connect`
+        address (ISSUE 18). The new streams swap in only on success —
+        on dial failure the dead ones stay, and the next roundtrip
+        surfaces ``connection_lost`` again (consuming another retry)
+        instead of tripping over an already-closed file object."""
+        host, port, timeout = self._addr  # type: ignore[misc]
+        sock = socket.create_connection((host, port), timeout=timeout)
+        sock.settimeout(timeout)
+        rw = sock.makefile("rwb")
+        old = (self._r, self._w, self._sock)
+        self._r = self._w = rw
+        self._sock = sock
+        for stale in old:
+            if stale is not None:
+                try:
+                    stale.close()
+                except (OSError, ValueError):
+                    pass
 
     def predict_full(
         self,
@@ -183,7 +229,46 @@ class CateClient:
                         attempt - 1,
                     )
                 request["deadline_ms"] = round(remaining, 3)
-            header, arrays = self._roundtrip(request, {"x": x})
+            try:
+                header, arrays = self._roundtrip(request, {"x": x})
+            except ServingError as e:
+                if e.code != CONNECTION_LOST or self._addr is None:
+                    # Non-transport errors propagate; a stdio/socketpair
+                    # transport has nothing to re-dial, so its loss is
+                    # terminal (but still typed).
+                    raise
+                if attempt > max_retries:
+                    raise ServingUnavailable(
+                        CONNECTION_LOST,
+                        "connection lost and retry budget exhausted",
+                        attempt,
+                    ) from e
+                # Reconnect-and-resubmit under the SAME request id: ids
+                # are the idempotency key (the answer is deterministic
+                # per model version), so a daemon failover behind a
+                # router is invisible here — this is what makes the
+                # kill -9 episode's zero-silent-drops invariant
+                # achievable (ISSUE 18).
+                self.retry_counts[CONNECTION_LOST] = (
+                    self.retry_counts.get(CONNECTION_LOST, 0) + 1
+                )
+                cap_s = self.max_backoff_s
+                if budget is not None:
+                    cap_s = min(cap_s, max(0.0, budget.remaining_s()))
+                delay = retry_backoff_delay(
+                    rid, CONNECTION_LOST, attempt, 0.05, cap_s=cap_s
+                )
+                self.backoff_s_total += delay
+                time.sleep(delay)
+                try:
+                    self._reconnect()
+                except OSError:
+                    # Dial failed — the daemon may still be restarting.
+                    # The dead streams stayed in place, so the next
+                    # attempt's roundtrip re-raises connection_lost and
+                    # consumes another retry.
+                    pass
+                continue
             if header.get("ok"):
                 return arrays["cate"], arrays["variance"], header
             code = header.get("error", "error")

@@ -113,6 +113,7 @@ import os
 import socket
 import threading
 import time
+from typing import Callable
 
 import numpy as np
 
@@ -1618,6 +1619,22 @@ class CateServer:
         """Mean masked (exact-zero) fraction across fused dispatches."""
         return self._fraction_mean("serving_masked_fraction")
 
+    def model_bindings(self) -> dict:
+        """The probe-visible routing table (ISSUE 18): every non-retired
+        model id this daemon serves, mapped to its bound checkpoint
+        version and path. ``/readyz`` and the ``stats`` op both publish
+        this, so a router (or any load balancer) builds its routing
+        table from health probes alone — no static model→daemon config
+        to drift out of date."""
+        return {
+            mid: {
+                "version": info.get("version"),
+                "checkpoint": info.get("checkpoint"),
+            }
+            for mid, info in self.fleet.describe().items()
+            if info.get("state") != "retired"
+        }
+
     def stats(self) -> dict:
         """The ``stats`` op payload: state, depth, startup phases, the
         no-compile window term, the per-phase latency decomposition and
@@ -1651,8 +1668,10 @@ class CateServer:
             "stalled_lanes": list(self.stalled_lanes()),
             "slo": self.slo.health(),
             # Fleet state (ISSUE 11): per-model version/lifecycle plus
-            # the shedder's cached per-model burn rates.
+            # the shedder's cached per-model burn rates. "models" is the
+            # compact binding table the router tier consumes (ISSUE 18).
             "fleet": self.fleet.describe(),
+            "models": self.model_bindings(),
             "shed_burn_threshold": self._shedder.threshold,
             "shed_burns": self._shedder.burns(),
             # Statistical health (ISSUE 16): per-model sketch counts and
@@ -1975,11 +1994,14 @@ def serve_stdio(server: CateServer) -> None:
 
 
 def serve_socket(server: CateServer, host: str = "127.0.0.1",
-                 port: int = 0) -> None:
+                 port: int = 0,
+                 on_bound: Callable[[int], None] | None = None) -> None:
     """Accept loop: one reader thread per connection, all feeding the
     shared coalescer (this is where micro-batching pays). Returns after
     a ``shutdown`` op. Binds ``port`` (0 = ephemeral; the bound port is
-    printed to stderr and exported as a gauge for discovery)."""
+    printed to stderr and exported as a gauge for discovery —
+    ``on_bound`` gets it directly, for in-process rigs that run this
+    loop on a thread and cannot parse their own stderr)."""
     import sys
 
     stop_evt = threading.Event()
@@ -1988,6 +2010,8 @@ def serve_socket(server: CateServer, host: str = "127.0.0.1",
         bound = srv.getsockname()[1]
         obs.gauge("serving_port", "bound TCP port").set(bound)
         print(f"# serving on {host}:{bound}", file=sys.stderr, flush=True)
+        if on_bound is not None:
+            on_bound(bound)
 
         def _conn(conn: socket.socket) -> None:
             with conn:

@@ -1,0 +1,1049 @@
+"""Horizontal serving tier: a health-routed router over N daemons
+(ISSUE 18, tentpole).
+
+One :class:`CateServer` process serving millions of users is a fiction
+no matter how fast the predict path gets (ROADMAP item 2). This module
+is the scale-out half: a **jax-free, stdlib-only** router process that
+fronts N daemons over the existing length-prefixed wire protocol
+(``serving/protocol.py``) and makes the fleet look like one daemon to
+every existing client:
+
+* **Consistent-hash routing** — requests route on a deterministic
+  sha256 ring keyed by *model id* (:class:`ConsistentHashRing`), so a
+  model's traffic concentrates on one daemon and that daemon's
+  geometry-keyed AOT executables stay warm; membership change moves
+  only the keys the changed node owned (minimal movement, unit-proven
+  in ``tests/test_router.py``). The ring is pure and immutable —
+  eviction never rebuilds it, it just walks to the next live owner, so
+  a daemon's keys come straight back when it readmits.
+* **Probe-driven rotation membership** — eviction and readmission
+  decisions come purely from the daemons' existing admin probes:
+  ``/readyz`` (readiness + the model/version bindings the daemon
+  serves) and the liveness ``/healthz`` (a wedged dispatcher is dead
+  however healthy its HTTP thread feels — ISSUE 14). No config push: a
+  degraded daemon drops out of rotation at the next probe tick and
+  rejoins the same way.
+* **Circuit breaking + bounded failover** — per-backend
+  :class:`CircuitBreaker` (closed → open after N consecutive
+  connection failures, half-open trial after a cooldown); a forward
+  that dies mid-stream retries against the next distinct ring owner at
+  most ``failover_hops`` times (metered ``router_failover_total``),
+  and an exhausted candidate list is a typed ``backend_unavailable``
+  reject with a retry-after hint — never a dead client connection.
+* **Fleet-wide rolling rotation** — :class:`FleetSupervisor.rotate_all`
+  drains one daemon at a time through the PR 14 discipline applied at
+  the router (cordon = administrative out-of-rotation; in-flight
+  forwards complete), rotates it from the one published checkpoint
+  path, waits for the probe to confirm the advanced version, and
+  readmits before touching the next — asserting at every step that at
+  least one backend stays in rotation (zero downtime is a checked
+  number, not a vibe).
+* **Merged fleet dump** — :meth:`RouterServer.dump_fleet` exports every
+  live daemon's artifact set into ``outdir/daemon-<name>/`` plus a
+  ``fleet_manifest.json`` carrying the router's own counters, so
+  ``scripts/check_metrics_schema.py`` can reconcile per-daemon reports
+  against the router's totals.
+
+Everything here must stay importable (and runnable —
+``scripts/router.py``) on hosts that will never initialize a backend:
+stdlib + the protocol module only, no numpy arrays ever materialized
+(frames forward as decoded dicts/arrays from the protocol layer, which
+the router treats as opaque).
+
+Observed counter families (pre-created by ``install_jax_monitoring``
+so "the router never ran" is a recorded 0):
+
+* ``router_requests_total{backend,outcome}`` — one bump per forward
+  attempt outcome (``ok`` / ``reject`` / ``error`` /
+  ``connection_error``) plus ``backend="-",outcome="unavailable"``
+  for requests no candidate could take;
+* ``router_failover_total`` — forwards retried against the next ring
+  owner after a connection-level failure;
+* ``router_backend_state{backend,state}`` — rotation-membership
+  transitions (``admitted`` / ``evicted`` / ``cordoned`` /
+  ``uncordoned``), so a flapping daemon is visible as a counter slope.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import hashlib
+import http.client
+import json
+import os
+import socket
+import threading
+import time
+from typing import Callable, Sequence
+
+from ate_replication_causalml_tpu import observability as obs
+from ate_replication_causalml_tpu.serving import protocol
+
+__all__ = [
+    "BackendSpec",
+    "CircuitBreaker",
+    "ConsistentHashRing",
+    "FleetSupervisor",
+    "RouterConfig",
+    "RouterServer",
+    "parse_backend_specs",
+]
+
+#: vnodes per backend — enough that a 3..8-node ring balances within
+#: the bound the tier-1 test pins, few enough that ring build is free.
+DEFAULT_VNODES = 64
+
+#: the reject code a request gets when no in-rotation backend could
+#: take it — typed and retryable, the fleet's analogue of
+#: ``overloaded``.
+BACKEND_UNAVAILABLE = "backend_unavailable"
+
+#: forward-attempt outcomes router_requests_total is labeled with.
+OUTCOMES = ("ok", "reject", "error", "connection_error", "unavailable")
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        value = float(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r}: expected a number") from None
+    if value <= 0:
+        raise ValueError(f"{name}={value}: expected > 0")
+    return value
+
+
+def _env_int(name: str, default: int, minimum: int = 1) -> int:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        value = int(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r}: expected an integer") from None
+    if value < minimum:
+        raise ValueError(f"{name}={value}: expected >= {minimum}")
+    return value
+
+
+@dataclasses.dataclass(frozen=True)
+class BackendSpec:
+    """One daemon the router fronts: wire address + admin-probe port."""
+
+    name: str
+    host: str
+    port: int
+    admin_port: int
+
+
+def parse_backend_specs(spec: str) -> tuple[BackendSpec, ...]:
+    """Parse ``name=host:port@adminport,...`` (config-time raise on any
+    malformed entry — the repo-wide env/flag discipline)."""
+    out: list[BackendSpec] = []
+    seen: set[str] = set()
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, eq, addr = part.partition("=")
+        name = name.strip()
+        hostport, at, admin_s = addr.partition("@")
+        host, colon, port_s = hostport.rpartition(":")
+        if not (eq and at and colon and name and host):
+            raise ValueError(
+                f"bad backend entry {part!r} in {spec!r} "
+                "(want name=host:port@adminport)"
+            )
+        if name in seen:
+            raise ValueError(f"duplicate backend name {name!r} in {spec!r}")
+        try:
+            port, admin_port = int(port_s), int(admin_s)
+        except ValueError:
+            raise ValueError(
+                f"bad backend ports in {part!r} (want integers)"
+            ) from None
+        if not (0 < port < 65536 and 0 < admin_port < 65536):
+            raise ValueError(f"backend ports out of range in {part!r}")
+        seen.add(name)
+        out.append(BackendSpec(name, host.strip(), port, admin_port))
+    if not out:
+        raise ValueError(f"empty backend spec {spec!r}")
+    return tuple(out)
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    """Router knobs; :meth:`from_env` reads the ``ATE_TPU_ROUTER_*``
+    family with config-time validation."""
+
+    backends: tuple[BackendSpec, ...]
+    vnodes: int = DEFAULT_VNODES
+    probe_interval_s: float = 0.25
+    probe_timeout_s: float = 2.0
+    connect_timeout_s: float = 5.0
+    io_timeout_s: float = 30.0
+    failure_threshold: int = 3
+    cooldown_s: float = 1.0
+    failover_hops: int = 2
+    retry_after_s: float = 0.05
+
+    @classmethod
+    def from_env(cls, backends: "str | tuple[BackendSpec, ...]",
+                 **overrides) -> "RouterConfig":
+        if isinstance(backends, str):
+            backends = parse_backend_specs(backends)
+        kw: dict = {
+            "vnodes": _env_int("ATE_TPU_ROUTER_VNODES", DEFAULT_VNODES),
+            "probe_interval_s": _env_float("ATE_TPU_ROUTER_PROBE_S", 0.25),
+            "failure_threshold": _env_int("ATE_TPU_ROUTER_FAILURES", 3),
+            "cooldown_s": _env_float("ATE_TPU_ROUTER_COOLDOWN_S", 1.0),
+            "failover_hops": _env_int("ATE_TPU_ROUTER_FAILOVER", 2,
+                                      minimum=0),
+            "retry_after_s": _env_float("ATE_TPU_ROUTER_RETRY_AFTER_S",
+                                        0.05),
+        }
+        kw.update(overrides)
+        return cls(backends=tuple(backends), **kw)
+
+
+# ── the consistent-hash ring (pure) ──────────────────────────────────
+
+
+def _ring_pos(token: str) -> int:
+    """A vnode/key position: the first 8 bytes of sha256 as an int —
+    stable across processes, platforms and Python hash randomization."""
+    return int.from_bytes(
+        hashlib.sha256(token.encode()).digest()[:8], "big"
+    )
+
+
+class ConsistentHashRing:
+    """Deterministic consistent-hash ring over backend names.
+
+    Pure and immutable: positions are sha256 of ``{salt}{name}#{i}``
+    for ``vnodes`` virtual nodes per backend, a key routes to the
+    first vnode clockwise of ``sha256(key)``. Two properties the
+    tier-1 tests pin:
+
+    * **determinism** — the same members produce the identical
+      assignment in every process (no seed, no insertion order);
+    * **minimal movement** — :meth:`with_backend` /
+      :meth:`without_backend` move only keys the changed backend owns
+      (true by construction: every other vnode keeps its position).
+    """
+
+    def __init__(self, backends: Sequence[str],
+                 vnodes: int = DEFAULT_VNODES, salt: str = ""):
+        if vnodes < 1:
+            raise ValueError(f"vnodes must be >= 1, got {vnodes}")
+        names = tuple(sorted(set(backends)))
+        if len(names) != len(tuple(backends)):
+            raise ValueError(f"duplicate backend names in {backends!r}")
+        if not names:
+            raise ValueError("a ring needs at least one backend")
+        self.backends = names
+        self.vnodes = int(vnodes)
+        self.salt = salt
+        points: list[tuple[int, str]] = []
+        for name in names:
+            for i in range(self.vnodes):
+                points.append((_ring_pos(f"{salt}{name}#{i}"), name))
+        points.sort()
+        self._points = points
+        self._positions = [p for p, _ in points]
+
+    def owner(self, key: str) -> str:
+        """The backend owning ``key`` — first vnode clockwise."""
+        return self.owners(key, 1)[0]
+
+    def owners(self, key: str, n: int | None = None) -> tuple[str, ...]:
+        """The first ``n`` DISTINCT backends clockwise of ``key`` (all
+        of them by default) — the failover candidate order: owner
+        first, then each next-nearest distinct backend."""
+        want = len(self.backends) if n is None else min(n, len(self.backends))
+        start = bisect.bisect_right(self._positions, _ring_pos(key))
+        out: list[str] = []
+        seen: set[str] = set()
+        for i in range(len(self._points)):
+            _, name = self._points[(start + i) % len(self._points)]
+            if name not in seen:
+                seen.add(name)
+                out.append(name)
+                if len(out) == want:
+                    break
+        return tuple(out)
+
+    def assignment(self, keys: Sequence[str]) -> dict[str, str]:
+        return {k: self.owner(k) for k in keys}
+
+    def with_backend(self, name: str) -> "ConsistentHashRing":
+        return ConsistentHashRing(
+            (*self.backends, name), self.vnodes, self.salt
+        )
+
+    def without_backend(self, name: str) -> "ConsistentHashRing":
+        rest = tuple(b for b in self.backends if b != name)
+        return ConsistentHashRing(rest, self.vnodes, self.salt)
+
+
+# ── per-backend circuit breaker ──────────────────────────────────────
+
+
+class CircuitBreaker:
+    """Closed → open after ``threshold`` consecutive connection-level
+    failures; after ``cooldown_s`` one half-open trial is allowed —
+    its success closes the breaker, its failure re-opens (and re-arms
+    the cooldown). The clock is injectable so the state machine is
+    provable without wall sleeping."""
+
+    def __init__(self, threshold: int = 3, cooldown_s: float = 1.0,
+                 clock: Callable[[], float] = time.monotonic):
+        if threshold < 1:
+            raise ValueError(f"threshold must be >= 1, got {threshold}")
+        self._threshold = threshold
+        self._cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._opened_at: float | None = None
+        self._trial_out = False
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            if self._opened_at is None:
+                return "closed"
+            return "half_open" if self._trial_out else "open"
+
+    def allow(self) -> bool:
+        """May a forward attempt go to this backend right now? An open
+        breaker releases exactly one trial per cooldown window."""
+        with self._lock:
+            if self._opened_at is None:
+                return True
+            if self._trial_out:
+                return False
+            if self._clock() - self._opened_at >= self._cooldown_s:
+                self._trial_out = True
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._opened_at = None
+            self._trial_out = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            if self._trial_out or self._failures >= self._threshold:
+                self._opened_at = self._clock()
+                self._trial_out = False
+
+
+# ── health probing (admin-plane HTTP) ────────────────────────────────
+
+
+def probe_backend(spec: BackendSpec, timeout_s: float = 2.0
+                  ) -> tuple[bool, bool, dict]:
+    """One probe round against a daemon's admin plane: ``(ready,
+    alive, models)``. ``ready`` is ``/readyz`` 200, ``alive`` is
+    ``/healthz`` 200 (the ISSUE 14 liveness — a wedged dispatcher
+    503s here however warm the HTTP thread is), ``models`` is the
+    readyz body's ``{model_id: {"version": ..., "checkpoint": ...}}``
+    binding table (ISSUE 18 satellite: the router builds its routing
+    table from probes alone, never from static config). Any transport
+    failure is simply ``(False, False, {})`` — an unreachable daemon
+    is out of rotation, not an error."""
+    try:
+        conn = http.client.HTTPConnection(
+            spec.host, spec.admin_port, timeout=timeout_s
+        )
+        try:
+            conn.request("GET", "/readyz")
+            resp = conn.getresponse()
+            body = resp.read()
+            ready = resp.status == 200
+            models: dict = {}
+            try:
+                models = dict(json.loads(body).get("models") or {})
+            except (json.JSONDecodeError, UnicodeDecodeError, AttributeError):
+                models = {}
+            conn.request("GET", "/healthz")
+            live = conn.getresponse()
+            live.read()
+            alive = live.status == 200
+        finally:
+            conn.close()
+        return ready, alive, models
+    except OSError:
+        return False, False, {}
+
+
+class HealthProber:
+    """One daemon thread polling every backend's admin plane at a
+    fixed interval and feeding :meth:`RouterServer.update_health`.
+    Stop is bounded (JGL012): the loop wakes on an event, the join is
+    a visible timed wait."""
+
+    def __init__(self, router: "RouterServer", interval_s: float,
+                 timeout_s: float = 2.0):
+        self._router = router
+        self._interval_s = float(interval_s)
+        self._timeout_s = float(timeout_s)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def probe_once(self) -> None:
+        for spec in self._router.config.backends:
+            ready, alive, models = probe_backend(spec, self._timeout_s)
+            self._router.update_health(spec.name, ready, alive, models)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        t = threading.Thread(target=self._run, name="router-prober",
+                             daemon=True)
+        self._thread = t
+        t.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.probe_once()
+            self._stop.wait(self._interval_s)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        t = self._thread
+        self._thread = None
+        if t is not None:
+            t.join(timeout)
+
+
+# ── the router ───────────────────────────────────────────────────────
+
+
+class _BackendConn:
+    """One pooled wire connection to a backend."""
+
+    def __init__(self, spec: BackendSpec, connect_timeout_s: float,
+                 io_timeout_s: float):
+        self.sock = socket.create_connection(
+            (spec.host, spec.port), timeout=connect_timeout_s
+        )
+        self.sock.settimeout(io_timeout_s)
+        self.rw = self.sock.makefile("rwb")
+
+    def roundtrip(self, header: dict, arrays: dict):
+        """Forward one frame and read the reply; a clean server close
+        mid-request surfaces as :class:`protocol.ProtocolError` (the
+        caller treats every transport failure identically)."""
+        protocol.write_frame(self.rw, header, arrays)
+        frame = protocol.read_frame(self.rw)
+        if frame is None:
+            raise protocol.ProtocolError(
+                "backend closed the connection before replying"
+            )
+        return frame
+
+    def close(self) -> None:
+        for closer in (self.rw.close, self.sock.close):
+            try:
+                closer()
+            except OSError:
+                pass
+
+
+class _Backend:
+    """Router-side record for one daemon: probe state, cordon flag,
+    breaker, connection pool and in-flight count. Mutable fields are
+    guarded by the owning router's lock; the breaker locks itself."""
+
+    def __init__(self, spec: BackendSpec, breaker: CircuitBreaker):
+        self.spec = spec
+        self.breaker = breaker
+        self.ready = False
+        self.alive = False
+        self.models: dict = {}
+        self.cordoned = False
+        self.in_flight = 0
+        self.pool: list[_BackendConn] = []
+
+    def in_rotation(self) -> bool:
+        return self.ready and self.alive and not self.cordoned
+
+
+class RouterServer:
+    """The daemon-fronting router: accepts client connections on the
+    same wire protocol the daemons speak and forwards ``predict`` by
+    consistent-hash model routing; everything else it answers itself
+    (``ping`` / ``stats`` / ``dump`` / ``rotate_all`` / ``shutdown``).
+    jax-free by contract — this process must run on a host with no
+    accelerator stack."""
+
+    def __init__(self, config: RouterConfig,
+                 clock: Callable[[], float] = time.monotonic):
+        if not config.backends:
+            raise ValueError("router needs at least one backend")
+        self.config = config
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._backends = {
+            spec.name: _Backend(spec, CircuitBreaker(
+                config.failure_threshold, config.cooldown_s, clock
+            ))
+            for spec in config.backends
+        }
+        self.ring = ConsistentHashRing(
+            tuple(self._backends), config.vnodes
+        )
+        self.prober = HealthProber(
+            self, config.probe_interval_s, config.probe_timeout_s
+        )
+        self._stopped = False
+        self._requests = obs.counter(
+            "router_requests_total",
+            "router forward attempts by backend and outcome",
+        )
+        self._failovers = obs.counter(
+            "router_failover_total",
+            "forwards retried against the next ring owner",
+        )
+        self._transitions = obs.counter(
+            "router_backend_state",
+            "backend rotation-membership transitions",
+        )
+
+    # ── membership ───────────────────────────────────────────────────
+
+    def start(self, probe: bool = True) -> None:
+        """Run one synchronous probe round (so the routing table is
+        populated before the first request), then start the prober."""
+        self.prober.probe_once()
+        if probe:
+            self.prober.start()
+
+    def update_health(self, name: str, ready: bool, alive: bool,
+                      models: dict) -> None:
+        with self._lock:
+            b = self._backends[name]
+            was = b.in_rotation()
+            b.ready, b.alive = bool(ready), bool(alive)
+            b.models = dict(models)
+            now = b.in_rotation()
+        if was != now:
+            state = "admitted" if now else "evicted"
+            self._transitions.inc(1, backend=name, state=state)
+            obs.emit("router_backend_state", status="ok", backend=name,
+                     state=state)
+
+    def set_cordon(self, name: str, cordoned: bool) -> None:
+        """Administrative out-of-rotation (the rolling-rotation drain):
+        new forwards skip the backend, in-flight ones complete."""
+        with self._lock:
+            b = self._backends[name]
+            if b.cordoned == bool(cordoned):
+                return
+            b.cordoned = bool(cordoned)
+        state = "cordoned" if cordoned else "uncordoned"
+        self._transitions.inc(1, backend=name, state=state)
+        obs.emit("router_backend_state", status="ok", backend=name,
+                 state=state)
+
+    def in_rotation(self) -> tuple[str, ...]:
+        with self._lock:
+            return tuple(sorted(
+                n for n, b in self._backends.items() if b.in_rotation()
+            ))
+
+    def in_flight(self, name: str) -> int:
+        with self._lock:
+            return self._backends[name].in_flight
+
+    def probe_ready(self, name: str) -> bool:
+        """Last-probe readiness + liveness, ignoring cordon — what the
+        rotation supervisor confirms against while the backend is
+        still deliberately cordoned out of rotation."""
+        with self._lock:
+            b = self._backends[name]
+            return b.ready and b.alive
+
+    def bound_version(self, name: str, model: str):
+        """The checkpoint version backend ``name`` reports for
+        ``model`` (from its last probe), or None."""
+        with self._lock:
+            entry = self._backends[name].models.get(model)
+        if isinstance(entry, dict):
+            return entry.get("version")
+        return entry
+
+    def candidates(self, model: str) -> list[str]:
+        """Forward candidates for ``model``: the ring's distinct owner
+        order filtered to in-rotation backends whose breaker admits an
+        attempt, truncated to 1 + ``failover_hops``."""
+        out: list[str] = []
+        for name in self.ring.owners(model):
+            with self._lock:
+                ok = self._backends[name].in_rotation()
+            if ok and self._backends[name].breaker.allow():
+                out.append(name)
+                if len(out) > self.config.failover_hops:
+                    break
+        return out
+
+    # ── forwarding ───────────────────────────────────────────────────
+
+    def _acquire(self, name: str) -> _BackendConn:
+        with self._lock:
+            b = self._backends[name]
+            conn = b.pool.pop() if b.pool else None
+            b.in_flight += 1
+        if conn is None:
+            try:
+                conn = _BackendConn(
+                    b.spec, self.config.connect_timeout_s,
+                    self.config.io_timeout_s,
+                )
+            except OSError:
+                with self._lock:
+                    b.in_flight -= 1
+                raise
+        return conn
+
+    def _release(self, name: str, conn: _BackendConn | None,
+                 reusable: bool) -> None:
+        with self._lock:
+            b = self._backends[name]
+            b.in_flight -= 1
+            if conn is not None and reusable:
+                b.pool.append(conn)
+                conn = None
+        if conn is not None:
+            conn.close()
+
+    def forward_predict(self, header: dict, arrays: dict
+                        ) -> tuple[dict, dict]:
+        """Route one predict frame: try each candidate in ring order,
+        failing over on connection-level errors (the backend's typed
+        rejects are NOT failed over — they forward to the client,
+        whose retry may legitimately land on the same owner). Returns
+        the reply ``(header, arrays)``."""
+        model = str(header.get("model") or "default")
+        rid = str(header.get("id", ""))
+        hops = 0
+        for name in self.candidates(model):
+            if hops:
+                self._failovers.inc(1)
+                obs.emit("router_failover", status="ok", request_id=rid,
+                         backend=name, hop=hops)
+            hops += 1
+            try:
+                conn = self._acquire(name)
+            except OSError:
+                self._backends[name].breaker.record_failure()
+                self._requests.inc(1, backend=name,
+                                   outcome="connection_error")
+                continue
+            try:
+                reply, out_arrays = conn.roundtrip(header, arrays)
+            except (protocol.ProtocolError, OSError):
+                # The backend died mid-stream (kill -9's wire
+                # signature). The request id is the idempotency key —
+                # resubmitting the SAME frame to the next owner is the
+                # client's own retry discipline, applied one tier down.
+                self._backends[name].breaker.record_failure()
+                self._requests.inc(1, backend=name,
+                                   outcome="connection_error")
+                self._release(name, conn, reusable=False)
+                continue
+            self._backends[name].breaker.record_success()
+            self._release(name, conn, reusable=True)
+            outcome = ("ok" if reply.get("ok")
+                       else "reject" if reply.get("error") else "error")
+            self._requests.inc(1, backend=name, outcome=outcome)
+            return reply, out_arrays
+        self._requests.inc(1, backend="-", outcome="unavailable")
+        return {
+            "ok": False, "id": rid, "error": BACKEND_UNAVAILABLE,
+            "message": f"no backend in rotation for model {model!r}",
+            "retry_after_s": self.config.retry_after_s,
+        }, {}
+
+    def call_backend(self, name: str, header: dict,
+                     arrays: dict | None = None) -> tuple[dict, dict]:
+        """One direct (non-routed) op against a named backend — the
+        fleet supervisor's rotate/stats/dump channel. Connection
+        errors propagate: the caller decides what a dead backend
+        means."""
+        conn = self._acquire(name)
+        try:
+            reply, out_arrays = conn.roundtrip(header, arrays or {})
+        except (protocol.ProtocolError, OSError):
+            self._backends[name].breaker.record_failure()
+            self._release(name, conn, reusable=False)
+            raise
+        self._release(name, conn, reusable=True)
+        return reply, out_arrays
+
+    # ── stats & merged dump ──────────────────────────────────────────
+
+    def stats(self) -> dict:
+        with self._lock:
+            backends = {
+                name: {
+                    "ready": b.ready,
+                    "alive": b.alive,
+                    "cordoned": b.cordoned,
+                    "in_rotation": b.in_rotation(),
+                    "breaker": b.breaker.state,
+                    "in_flight": b.in_flight,
+                    "models": dict(b.models),
+                }
+                for name, b in sorted(self._backends.items())
+            }
+        requests = obs.REGISTRY.peek("router_requests_total") or {}
+        failovers = obs.REGISTRY.peek("router_failover_total") or {}
+        return {
+            "role": "router",
+            "backends": backends,
+            "ring": {"vnodes": self.ring.vnodes,
+                     "backends": list(self.ring.backends)},
+            "requests": {k: int(v) for k, v in sorted(requests.items())},
+            "failover_total": int(sum(failovers.values())),
+        }
+
+    def request_counts(self) -> dict[str, dict[str, int]]:
+        """``{backend: {outcome: n}}`` from the router's own counter —
+        the totals the fleet manifest publishes for reconciliation.
+        The registry is process-global, so the view is filtered to THIS
+        router's backends (plus the ``-`` null backend): another
+        router in the same process must not leak into the manifest."""
+        mine = set(self._backends) | {"-"}
+        out: dict[str, dict[str, int]] = {}
+        for key, v in (obs.REGISTRY.peek("router_requests_total")
+                       or {}).items():
+            labels = dict(
+                pair.split("=", 1) for pair in key.split(",") if "=" in pair
+            )
+            backend = labels.get("backend", "?")
+            outcome = labels.get("outcome", "?")
+            if backend not in mine:
+                continue
+            out.setdefault(backend, {})[outcome] = int(v)
+        return out
+
+    def dump_fleet(self, outdir: str) -> dict:
+        """Merged fleet dump: every in-rotation daemon exports its
+        artifact set into ``outdir/daemon-<name>/`` (the daemon's own
+        ``dump`` op — trace, serving report, SLO report, metrics
+        triple), and the router writes ``fleet_manifest.json`` beside
+        them with its request totals per backend so the validator can
+        reconcile the two views. Returns the manifest dict."""
+        os.makedirs(outdir, exist_ok=True)
+        backends: dict[str, dict] = {}
+        for name in sorted(self._backends):
+            with self._lock:
+                up = self._backends[name].in_rotation()
+            entry: dict = {"in_rotation": up, "dumped": False}
+            if up:
+                sub = os.path.join(outdir, f"daemon-{name}")
+                try:
+                    reply, _ = self.call_backend(
+                        name, {"op": "dump", "dir": sub}
+                    )
+                    entry["dumped"] = bool(reply.get("ok"))
+                    entry["dir"] = f"daemon-{name}"
+                except (protocol.ProtocolError, OSError) as e:
+                    entry["error"] = f"{type(e).__name__}: {e}"
+            backends[name] = entry
+        manifest = {
+            "schema_version": 1,
+            "kind": "fleet_manifest",
+            "backends": backends,
+            "router": {
+                "requests": self.request_counts(),
+                "failover_total": int(sum(
+                    (obs.REGISTRY.peek("router_failover_total")
+                     or {}).values()
+                )),
+            },
+        }
+        obs.atomic_write_json(
+            os.path.join(outdir, "fleet_manifest.json"), manifest
+        )
+        return manifest
+
+    def stop(self) -> None:
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+        self.prober.stop()
+        with self._lock:
+            conns = [c for b in self._backends.values() for c in b.pool]
+            for b in self._backends.values():
+                b.pool.clear()
+        for c in conns:
+            c.close()
+
+    @property
+    def stopped(self) -> bool:
+        with self._lock:
+            return self._stopped
+
+
+# ── wire serving (client-facing loop) ────────────────────────────────
+
+
+def handle_router_op(router: RouterServer, supervisor: "FleetSupervisor",
+                     header: dict, arrays: dict):
+    """One client frame → ``(reply_header, reply_arrays, stop?)`` —
+    the router's analogue of the daemon's ``_handle_op``."""
+    op = header.get("op")
+    rid = str(header.get("id", ""))
+    if op == "predict":
+        reply, out = router.forward_predict(header, arrays)
+        return reply, out, False
+    if op == "ping":
+        return {"ok": True, "op": "ping", "role": "router",
+                "in_rotation": list(router.in_rotation())}, {}, False
+    if op == "stats":
+        return {"ok": True, "op": "stats", "stats": router.stats()}, {}, False
+    if op == "dump":
+        outdir = header.get("dir") or os.environ.get("ATE_TPU_METRICS_DIR")
+        if not outdir:
+            return {"ok": False, "id": rid, "error": "bad_request",
+                    "message": "dump needs a 'dir' header field or "
+                               "$ATE_TPU_METRICS_DIR"}, {}, False
+        try:
+            manifest = router.dump_fleet(outdir)
+        except OSError as e:
+            return {"ok": False, "id": rid, "error": "error",
+                    "message": f"{type(e).__name__}: {e}"}, {}, False
+        return {"ok": True, "op": "dump",
+                "manifest": manifest}, {}, False
+    if op == "rotate_all":
+        checkpoint = header.get("checkpoint")
+        if not checkpoint:
+            return {"ok": False, "id": rid, "error": "bad_request",
+                    "message": "rotate_all needs a 'checkpoint' header "
+                               "field"}, {}, False
+        result = supervisor.rotate_all(
+            str(checkpoint), model=str(header.get("model") or "default"),
+            timeout_s=float(header.get("timeout_s") or 120.0),
+        )
+        ok = all(s == "rotated" for s in result["statuses"].values()) \
+            and result["zero_downtime"]
+        return {"ok": ok, "op": "rotate_all", **result}, {}, False
+    if op == "shutdown":
+        return {"ok": True, "op": "shutdown"}, {}, True
+    return {"ok": False, "error": "bad_request",
+            "message": f"unknown op {op!r}"}, {}, False
+
+
+def serve_stream(router: RouterServer, supervisor: "FleetSupervisor",
+                 rstream, wstream) -> bool:
+    """One client connection's framed loop; True when a ``shutdown``
+    op asked the router to exit."""
+    while True:
+        try:
+            frame = protocol.read_frame(rstream)
+        except protocol.ProtocolError as e:
+            obs.emit("router_protocol_error", status="error", error=str(e))
+            return False
+        if frame is None:
+            return False
+        header, arrays = frame
+        reply, out_arrays, stop = handle_router_op(
+            router, supervisor, header, arrays
+        )
+        protocol.write_frame(wstream, reply, out_arrays)
+        if stop:
+            return True
+
+
+def serve_socket(router: RouterServer, host: str = "127.0.0.1",
+                 port: int = 0,
+                 on_bound: Callable[[int], None] | None = None) -> None:
+    """Client-facing accept loop, the daemon's shape: one reader
+    thread per connection, 0.25 s accept timeout so a stop() underneath
+    ends the loop, bounded joins on exit."""
+    import sys
+
+    supervisor = FleetSupervisor(router)
+    stop_evt = threading.Event()
+    with socket.create_server((host, port)) as srv:
+        srv.settimeout(0.25)
+        bound = srv.getsockname()[1]
+        obs.gauge("router_port", "bound router TCP port").set(bound)
+        print(f"# routing on {host}:{bound}", file=sys.stderr, flush=True)
+        if on_bound is not None:
+            on_bound(bound)
+
+        def _conn(conn: socket.socket) -> None:
+            with conn:
+                rw = conn.makefile("rwb")
+                try:
+                    if serve_stream(router, supervisor, rw, rw):
+                        stop_evt.set()
+                finally:
+                    rw.close()
+
+        threads: list[threading.Thread] = []
+        conn_seq = 0
+        while not stop_evt.is_set() and not router.stopped:
+            threads = [t for t in threads if t.is_alive()]
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            conn_seq += 1
+            t = threading.Thread(target=_conn, args=(conn,), daemon=True,
+                                 name=f"router-conn-{conn_seq}")
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(1.0)
+    router.stop()
+
+
+# ── fleet-wide rolling rotation ──────────────────────────────────────
+
+
+class FleetSupervisor:
+    """Fleet-wide operations driven through the router's view of the
+    world. :meth:`rotate_all` is the rolling rotation the README
+    runbook documents: one daemon at a time, drained through the
+    cordon (the PR 14 graceful-drain discipline applied at the router
+    — no new forwards, in-flight completes), rotated from the SAME
+    published checkpoint path, probe-confirmed at the advanced
+    version, readmitted before the next daemon is touched."""
+
+    def __init__(self, router: RouterServer,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.router = router
+        self._clock = clock
+        self._sleep = sleep
+
+    def _wait(self, pred: Callable[[], bool], deadline: float) -> bool:
+        while not pred():
+            if self._clock() >= deadline:
+                return False
+            self._sleep(0.01)
+        return True
+
+    def rotate_all(self, checkpoint: str, model: str = "default",
+                   timeout_s: float = 120.0) -> dict:
+        """Roll ``model`` onto ``checkpoint`` across every in-rotation
+        daemon. Returns per-backend statuses, the probe-confirmed
+        version bindings, each daemon's post-swap compile count (must
+        be 0 — the PR 11/12 verify-window prebuild contract), and
+        ``zero_downtime``: True iff at least one backend stayed in
+        rotation through every step (checked at every transition, not
+        assumed)."""
+        statuses: dict[str, str] = {}
+        versions: dict[str, object] = {}
+        compiles: dict[str, object] = {}
+        min_in_rotation = len(self.router.in_rotation())
+        zero_downtime = min_in_rotation >= 1
+
+        def note_rotation_floor() -> None:
+            nonlocal min_in_rotation, zero_downtime
+            n = len(self.router.in_rotation())
+            min_in_rotation = min(min_in_rotation, n)
+            if n < 1:
+                zero_downtime = False
+
+        for name in sorted(self.router.ring.backends):
+            deadline = self._clock() + timeout_s
+            if name not in self.router.in_rotation():
+                statuses[name] = "not_in_rotation"
+                continue
+            if len(self.router.in_rotation()) <= 1:
+                # Cordoning the last live backend IS downtime; refuse
+                # this daemon's turn rather than take the fleet out.
+                statuses[name] = "refused_no_capacity"
+                zero_downtime = False
+                continue
+            before = self.router.bound_version(name, model)
+            self.router.set_cordon(name, True)
+            note_rotation_floor()
+            try:
+                drained = self._wait(
+                    lambda: self.router.in_flight(name) == 0, deadline
+                )
+                if not drained:
+                    statuses[name] = "drain_timeout"
+                    continue
+                try:
+                    reply, _ = self.router.call_backend(name, {
+                        "op": "rotate", "model": model,
+                        "checkpoint": checkpoint,
+                    })
+                except (protocol.ProtocolError, OSError) as e:
+                    statuses[name] = f"unreachable:{type(e).__name__}"
+                    continue
+                status = str(reply.get("status", reply.get("error", "error")))
+                statuses[name] = status
+                if status != "rotated":
+                    continue
+                # Probe-confirm: the daemon must report ready with the
+                # version ADVANCED past what it served before the swap
+                # (the router never trusts its own rotate reply alone).
+                confirmed = self._wait(
+                    lambda: (
+                        _probe_ready(self.router, name)
+                        and self.router.bound_version(name, model)
+                        not in (None, before)
+                    ),
+                    deadline,
+                )
+                if not confirmed:
+                    statuses[name] = "verify_timeout"
+                    continue
+                versions[name] = self.router.bound_version(name, model)
+                try:
+                    sreply, _ = self.router.call_backend(
+                        name, {"op": "stats"}
+                    )
+                    compiles[name] = (sreply.get("stats") or {}).get(
+                        "compile_events_in_window"
+                    )
+                except (protocol.ProtocolError, OSError):
+                    compiles[name] = None
+            finally:
+                self.router.set_cordon(name, False)
+                self.router.prober.probe_once()
+                note_rotation_floor()
+            obs.emit("fleet_rotation", status="ok", backend=name,
+                     model=model, outcome=statuses[name])
+        result = {
+            "model": model,
+            "checkpoint": checkpoint,
+            "statuses": statuses,
+            "versions": versions,
+            "post_swap_compiles": compiles,
+            "zero_downtime": zero_downtime,
+            "min_in_rotation": min_in_rotation,
+        }
+        obs.emit(
+            "fleet_rotation_all", model=model,
+            status="ok" if all(
+                s == "rotated" for s in statuses.values()
+            ) and zero_downtime else "error",
+            rotated=sum(1 for s in statuses.values() if s == "rotated"),
+        )
+        return result
+
+
+def _probe_ready(router: RouterServer, name: str) -> bool:
+    """Force one probe round and report whether ``name`` probes ready
+    — never stale cache, and deliberately NOT the in-rotation set:
+    the backend under confirmation is still cordoned."""
+    router.prober.probe_once()
+    return router.probe_ready(name)

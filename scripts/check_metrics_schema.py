@@ -12,6 +12,7 @@ Usage::
     python scripts/check_metrics_schema.py SCENARIO_MATRIX.json # ISSUE 13
     python scripts/check_metrics_schema.py CHAOS_CAMPAIGN.json  # ISSUE 15
     python scripts/check_metrics_schema.py .../campaign_report.json
+    python scripts/check_metrics_schema.py fleet_dump/          # ISSUE 18
 
 Checks ``metrics.json`` (schema version, section shapes, the counter
 families every instrumented run must carry — shard retry, compile
@@ -142,6 +143,14 @@ REQUIRED_COUNTERS = (
     "serving_stat_rows_total",
     "serving_stat_windows_total",
     "stat_drift_events_total",
+    # Fleet router (ISSUE 18): forward outcomes per backend, failovers
+    # to the next ring owner, and rotation-membership transitions —
+    # "the router never ran" is a recorded 0 on every instrumented run,
+    # and the fleet-manifest reconciliation below reads the same
+    # families.
+    "router_requests_total",
+    "router_failover_total",
+    "router_backend_state",
 )
 
 _EVENT_FIELDS = (
@@ -1465,6 +1474,148 @@ def validate_trace_files(outdir: str) -> list[str]:
     return errors
 
 
+#: router forward-attempt outcomes a fleet manifest may carry — must
+#: mirror serving/router.py OUTCOMES (asserted by the router tests).
+_ROUTER_OUTCOMES = ("ok", "reject", "error", "connection_error",
+                    "unavailable")
+
+
+def _metrics_counter_total(snap: dict, name: str,
+                           label: str | None = None) -> float:
+    """Sum of a counter family in a metrics.json snapshot, optionally
+    restricted to samples whose label key contains ``label``."""
+    total = 0.0
+    for key, val in (snap.get("counters", {}).get(name) or {}).items():
+        if label is not None and label not in key:
+            continue
+        if isinstance(val, (int, float)) and not isinstance(val, bool):
+            total += val
+    return total
+
+
+def validate_fleet_dump(outdir: str) -> list[str]:
+    """A merged fleet dump directory (ISSUE 18): ``fleet_manifest.json``
+    (written by the router's ``dump_fleet``) beside one ``daemon-<name>``
+    artifact directory per in-rotation backend. Checks:
+
+    * manifest shape — kind/schema_version, a non-empty backend table,
+      and the router's request/failover totals;
+    * every backend the manifest marks ``dumped`` has its artifact
+      directory on disk and that directory validates as a full
+      telemetry pair (plus trace/serving/slo files when present);
+    * every ``daemon-*`` directory on disk is accounted for in the
+      manifest — an orphan dump means the manifest lies about fleet
+      membership;
+    * reconciliation — router outcomes are from the typed vocabulary,
+      the ``backend="-"`` row carries only ``unavailable`` (no real
+      forward ever books to the null backend), and no dumped backend's
+      daemon recorded fewer served requests than the router claims to
+      have successfully forwarded to it (the router cannot invent
+      serves a daemon never saw).
+    """
+    errors: list[str] = []
+    mpath = os.path.join(outdir, "fleet_manifest.json")
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"fleet: cannot read {mpath}: {e}"]
+    if manifest.get("kind") != "fleet_manifest":
+        errors.append(
+            f"fleet: kind {manifest.get('kind')!r} != 'fleet_manifest'"
+        )
+    if manifest.get("schema_version") != EXPECTED_SCHEMA_VERSION:
+        errors.append(
+            f"fleet: schema_version {manifest.get('schema_version')!r} "
+            f"!= {EXPECTED_SCHEMA_VERSION}"
+        )
+    backends = manifest.get("backends")
+    if not isinstance(backends, dict) or not backends:
+        return errors + ["fleet: manifest backends missing or empty"]
+    router = manifest.get("router")
+    if not isinstance(router, dict) or "requests" not in router:
+        return errors + ["fleet: manifest router section missing"]
+    failover = router.get("failover_total")
+    if not isinstance(failover, int) or failover < 0:
+        errors.append(
+            f"fleet: failover_total {failover!r} is not an int >= 0"
+        )
+    requests = router.get("requests") or {}
+    for backend, outcomes in requests.items():
+        if not isinstance(outcomes, dict):
+            errors.append(f"fleet: router requests[{backend!r}] malformed")
+            continue
+        for outcome, count in outcomes.items():
+            if outcome not in _ROUTER_OUTCOMES:
+                errors.append(
+                    f"fleet: unknown router outcome {outcome!r} on "
+                    f"backend {backend!r}"
+                )
+            if not isinstance(count, int) or count < 0:
+                errors.append(
+                    f"fleet: router requests[{backend!r}][{outcome!r}] "
+                    f"= {count!r} is not an int >= 0"
+                )
+        if backend == "-" and set(outcomes) - {"unavailable"}:
+            errors.append(
+                "fleet: the null backend '-' carries outcomes other "
+                f"than 'unavailable': {sorted(set(outcomes) - {'unavailable'})}"
+            )
+        elif backend != "-" and backend not in backends:
+            errors.append(
+                f"fleet: router metered unknown backend {backend!r}"
+            )
+    on_disk = {
+        d[len("daemon-"):] for d in os.listdir(outdir)
+        if d.startswith("daemon-")
+        and os.path.isdir(os.path.join(outdir, d))
+    }
+    for orphan in sorted(on_disk - set(backends)):
+        errors.append(
+            f"fleet: daemon-{orphan} dumped on disk but absent from the "
+            "manifest"
+        )
+    for name, entry in sorted(backends.items()):
+        if not isinstance(entry, dict):
+            errors.append(f"fleet: backend {name!r} entry malformed")
+            continue
+        if not entry.get("dumped"):
+            # An out-of-rotation backend (evicted or SIGKILLed) cannot
+            # dump — the manifest says so explicitly; nothing to check.
+            continue
+        ddir = entry.get("dir") or f"daemon-{name}"
+        if not os.path.isabs(ddir):
+            # The manifest records dirs relative to itself, so a dump
+            # tree stays valid when moved or validated from elsewhere.
+            ddir = os.path.join(outdir, ddir)
+        if not os.path.isdir(ddir):
+            errors.append(
+                f"fleet: backend {name!r} marked dumped but {ddir} is "
+                "not a directory"
+            )
+            continue
+        sub = validate_pair(os.path.join(ddir, "metrics.json"),
+                            os.path.join(ddir, "events.jsonl"))
+        sub += validate_trace_files(ddir)
+        errors += [f"fleet[{name}]: {e}" for e in sub]
+        try:
+            with open(os.path.join(ddir, "metrics.json")) as f:
+                snap = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue  # already reported by validate_pair
+        daemon_ok = _metrics_counter_total(
+            snap, "serving_requests_total", label="status=ok"
+        )
+        router_ok = (requests.get(name) or {}).get("ok", 0)
+        if isinstance(router_ok, int) and daemon_ok < router_ok:
+            errors.append(
+                f"fleet: backend {name!r} daemon recorded "
+                f"{int(daemon_ok)} ok requests but the router claims "
+                f"{router_ok} successful forwards to it"
+            )
+    return errors
+
+
 def validate_pair(metrics_path: str, events_path: str,
                   require_stages: list[str] | None = None) -> list[str]:
     errors: list[str] = []
@@ -1524,6 +1675,18 @@ def main(argv: list[str] | None = None) -> int:
                 return 1
             print(f"OK {args.paths[0]}")
             return 0
+    if len(args.paths) == 1 and os.path.isdir(args.paths[0]) and \
+            os.path.exists(os.path.join(args.paths[0],
+                                        "fleet_manifest.json")):
+        # A merged fleet dump (ISSUE 18): the manifest + one daemon-*
+        # artifact directory per in-rotation backend, reconciled.
+        errors = validate_fleet_dump(args.paths[0])
+        for e in errors:
+            print(f"FAIL {e}", file=sys.stderr)
+        if errors:
+            return 1
+        print(f"OK {os.path.join(args.paths[0], 'fleet_manifest.json')}")
+        return 0
     if len(args.paths) == 1 and os.path.isdir(args.paths[0]):
         trace_dir = args.paths[0]
         metrics_path = os.path.join(args.paths[0], "metrics.json")

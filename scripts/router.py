@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Start the horizontal-fleet serving router (ISSUE 18).
+
+Usage::
+
+    python scripts/router.py \
+        --backends b0=127.0.0.1:7771@8871,b1=127.0.0.1:7772@8872 \
+        --port 7700
+
+Fronts N running ``scripts/serve.py`` daemons over the same
+length-prefixed wire protocol the daemons speak: requests hash onto a
+deterministic consistent ring keyed by model id, membership follows the
+daemons' own ``/readyz`` + ``/healthz`` probes, connection-level
+failures trip a per-backend breaker and fail over to the next ring
+owner, and ``rotate_all`` rolls a new checkpoint across the whole fleet
+one drained daemon at a time. Knobs default from the
+``ATE_TPU_ROUTER_*`` env vars (see the README's Horizontal fleet
+section); flags override. Stdlib-only — no jax in the process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--backends", required=True,
+                    help="comma-separated name=host:port@adminport fleet "
+                         "spec (adminport = the daemon's --admin-port)")
+    ap.add_argument("--port", type=int, default=0,
+                    help="router TCP port (0 = ephemeral; bound port "
+                         "printed to stderr)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--vnodes", type=int, default=None,
+                    help="virtual nodes per backend on the hash ring "
+                         "(default $ATE_TPU_ROUTER_VNODES or 64)")
+    ap.add_argument("--probe-s", type=float, default=None,
+                    help="health-probe interval in seconds (default "
+                         "$ATE_TPU_ROUTER_PROBE_S or 0.25)")
+    ap.add_argument("--failover", type=int, default=None,
+                    help="max failover hops past the ring owner "
+                         "(default $ATE_TPU_ROUTER_FAILOVER or 2)")
+    args = ap.parse_args(argv)
+
+    from ate_replication_causalml_tpu.serving.router import (
+        RouterConfig,
+        RouterServer,
+        parse_backend_specs,
+        serve_socket,
+    )
+
+    overrides: dict = {}
+    if args.vnodes is not None:
+        overrides["vnodes"] = args.vnodes
+    if args.probe_s is not None:
+        overrides["probe_interval_s"] = args.probe_s
+    if args.failover is not None:
+        overrides["failover_hops"] = args.failover
+    config = RouterConfig.from_env(
+        parse_backend_specs(args.backends), **overrides
+    )
+    router = RouterServer(config)
+    router.start()
+
+    # SIGTERM = stop accepting, close the probe thread, exit 0 — the
+    # daemons behind the router drain on their own SIGTERMs; the router
+    # holds no request state worth draining.
+    import signal
+    import threading
+
+    def _sigterm(signum, frame):
+        threading.Thread(target=router.stop, name="sigterm-stop",
+                         daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _sigterm)
+    except ValueError:
+        pass  # not the main thread (embedded use) — no signal wiring
+    print(
+        "# fleet: " + " ".join(
+            f"{s.name}={s.host}:{s.port}@{s.admin_port}"
+            for s in config.backends
+        ) + f" in_rotation={list(router.in_rotation())}",
+        file=sys.stderr, flush=True,
+    )
+    serve_socket(router, args.host, args.port)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -891,7 +891,11 @@ def test_sigterm_drains_tcp_daemon_cleanly(tmp_path):
                     client.predict(x, request_id=f"w{i}", max_retries=2)
                     served.append(f"w{i}")
                 except ServingError as e:
-                    if e.code in ("draining", "stopped", "closed"):
+                    # "connection_lost" joined with ISSUE 18: a drained
+                    # daemon that closed the socket (and refuses the
+                    # client's re-dial) is a typed going-away answer.
+                    if e.code in ("draining", "stopped", "closed",
+                                  "connection_lost"):
                         rejected.append(f"w{i}")
                         return  # daemon is going away — stop offering
                     torn.append(f"{e.code}: {e}")
