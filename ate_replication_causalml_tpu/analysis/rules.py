@@ -1,4 +1,8 @@
-"""The graftlint rule set (JGL001–JGL014).
+"""The graftlint rule set (JGL001–JGL014, JGL020).
+
+(JGL015–JGL019 are the whole-program concurrency rules in
+``analysis/concurrency/rules.py``; JGL020 lives here because it is a
+single-module AST rule like the rest of this file.)
 
 Each rule targets a failure class that has actually bitten (or nearly
 bitten) this codebase on TPU — see ADVICE.md and the rule docstrings.
@@ -1589,4 +1593,137 @@ class UnboundedMetricLabelCardinality(Rule):
                         "every label key forever; fold to a closed set "
                         "(registry.sanitize_label) or record it in the "
                         "trace instead",
+                    )
+
+
+# ---------------------------------------------------------------- JGL020
+
+#: container-mutator method names that GROW the receiver by one entry
+#: per call — the per-iteration accumulation JGL020 is about. ``pop``/
+#: ``clear`` shrink; assignment rebinding is a fresh object.
+_ACCUMULATOR_METHODS = ("append", "extend", "appendleft", "add")
+
+#: module-scope constructors whose result is a growable container.
+_CONTAINER_CTORS = {
+    "dict", "list", "set", "collections.deque",
+    "collections.defaultdict", "collections.OrderedDict",
+}
+
+
+def _module_container_names(module: ModuleInfo) -> set[str]:
+    """Module-level names bound to a growable container at module scope
+    (literal or constructor call) — the cross-call persistent state a
+    per-cell accumulation leaks into."""
+    names: set[str] = set()
+    for node in module.tree.body:
+        targets: list[ast.expr] = []
+        value: ast.expr | None = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign):
+            targets, value = [node.target], node.value
+        for t in targets:
+            if not isinstance(t, ast.Name):
+                continue
+            if isinstance(value, (ast.Dict, ast.List, ast.Set)) or (
+                isinstance(value, ast.Call)
+                and module.resolve(value.func) in _CONTAINER_CTORS
+            ):
+                names.add(t.id)
+    return names
+
+
+@register
+class UnboundedCellAccumulation(Rule):
+    """ISSUE 19's streaming contract, enforced at the AST: in
+    ``scenarios/`` the loop axis IS the replicate grid — a million-cell
+    run iterates a million times — so appending one host object per
+    iteration into state that outlives the call (a module-level
+    container, or an attribute of a long-lived ``self``) grows host
+    memory O(cells) and silently reintroduces the materialized-rows
+    regime the streaming aggregate runner exists to retire. Per-call
+    locals are fine (they die with the call and rows mode is an
+    explicit opt-in); persistent accumulators must either journal an
+    O(1) block record or fold into mergeable sufficient statistics
+    (``aggregate.AggState``). The sanctioned escape hatch for a
+    deliberately bounded accumulator is the standard suppression
+    comment with a rationale."""
+
+    id = "JGL020"
+    name = "unbounded-cell-accumulation"
+    description = (
+        "per-iteration append/extend into a module-level container or "
+        "self attribute inside a scenarios/ loop — grows O(cells) "
+        "across the run; journal a block record or fold into AggState "
+        "sums instead"
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if not scopes.SCENARIOS.contains(module.relpath):
+            return
+        containers = _module_container_names(module)
+        seen: set[int] = set()
+        for func in ast.walk(module.tree):
+            if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            # Python scoping, as in JGL001: a name bound anywhere in
+            # the function shadows the like-named module container.
+            local_binds = {a.arg for a in (
+                func.args.args + func.args.posonlyargs
+                + func.args.kwonlyargs
+            )}
+            global_decls: set[str] = set()
+            for n in ast.walk(func):
+                if isinstance(n, ast.Global):
+                    global_decls.update(n.names)
+                elif isinstance(n, ast.Name) and isinstance(
+                    n.ctx, (ast.Store, ast.Del)
+                ):
+                    local_binds.add(n.id)
+            local_binds -= global_decls
+            for loop in ast.walk(func):
+                if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                    continue
+                for node in ast.walk(loop):
+                    if (
+                        not isinstance(node, ast.Call)
+                        or id(node) in seen
+                        or not isinstance(node.func, ast.Attribute)
+                        or node.func.attr not in _ACCUMULATOR_METHODS
+                    ):
+                        continue
+                    # Unwind attribute chains AND pass through calls:
+                    # `_BY_COL.setdefault(k, []).append(x)` mutates the
+                    # container _BY_COL holds, so the receiver's root
+                    # is _BY_COL, not the setdefault result.
+                    root = node.func.value
+                    while isinstance(root, (ast.Attribute, ast.Call)):
+                        root = (root.func if isinstance(root, ast.Call)
+                                else root.value)
+                    if not isinstance(root, ast.Name):
+                        continue
+                    if root.id == "self":
+                        if not isinstance(node.func.value, ast.Attribute):
+                            continue  # self.append: not attribute state
+                        culprit = (
+                            "self attribute "
+                            f"'self.{node.func.value.attr}'"
+                        )
+                    elif (
+                        root.id in containers
+                        and root.id not in local_binds
+                    ):
+                        culprit = f"module-level container '{root.id}'"
+                    else:
+                        continue
+                    seen.add(id(node))
+                    yield self.finding(
+                        module,
+                        node,
+                        f".{node.func.attr}() onto {culprit} "
+                        "inside a loop accumulates one host "
+                        "object per replicate — O(cells) growth across "
+                        "the run; journal an O(1) block record or fold "
+                        "into AggState sums (scenarios/aggregate.py), or "
+                        "keep the accumulator local to the call",
                     )

@@ -209,6 +209,16 @@ def install_jax_monitoring() -> bool:
     counter("scenario_column_compile_total",
             "scenario column executables AOT-compiled, by column and kind"
             ).inc(0)
+    # Streaming-aggregate + frontier families (ISSUE 19): block commits
+    # by status (the O(blocks) journal meter) and frontier probe blocks
+    # by estimator/status. "No streaming matrix / frontier ever ran" is
+    # a recorded 0 on every instrumented run.
+    counter("scenario_aggregate_blocks_total",
+            "streaming aggregate blocks by column and "
+            "computed/resumed/failed status").inc(0)
+    counter("scenario_frontier_probes_total",
+            "frontier probe blocks by estimator and computed/resumed "
+            "status").inc(0)
     # Chaos campaign families (ISSUE 15): episode outcomes per workload
     # and invariant verdicts — "no campaign ever ran" is a recorded 0,
     # and a nonzero {status=violated} after a campaign is the

@@ -376,6 +376,10 @@ def _run_matrix_workload(outdir: str, seed: int, scale: CampaignScale):
         dgps=(calib,), estimators=("naive", "ipw_logit"),
         n_reps=scale.matrix_reps, batch_width=scale.matrix_width,
         seed=seed, shard=False,
+        # The invariants read the per-cell table (cells.jsonl rows,
+        # cell-granular resume) — pin the PR 13 rows mode whatever the
+        # ISSUE 19 streaming default or ATE_TPU_SCENARIO_ROWS says.
+        rows=True,
     )
     plans, _skipped = plan_columns(spec)
     batches = {
@@ -925,6 +929,12 @@ def _ddmin(atoms: list, fails: Callable[[list], bool]) -> list:
                 break
             n = min(len(cur), n * 2)
     return cur
+
+
+#: Public name (ISSUE 19): the frontier search shrinks failing knob
+#: vectors through the SAME delta-debugging core the chaos campaign
+#: shrinks fault specs with — one minimizer, two atom vocabularies.
+ddmin = _ddmin
 
 
 def shrink_episode(

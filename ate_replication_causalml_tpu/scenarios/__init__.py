@@ -1,16 +1,31 @@
-"""Monte-Carlo scenario matrix (ISSUE 13): seeded synthetic DGP
-library, batched (vmapped-replicate) estimator entry points, and the
-matrix runner on the SweepEngine. One executable per scenario COLUMN,
-thousands of cells — see ``scenarios/matrix.py`` for the contracts."""
+"""Monte-Carlo scenario matrix (ISSUE 13 + 19): seeded synthetic DGP
+library, batched (vmapped-replicate) estimator entry points, the matrix
+runner on the SweepEngine — streaming device-resident aggregates by
+default, per-cell rows opt-in — and the adversarial failure-frontier
+search. One executable per scenario COLUMN, millions of cells — see
+``scenarios/matrix.py`` and ``scenarios/frontier.py`` for the
+contracts."""
 
+from ate_replication_causalml_tpu.scenarios.aggregate import (
+    AGG_SCHEMA_TAG,
+    AggState,
+    N_STATS,
+    STAT_FIELDS,
+    aggregate_executable,
+    batch_stats,
+    fold_executable,
+    fold_rows,
+)
 from ate_replication_causalml_tpu.scenarios.batched import (
     MAX_VMAP_COLLAPSE_ULP,
     SCENARIO_ESTIMATORS,
     ScenarioEstimator,
+    batch_mask,
     cell_fn,
     clear_executables,
     column_cache_key,
     column_executable,
+    pad_ids,
     scalar_executable,
 )
 from ate_replication_causalml_tpu.scenarios.dgp import (
@@ -24,6 +39,7 @@ from ate_replication_causalml_tpu.scenarios.matrix import (
     ColumnPlan,
     MatrixReport,
     MatrixSpec,
+    block_row_id,
     cell_row_id,
     column_aggregates,
     column_name,
@@ -35,12 +51,15 @@ from ate_replication_causalml_tpu.scenarios.matrix import (
 )
 
 __all__ = [
-    "MAX_VMAP_COLLAPSE_ULP", "SCENARIO_ESTIMATORS", "STOCK_DGPS",
-    "ColumnPlan", "DGPSpec", "MatrixReport", "MatrixSpec",
+    "AGG_SCHEMA_TAG", "MAX_VMAP_COLLAPSE_ULP", "N_STATS",
+    "SCENARIO_ESTIMATORS", "STAT_FIELDS", "STOCK_DGPS",
+    "AggState", "ColumnPlan", "DGPSpec", "MatrixReport", "MatrixSpec",
     "ScenarioEstimator",
+    "aggregate_executable", "batch_mask", "batch_stats", "block_row_id",
     "cell_fn", "cell_row_id", "clear_executables", "column_aggregates",
     "column_cache_key", "column_executable", "column_name",
-    "compare_cells", "data_cell_id", "estimator_salt", "generate",
-    "micro_matrix_spec", "plan_columns", "run_matrix",
-    "run_scalar_replay", "scalar_executable",
+    "compare_cells", "data_cell_id", "estimator_salt", "fold_executable",
+    "fold_rows", "generate", "micro_matrix_spec", "pad_ids",
+    "plan_columns", "run_matrix", "run_scalar_replay",
+    "scalar_executable",
 ]

@@ -1,0 +1,312 @@
+"""Device-resident streaming aggregates for the scenario matrix
+(ISSUE 19, tentpole part a).
+
+The PR 13 runner materializes one host row per (DGP × estimator × seed)
+cell — journal bytes, host transfers and Python-side record building
+all O(cells), which caps the grid in the thousands. This module folds
+the coverage/bias/RMSE/power SUFFICIENT STATISTICS inside each column's
+vmapped executable instead: a width-W batch returns one fixed-length
+f32 stat vector (:data:`N_STATS` sums — counts, Σerr, Σerr², cover
+hits, reject hits, error-histogram cells), so the host sees O(1) bytes
+per block and O(blocks) journal records however many cells the block
+carries.
+
+Exactness discipline (the PR 13 ``cell_fn`` contract, one level up):
+
+* :func:`batch_stats` is the ONE segment-reduce epilogue — the fused
+  streaming executable (:func:`aggregate_executable`, which traces
+  ``batch_stats(vmap(cell_fn)(...))``) and the materialized-rows
+  reference fold (:func:`fold_executable` + :func:`fold_rows`, the same
+  function jitted standalone over journaled row values) share it
+  VERBATIM. Streaming-vs-rows bit-identity is therefore an assertion
+  about XLA fusing a tiny epilogue onto an unchanged vmapped column,
+  not about two aggregate implementations agreeing.
+* Every stat is a plain per-lane sum with masked lanes excluded by
+  ``where``-selection (never by multiplying — ``0·NaN`` is NaN), so
+  block states merge by ADDITION on the host (:meth:`AggState.merge`),
+  in declared block order, exactly — counts and histogram cells are
+  small-integer-exact in f32 per block and merge in f64.
+* The reference fold must chunk rows into the SAME width-W blocks the
+  streaming run dispatched (``fold_rows(..., width=W)``): f32 sums are
+  chunking-dependent, and bit-identity is only a meaningful claim when
+  both sides reduce the same lanes in the same segments.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ate_replication_causalml_tpu.observability.sketch import (
+    CalibrationSketch,
+    FixedBinSketch,
+)
+from ate_replication_causalml_tpu.scenarios.batched import (
+    ScenarioEstimator,
+    cached_executable,
+    cell_fn,
+    column_cache_key,
+)
+from ate_replication_causalml_tpu.scenarios.dgp import DGPSpec
+
+#: bump when the stat-vector layout or the epilogue numerics change —
+#: old block journals must not merge into new aggregates. Rides the
+#: checkpoint fingerprint (mode suffix) AND every block record's
+#: ``schema`` field (the ISSUE 19 defense-in-depth resume assert).
+AGG_SCHEMA_TAG = "scenarios-agg-v1"
+
+#: 95% normal critical value — matching estimators.base.Z_95 and the
+#: rows-mode host recipe in scenarios/matrix.py.
+Z95 = 1.96
+
+#: Error-sketch shape shared with the rows-mode column aggregates and
+#: the ISSUE 16 stat-health plane: estimation errors ``ate - tau_true``
+#: live well inside ±8 for every stock DGP; outliers land in the
+#: explicit tails so mass is conserved either way.
+ERROR_SKETCH_RANGE = (-8.0, 8.0)
+ERROR_SKETCH_BINS = 8
+
+#: Stat-vector layout, fixed order. The first 8 are the moment/count
+#: sums; the remaining ``ERROR_SKETCH_BINS + 2`` are the error
+#: histogram's extended cells ``[underflow, *bins, overflow]`` (the
+#: FixedBinSketch cell convention). Everything is a sum over the
+#: block's unmasked lanes — mergeable by addition, order-fixed.
+STAT_FIELDS = (
+    "n_cells",      # unmasked lanes dispatched
+    "n_ok",         # finite point estimate
+    "n_se",         # finite point estimate AND finite SE
+    "sum_err",      # Σ (ate - tau_true)        over ok lanes
+    "sum_err2",     # Σ (ate - tau_true)²       over ok lanes
+    "sum_tau",      # Σ tau_true                over ok lanes
+    "cover_hits",   # Σ 1[|ate - tau| <= z·se]  over se lanes
+    "reject_hits",  # Σ 1[|ate| > z·se]         over se lanes
+)
+N_STATS = len(STAT_FIELDS) + ERROR_SKETCH_BINS + 2
+
+
+def batch_stats(ate, se, tau_true, mask):
+    """The segment-reduce epilogue: ``(W,) × 4 -> (N_STATS,)`` f32.
+
+    ``mask`` marks the real lanes (the final partial batch pads to the
+    column's one executable width — padded lanes must not count).
+    Shared verbatim by the fused streaming executable and the
+    standalone reference fold; see the module docstring for why that
+    sharing IS the bit-identity contract."""
+    dtype = ate.dtype
+    live = mask.astype(jnp.bool_)
+    ok = live & jnp.isfinite(ate)
+    has_se = ok & jnp.isfinite(se)
+    z = jnp.asarray(Z95, dtype)
+    err = jnp.where(ok, ate - tau_true, jnp.zeros((), dtype))
+    covered = has_se & (ate - z * se <= tau_true) & (tau_true <= ate + z * se)
+    rejected = has_se & (jnp.abs(ate) > z * se)
+
+    def count(flags):
+        return jnp.sum(flags.astype(dtype))
+
+    lo, hi = ERROR_SKETCH_RANGE
+    width = (hi - lo) / ERROR_SKETCH_BINS
+    # Extended-cell index: -1 = underflow, n_bins = overflow — the
+    # FixedBinSketch cells() convention, so merged histogram sums
+    # reconstruct a merge-compatible sketch dict without rebinning.
+    idx = jnp.clip(
+        jnp.floor((err - lo) / jnp.asarray(width, dtype)).astype(jnp.int32),
+        -1, ERROR_SKETCH_BINS,
+    )
+    hist = [
+        count(ok & (idx == cell - 1))
+        for cell in range(ERROR_SKETCH_BINS + 2)
+    ]
+    return jnp.stack([
+        count(live), count(ok), count(has_se),
+        jnp.sum(jnp.where(ok, err, jnp.zeros((), dtype))),
+        jnp.sum(jnp.where(ok, err * err, jnp.zeros((), dtype))),
+        jnp.sum(jnp.where(ok, tau_true, jnp.zeros((), dtype))),
+        count(covered), count(rejected), *hist,
+    ])
+
+
+@dataclasses.dataclass(frozen=True)
+class AggState:
+    """One column's merged sufficient statistics — the O(1) object a
+    streaming block journals and a resumed run re-merges. Host-side
+    state is f64 (exact for the f32-integer counts and far past any
+    realistic Σerr² magnitude); merge is plain addition in declared
+    block order, so resumed and straight-through runs agree exactly."""
+
+    stats: tuple[float, ...]
+
+    def __post_init__(self) -> None:
+        if len(self.stats) != N_STATS:
+            raise ValueError(
+                f"AggState wants {N_STATS} stats, got {len(self.stats)}"
+            )
+
+    @classmethod
+    def zero(cls) -> "AggState":
+        return cls((0.0,) * N_STATS)
+
+    @classmethod
+    def from_array(cls, arr) -> "AggState":
+        return cls(tuple(float(v) for v in np.asarray(arr).reshape(-1)))
+
+    def merge(self, other: "AggState") -> "AggState":
+        return AggState(tuple(
+            a + b for a, b in zip(self.stats, other.stats)
+        ))
+
+    def __getattr__(self, name: str):
+        try:
+            return self.stats[STAT_FIELDS.index(name)]
+        except ValueError:
+            raise AttributeError(name) from None
+
+    def hist_cells(self) -> list[int]:
+        """``[underflow, *bins, overflow]`` as exact ints."""
+        return [int(v) for v in self.stats[len(STAT_FIELDS):]]
+
+    def summary(self, nominal: float = 0.95) -> dict:
+        """The per-column aggregate dict, schema-compatible with the
+        rows-mode ``column_aggregates`` recipe (coverage/power/bias/
+        RMSE/MC-SE + the ISSUE 16 mergeable sketches) — computed from
+        sums instead of a materialized cell table."""
+        n_cells = int(self.n_cells)
+        n_ok = int(self.n_ok)
+        n_se = int(self.n_se)
+        out: dict = {
+            "n_cells": n_cells,
+            "n_ok": n_ok,
+            "n_failed": n_cells - n_ok,
+            "coverage": None,
+            "power": None,
+            "bias": None,
+            "rmse": None,
+            "coverage_mc_se": None,
+            "nominal": nominal,
+        }
+        if n_ok:
+            out["bias"] = self.sum_err / n_ok
+            out["rmse"] = math.sqrt(max(0.0, self.sum_err2 / n_ok))
+            out["mean_tau_true"] = self.sum_tau / n_ok
+        if n_se:
+            out["coverage"] = self.cover_hits / n_se
+            out["power"] = self.reject_hits / n_se
+            out["coverage_mc_se"] = math.sqrt(
+                nominal * (1.0 - nominal) / n_se
+            )
+        err_sketch = FixedBinSketch(*ERROR_SKETCH_RANGE, ERROR_SKETCH_BINS)
+        cells = self.hist_cells()
+        err_sketch.underflow = cells[0]
+        err_sketch.overflow = cells[-1]
+        err_sketch.counts = cells[1:-1]
+        cov_sketch = CalibrationSketch()
+        if n_se:
+            # Every se-lane is one (predicted=nominal, covered) pair —
+            # identical to the rows-mode update, just pre-counted.
+            bucket = min(cov_sketch.n_buckets - 1,
+                         int(nominal * cov_sketch.n_buckets))
+            cov_sketch.counts[bucket] = n_se
+            cov_sketch.positives[bucket] = int(self.cover_hits)
+        out["sketches"] = {
+            "error": err_sketch.to_dict(),
+            "coverage": cov_sketch.to_dict(),
+        }
+        return out
+
+
+# ── executables ──────────────────────────────────────────────────────
+
+
+def aggregate_executable(
+    spec: DGPSpec, est: ScenarioEstimator, width: int, column: str = "",
+    ids_sharding=None,
+):
+    """The column's ONE fused streaming executable:
+    ``compiled(root_key, cell_ids[W], mask[W]) -> stats[N_STATS]`` —
+    ``batch_stats`` traced directly onto the vmapped cell outputs, so a
+    block's W rows never reach the host. Same cache/compile-counter
+    discipline as the rows-mode column executable (one compile per
+    column per process, ``kind="aggregate"``); ``ids_sharding`` shards
+    the lane axis over the mesh with replicated outputs — the per-lane
+    sums become a single small cross-device reduction, dispatched
+    inside the mesh lane like every other collective."""
+    if not est.vmapped:
+        raise ValueError(
+            f"estimator {est.name!r} is not vmappable — fold its eager "
+            "cells host-side through fold_rows instead"
+        )
+    key = column_cache_key(spec, est.name, width) + ("agg", ids_sharding)
+
+    def build():
+        cells = jax.vmap(cell_fn(spec, est), in_axes=(None, 0))
+
+        def agg(root_key, ids, mask):
+            ate, se, tau_true = cells(root_key, ids)
+            return batch_stats(ate, se, tau_true, mask)
+
+        root = jax.random.key(0)
+        ids = jnp.zeros((width,), jnp.uint32)
+        mask = jnp.zeros((width,), jnp.dtype(spec.dtype))
+        if ids_sharding is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            rep = NamedSharding(ids_sharding.mesh, P())
+            jitted = jax.jit(
+                agg, in_shardings=(rep, ids_sharding, ids_sharding),
+                out_shardings=rep,
+            )
+            ids = jax.device_put(np.zeros((width,), np.uint32), ids_sharding)
+            mask = jax.device_put(
+                np.zeros((width,), spec.dtype), ids_sharding
+            )
+            root = jax.device_put(root, rep)
+        else:
+            jitted = jax.jit(agg)
+        return jitted.lower(root, ids, mask).compile()
+
+    return cached_executable(
+        key, build, column or f"{spec.name}:{est.name}", "aggregate")
+
+
+def fold_executable(width: int, dtype: str = "float32"):
+    """The reference fold: the SAME ``batch_stats`` epilogue jitted
+    standalone over width-W row arrays — what the bit-identity tests
+    and the non-vmapped (eager-engine) path fold materialized rows
+    through. One compile per width, shared across columns (the epilogue
+    has no column in its shape)."""
+    key = ("scenario-agg-fold", width, dtype)
+
+    def build():
+        arr = jnp.zeros((width,), jnp.dtype(dtype))
+        return jax.jit(batch_stats).lower(arr, arr, arr, arr).compile()
+
+    return cached_executable(key, build, f"fold:w{width}", "aggregate_fold")
+
+
+def fold_rows(
+    rows, width: int, dtype: str = "float32",
+) -> AggState:
+    """Fold materialized ``(ate, se, tau_true)`` triples into an
+    :class:`AggState` through :func:`fold_executable`, chunked into the
+    same width-W mask-padded blocks a streaming run dispatches (f32
+    sums are segment-dependent — the reference must reduce the same
+    lanes in the same segments to be comparable at the bit level).
+    ``rows`` is an iterable of 3-tuples in replicate order."""
+    rows = list(rows)
+    exe = fold_executable(width, dtype)
+    state = AggState.zero()
+    np_dtype = np.dtype(dtype)
+    for i in range(0, len(rows), width):
+        chunk = rows[i:i + width]
+        pad = width - len(chunk)
+        ate = np.asarray(
+            [r[0] for r in chunk] + [0.0] * pad, np_dtype)
+        se = np.asarray([r[1] for r in chunk] + [0.0] * pad, np_dtype)
+        tau = np.asarray([r[2] for r in chunk] + [0.0] * pad, np_dtype)
+        mask = np.asarray([1.0] * len(chunk) + [0.0] * pad, np_dtype)
+        state = state.merge(AggState.from_array(exe(ate, se, tau, mask)))
+    return state

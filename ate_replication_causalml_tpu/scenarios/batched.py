@@ -210,6 +210,29 @@ def cell_fn(spec: DGPSpec, est: ScenarioEstimator) -> Callable:
     return run
 
 
+def pad_ids(dgp_name: str, batch: tuple[int, ...], width: int) -> np.ndarray:
+    """Cell-id operand for one dispatched batch: the final partial
+    batch pads to the column's one executable width with duplicate ids
+    (one executable SHAPE per column, the compile-count contract).
+    Rows mode discards the padded outputs host-side; aggregate mode
+    masks them inside the epilogue — both consume this same layout."""
+    from ate_replication_causalml_tpu.scenarios.dgp import data_cell_id
+
+    return np.asarray(
+        [data_cell_id(dgp_name, r) for r in batch]
+        + [data_cell_id(dgp_name, batch[0])] * (width - len(batch)),
+        dtype=np.uint32,
+    )
+
+
+def batch_mask(batch: tuple[int, ...], width: int,
+               dtype: str = "float32") -> np.ndarray:
+    """The matching lane mask: 1.0 on real lanes, 0.0 on padding."""
+    return np.asarray(
+        [1.0] * len(batch) + [0.0] * (width - len(batch)), dtype=dtype
+    )
+
+
 def column_cache_key(spec: DGPSpec, estimator: str, width: int | None) -> tuple:
     """The executable-cache identity of one scenario column: the DGP
     spec's FULL field tuple (two specs differing in any knob can never

@@ -37,11 +37,39 @@ Batch width is deliberately NOT part of the checkpoint fingerprint:
 batched columns are bit-identical to their scalar replays (asserted in
 tests/test_scenarios.py), so journals resume across widths — exactly
 like the sweep's concurrent/sequential modes sharing one journal.
+
+ISSUE 19 rebuilt the hot path so AGGREGATES are the product and rows
+are the exception:
+
+* **streaming aggregate mode (the default)** — each batch dispatches
+  the column's fused ``aggregate_executable`` (the vmapped cell
+  program with the ``batch_stats`` segment-reduce epilogue traced on),
+  so a width-W block returns one O(1) stat vector instead of W host
+  rows; ``cells.jsonl`` carries ONE record per dispatched block
+  (merged stats + the rep list) and resume granularity moves from
+  cells to blocks. The checkpoint fingerprint gains an
+  ``|mode=scenarios-agg-v1`` suffix, so a rows-mode journal resumed in
+  aggregate mode is set aside as ``.stale`` by the header check — and
+  the block-resume scan ADDITIONALLY asserts every record's ``schema``
+  tag before trusting it (a hand-edited journal whose header lies must
+  also set aside, never silently merge).
+* **rows mode (``ATE_TPU_SCENARIO_ROWS=1`` or ``MatrixSpec(rows=True)``)**
+  — the PR 13 per-cell path, unchanged: one journal record and one
+  host row per cell, cell-granular resume, per-cell degrade. The
+  campaign workloads and every consumer that reads a cell table pin
+  this mode explicitly.
+
+Extend-reps resume works in both modes (replicate count stays out of
+the fingerprint); streaming blocks pack rep-contiguous chunks of the
+declared width, so a resumed extension reduces the same segments a
+straight-through run would — merged aggregates are bit-equal, not just
+statistically equal.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import json
 import math
 import os
 import time
@@ -56,11 +84,21 @@ from ate_replication_causalml_tpu.observability.sketch import (
 )
 from ate_replication_causalml_tpu.resilience import chaos
 from ate_replication_causalml_tpu.resilience.errors import ChaosStageFault
+from ate_replication_causalml_tpu.scenarios.aggregate import (
+    AGG_SCHEMA_TAG,
+    AggState,
+    N_STATS,
+    Z95,
+    aggregate_executable,
+    fold_rows,
+)
 from ate_replication_causalml_tpu.scenarios.batched import (
     SCENARIO_ESTIMATORS,
     SCHEMA_TAG,
+    batch_mask,
     column_cache_key,
     column_executable,
+    pad_ids,
     scalar_executable,
 )
 from ate_replication_causalml_tpu.scenarios.dgp import (
@@ -73,9 +111,12 @@ from ate_replication_causalml_tpu.scenarios.dgp import (
 _BATCH_ENV = "ATE_TPU_SCENARIO_BATCH"
 _REPS_ENV = "ATE_TPU_SCENARIO_REPS"
 _SHARD_ENV = "ATE_TPU_SCENARIO_SHARD"
+_ROWS_ENV = "ATE_TPU_SCENARIO_ROWS"
 
-#: 95% normal critical value, matching estimators.base.Z_95.
-_Z95 = 1.96
+#: 95% normal critical value, matching estimators.base.Z_95 and the
+#: device epilogue (scenarios/aggregate.py — one constant, two homes
+#: would drift).
+_Z95 = Z95
 
 
 def _env_int(name: str, default: int) -> int:
@@ -110,11 +151,19 @@ def _env_shard() -> bool:
     )
 
 
+def _env_rows() -> bool:
+    return os.environ.get(_ROWS_ENV, "").strip().lower() in (
+        "1", "true", "yes", "on"
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class MatrixSpec:
     """One scenario matrix: the DGP grid, the estimator set, and the
     replicate/batching policy. ``shard=None`` defers to
-    ``ATE_TPU_SCENARIO_SHARD``."""
+    ``ATE_TPU_SCENARIO_SHARD``; ``rows=None`` defers to
+    ``ATE_TPU_SCENARIO_ROWS`` (streaming aggregates by default, the
+    per-cell row table opt-in)."""
 
     dgps: tuple[DGPSpec, ...]
     estimators: tuple[str, ...]
@@ -123,6 +172,7 @@ class MatrixSpec:
     seed: int = 0
     fail_policy: str = "degrade"
     shard: bool | None = None
+    rows: bool | None = None
 
     def __post_init__(self) -> None:
         if self.fail_policy not in ("degrade", "raise"):
@@ -147,22 +197,33 @@ class MatrixSpec:
                     f"duplicate {what} name(s) in MatrixSpec: {sorted(dupes)}"
                 )
 
+    def resolved_rows(self) -> bool:
+        """Whether this run journals per-cell rows (the PR 13 path) or
+        streaming aggregate blocks (the ISSUE 19 default)."""
+        return _env_rows() if self.rows is None else bool(self.rows)
+
     def fingerprint(self) -> str:
         """Resume validity: DGP field tuples + estimator set + seed +
         schema tag. Replicate count and batch width are deliberately
-        absent — extending reps resumes completed cells, and batched ==
-        scalar bit-identity (asserted in-suite) makes widths
-        interchangeable over one journal."""
+        absent — extending reps resumes completed cells/blocks, and
+        batched == scalar bit-identity (asserted in-suite) makes widths
+        interchangeable over one journal. Aggregate mode appends its
+        own schema tag: a rows journal and a block journal can NEVER
+        resume each other — the header check sets the other mode's file
+        aside as ``.stale``."""
         dgps = ";".join(repr(d.fields()) for d in self.dgps)
-        return (
+        fp = (
             f"{SCHEMA_TAG}|dgps=[{dgps}]|est={list(self.estimators)!r}"
             f"|seed={self.seed}"
         )
+        if not self.resolved_rows():
+            fp += f"|mode={AGG_SCHEMA_TAG}"
+        return fp
 
 
 def micro_matrix_spec(
     n_reps: int | None = None, batch_width: int | None = None,
-    n: int = 384, seed: int = 0,
+    n: int = 384, seed: int = 0, rows: bool | None = None,
 ) -> MatrixSpec:
     """The canonical micro matrix (2 DGPs × 3 estimators): the
     calibration design (coverage must sit at nominal) and the
@@ -178,6 +239,7 @@ def micro_matrix_spec(
         n_reps=default_reps() if n_reps is None else n_reps,
         batch_width=default_batch_width() if batch_width is None else batch_width,
         seed=seed,
+        rows=rows,
     )
 
 
@@ -189,6 +251,13 @@ def cell_row_id(dgp_name: str, estimator: str, rep: int) -> str:
     """The journal key of one cell — ``_Checkpoint`` keys rows by
     ``method``, so the cell id IS the method field."""
     return f"{dgp_name}:{estimator}:{rep}"
+
+
+def block_row_id(column: str, batch: tuple[int, ...]) -> str:
+    """The journal key of one streaming aggregate block. Blocks in a
+    column are disjoint rep sets, so the first rep is a unique suffix
+    whatever resume history packed the batch."""
+    return f"agg:{column}:r{batch[0]}-{batch[-1]}"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -396,6 +465,13 @@ class MatrixReport:
     #: a SIGTERM drain (ISSUE 14) cut this run short: the journal holds
     #: the committed prefix and a rerun resumes cell-exact.
     drained: bool = False
+    #: "aggregate" (streaming, the default) or "rows" (per-cell table).
+    mode: str = "rows"
+    #: streaming mode only: journaled block records this run committed
+    #: (``cells`` stays empty — the cell table is never materialized)
+    #: and the merged per-column sufficient statistics.
+    n_blocks: int = 0
+    states: dict = dataclasses.field(default_factory=dict)
 
 
 def _cells_counter():
@@ -409,6 +485,14 @@ def _dispatch_counter():
     return obs.counter(
         "scenario_batch_dispatch_total",
         "scenario-matrix batch dispatches by column and vmapped/sequential mode",
+    )
+
+
+def _blocks_counter():
+    return obs.counter(
+        "scenario_aggregate_blocks_total",
+        "streaming aggregate blocks by column and "
+        "computed/resumed/failed status",
     )
 
 
@@ -457,6 +541,132 @@ def _failed_record(plan: ColumnPlan, rep: int, error: str) -> dict:
     }
 
 
+# ── streaming block records ──────────────────────────────────────────
+
+
+def _pack_reps(batch: tuple[int, ...]) -> list[list[int]]:
+    """Run-length ``[[lo, hi], ...]`` encoding of a block's rep set —
+    the journal-bytes-O(blocks) guarantee depends on this: a fresh
+    block is one contiguous run whatever its width, so the record costs
+    O(1) bytes, not O(width). Resume holes can fragment a block into a
+    few runs; that stays O(runs), never O(cells)."""
+    runs: list[list[int]] = []
+    for r in batch:
+        if runs and r == runs[-1][1] + 1:
+            runs[-1][1] = r
+        else:
+            runs.append([r, r])
+    return runs
+
+
+def _unpack_reps(packed: list) -> list[int]:
+    return [r for lo, hi in packed for r in range(lo, hi + 1)]
+
+
+def _packed_count(packed: list) -> int:
+    return sum(hi - lo + 1 for lo, hi in packed)
+
+
+def _block_record(plan: ColumnPlan, batch: tuple[int, ...],
+                  state: AggState, seconds: float) -> dict:
+    return {
+        "method": block_row_id(plan.name, batch),
+        "schema": AGG_SCHEMA_TAG,
+        "column": plan.name,
+        "dgp": plan.dgp.name,
+        "estimator": plan.estimator,
+        "reps": _pack_reps(batch),
+        "width": plan.width,
+        "status": "ok",
+        "stats": list(state.stats),
+        "seconds": round(seconds, 6),
+    }
+
+
+def _failed_block_record(plan: ColumnPlan, batch: tuple[int, ...],
+                         error: str) -> dict:
+    return {
+        "method": block_row_id(plan.name, batch),
+        "schema": AGG_SCHEMA_TAG,
+        "column": plan.name,
+        "dgp": plan.dgp.name,
+        "estimator": plan.estimator,
+        "reps": _pack_reps(batch),
+        "width": plan.width,
+        "status": "failed",
+        "error": error,
+        "seconds": 0.0,
+    }
+
+
+def _block_resumable(rec: dict) -> bool:
+    """A block record the resume scan may trust: schema-tagged, status
+    ok, a full finite stat vector, and a well-formed packed rep set.
+    Anything else (a failed block, a torn-then-hand-fixed record)
+    recomputes."""
+    if rec.get("schema") != AGG_SCHEMA_TAG:
+        return False
+    if rec.get("status", "ok") != "ok":
+        return False
+    stats = rec.get("stats")
+    if not isinstance(stats, list) or len(stats) != N_STATS:
+        return False
+    if not all(_finite(v) for v in stats):
+        return False
+    reps = rec.get("reps")
+    return (
+        isinstance(reps, list) and bool(reps)
+        and all(
+            isinstance(run, list) and len(run) == 2
+            and all(isinstance(r, int) for r in run)
+            and run[0] <= run[1]
+            for run in reps
+        )
+    )
+
+
+def _scan_blocks(ckpt, fingerprint: str, log: Callable[[str], None]) -> dict:
+    """Index a block journal's resumable records by column, ASSERTING
+    every non-header record's schema tag first (the ISSUE 19 small
+    fix): the fingerprint header already stales a rows-mode journal,
+    but a hand-edited file whose header lies must ALSO be set aside as
+    ``.stale`` — a rows record silently merged as a block would corrupt
+    every aggregate downstream. Returns ``{column: {rep: record}}``;
+    on a tag violation the journal is renamed and the scan restarts
+    empty."""
+    from ate_replication_causalml_tpu.pipeline import _unused_stale_path
+
+    foreign = [
+        m for m, rec in ckpt.done.items()
+        if rec.get("schema") != AGG_SCHEMA_TAG
+    ]
+    if foreign:
+        if ckpt.path and os.path.exists(ckpt.path):
+            stale = _unused_stale_path(ckpt.path)
+            os.replace(ckpt.path, stale)
+            log(
+                f"checkpoint {ckpt.path}: {len(foreign)} record(s) "
+                f"without the {AGG_SCHEMA_TAG!r} schema tag (e.g. "
+                f"{foreign[0]!r}) — not a block journal; moved to "
+                f"{stale} and starting fresh"
+            )
+            # Re-seed the header the rename removed: the journal file
+            # must stay self-describing for the NEXT resume.
+            obs.atomic_write_text(ckpt.path, json.dumps(
+                {"method": "__config__", "fingerprint": fingerprint}
+            ) + "\n")
+        ckpt.done.clear()
+        return {}
+    by_column: dict[str, dict[int, dict]] = {}
+    for rec in ckpt.done.values():
+        if not _block_resumable(rec):
+            continue
+        col = by_column.setdefault(rec["column"], {})
+        for rep in _unpack_reps(rec["reps"]):
+            col[rep] = rec
+    return by_column
+
+
 def run_matrix(
     spec: MatrixSpec,
     outdir: str | None = None,
@@ -470,11 +680,63 @@ def run_matrix(
     docstring for the contracts; telemetry exports to ``outdir`` beside
     ``cells.jsonl`` and ``matrix_report.json``. With
     ``drain_on_sigterm`` (the CLI default), SIGTERM gracefully drains
-    the engine (ISSUE 14): in-flight batch stages complete, their rows
-    commit in declared order through the checkpoint journal, the
-    process exits 0 — and a resumed run picks up cell-exact where the
+    the engine (ISSUE 14): in-flight batch stages complete, their
+    rows/blocks commit in declared order through the checkpoint
+    journal, the process exits 0 — and a resumed run picks up
+    cell-exact (rows mode) or block-exact (streaming mode) where the
     drain stopped, exactly like the SIGKILL crash-resume contract but
-    without losing the in-flight batches."""
+    without losing the in-flight batches.
+
+    Streaming aggregate mode is the default (ISSUE 19); rows mode —
+    ``MatrixSpec(rows=True)`` or ``ATE_TPU_SCENARIO_ROWS=1`` —
+    materializes the PR 13 per-cell table."""
+    if spec.resolved_rows():
+        return _run_matrix_rows(
+            spec, outdir=outdir, workers=workers, scheduler=scheduler,
+            prefetch=prefetch, log=log, drain_on_sigterm=drain_on_sigterm,
+        )
+    return _run_matrix_aggregate(
+        spec, outdir=outdir, workers=workers, scheduler=scheduler,
+        prefetch=prefetch, log=log, drain_on_sigterm=drain_on_sigterm,
+    )
+
+
+def _install_drain(engine, log: Callable[[str], None]):
+    """SIGTERM → engine drain (ISSUE 14), returning a restore thunk.
+    Restoring matters: a SIGTERM after the run must kill the process
+    again, not drain a finished engine (and pin it in memory) forever."""
+    import signal
+
+    def _drain(signum, frame, _engine=engine):
+        log("SIGTERM: draining scenario matrix "
+            "(in-flight batches will commit)")
+        _engine.request_drain()
+
+    try:
+        prev = signal.signal(signal.SIGTERM, _drain)
+    except ValueError:
+        return lambda: None  # not the main thread — no signal wiring
+
+    def restore():
+        try:
+            signal.signal(signal.SIGTERM, prev)
+        except ValueError:
+            pass
+
+    return restore
+
+
+def _run_matrix_rows(
+    spec: MatrixSpec,
+    outdir: str | None = None,
+    workers: int | None = None,
+    scheduler: str | None = None,
+    prefetch: bool | None = None,
+    log: Callable[[str], None] = print,
+    drain_on_sigterm: bool = False,
+) -> MatrixReport:
+    """The PR 13 per-cell path: one journal record and one host row per
+    cell, cell-granular resume, per-cell degrade."""
     import jax
 
     from ate_replication_causalml_tpu.pipeline import (
@@ -574,12 +836,7 @@ def run_matrix(
             # Pad the final partial batch to the column's one executable
             # width with duplicate ids; padded outputs are discarded
             # host-side (never journaled).
-            ids = np.asarray(
-                [data_cell_id(plan.dgp.name, r) for r in batch]
-                + [data_cell_id(plan.dgp.name, batch[0])]
-                * (plan.width - len(batch)),
-                dtype=np.uint32,
-            )
+            ids = pad_ids(plan.dgp.name, batch, plan.width)
             if ids_sharding is not None:
                 from ate_replication_causalml_tpu.parallel import shardio
 
@@ -712,36 +969,12 @@ def run_matrix(
                     prefetch=prefetch,
                     span_parent=getattr(root_sp, "span_id", None),
                 )
-                prev_sigterm = None
-                if drain_on_sigterm:
-                    import signal
-
-                    def _drain(signum, frame, _engine=engine):
-                        # The ISSUE 14 drain contract: stop scheduling,
-                        # finish in-flight batch stages, commit the
-                        # declared-order prefix — run() then returns
-                        # and the journal resumes cell-exact.
-                        log("SIGTERM: draining scenario matrix "
-                            "(in-flight batches will commit)")
-                        _engine.request_drain()
-
-                    try:
-                        prev_sigterm = signal.signal(signal.SIGTERM, _drain)
-                    except ValueError:
-                        pass  # not the main thread — no signal wiring
+                restore = (_install_drain(engine, log)
+                           if drain_on_sigterm else (lambda: None))
                 try:
                     engine.run()
                 finally:
-                    # Restore the caller's handler: a SIGTERM after this
-                    # run must kill the process again, not drain a
-                    # finished engine (and pin it in memory) forever.
-                    if prev_sigterm is not None:
-                        import signal
-
-                        try:
-                            signal.signal(signal.SIGTERM, prev_sigterm)
-                        except ValueError:
-                            pass
+                    restore()
                 if engine.draining:
                     report.drained = True
     finally:
@@ -776,6 +1009,354 @@ def run_matrix(
     return report
 
 
+def _run_matrix_aggregate(
+    spec: MatrixSpec,
+    outdir: str | None = None,
+    workers: int | None = None,
+    scheduler: str | None = None,
+    prefetch: bool | None = None,
+    log: Callable[[str], None] = print,
+    drain_on_sigterm: bool = False,
+) -> MatrixReport:
+    """The ISSUE 19 streaming path: each batch dispatches the column's
+    fused aggregate executable and journals ONE block record (merged
+    stat vector + rep list); ``report.cells`` stays empty, resume is
+    block-granular, and a failed block degrades to a failed-block
+    record for exactly its reps.
+
+    Two deliberate divergences from rows mode, both consequences of the
+    block being the atomic unit:
+
+    * a resumed block with failed CELLS inside it is still complete —
+      cell failure in streaming mode means a non-finite estimate folded
+      into ``n_failed`` inside the stats, and recomputing the same
+      deterministic program would fold the same value;
+    * a failed BLOCK (stage exception) journals with no stats and is
+      not resumable — the whole block recomputes on the next run.
+    """
+    import jax
+
+    from ate_replication_causalml_tpu.pipeline import (
+        _Checkpoint,
+        _resolve_scheduler,
+    )
+    from ate_replication_causalml_tpu.scheduler import (
+        ArtifactSpec,
+        StageSpec,
+        SweepEngine,
+    )
+
+    obs.install_jax_monitoring()
+    n_workers = _resolve_scheduler(scheduler, workers, log)
+    t_start = time.monotonic()
+    compiles_before = obs.compile_event_count()
+    if outdir:
+        os.makedirs(outdir, exist_ok=True)
+    fingerprint = spec.fingerprint()
+    ckpt = _Checkpoint(
+        os.path.join(outdir, "cells.jsonl") if outdir else None,
+        fingerprint, log=log,
+    )
+    blocks_by_col = _scan_blocks(ckpt, fingerprint, log)
+    trusted, covered = _trusted_blocks(blocks_by_col, spec.n_reps)
+
+    def resumed(cell: str) -> bool:
+        col, _, rep = cell.rpartition(":")
+        return int(rep) in covered.get(col, ())
+
+    shard = _env_shard() if spec.shard is None else spec.shard
+    devices = jax.device_count()
+    shard = bool(shard and devices > 1)
+    plans, skipped = plan_columns(spec, done=resumed,
+                                  devices=devices if shard else 1)
+    # Sequential (non-vmapped) columns plan at width 1 for dispatch, but
+    # a width-1 BLOCK would journal one record per cell — exactly the
+    # O(cells) cost this mode removes. Re-pack their remaining reps into
+    # batch_width chunks: each chunk computes its cells eagerly and
+    # folds host-side through the same batch_stats epilogue.
+    seq_width = min(spec.batch_width, spec.n_reps)
+    plans = [
+        p if p.mode == "vmapped" else dataclasses.replace(
+            p, width=seq_width,
+            batches=tuple(
+                p.remaining[i:i + seq_width]
+                for i in range(0, len(p.remaining), seq_width)
+            ),
+        )
+        for p in plans
+    ]
+
+    report = MatrixReport(skipped_columns=skipped, n_columns=len(plans),
+                          mode="aggregate")
+    cells_c, disp_c = _cells_counter(), _dispatch_counter()
+    blocks_c = _blocks_counter()
+    failed_by_col: dict[str, int] = {}
+    root_key = jax.random.key(spec.seed)
+
+    ids_sharding = None
+    root_dispatch = root_key
+    if shard:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ate_replication_causalml_tpu.parallel.mesh import (
+            DATA_AXIS,
+            make_mesh,
+        )
+
+        mesh = make_mesh((DATA_AXIS,))
+        ids_sharding = NamedSharding(mesh, P(DATA_AXIS))
+        root_dispatch = jax.device_put(root_key, NamedSharding(mesh, P()))
+        log(f"scenario matrix: sharded dispatch over {devices} devices")
+
+    # Resumed blocks never reach the engine: merge their states now, in
+    # plan order, so resumed and straight-through reports agree.
+    for plan in plans:
+        for rec in trusted.get(plan.name, ()):
+            state = AggState.from_array(np.asarray(rec["stats"]))
+            report.states[plan.name] = (
+                report.states.get(plan.name, AggState.zero()).merge(state)
+            )
+            n = _packed_count(rec["reps"])
+            report.n_resumed += n
+            cells_c.inc(n, column=plan.name, status="resumed")
+            blocks_c.inc(1, column=plan.name, status="resumed")
+
+    artifacts: list = []
+    stages: list = []
+    lane = "mesh" if shard else None
+
+    def make_exe_artifact(plan: ColumnPlan) -> str:
+        name = f"exe:{plan.name}"
+        fit = lambda c=None, p=plan: aggregate_executable(
+            p.dgp, SCENARIO_ESTIMATORS[p.estimator], p.width,
+            column=p.name, ids_sharding=ids_sharding,
+        )
+        artifacts.append(ArtifactSpec(
+            name, fit=fit,
+            key=(fingerprint,
+                 column_cache_key(plan.dgp, plan.estimator, plan.width),
+                 "agg"),
+            warm=fit,
+            exclusive=lane,
+        ))
+        return name
+
+    def vmapped_block(plan: ColumnPlan, bi: int, batch: tuple[int, ...],
+                      exe_name: str) -> StageSpec:
+        def run(cache, plan=plan, batch=batch, exe_name=exe_name):
+            t0 = time.perf_counter()
+            exe = cache.get(exe_name)
+            ids = pad_ids(plan.dgp.name, batch, plan.width)
+            mask = batch_mask(batch, plan.width, plan.dgp.dtype)
+            if ids_sharding is not None:
+                from ate_replication_causalml_tpu.parallel import shardio
+
+                ids_dev = shardio.commit(ids, ids_sharding,
+                                         artifact=plan.name)
+                mask_dev = shardio.commit(mask, ids_sharding,
+                                          artifact=plan.name)
+                stats = exe(root_dispatch, ids_dev, mask_dev)
+                stats = shardio.gather_host(stats, artifact=plan.name)
+            else:
+                stats = np.asarray(exe(
+                    root_key, jax.numpy.asarray(ids),
+                    jax.numpy.asarray(mask),
+                ))
+            disp_c.inc(1, column=plan.name, mode="vmapped")
+            state = AggState.from_array(np.asarray(stats))
+            return [_block_record(plan, batch, state,
+                                  time.perf_counter() - t0)]
+
+        return StageSpec(f"{plan.name}#b{bi}", run, needs=(exe_name,),
+                         exclusive=lane)
+
+    def sequential_block(plan: ColumnPlan, bi: int,
+                         batch: tuple[int, ...]) -> StageSpec:
+        def run(cache, plan=plan, batch=batch):
+            import jax.numpy as jnp
+
+            est = SCENARIO_ESTIMATORS[plan.estimator]
+            gen = scalar_generate_executable(plan.dgp, column=plan.name)
+            salt = np.uint32(estimator_salt(est.name))
+            t0 = time.perf_counter()
+            triples = []
+            for rep in batch:
+                cid = jnp.asarray(data_cell_id(plan.dgp.name, rep),
+                                  jnp.uint32)
+                x, w, y, tau_true, est_key = gen(root_key, cid, salt)
+                ate, se = est.fn(plan.dgp, x, w, y, est_key)
+                disp_c.inc(1, column=plan.name, mode="sequential")
+                triples.append((float(ate), float(se), float(tau_true)))
+            state = fold_rows(triples, plan.width, plan.dgp.dtype)
+            return [_block_record(plan, batch, state,
+                                  time.perf_counter() - t0)]
+
+        return StageSpec(f"{plan.name}#b{bi}", run, needs=(),
+                         exclusive=lane)
+
+    def wrap_degrade(spec_stage: StageSpec, plan: ColumnPlan,
+                     batch: tuple[int, ...]) -> StageSpec:
+        inner = spec_stage.run
+
+        def run(cache):
+            try:
+                return inner(cache)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                if spec.fail_policy != "degrade":
+                    raise
+                err = f"{type(e).__name__}: {e}"
+                obs.emit("scenario_batch_failed", status="error",
+                         column=plan.name, batch=len(batch), error=err)
+                return [_failed_block_record(plan, batch, err)]
+
+        return dataclasses.replace(spec_stage, run=run)
+
+    inj = chaos.active()
+    stage_faults: frozenset[str] = frozenset()
+    if inj is not None:
+        stage_faults = inj.plan_stage_faults([
+            f"{p.name}#b{bi}"
+            for p in plans for bi in range(len(p.batches))
+        ])
+
+    def wrap_stage_fault(spec_stage: StageSpec) -> StageSpec:
+        def run(cache, _name=spec_stage.name):
+            inj.record_stage_fault(_name)
+            raise ChaosStageFault(
+                f"chaos: injected stage fault on {_name!r}"
+            )
+
+        return dataclasses.replace(spec_stage, run=run)
+
+    for plan in plans:
+        if not plan.batches:
+            continue
+        exe_name = None
+        if plan.mode == "vmapped":
+            exe_name = make_exe_artifact(plan)
+        for bi, batch in enumerate(plan.batches):
+            st = (
+                vmapped_block(plan, bi, batch, exe_name)
+                if plan.mode == "vmapped"
+                else sequential_block(plan, bi, batch)
+            )
+            if st.name in stage_faults:
+                st = wrap_stage_fault(st)
+            stages.append(wrap_degrade(st, plan, batch))
+            report.n_batches += 1
+
+    def commit(spec_stage: StageSpec, recs: list) -> None:
+        for rec in recs:
+            ckpt.put(rec)
+            report.n_blocks += 1
+            col = rec["column"]
+            n = _packed_count(rec["reps"])
+            if rec.get("status", "ok") == "ok":
+                state = AggState.from_array(np.asarray(rec["stats"]))
+                report.states[col] = (
+                    report.states.get(col, AggState.zero()).merge(state)
+                )
+                report.n_computed += n
+                cells_c.inc(n, column=col, status="computed")
+                blocks_c.inc(1, column=col, status="computed")
+                log(f"  [{spec_stage.name}] block ok ({n} cells)")
+            else:
+                failed_by_col[col] = failed_by_col.get(col, 0) + n
+                report.n_failed += n
+                cells_c.inc(n, column=col, status="failed")
+                blocks_c.inc(1, column=col, status="failed")
+                log(f"  [{spec_stage.name}] block FAILED ({n} cells)")
+
+    try:
+        with obs.span("run_matrix", columns=len(plans),
+                      reps=spec.n_reps, out=outdir or "",
+                      mode="aggregate") as root_sp:
+            if stages:
+                engine = SweepEngine(
+                    artifacts, stages, commit=commit, workers=n_workers,
+                    prefetch=prefetch,
+                    span_parent=getattr(root_sp, "span_id", None),
+                )
+                restore = (_install_drain(engine, log)
+                           if drain_on_sigterm else (lambda: None))
+                try:
+                    engine.run()
+                finally:
+                    restore()
+                if engine.draining:
+                    report.drained = True
+    finally:
+        report.wall_s = time.monotonic() - t_start
+        report.compile_events_delta = (
+            obs.compile_event_count() - compiles_before
+        )
+        # Column summaries from merged sums — schema-compatible with
+        # rows-mode column_aggregates. Failed-BLOCK cells never folded
+        # into any stat vector, so account them into the summary
+        # explicitly (rows mode counts its failed rows the same way).
+        report.columns = {}
+        for col, st in report.states.items():
+            summ = st.summary()
+            extra = failed_by_col.pop(col, 0)
+            summ["n_cells"] += extra
+            summ["n_failed"] += extra
+            report.columns[col] = summ
+        for col, extra in failed_by_col.items():
+            summ = AggState.zero().summary()
+            summ["n_cells"] = extra
+            summ["n_failed"] = extra
+            report.columns[col] = summ
+        if outdir:
+            try:
+                obs.atomic_write_json(
+                    os.path.join(outdir, "matrix_report.json"),
+                    _report_json(spec, report),
+                )
+                obs.write_run_artifacts(outdir)
+            except Exception as e:  # noqa: BLE001 — the export must not
+                # replace the run's real exception.
+                log(f"matrix export failed: {e!r}")
+    log(
+        f"scenario matrix [streaming]: {report.n_computed} computed, "
+        f"{report.n_resumed} resumed, {report.n_failed} failed across "
+        f"{report.n_columns} columns / {report.n_blocks} blocks in "
+        f"{report.wall_s:.1f}s "
+        f"(compile events +{report.compile_events_delta:.0f})"
+    )
+    return report
+
+
+def _trusted_blocks(
+    blocks_by_col: dict, n_reps: int,
+) -> tuple[dict, dict]:
+    """From the resume scan's ``{column: {rep: record}}``, the block
+    records a run at ``n_reps`` may merge: all reps inside the grid and
+    no overlap with an already-accepted block (overlaps can only come
+    from journals written at DIFFERENT rep counts — e.g. shrinking
+    ``n_reps`` after a run left blocks that straddle the new boundary —
+    and merging one twice would double-count every cell). Deterministic:
+    records process in min-rep order. Returns ``(trusted, covered)`` =
+    ``{column: [records]}``, ``{column: set(reps)}``; reps NOT covered
+    recompute."""
+    trusted: dict[str, list[dict]] = {}
+    covered: dict[str, set[int]] = {}
+    for col, by_rep in blocks_by_col.items():
+        uniq = {rec["method"]: rec for rec in by_rep.values()}
+        cov: set[int] = set()
+        keep: list[dict] = []
+        for rec in sorted(uniq.values(), key=lambda r: r["reps"][0][0]):
+            reps = set(_unpack_reps(rec["reps"]))
+            if max(reps) >= n_reps or reps & cov:
+                continue
+            keep.append(rec)
+            cov |= reps
+        trusted[col] = keep
+        covered[col] = cov
+    return trusted, covered
+
+
 def _report_json(spec: MatrixSpec, report: MatrixReport) -> dict:
     def _san(v):
         if isinstance(v, float) and not math.isfinite(v):
@@ -788,8 +1369,10 @@ def _report_json(spec: MatrixSpec, report: MatrixReport) -> dict:
 
     return _san({
         "fingerprint": spec.fingerprint(),
+        "mode": report.mode,
         "n_reps": spec.n_reps,
         "batch_width": spec.batch_width,
+        "n_blocks": report.n_blocks,
         "columns": report.columns,
         "skipped_columns": report.skipped_columns,
         "n_computed": report.n_computed,
@@ -907,6 +1490,10 @@ def main(argv: list[str] | None = None) -> MatrixReport:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--sequential", action="store_true")
     ap.add_argument("--workers", type=int, default=None)
+    ap.add_argument("--rows", action="store_true",
+                    help="materialize the per-cell row table (the PR 13 "
+                    "path) instead of streaming aggregate blocks; same "
+                    "as ATE_TPU_SCENARIO_ROWS=1")
     args = ap.parse_args(argv)
     spec = MatrixSpec(
         dgps=tuple(STOCK_DGPS[d] for d in args.dgps.split(",") if d),
@@ -915,6 +1502,7 @@ def main(argv: list[str] | None = None) -> MatrixReport:
         batch_width=(default_batch_width() if args.batch is None
                      else args.batch),
         seed=args.seed,
+        rows=True if args.rows else None,
     )
     return run_matrix(
         spec, outdir=args.out,

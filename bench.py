@@ -56,6 +56,14 @@ PREDICT_AB_ROWS = int(os.environ.get("ATE_BENCH_PREDICT_AB_ROWS", 16_384))
 SCENARIO_REPS = int(os.environ.get("ATE_BENCH_SCENARIO_REPS", 32))
 SCENARIO_ROWS = int(os.environ.get("ATE_BENCH_SCENARIO_ROWS", 384))
 
+# --scenario-matrix streaming legs (ISSUE 19; smoke overrides). 256
+# reps at 64 DGP rows is the smallest grid where rows-mode journaling
+# and host record building dominate the wall enough for a stable
+# streaming speedup measurement; below that the walls are compile- and
+# dispatch-latency noise.
+STREAM_REPS = int(os.environ.get("ATE_BENCH_STREAM_REPS", 256))
+STREAM_ROWS = int(os.environ.get("ATE_BENCH_STREAM_ROWS", 64))
+
 # --chaos-campaign scale (ISSUE 15; smoke override).
 CAMPAIGN_EPISODES = int(os.environ.get("ATE_BENCH_CAMPAIGN_EPISODES", 4))
 
@@ -415,6 +423,112 @@ def bench_hist_ab(n=N_ROWS, trees=32, depth=9):
     )))
 
 
+def _streaming_legs(sc, n_reps=STREAM_REPS, n_rows=STREAM_ROWS):
+    """ISSUE 19 streaming-aggregate legs for ``--scenario-matrix``:
+
+    * **rows-mode leg** — the PR 13 per-cell path at the standard
+      width-32 blocks WITH journaling (the O(cells) journal and host
+      record building are part of the cost being retired, so they stay
+      inside the measured wall); min-of-3 fresh-journal walls;
+    * **aggregate leg** — the streaming runner at full-grid block width
+      (one dispatch and ONE O(1) journal record per column); cold run
+      first so the compile charge is recorded separately (it must stay
+      O(columns)), then min-of-3 warm fresh-journal walls;
+    * **bit identity** — a rows-mode reference at the SAME vmap width
+      as the aggregate leg, folded through ``sc.fold_rows`` into the
+      same width-W segments and compared stat-by-stat against the
+      streaming states. f32 sums are chunking-dependent, so equal
+      widths make this an EXACT claim for every column, the
+      panel-folding GLM estimators included (scenarios/aggregate.py).
+
+    Returns the ``streaming`` section of SCENARIO_MATRIX.json; the
+    schema validator holds the speedup to >= 2x and the aggregate
+    journal to O(blocks) bytes."""
+    import shutil
+    import tempfile
+
+    def run(spec):
+        outdir = tempfile.mkdtemp(prefix="scenario_stream_")
+        try:
+            t0 = time.perf_counter()
+            rep = sc.run_matrix(spec, outdir=outdir, log=lambda s: None)
+            wall = time.perf_counter() - t0
+            journal = os.path.getsize(os.path.join(outdir, "cells.jsonl"))
+            return rep, wall, journal
+        finally:
+            shutil.rmtree(outdir, ignore_errors=True)
+
+    rows_width = min(32, n_reps)
+    rows_spec = sc.micro_matrix_spec(
+        n_reps=n_reps, batch_width=rows_width, n=n_rows, rows=True)
+    agg_spec = sc.micro_matrix_spec(
+        n_reps=n_reps, batch_width=n_reps, n=n_rows, rows=False)
+
+    c0 = obs.compile_event_count()
+    run(agg_spec)  # cold: pays the per-column aggregate compiles
+    agg_compiles = obs.compile_event_count() - c0
+    rep_a, agg_wall, agg_bytes = min(
+        [run(agg_spec) for _ in range(3)], key=lambda t: t[1])
+
+    c0 = obs.compile_event_count()
+    run(rows_spec)  # cold
+    rows_compiles = obs.compile_event_count() - c0
+    rep_r, rows_wall, rows_bytes = min(
+        [run(rows_spec) for _ in range(3)], key=lambda t: t[1])
+
+    # Bit identity: rows reference at the aggregate leg's vmap width,
+    # folded into the same width-W segments (see docstring).
+    ref_spec = sc.micro_matrix_spec(
+        n_reps=n_reps, batch_width=n_reps, n=n_rows, rows=True)
+    rep_ref = sc.run_matrix(ref_spec, outdir=None, log=lambda s: None)
+    by_col = {}
+    for r in rep_ref.cells:
+        by_col.setdefault(r["column"], []).append(r)
+    assert set(by_col) == set(rep_a.states), (
+        f"streaming states cover {sorted(rep_a.states)}, rows reference "
+        f"covers {sorted(by_col)}")
+    for col, state in sorted(rep_a.states.items()):
+        triples = [
+            (r["ate"], r["se"], r["tau_true"])
+            for r in sorted(by_col[col], key=lambda r: r["rep"])
+        ]
+        ref = sc.fold_rows(triples, width=n_reps)
+        diff = max(abs(a - b) for a, b in zip(state.stats, ref.stats))
+        assert diff == 0.0, (
+            f"{col}: streaming aggregate diverged from the materialized "
+            f"fold by {diff} — same epilogue, same segments, must be 0")
+
+    cells = rep_a.n_columns * n_reps
+    assert rep_r.n_computed + rep_r.n_failed == cells
+    return {
+        "n_reps": n_reps,
+        "dgp_rows": n_rows,
+        "columns": rep_a.n_columns,
+        "cells": cells,
+        "rows_mode": {
+            "batch_width": rows_width,
+            "wall_s": round(rows_wall, 3),
+            "compile_events_cold": rows_compiles,
+            "journal_bytes": rows_bytes,
+            "bytes_per_cell": round(rows_bytes / cells, 2),
+            "cells_per_s": round(cells / rows_wall, 2),
+        },
+        "aggregate": {
+            "block_width": n_reps,
+            "blocks": rep_a.n_blocks,
+            "wall_s": round(agg_wall, 3),
+            "compile_events_cold": agg_compiles,
+            "journal_bytes": agg_bytes,
+            "bytes_per_cell": round(agg_bytes / cells, 2),
+            "cells_per_s": round(cells / agg_wall, 2),
+        },
+        # From the SAME rounded walls the record commits (the validator
+        # recomputes the ratio from wall_s fields).
+        "speedup": round(round(rows_wall, 3) / round(agg_wall, 3), 3),
+        "bit_identity": {"columns": rep_a.n_columns, "max_abs_diff": 0.0},
+    }
+
+
 def scenario_matrix_record(n_reps=SCENARIO_REPS, n_rows=SCENARIO_ROWS):
     """``--scenario-matrix`` (ISSUE 13): the micro Monte-Carlo matrix
     (2 DGPs × 3 estimators × ``n_reps`` seeds) through the real
@@ -433,7 +547,10 @@ def scenario_matrix_record(n_reps=SCENARIO_REPS, n_rows=SCENARIO_ROWS):
       panel-folding rationale, see scenarios/batched.py) for the rest;
     * **coverage** — the calibration DGP's CI coverage per estimator,
       which the schema validator requires within binomial MC error of
-      nominal 95%.
+      nominal 95%;
+    * **streaming legs** (ISSUE 19, :func:`_streaming_legs`) — the
+      rows-vs-aggregate cells/s, journal-bytes-per-cell and
+      bit-identity contract for the device-resident streaming runner.
 
     Writes the schema-validated ``SCENARIO_MATRIX.json`` at the repo
     root (``scripts/check_metrics_schema.py SCENARIO_MATRIX.json``).
@@ -446,7 +563,10 @@ def scenario_matrix_record(n_reps=SCENARIO_REPS, n_rows=SCENARIO_ROWS):
     obs.install_jax_monitoring()
     sc.clear_executables()
     width = min(32, n_reps)
-    spec = sc.micro_matrix_spec(n_reps=n_reps, batch_width=width, n=n_rows)
+    # ISSUE 19 made streaming aggregates the default mode; these legs
+    # measure the PR 13 cell-table contract, so pin rows explicitly.
+    spec = sc.micro_matrix_spec(n_reps=n_reps, batch_width=width, n=n_rows,
+                                rows=True)
     outdir = tempfile.mkdtemp(prefix="scenario_matrix_")
     try:
         c0 = obs.compile_event_count()
@@ -510,6 +630,7 @@ def scenario_matrix_record(n_reps=SCENARIO_REPS, n_rows=SCENARIO_ROWS):
         if col.startswith("calibration:") and agg["coverage"] is not None:
             coverage[col] = agg["coverage"]
             coverage_mc_se[col] = agg["coverage_mc_se"]
+    streaming = _streaming_legs(sc)
     record = obs.bench_record(
         metric="scenario_matrix_micro",
         value=round(cells / batched_warm, 2),
@@ -556,6 +677,7 @@ def scenario_matrix_record(n_reps=SCENARIO_REPS, n_rows=SCENARIO_ROWS):
         coverage=coverage,
         coverage_nominal=0.95,
         coverage_mc_se=coverage_mc_se,
+        streaming=streaming,
     )
     out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "SCENARIO_MATRIX.json")

@@ -151,6 +151,12 @@ REQUIRED_COUNTERS = (
     "router_requests_total",
     "router_failover_total",
     "router_backend_state",
+    # Streaming aggregates + failure frontier (ISSUE 19): block commits
+    # by status (the O(blocks) journal meter) and frontier probe blocks
+    # by estimator/status — "no streaming matrix / frontier ever ran"
+    # is a recorded 0 on every instrumented run.
+    "scenario_aggregate_blocks_total",
+    "scenario_frontier_probes_total",
 )
 
 _EVENT_FIELDS = (
@@ -1024,6 +1030,17 @@ SCENARIO_COMPILES_PER_COLUMN = 60
 #: resume must schedule zero refits: a handful of eager-op events is
 #: tolerated, a recompiled column (>= ~35 events) is not.
 SCENARIO_RESUME_COMPILES_MAX = 20
+#: ISSUE 19 streaming section: the aggregate runner must beat the
+#: rows-mode wall by at least this factor at the committed bench scale
+#: (the claim the refactor was sized against, not a marketing number).
+STREAM_SPEEDUP_MIN = 2.0
+#: O(blocks) journal ceiling: one packed block record is ~400 B; the
+#: fingerprint header and report overhead ride as two extra records.
+STREAM_BLOCK_BYTES_MAX = 1024
+#: O(cells) floor for the rows-mode leg — guards against accidentally
+#: benchmarking a journal-disabled rows run (each cell record is
+#: ~330 B; anything under this means the leg did not journal per cell).
+STREAM_ROWS_BYTES_PER_CELL_MIN = 50
 
 
 def validate_scenario_matrix_record(record: dict, tol: float = 1e-9) -> list[str]:
@@ -1180,6 +1197,271 @@ def validate_scenario_matrix_record(record: dict, tol: float = 1e-9) -> list[str
                     f"scenario_matrix: coverage[{col!r}] = {c} outside "
                     f"nominal {nominal} ± 3×{se} Monte-Carlo error"
                 )
+    errors += _check_streaming_section(record.get("streaming"), tol)
+    return errors
+
+
+def _check_streaming_section(st, tol: float) -> list[str]:
+    """ISSUE 19 streaming legs of SCENARIO_MATRIX.json: the aggregate
+    runner's >= 2x cells/s claim, the O(blocks)-bytes journal claim,
+    and the exact streaming-vs-materialized-fold bit identity."""
+    errors: list[str] = []
+    if not isinstance(st, dict):
+        return ["scenario_matrix: missing streaming section (ISSUE 19)"]
+    s_cols, s_reps, s_cells = (
+        st.get("columns"), st.get("n_reps"), st.get("cells"))
+    if not (_num(s_cols) and _num(s_reps) and _num(s_cells)
+            and s_cells == s_cols * s_reps):
+        return ["scenario_matrix: streaming cell accounting does not close"]
+    legs = {}
+    for leg in ("rows_mode", "aggregate"):
+        d = st.get(leg)
+        if not isinstance(d, dict) or not all(
+            _num(d.get(k)) and d[k] > 0
+            for k in ("wall_s", "journal_bytes", "bytes_per_cell",
+                      "cells_per_s", "compile_events_cold")
+        ):
+            errors.append(f"scenario_matrix: streaming {leg} leg malformed")
+            continue
+        legs[leg] = d
+    if len(legs) != 2:
+        return errors
+    rm, ag = legs["rows_mode"], legs["aggregate"]
+    speedup = st.get("speedup")
+    if not _num(speedup) or speedup <= 0 or abs(
+        speedup - rm["wall_s"] / ag["wall_s"]
+    ) > 0.05 * speedup + tol:
+        errors.append(
+            f"scenario_matrix: streaming speedup {speedup!r} does not "
+            f"match rows {rm['wall_s']}s / aggregate {ag['wall_s']}s"
+        )
+    elif speedup < STREAM_SPEEDUP_MIN:
+        errors.append(
+            f"scenario_matrix: streaming speedup {speedup} below the "
+            f"{STREAM_SPEEDUP_MIN}x contract"
+        )
+    blocks = ag.get("blocks")
+    if not _num(blocks) or blocks < s_cols:
+        errors.append(
+            f"scenario_matrix: aggregate blocks {blocks!r} below one "
+            f"per column ({s_cols})"
+        )
+    elif ag["journal_bytes"] > (blocks + 2) * STREAM_BLOCK_BYTES_MAX:
+        errors.append(
+            f"scenario_matrix: aggregate journal {ag['journal_bytes']} B "
+            f"exceeds O(blocks) ceiling "
+            f"{(blocks + 2) * STREAM_BLOCK_BYTES_MAX} B for {blocks} "
+            "blocks — per-cell bytes leaked into the block journal"
+        )
+    if rm["bytes_per_cell"] < STREAM_ROWS_BYTES_PER_CELL_MIN:
+        errors.append(
+            f"scenario_matrix: rows-mode leg journaled only "
+            f"{rm['bytes_per_cell']} B/cell — the baseline leg must "
+            "journal per cell for the comparison to mean anything"
+        )
+    if ag["compile_events_cold"] > s_cols * SCENARIO_COMPILES_PER_COLUMN:
+        errors.append(
+            f"scenario_matrix: aggregate cold compiles "
+            f"{ag['compile_events_cold']} exceed "
+            f"{SCENARIO_COMPILES_PER_COLUMN} per column — compiles must "
+            "grow with columns, never cells"
+        )
+    bi = st.get("bit_identity")
+    if not (isinstance(bi, dict) and bi.get("columns") == s_cols
+            and bi.get("max_abs_diff") == 0):
+        errors.append(
+            "scenario_matrix: streaming bit_identity must cover every "
+            "column at exactly 0 difference (same epilogue, same "
+            f"segments); got {bi!r}"
+        )
+    return errors
+
+
+#: FAILURE_ATLAS.json schema gate (ISSUE 19) — must track
+#: scenarios/frontier.py's FRONTIER_SCHEMA_TAG.
+FAILURE_ATLAS_SCHEMA = "scenarios-frontier-v1"
+#: the committed atlas must cover a real grid: >= 2 knob axes probed by
+#: >= 2 estimators (the ISSUE 19 acceptance floor).
+FAILURE_ATLAS_MIN_AXES = 2
+FAILURE_ATLAS_MIN_ESTIMATORS = 2
+_ATLAS_VERDICTS = ("ok", "failing", "degenerate", "skipped")
+
+
+def validate_failure_atlas(atlas: dict, tol: float = 1e-9) -> list[str]:
+    """``FAILURE_ATLAS.json`` (ISSUE 19): the committed frontier-search
+    atlas. This script stays jax-free, so the checks are STRUCTURAL —
+    grid accounting closes, every coverage claim carries a positive MC
+    error band, every failing cell has a shrunk + confirmed failure
+    entry whose one-line repro pins the exact probe — and replaying a
+    repro to the same verdict is the @slow test suite's job.
+    """
+    errors: list[str] = []
+    if atlas.get("schema") != FAILURE_ATLAS_SCHEMA or \
+            atlas.get("schema_version") != 1:
+        return [
+            f"failure_atlas: schema {atlas.get('schema')!r} v"
+            f"{atlas.get('schema_version')!r} is not "
+            f"{FAILURE_ATLAS_SCHEMA!r} v1"
+        ]
+    if not isinstance(atlas.get("fingerprint"), str) or \
+            not atlas["fingerprint"].startswith(FAILURE_ATLAS_SCHEMA):
+        errors.append("failure_atlas: fingerprint missing or untagged")
+    nominal = atlas.get("nominal")
+    if not _num(nominal) or not 0 < nominal < 1:
+        errors.append(f"failure_atlas: nominal {nominal!r} not in (0, 1)")
+        return errors
+    for key in ("fail_z", "refine_z", "n_reps", "refine_reps",
+                "block_width", "seed"):
+        if not _num(atlas.get(key)):
+            errors.append(f"failure_atlas: {key} non-numeric")
+    if errors:
+        return errors
+    if atlas["refine_reps"] < atlas["n_reps"]:
+        errors.append(
+            f"failure_atlas: refine_reps {atlas['refine_reps']} below "
+            f"base n_reps {atlas['n_reps']}"
+        )
+    if not isinstance(atlas.get("baseline"), dict) or not atlas["baseline"]:
+        errors.append("failure_atlas: baseline knob vector missing")
+    estimators = atlas.get("estimators")
+    if not (isinstance(estimators, list)
+            and len(estimators) >= FAILURE_ATLAS_MIN_ESTIMATORS
+            and all(isinstance(e, str) for e in estimators)):
+        errors.append(
+            f"failure_atlas: wants >= {FAILURE_ATLAS_MIN_ESTIMATORS} "
+            f"estimators, got {estimators!r}"
+        )
+        return errors
+    axes = atlas.get("axes")
+    if not isinstance(axes, list) or len(axes) < FAILURE_ATLAS_MIN_AXES:
+        errors.append(
+            f"failure_atlas: wants >= {FAILURE_ATLAS_MIN_AXES} knob "
+            f"axes, got {len(axes) if isinstance(axes, list) else axes!r}"
+        )
+        return errors
+
+    def _key(axis_name, est, knobs):
+        return (axis_name, est, tuple(sorted(knobs.items())))
+
+    failing = set()
+    knob_grid: dict[str, dict] = {}
+    for ax in axes:
+        name = ax.get("name") if isinstance(ax, dict) else None
+        knobs = ax.get("knobs") if isinstance(ax, dict) else None
+        cells = ax.get("cells") if isinstance(ax, dict) else None
+        if not (isinstance(name, str) and isinstance(knobs, dict) and knobs
+                and isinstance(cells, list)):
+            errors.append(f"failure_atlas: axis {ax!r} malformed")
+            continue
+        knob_grid[name] = knobs
+        n_points = 1
+        for knob, values in knobs.items():
+            if not (isinstance(values, list) and values
+                    and all(_num(v) for v in values)):
+                errors.append(
+                    f"failure_atlas: axis {name!r} knob {knob!r} values "
+                    f"{values!r} malformed"
+                )
+                values = [None]
+            n_points *= len(values)
+        if len(cells) != n_points * len(estimators):
+            errors.append(
+                f"failure_atlas: axis {name!r} has {len(cells)} cells, "
+                f"wants {n_points} grid points × {len(estimators)} "
+                "estimators"
+            )
+        for cell in cells:
+            where = f"failure_atlas: axis {name!r} cell {cell.get('knobs')!r}"
+            est = cell.get("estimator")
+            if est not in estimators:
+                errors.append(f"{where} names unknown estimator {est!r}")
+            ck = cell.get("knobs")
+            if not isinstance(ck, dict) or set(ck) != set(knobs) or any(
+                ck[k] not in knobs[k] for k in ck
+            ):
+                errors.append(f"{where} off the declared grid")
+                continue
+            verdict = cell.get("verdict")
+            if verdict not in _ATLAS_VERDICTS:
+                errors.append(f"{where} verdict {verdict!r} unknown")
+            if verdict in ("ok", "failing"):
+                cov, mc = cell.get("coverage"), cell.get("mc_se")
+                if not (_num(cov) and 0 <= cov <= 1 and _num(mc)
+                        and mc > 0):
+                    errors.append(
+                        f"{where} coverage {cov!r} lacks a positive "
+                        "MC error band"
+                    )
+                elif abs(cell.get("deficit", 1e9)
+                         - (nominal - cov)) > tol:
+                    errors.append(f"{where} deficit != nominal - coverage")
+            if verdict == "failing":
+                failing.add(_key(name, est, ck))
+
+    failures = atlas.get("failures")
+    if not isinstance(failures, list):
+        return errors + ["failure_atlas: failures section missing"]
+    seen = set()
+    for f in failures:
+        est, axis = f.get("estimator"), f.get("axis")
+        knobs, minimal = f.get("knobs"), f.get("minimal_knobs")
+        where = f"failure_atlas: failure {est!r}@{knobs!r}"
+        if axis not in knob_grid or est not in estimators or \
+                not isinstance(knobs, dict):
+            errors.append(f"{where} not addressable on the grid")
+            continue
+        seen.add(_key(axis, est, knobs))
+        cov, mc, reps = f.get("coverage"), f.get("mc_se"), f.get("reps")
+        if not (_num(cov) and _num(mc) and mc > 0 and _num(reps)
+                and reps > 0):
+            errors.append(f"{where} lacks coverage/mc_se/reps")
+        elif not nominal - cov > atlas["fail_z"] * mc - tol:
+            errors.append(
+                f"{where} coverage {cov} is NOT a {atlas['fail_z']}-sigma "
+                f"deficit at mc_se {mc} — not a failure by its own record"
+            )
+        if not (isinstance(minimal, dict) and minimal
+                and set(minimal) <= set(knobs)
+                and all(minimal[k] == knobs[k] for k in minimal)):
+            errors.append(
+                f"{where} minimal_knobs {minimal!r} is not a sub-vector "
+                "of the failing knobs"
+            )
+            minimal = {}
+        if f.get("confirmed") is not True or not _num(
+            f.get("confirm_coverage")
+        ):
+            errors.append(f"{where} shrunk vector not re-confirmed")
+        repro = f.get("repro")
+        want = ["scenarios.frontier", "--repro", f"--estimator {est}",
+                f"--seed {atlas['seed']}", f"--reps {reps}"]
+        want += [f"{k}={v:g}" for k, v in (minimal or {}).items()]
+        if not isinstance(repro, str) or any(w not in repro for w in want):
+            errors.append(
+                f"{where} repro line does not pin the minimal probe "
+                f"(wants all of {want!r})"
+            )
+    if failing != seen:
+        errors.append(
+            f"failure_atlas: failing cells {sorted(failing)} and failure "
+            f"entries {sorted(seen)} disagree"
+        )
+    if not seen:
+        errors.append(
+            "failure_atlas: zero failures — the committed atlas must "
+            "chart a non-empty frontier (ISSUE 19 acceptance)"
+        )
+    probes = atlas.get("probes")
+    if not (isinstance(probes, dict) and all(
+        _num(probes.get(k)) and probes[k] > 0
+        for k in ("blocks", "cells")
+    ) and _num(probes.get("shrink_probes"))):
+        errors.append(f"failure_atlas: probes accounting {probes!r} broken")
+    elif probes["cells"] != probes["blocks"] * atlas["block_width"]:
+        errors.append(
+            f"failure_atlas: probe cells {probes['cells']} != blocks "
+            f"{probes['blocks']} × width {atlas['block_width']}"
+        )
     return errors
 
 
@@ -1654,6 +1936,7 @@ def main(argv: list[str] | None = None) -> int:
         ("PREDICT_AB", "predict_ab", validate_predict_ab_record),
         ("SCENARIO_MATRIX", "scenario_matrix",
          validate_scenario_matrix_record),
+        ("FAILURE_ATLAS", "failure_atlas", validate_failure_atlas),
         ("CHAOS_CAMPAIGN", "chaos_campaign",
          validate_chaos_campaign_record),
         ("campaign_report", "campaign", validate_campaign_report),

@@ -16,7 +16,7 @@ PY="${PYTHON:-$(command -v python || command -v python3)}"
 
 fail=0
 
-echo "== graftlint (JAX-aware rules JGL001-014 + concurrency JGL015-019) =="
+echo "== graftlint (JAX-aware rules JGL001-014, JGL020 + concurrency JGL015-019) =="
 # Content-hash result cache: warm gate runs re-lint only changed files.
 # Override the location with GRAFTLINT_CACHE; it is gitignored.
 "$PY" scripts/graftlint.py ate_replication_causalml_tpu scripts \
