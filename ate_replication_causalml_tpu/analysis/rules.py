@@ -1,8 +1,8 @@
-"""The graftlint rule set (JGL001–JGL014, JGL020).
+"""The graftlint rule set (JGL001–JGL014, JGL020–JGL021).
 
 (JGL015–JGL019 are the whole-program concurrency rules in
-``analysis/concurrency/rules.py``; JGL020 lives here because it is a
-single-module AST rule like the rest of this file.)
+``analysis/concurrency/rules.py``; JGL020 and JGL021 live here because
+they are single-module AST rules like the rest of this file.)
 
 Each rule targets a failure class that has actually bitten (or nearly
 bitten) this codebase on TPU — see ADVICE.md and the rule docstrings.
@@ -13,6 +13,7 @@ unless a ``select`` list narrows the set.
 from __future__ import annotations
 
 import ast
+import os
 import re
 from typing import Iterable, Iterator
 
@@ -1727,3 +1728,206 @@ class UnboundedCellAccumulation(Rule):
                         "into AggState sums (scenarios/aggregate.py), or "
                         "keep the accumulator local to the call",
                     )
+
+
+# ---------------------------------------------------------------- JGL021
+
+#: registry creator functions whose first positional argument is the
+#: family name. ``gauge`` is deliberately exempt: gauges are
+#: snapshot-time samples with open-ended names (per-entry-point
+#: cost_analysis, per-device memory) and no "present at zero on every
+#: run" contract.
+_FAMILY_CREATOR_ATTRS = ("counter", "histogram", "bucket_histogram")
+
+#: the one sanctioned pre-creation site, parsed from the REAL device.py
+#: that sits next to this package (the linter lints this repository;
+#: the contract is against this repository's pre-creation list).
+_PRECREATION_FUNC = "install_jax_monitoring"
+
+_precreated_cache: frozenset[str] | None = None
+
+
+def _device_py_path() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "observability",
+        "device.py",
+    )
+
+
+def _module_string_constants(tree: ast.Module) -> dict[str, str]:
+    """Module-level ``NAME = "literal"`` bindings — the indirection
+    shardio.py uses (``BYTES_FAMILY = "artifact_transfer_bytes_total"``)
+    and the only non-literal family-name form this rule resolves."""
+    out: dict[str, str] = {}
+    for node in tree.body:
+        targets: list[ast.expr] = []
+        value: ast.expr | None = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign):
+            targets, value = [node.target], node.value
+        if not (isinstance(value, ast.Constant) and isinstance(value.value, str)):
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                out[t.id] = value.value
+    return out
+
+
+def _module_string_dicts(tree: ast.Module) -> dict[str, set[str]]:
+    """Module-level dicts with literal string VALUES, by constant name —
+    device.py's ``_CACHE_EVENT_COUNTERS`` event->family maps, whose
+    ``.values()`` feed pre-creation loops."""
+    out: dict[str, set[str]] = {}
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict)):
+            continue
+        vals = {
+            v.value
+            for v in node.value.values
+            if isinstance(v, ast.Constant) and isinstance(v.value, str)
+        }
+        for t in node.targets:
+            if isinstance(t, ast.Name) and vals:
+                out[t.id] = vals
+    return out
+
+
+def _literal_strings(expr: ast.expr) -> set[str]:
+    if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+        return {
+            e.value
+            for e in expr.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        }
+    return set()
+
+
+def precreated_families() -> frozenset[str]:
+    """The family names ``install_jax_monitoring`` pre-creates, read by
+    AST from ``observability/device.py``: literal first args of creator
+    calls, plus the strings any ``for``-loop in the function iterates —
+    a literal tuple/list, or ``CONST.values()`` of a module-level
+    string-valued dict. Cached for the process; an unreadable or
+    unparsable device.py yields the empty set (the rule then stays
+    silent rather than failing the whole lint on a broken neighbor —
+    the parse error surfaces on device.py itself)."""
+    global _precreated_cache
+    if _precreated_cache is not None:
+        return _precreated_cache
+    try:
+        with open(_device_py_path(), "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError, ValueError):
+        _precreated_cache = frozenset()
+        return _precreated_cache
+    dicts = _module_string_dicts(tree)
+    names: set[str] = set()
+    for node in tree.body:
+        if not (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name == _PRECREATION_FUNC
+        ):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                func = sub.func
+                attr = (
+                    func.attr
+                    if isinstance(func, ast.Attribute)
+                    else func.id if isinstance(func, ast.Name) else None
+                )
+                if attr in _FAMILY_CREATOR_ATTRS and sub.args:
+                    arg = sub.args[0]
+                    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                        names.add(arg.value)
+            elif isinstance(sub, (ast.For, ast.AsyncFor)):
+                names |= _literal_strings(sub.iter)
+                it = sub.iter
+                if (
+                    isinstance(it, ast.Call)
+                    and isinstance(it.func, ast.Attribute)
+                    and it.func.attr == "values"
+                    and isinstance(it.func.value, ast.Name)
+                ):
+                    names |= dicts.get(it.func.value.id, set())
+    _precreated_cache = frozenset(names)
+    return _precreated_cache
+
+
+def _family_creator_kind(module: ModuleInfo, node: ast.Call) -> str | None:
+    """``'counter'`` / ``'histogram'`` / ``'bucket_histogram'`` when
+    this call mints (or fetches) a registry family, else None. Matched
+    on the resolved dotted name so every spelling in the tree counts:
+    ``obs.counter``, ``_registry.counter``, bare ``counter`` imported
+    from the registry, ``REGISTRY.bucket_histogram``. ``self.``-rooted
+    chains are skipped — an injected registry double is the test's
+    business, not the shipped contract's."""
+    name = module.resolve(node.func)
+    if name is None:
+        return None
+    parts = name.split(".")
+    if parts[-1] not in _FAMILY_CREATOR_ATTRS or parts[0] == "self":
+        return None
+    if "observability" in parts or "registry" in parts or "REGISTRY" in parts:
+        return parts[-1]
+    return None
+
+
+@register
+class MetricFamilyNotPrecreated(Rule):
+    """ISSUE 20's metrics-contract closure: ``install_jax_monitoring``
+    pre-creates every counter/histogram family at zero so "it never
+    happened" is a recorded 0 in metrics.json, not a missing key —
+    ``scripts/check_metrics_schema.py`` and every downstream consumer
+    (the fleet reconciler, the SLO engine, dashboards diffing runs)
+    key on that. A family first created at its emit site exists only
+    on runs that take that code path: the export schema then depends
+    on traffic, and a zero regresses to an absence. The fix is one
+    pre-creation line in device.py (with an identical bucket ladder
+    for bucket histograms — the registry rejects a mismatched
+    re-creation). Dynamic family names can't be cross-checked
+    statically and are skipped; route them through a closed set or a
+    pre-created prefix instead."""
+
+    id = "JGL021"
+    name = "metric-family-not-precreated"
+    description = (
+        "counter/histogram family created outside "
+        "install_jax_monitoring and missing from its pre-creation "
+        "list — the family exists only on runs that take this code "
+        "path, so the metrics.json schema depends on traffic; add the "
+        "pre-creation line in observability/device.py"
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if scopes.METRIC_FAMILY_ORIGIN.contains(module.relpath):
+            return
+        precreated = precreated_families()
+        if not precreated:
+            return  # device.py unreadable here: nothing to check against
+        consts = _module_string_constants(module.tree)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _family_creator_kind(module, node)
+            if kind is None or not node.args:
+                continue
+            arg = node.args[0]
+            family: str | None = None
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                family = arg.value
+            elif isinstance(arg, ast.Name):
+                family = consts.get(arg.id)
+            if family is None or family in precreated:
+                continue
+            yield self.finding(
+                module,
+                node,
+                f"metric family '{family}' ({kind}) is not pre-created "
+                "in install_jax_monitoring — it will be missing from "
+                "metrics.json on any run that never reaches this line; "
+                "add the pre-creation in observability/device.py (same "
+                "bucket ladder for bucket histograms)",
+            )

@@ -111,3 +111,10 @@ CONCURRENCY = Scope(
     dirs=("scheduler", "serving", "parallel", "observability", "resilience"),
     top_files=("pipeline.py",),
 )
+
+#: JGL021 exemption — where metric families ORIGINATE: the registry
+#: primitives themselves and the one sanctioned pre-creation site
+#: (``install_jax_monitoring``).
+METRIC_FAMILY_ORIGIN = Scope(
+    files=("observability/device.py", "observability/registry.py"),
+)

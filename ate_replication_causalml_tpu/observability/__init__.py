@@ -59,6 +59,8 @@ from ate_replication_causalml_tpu.observability.registry import (
     enabled,
     gauge,
     histogram,
+    parse_label_key,
+    peek_labeled,
     sanitize_label,
     set_enabled,
 )
@@ -78,7 +80,8 @@ __all__ = [
     "bench_record", "bucket_histogram", "build_trace",
     "compile_event_count", "counter",
     "emit", "enabled", "gauge", "histogram", "install_jax_monitoring",
-    "instrument_dispatch", "record_compiled_cost", "record_device_memory",
+    "instrument_dispatch", "parse_label_key", "peek_labeled",
+    "record_compiled_cost", "record_device_memory",
     "sanitize_label", "set_enabled", "span", "trace_enabled",
     "watch_cache_dir",
     "write_events_jsonl", "write_metrics_json", "write_run_artifacts",

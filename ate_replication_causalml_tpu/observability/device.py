@@ -253,6 +253,37 @@ def install_jax_monitoring() -> bool:
             "forwards retried against the next ring owner").inc(0)
     counter("router_backend_state",
             "backend rotation-membership transitions").inc(0)
+    # Fleet observability plane (ISSUE 20): the router's request-path
+    # split (direct / failover / exhausted — the router:failover SLO's
+    # denominator) and the router-observed e2e ladder the
+    # router:latency SLO burns through. "The router never forwarded" is
+    # a recorded 0 on every instrumented run.
+    counter("router_request_path_total",
+            "router forwards by direct/failover/exhausted path").inc(0)
+    bucket_histogram("router_request_seconds",
+                     "router-observed forward latency (e2e)")
+    # Remaining emit-site families, folded in when JGL021 closed the
+    # contract (ISSUE 20): every counter/histogram family minted
+    # anywhere in the tree is pre-created HERE, so metrics.json carries
+    # the same key set on every instrumented run regardless of which
+    # code paths traffic happened to reach.
+    counter("serving_batches_total",
+            "dispatched micro-batches by bucket").inc(0)
+    bucket_histogram("serving_batch_fill",
+                     "micro-batch fill ratio (real rows / bucket rows)",
+                     bounds=PAD_FRACTION_BOUNDS)
+    counter("serving_reloads_total",
+            "degraded-mode reload attempts by status").inc(0)
+    histogram("scheduler_node_seconds", "per-node execution seconds")
+    histogram("scheduler_prefetch_seconds",
+              "per-node prefetch compile seconds")
+    counter("sweep_stage_total",
+            "sweep stages by resume-vs-computed status").inc(0)
+    counter("tree_dispatch_total", "forest tree-chunk dispatches").inc(0)
+    histogram("tree_dispatch_seconds", "per-dispatch host wall-clock")
+    histogram("stage_seconds", "StageTimer stage durations")
+    counter("xla_trace_total", "jax.profiler.trace activations").inc(0)
+    counter("xprof_trace_total", "whole-run xprof captures").inc(0)
     if _installed:
         return True
     try:

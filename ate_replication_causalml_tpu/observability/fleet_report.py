@@ -1,0 +1,605 @@
+"""Merged fleet observability artifacts (PR 20 tentpole).
+
+Everything here is a PURE function of a ``RouterServer.dump_fleet``
+output directory — the router's live dump and the offline
+``scripts/fleet_report.py`` both call exactly
+:func:`write_fleet_artifacts`, which is what makes the script's
+recomputation bit-for-bit (the PR 7 discipline, fleet-wide). Three
+artifacts land beside ``fleet_manifest.json``:
+
+* ``fleet_trace.json`` — every process's trace (the router's
+  ``router/trace.json`` plus each dumped daemon's
+  ``daemon-<name>/trace.json``) re-based onto ONE wall-clock axis via
+  each trace's ``otherData.wall_anchor_unix``, with distinct pids per
+  process and ``fleet_req`` flow arrows stitching each
+  ``router_request`` span to the daemon ``serving_request`` span that
+  served the same request id — one Perfetto timeline for the whole
+  fleet, kill and failover included.
+* ``fleet_report.json`` — the request-level reconciliation: matched
+  router↔daemon span pairs, orphans on either side (a routed request
+  with no daemon-side span is a lost trace, zero of them is the
+  acceptance number for a clean kill+failover episode), the
+  per-backend distribution of the residual gap (router ``wait_s``
+  minus daemon end-to-end — the wire + framing overhead between the
+  tiers), and the router's manifest ok-counts reconciled against each
+  daemon's own ``serving_requests_total``.
+* ``fleet_stat_health.json`` — every daemon's statistical-health total
+  sketches (``stathealth.state_dict`` — integer-count, associatively
+  mergeable by construction) folded per model × channel into fleet
+  distributions, plus fleet-level ``stat_drift:*`` /
+  ``stat_calibration:*`` figures folded from the sealed-window
+  statuses.
+
+Reconciliation uses ``≤`` semantics for counter totals: the registry
+is process-global, so in-process fleets (the tier-1 rig, the chaos
+campaign's router) surface combined counters in every daemon's
+``metrics.json`` — a router can never have MORE acknowledged forwards
+than its daemons served, but the daemons may report more (their own
+clients, shared registries). Jax-free and stdlib-only, like everything
+the router imports.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from ate_replication_causalml_tpu.observability.registry import (
+    parse_label_key,
+)
+from ate_replication_causalml_tpu.observability.serving_report import (
+    index_quantile,
+)
+from ate_replication_causalml_tpu.observability.sketch import (
+    CalibrationSketch,
+    FixedBinSketch,
+)
+
+__all__ = [
+    "FLEET_REPORT_BASENAME",
+    "FLEET_STAT_HEALTH_BASENAME",
+    "FLEET_TRACE_BASENAME",
+    "FLEET_REPORT_SCHEMA_VERSION",
+    "build_fleet_report",
+    "build_fleet_stat_health",
+    "build_fleet_trace",
+    "load_fleet_dump",
+    "write_fleet_artifacts",
+]
+
+FLEET_TRACE_BASENAME = "fleet_trace.json"
+FLEET_REPORT_BASENAME = "fleet_report.json"
+FLEET_STAT_HEALTH_BASENAME = "fleet_stat_health.json"
+FLEET_REPORT_SCHEMA_VERSION = 1
+
+#: how many orphan request ids each orphan section lists verbatim (the
+#: counts are always exact).
+MAX_ORPHAN_IDS = 20
+
+
+def _read_json(path: str) -> dict | None:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def load_fleet_dump(outdir: str) -> dict:
+    """Read everything the merged artifacts derive from. Raises
+    ``ValueError`` when ``fleet_manifest.json`` is missing or unreadable
+    (not a fleet dump); every OTHER input is optional — a daemon that
+    never dumped, a disabled-tracing router — and simply absent from
+    the outputs."""
+    manifest = _read_json(os.path.join(outdir, "fleet_manifest.json"))
+    if not isinstance(manifest, dict):
+        raise ValueError(
+            f"{outdir}: no readable fleet_manifest.json — not a fleet dump"
+        )
+    router_dir = str(manifest.get("router_dir") or "router")
+    router_trace = _read_json(
+        os.path.join(outdir, router_dir, "trace.json")
+    )
+    daemons: dict[str, dict] = {}
+    backends = manifest.get("backends")
+    backends = backends if isinstance(backends, dict) else {}
+    for name in sorted(backends):
+        entry = backends[name]
+        if not (isinstance(entry, dict) and entry.get("dumped")
+                and entry.get("dir")):
+            continue
+        ddir = os.path.join(outdir, str(entry["dir"]))
+        daemons[name] = {
+            "trace": _read_json(os.path.join(ddir, "trace.json")),
+            "metrics": _read_json(os.path.join(ddir, "metrics.json")),
+            "stat_health": _read_json(
+                os.path.join(ddir, "stat_health.json")
+            ),
+        }
+    return {
+        "manifest": manifest,
+        "router_trace": router_trace,
+        "daemons": daemons,
+    }
+
+
+# ── fleet_trace.json — one wall-clock axis, flow-stitched ────────────
+
+
+def _anchor(trace: dict | None) -> float | None:
+    if not isinstance(trace, dict):
+        return None
+    a = (trace.get("otherData") or {}).get("wall_anchor_unix")
+    return float(a) if isinstance(a, (int, float)) else None
+
+
+def _spans(trace: dict | None, name: str) -> list[dict]:
+    """Complete (ph X) spans named ``name`` carrying a request id."""
+    if not isinstance(trace, dict):
+        return []
+    out = []
+    for ev in trace.get("traceEvents") or []:
+        if (isinstance(ev, dict) and ev.get("ph") == "X"
+                and ev.get("name") == name
+                and (ev.get("args") or {}).get("request_id")):
+            out.append(ev)
+    return out
+
+
+def build_fleet_trace(dump: dict) -> dict:
+    """Merge the per-process traces onto one wall-clock axis.
+
+    Each process keeps its own monotonic-derived ``ts`` values,
+    shifted by ``(wall_anchor_unix − min wall_anchor_unix) · 1e6`` —
+    the anchors were stamped from the same wall clock, so after the
+    shift "simultaneous" means simultaneous across processes to
+    wall-clock sync precision. Pids are reassigned (router first, then
+    daemons sorted) and each process's ``process_name`` metadata is
+    rewritten to its fleet role so the Perfetto track groups read
+    ``router`` / ``daemon-<name>``."""
+    procs: list[tuple[str, dict]] = []
+    if isinstance(dump.get("router_trace"), dict):
+        procs.append(("router", dump["router_trace"]))
+    for name in sorted(dump.get("daemons") or {}):
+        trace = dump["daemons"][name].get("trace")
+        if isinstance(trace, dict):
+            procs.append((f"daemon-{name}", trace))
+    anchors = {pname: _anchor(trace) for pname, trace in procs}
+    known = [a for a in anchors.values() if a is not None]
+    origin = min(known) if known else 0.0
+
+    events: list[dict] = []
+    processes: dict[str, dict] = {}
+    pid_of: dict[str, int] = {}
+    for pid, (pname, trace) in enumerate(procs, start=1):
+        pid_of[pname] = pid
+        anchor = anchors[pname]
+        shift_us = 0.0 if anchor is None else (anchor - origin) * 1e6
+        saw_process_name = False
+        for ev in trace.get("traceEvents") or []:
+            if not isinstance(ev, dict):
+                continue
+            ev2 = dict(ev)
+            ev2["pid"] = pid
+            if isinstance(ev2.get("ts"), (int, float)):
+                ev2["ts"] = round(float(ev2["ts"]) + shift_us, 3)
+            if ev2.get("ph") == "M" and ev2.get("name") == "process_name":
+                ev2["args"] = {"name": pname}
+                saw_process_name = True
+            events.append(ev2)
+        if not saw_process_name:
+            events.append({"ph": "M", "pid": pid, "tid": 0,
+                           "name": "process_name",
+                           "args": {"name": pname}})
+        processes[pname] = {
+            "pid": pid,
+            "wall_anchor_unix": anchor,
+            "events": sum(1 for e in trace.get("traceEvents") or []
+                          if isinstance(e, dict)),
+        }
+
+    # Flow arrows: router_request → serving_request on request id. One
+    # s/f pair per router×daemon span match, each under its own flow
+    # id so retried ids (failover that reached two daemons) stay
+    # unambiguous.
+    router_trace = dump.get("router_trace")
+    router_shift = (
+        0.0 if _anchor(router_trace) is None
+        else (_anchor(router_trace) - origin) * 1e6
+    )
+    daemon_spans: dict[str, list[tuple[str, dict, float]]] = {}
+    for pname, trace in procs:
+        if pname == "router":
+            continue
+        shift_us = (
+            0.0 if anchors[pname] is None
+            else (anchors[pname] - origin) * 1e6
+        )
+        for ev in _spans(trace, "serving_request"):
+            rid = str(ev["args"]["request_id"])
+            daemon_spans.setdefault(rid, []).append((pname, ev, shift_us))
+    for ev in _spans(router_trace, "router_request"):
+        rid = str(ev["args"]["request_id"])
+        for k, (pname, dev, shift_us) in enumerate(
+            daemon_spans.get(rid, ())
+        ):
+            flow_id = f"fleet:{rid}" if k == 0 else f"fleet:{rid}/{k}"
+            events.append({
+                "ph": "s", "cat": "fleet_req", "id": flow_id,
+                "name": "fleet_request", "pid": pid_of["router"],
+                "tid": ev.get("tid", 0),
+                "ts": round(float(ev.get("ts", 0.0)) + router_shift, 3),
+            })
+            events.append({
+                "ph": "f", "bp": "e", "cat": "fleet_req", "id": flow_id,
+                "name": "fleet_request", "pid": pid_of[pname],
+                "tid": dev.get("tid", 0),
+                "ts": round(float(dev.get("ts", 0.0)) + shift_us, 3),
+            })
+
+    return {
+        "traceEvents": events,
+        "otherData": {
+            "trace_schema_version": 1,
+            "kind": "fleet_trace",
+            "clock": "wall-rebased",
+            "time_unit": "us",
+            "wall_anchor_unix": origin if known else None,
+            "processes": processes,
+        },
+    }
+
+
+# ── fleet_report.json — request reconciliation ───────────────────────
+
+
+def _round9(v: float) -> float:
+    return round(float(v), 9)
+
+
+def _gap_stats(vals: list[float]) -> dict:
+    s = sorted(vals)
+    return {
+        "count": len(s),
+        "min_s": _round9(s[0]),
+        "p50_s": _round9(index_quantile(s, 0.50)),
+        "p99_s": _round9(index_quantile(s, 0.99)),
+        "max_s": _round9(s[-1]),
+    }
+
+
+def _daemon_ok_count(metrics: dict | None) -> int | None:
+    """``serving_requests_total{status=ok}`` summed over every other
+    label, via the registry's ONE canonical label-key parser."""
+    if not isinstance(metrics, dict):
+        return None
+    fam = (metrics.get("counters") or {}).get("serving_requests_total")
+    if not isinstance(fam, dict):
+        return 0
+    total = 0
+    for key, v in fam.items():
+        if parse_label_key(str(key)).get("status") == "ok":
+            total += int(v)
+    return total
+
+
+def build_fleet_report(dump: dict) -> dict:
+    """Cross-process request reconciliation, pure from the dump."""
+    manifest = dump["manifest"]
+    daemons = dump.get("daemons") or {}
+    router_spans = _spans(dump.get("router_trace"), "router_request")
+
+    daemon_span_ids: dict[str, set[str]] = {}
+    daemon_spans_by_rid: dict[str, list[tuple[str, dict]]] = {}
+    for name in sorted(daemons):
+        ids = set()
+        for ev in _spans(daemons[name].get("trace"), "serving_request"):
+            rid = str(ev["args"]["request_id"])
+            ids.add(rid)
+            daemon_spans_by_rid.setdefault(rid, []).append((name, ev))
+        daemon_span_ids[name] = ids
+
+    dumped = set(daemon_span_ids)
+    matched = 0
+    routed_to_undumped = 0
+    orphan_router: list[str] = []
+    matched_router_rids: set[str] = set()
+    gaps: dict[str, list[float]] = {}
+    for ev in router_spans:
+        args = ev.get("args") or {}
+        backend = str(args.get("backend", "-"))
+        rid = str(args.get("request_id"))
+        if backend == "-" or str(args.get("outcome")) not in (
+            "ok", "reject", "error"
+        ):
+            continue  # never reached a daemon — nothing to match
+        if backend not in dumped:
+            routed_to_undumped += 1
+            continue
+        if rid in daemon_span_ids[backend]:
+            matched += 1
+            matched_router_rids.add(rid)
+            wait_s = args.get("wait_s")
+            dev = next(
+                e for n, e in daemon_spans_by_rid[rid] if n == backend
+            )
+            if isinstance(wait_s, (int, float)):
+                gaps.setdefault(backend, []).append(
+                    float(wait_s) - float(dev.get("dur", 0.0)) / 1e6
+                )
+        else:
+            orphan_router.append(rid)
+    orphan_daemon = sorted(
+        rid for rid in daemon_spans_by_rid
+        if rid not in matched_router_rids
+        and rid not in {
+            str((e.get("args") or {}).get("request_id"))
+            for e in router_spans
+        }
+    )
+
+    # ── counter reconciliation (≤ semantics, see module docstring) ───
+    router_req = (manifest.get("router") or {}).get("requests") or {}
+    router_ok = {
+        b: int((router_req.get(b) or {}).get("ok", 0))
+        for b in sorted(daemons)
+    }
+    daemon_ok = {
+        b: _daemon_ok_count(daemons[b].get("metrics"))
+        for b in sorted(daemons)
+    }
+    router_ok_total = sum(router_ok.values())
+    daemon_ok_known = [v for v in daemon_ok.values() if v is not None]
+    daemon_ok_total = sum(daemon_ok_known) if daemon_ok_known else None
+    trace_router_ok: dict[str, int] = {}
+    for ev in router_spans:
+        args = ev.get("args") or {}
+        if str(args.get("outcome")) == "ok":
+            b = str(args.get("backend", "-"))
+            trace_router_ok[b] = trace_router_ok.get(b, 0) + 1
+    manifest_ok_all = {
+        b: int((router_req.get(b) or {}).get("ok", 0)) for b in router_req
+    }
+    # The trace is born-filtered per router; the counters are process-
+    # cumulative — the trace can never show MORE oks than the manifest.
+    trace_consistent = all(
+        n <= manifest_ok_all.get(b, 0)
+        for b, n in trace_router_ok.items()
+    )
+    consistent = (
+        daemon_ok_total is None or router_ok_total <= daemon_ok_total
+    ) and trace_consistent
+
+    return {
+        "schema_version": FLEET_REPORT_SCHEMA_VERSION,
+        "kind": "fleet_report",
+        "processes": {
+            "router": {
+                "present": dump.get("router_trace") is not None,
+                "wall_anchor_unix": _anchor(dump.get("router_trace")),
+                "spans": len(router_spans),
+            },
+            "daemons": {
+                name: {
+                    "wall_anchor_unix": _anchor(
+                        daemons[name].get("trace")
+                    ),
+                    "spans": len(daemon_span_ids[name]),
+                }
+                for name in sorted(daemons)
+            },
+        },
+        "requests": {
+            "router_spans": len(router_spans),
+            "daemon_spans": sum(
+                len(v) for v in daemon_span_ids.values()
+            ),
+            "matched": matched,
+            "routed_to_undumped": routed_to_undumped,
+            "orphan_router": len(orphan_router),
+            "orphan_router_ids": sorted(orphan_router)[:MAX_ORPHAN_IDS],
+            "orphan_daemon": len(orphan_daemon),
+            "orphan_daemon_ids": orphan_daemon[:MAX_ORPHAN_IDS],
+        },
+        "residual_gap": {
+            b: _gap_stats(vals) for b, vals in sorted(gaps.items())
+        },
+        "reconciliation": {
+            "router_ok": router_ok,
+            "daemon_ok": daemon_ok,
+            "router_ok_total": router_ok_total,
+            "daemon_ok_total": daemon_ok_total,
+            "trace_router_ok": {
+                b: trace_router_ok[b] for b in sorted(trace_router_ok)
+            },
+            "consistent": bool(consistent),
+        },
+    }
+
+
+# ── fleet_stat_health.json — folded sketches + fleet drift SLOs ──────
+
+
+def _merge_sketches(dicts: list[dict], cls):
+    merged = None
+    for d in dicts:
+        sk = cls.from_dict(d)
+        merged = sk if merged is None else merged.merge(sk)
+    return merged
+
+
+def _fold_statuses(states: list[dict], model: str, channel: str) -> dict:
+    """Sum sealed-window statuses for one model×channel across
+    daemons — the fleet-level numerators/denominators the
+    ``stat_drift:*`` figures burn from."""
+    counts = {"ok": 0, "drift": 0, "sparse": 0, "miscal": 0}
+    for st in states:
+        ms = (st.get("models") or {}).get(model) or {}
+        if channel == "calibration":
+            series = (ms.get("calibration") or {}).get("series") or []
+        else:
+            series = (
+                (ms.get("channels") or {}).get(channel) or {}
+            ).get("series") or []
+        for e in series:
+            s = str(e.get("status"))
+            if s in counts:
+                counts[s] += 1
+    return counts
+
+
+def build_fleet_stat_health(dump: dict, objective: float = 0.9) -> dict:
+    """Fold every dumped daemon's stat-health raw state into fleet
+    distributions (exact integer merges — the sketches are built for
+    this) and fleet ``stat_drift:*`` / ``stat_calibration:*`` figures.
+    ``objective`` mirrors ``slo.stat_health_slos``'s default."""
+    daemons = dump.get("daemons") or {}
+    states: dict[str, dict] = {}
+    for name in sorted(daemons):
+        rep = daemons[name].get("stat_health")
+        if isinstance(rep, dict) and isinstance(rep.get("state"), dict):
+            states[name] = rep["state"]
+    models_all = sorted({
+        m for st in states.values() for m in (st.get("models") or {})
+    })
+
+    models_out: dict[str, dict] = {}
+    slo_out: dict[str, dict] = {}
+    for m in models_all:
+        per_model = [st for st in states.values()
+                     if m in (st.get("models") or {})]
+        chans: dict[str, dict] = {}
+        channel_names = sorted({
+            ch for st in per_model
+            for ch in (st["models"][m].get("channels") or {})
+        })
+        for ch in channel_names:
+            totals = [
+                st["models"][m]["channels"][ch]["total"]
+                for st in per_model
+                if ch in (st["models"][m].get("channels") or {})
+            ]
+            try:
+                merged = _merge_sketches(totals, FixedBinSketch)
+            except ValueError:
+                chans[ch] = {"error": "incompatible_sketches"}
+                continue
+            folded = _fold_statuses(per_model, m, ch)
+            chans[ch] = {
+                "count": merged.total() if merged else 0,
+                "underflow": merged.underflow if merged else 0,
+                "overflow": merged.overflow if merged else 0,
+                "nan": merged.nan if merged else 0,
+                "p50": (None if merged is None
+                        else _round9_or_none(merged.quantile(0.5))),
+                "p90": (None if merged is None
+                        else _round9_or_none(merged.quantile(0.9))),
+                "windows_ok": folded["ok"],
+                "windows_drift": folded["drift"],
+                "windows_sparse": folded["sparse"],
+            }
+        cal_totals = [
+            st["models"][m]["calibration"]["total"]
+            for st in per_model
+            if isinstance(st["models"][m].get("calibration"), dict)
+        ]
+        try:
+            cal_merged = _merge_sketches(cal_totals, CalibrationSketch)
+        except ValueError:
+            cal_merged = None
+        cal_folded = _fold_statuses(per_model, m, "calibration")
+        cal = {
+            "enabled": any(
+                bool((st["models"][m].get("calibration") or {})
+                     .get("enabled"))
+                for st in per_model
+            ),
+            "count": cal_merged.total() if cal_merged else 0,
+            "error": (None if cal_merged is None
+                      else _round9_or_none(
+                          cal_merged.calibration_error())),
+            "windows_ok": cal_folded["ok"],
+            "windows_miscal": cal_folded["miscal"],
+            "windows_sparse": cal_folded["sparse"],
+        }
+        models_out[m] = {
+            "rows": sum(
+                int(st["models"][m].get("rows", 0)) for st in per_model
+            ),
+            "channels": chans,
+            "calibration": cal,
+        }
+
+        # Fleet drift figures: sparse windows excluded outright (the
+        # stat_health_slos contract — thin evidence neither spends nor
+        # banks budget).
+        drift_good = sum(
+            chans[ch].get("windows_ok", 0) for ch in chans
+        )
+        drift_total = drift_good + sum(
+            chans[ch].get("windows_drift", 0) for ch in chans
+        )
+        slo_out[f"stat_drift:{m}"] = _slo_figure(
+            drift_good, drift_total, objective
+        )
+        cal_total = cal["windows_ok"] + cal["windows_miscal"]
+        slo_out[f"stat_calibration:{m}"] = _slo_figure(
+            cal["windows_ok"], cal_total, objective
+        )
+
+    return {
+        "schema_version": FLEET_REPORT_SCHEMA_VERSION,
+        "kind": "fleet_stat_health",
+        "daemons": sorted(states),
+        "models": models_out,
+        "slo": slo_out,
+    }
+
+
+def _round9_or_none(v):
+    return None if v is None else round(float(v), 9)
+
+
+def _slo_figure(good: int, total: int, objective: float) -> dict:
+    ratio = None if total == 0 else _round9(good / total)
+    return {
+        "objective": objective,
+        "good": good,
+        "total": total,
+        "ratio": ratio,
+        "burning": bool(total and good / total < objective),
+    }
+
+
+# ── THE one write recipe (live dump == offline script, byte for byte) ─
+
+
+def write_fleet_artifacts(outdir: str) -> list[str]:
+    """Build and atomically write the merged triple from the on-disk
+    dump. Returns the paths written. The router's live ``dump_fleet``
+    and the offline ``scripts/fleet_report.py`` both end here — same
+    inputs, same pure builders, same compact-separator JSON recipe —
+    so recomputing over a committed dump reproduces the committed
+    artifacts bit-for-bit."""
+    from ate_replication_causalml_tpu.observability.export import (
+        atomic_write_json,
+        atomic_write_text,
+    )
+
+    dump = load_fleet_dump(outdir)
+    paths = []
+    trace = build_fleet_trace(dump)
+    tpath = os.path.join(outdir, FLEET_TRACE_BASENAME)
+    # The per-process traces use the compact trace recipe; the merged
+    # one matches (machine-read, compared byte-for-byte by tests).
+    atomic_write_text(
+        tpath, json.dumps(trace, separators=(",", ":")) + "\n"
+    )
+    paths.append(tpath)
+    rpath = os.path.join(outdir, FLEET_REPORT_BASENAME)
+    atomic_write_json(rpath, build_fleet_report(dump))
+    paths.append(rpath)
+    spath = os.path.join(outdir, FLEET_STAT_HEALTH_BASENAME)
+    atomic_write_json(spath, build_fleet_stat_health(dump))
+    paths.append(spath)
+    return paths

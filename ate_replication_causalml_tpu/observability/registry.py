@@ -69,6 +69,21 @@ def _label_key(labels: dict) -> str:
     return ",".join(f"{k}={clean(labels[k])}" for k in sorted(labels))
 
 
+def parse_label_key(key: str) -> dict[str, str]:
+    """Inverse of :func:`_label_key` for keys the registry itself built:
+    ``"backend=b0,outcome=ok"`` → ``{"backend": "b0", "outcome": "ok"}``
+    (the empty key → ``{}``). Values were sanitized at write time, so a
+    ``split("=", 1)`` per pair is exact — this is THE one parser every
+    reader of canonical label keys (the router's request table, the
+    admin ``/varz`` body, the fleet reconciliation) must share instead
+    of hand-rolling the split."""
+    if not key:
+        return {}
+    return dict(
+        pair.split("=", 1) for pair in key.split(",") if "=" in pair
+    )
+
+
 class Counter:
     """Monotonically increasing per-label-set float counter."""
 
@@ -361,6 +376,20 @@ class MetricsRegistry:
                 return {k: float(v["sum"]) for k, v in m.samples.items()}
             return dict(m.samples)
 
+    def peek_labeled(
+        self, name: str
+    ) -> list[tuple[dict[str, str], float]] | None:
+        """:meth:`peek` with every canonical label key parsed back into
+        its label dict: sorted ``[(labels, value), ...]`` (or None when
+        the family was never created). Same cheapness contract as peek —
+        no collector hooks run."""
+        samples = self.peek(name)
+        if samples is None:
+            return None
+        return [
+            (parse_label_key(k), v) for k, v in sorted(samples.items())
+        ]
+
     def snapshot(self) -> dict:
         """Versioned plain-dict snapshot (the metrics.json payload)."""
         for fn in list(self._collectors):
@@ -423,3 +452,7 @@ def bucket_histogram(
     name: str, help: str = "", bounds: Sequence[float] | None = None
 ) -> BucketHistogram:
     return REGISTRY.bucket_histogram(name, help, bounds=bounds)
+
+
+def peek_labeled(name: str) -> list[tuple[dict[str, str], float]] | None:
+    return REGISTRY.peek_labeled(name)

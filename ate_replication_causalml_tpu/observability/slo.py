@@ -194,6 +194,41 @@ def stat_health_slos(
     return tuple(out)
 
 
+def router_slos(
+    latency_threshold_s: float = 0.25,
+    windows_s: tuple[float, ...] = DEFAULT_WINDOWS,
+) -> tuple[SLO, ...]:
+    """The fleet router's objectives (ISSUE 20) — the router tier is
+    the front door, so its budget is spent on what CLIENTS experience:
+
+    * ``router:availability`` — 99.9% of forwards reach a terminal
+      ``ok``. Daemon-typed rejects (``outcome=reject`` — shed /
+      bad_request / deadline, the daemon's 4xx convention) are the
+      caller's or the *daemon's* budget, not the router's, so they are
+      excluded outright; connection errors, protocol errors and
+      capacity exhaustion (``unavailable``) DO spend it.
+    * ``router:latency`` — 99% of forwards complete under the
+      threshold, measured over the router-observed e2e bucket
+      histogram (``router_request_seconds``).
+    * ``router:failover`` — 99% of forwards land on the first ring
+      owner (``path=direct``); a burning failover SLO means a backend
+      is flapping even while availability still holds — the early
+      warning the breaker state alone does not give.
+    """
+    return (
+        SLO(name="router:availability", kind="availability",
+            objective=0.999, metric="router_requests_total",
+            windows_s=windows_s, good_match="outcome=ok",
+            ignore_match="outcome=reject"),
+        SLO(name="router:latency", kind="latency", objective=0.99,
+            metric="router_request_seconds", windows_s=windows_s,
+            threshold_s=latency_threshold_s),
+        SLO(name="router:failover", kind="availability", objective=0.99,
+            metric="router_request_path_total", windows_s=windows_s,
+            good_match="path=direct"),
+    )
+
+
 def _pairs(spec: str) -> tuple[str, ...]:
     return tuple(p for p in spec.split(",") if p)
 

@@ -65,6 +65,10 @@ _CATEGORIES = {
     "prefetch_compile": "prefetch",
     "serving_request": "request",
     "serving_batch": "batch",
+    # The fleet router's forward spans (ISSUE 20):
+    # observability/fleet_report.py stitches these to the daemons'
+    # ``request`` slices on request id across process boundaries.
+    "router_request": "router",
 }
 
 _PID = 1
@@ -104,6 +108,11 @@ def _track_of(rec: dict) -> tuple[str, str]:
         return ("conn", str(name))
     if rec.get("name") == "serving_batch":
         return ("dispatch", str(name))
+    # Router forward spans (ISSUE 20) render one track per router
+    # connection thread, beside the daemons' conn tracks — the fleet
+    # merge then shows request → forward → serve as adjacent rows.
+    if rec.get("name") == "router_request":
+        return ("conn", str(name))
     return ("worker", str(name))
 
 

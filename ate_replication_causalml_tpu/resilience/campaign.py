@@ -766,6 +766,22 @@ def _run_fleet_workload(outdir: str, seed: int, scale: CampaignScale):
                     rotation = supervisor.rotate_all(
                         ckpt_v2, model="default", timeout_s=120.0,
                     )
+                # The kill must be fully OBSERVED before the dump: the
+                # replay normally trips the victim's breaker through
+                # organic failover hops, but a probe tick can race the
+                # victim out of the candidate list first — drive any
+                # remaining failures over the ops channel so the merged
+                # timeline always carries the breaker-open instant.
+                for victim in victims:
+                    for _ in range(router.config.failure_threshold):
+                        state = router.stats()["backends"][victim][
+                            "breaker"]
+                        if state == "open":
+                            break
+                        try:
+                            router.call_backend(victim, {"op": "stats"})
+                        except Exception:  # noqa: BLE001 — dead by plan
+                            pass
                 router.dump_fleet(os.path.join(outdir, "fleet_dump"))
                 client_retries = dict(client.retry_counts)
             finally:

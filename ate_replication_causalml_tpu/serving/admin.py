@@ -43,15 +43,21 @@ ROUTES = ("/metrics", "/healthz", "/readyz", "/varz")
 
 
 def varz_payload(registry: _registry.MetricsRegistry | None = None) -> dict:
-    """Every family's ``peek()`` view: ``{family: {label_key: value}}``.
+    """Every family's ``peek_labeled()`` view:
+    ``{family: [{"labels": {...}, "value": v}, ...]}`` — self-describing
+    JSON, parsed by the registry's ONE canonical label-key parser
+    (``registry.parse_label_key``) instead of a hand-rolled split here.
     Cheap by contract — peek is a dict copy under the registry lock,
     never a collector scan."""
     reg = registry if registry is not None else _registry.REGISTRY
     out: dict = {}
     for m in reg.metrics():
-        samples = reg.peek(m.name)
+        samples = reg.peek_labeled(m.name)
         if samples:
-            out[m.name] = samples
+            out[m.name] = [
+                {"labels": labels, "value": value}
+                for labels, value in samples
+            ]
     return out
 
 
@@ -132,7 +138,10 @@ class AdminRequestHandler(BaseHTTPRequestHandler):
                 # The admin lane's own liveness stamp (ISSUE 14): a
                 # probe that answers IS a heartbeat.
                 hb.beat("admin")
-            code, ctype, body = handle_admin_path(
+            handler = getattr(
+                self.server, "path_handler", handle_admin_path
+            )
+            code, ctype, body = handler(
                 self.server.cate_server, self.path.split("?", 1)[0]
             )
         except Exception as e:  # noqa: BLE001 — a probe must answer
@@ -151,11 +160,20 @@ class AdminRequestHandler(BaseHTTPRequestHandler):
 
 
 class AdminServer:
-    """Owns the admin HTTP listener's lifetime beside a daemon."""
+    """Owns the admin HTTP listener's lifetime beside a daemon.
 
-    def __init__(self, cate_server, host: str = "127.0.0.1"):
+    ``handler`` swaps the transport-free path resolver — the daemon
+    keeps the default :func:`handle_admin_path`; the fleet router
+    passes its own (``serving/router.py handle_router_admin_path``) so
+    both admin planes share ONE HTTP shell (GET-only, 500-never-kill,
+    silent logs) instead of two copies of it."""
+
+    def __init__(self, cate_server, host: str = "127.0.0.1",
+                 handler=handle_admin_path, thread_name: str = "serving-admin"):
         self._cate_server = cate_server
         self._host = host
+        self._handler = handler
+        self._thread_name = thread_name
         self._lock = threading.Lock()
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
@@ -172,9 +190,11 @@ class AdminServer:
             )
             httpd.daemon_threads = True
             httpd.cate_server = self._cate_server
+            httpd.path_handler = self._handler
             self._httpd = httpd
             t = threading.Thread(
-                target=httpd.serve_forever, name="serving-admin", daemon=True
+                target=httpd.serve_forever, name=self._thread_name,
+                daemon=True,
             )
             self._thread = t
         t.start()

@@ -61,7 +61,32 @@ so "the router never ran" is a recorded 0):
   owner after a connection-level failure;
 * ``router_backend_state{backend,state}`` — rotation-membership
   transitions (``admitted`` / ``evicted`` / ``cordoned`` /
-  ``uncordoned``), so a flapping daemon is visible as a counter slope.
+  ``uncordoned``), so a flapping daemon is visible as a counter slope;
+* ``router_request_path_total{path}`` — one bump per *request* (not
+  attempt): ``direct`` / ``failover`` / ``exhausted``;
+* ``router_request_seconds{outcome}`` — bucket histogram of the
+  router-observed end-to-end forward latency, feeding the
+  ``router:latency`` SLO.
+
+**Request telemetry (PR 20).** Every forward is a ``router_request``
+span carrying backend, failover hop count, outcome and a four-phase
+latency split — ``connect_s`` (candidate scan + connection acquire),
+``send_s`` (request frame on the wire), ``wait_s`` (backend think
+time until the first reply byte) and ``reply_s`` (reply read +
+bookkeeping). The phases are contiguous ``perf_counter`` intervals
+accumulated across failover hops, so their sum telescopes to the
+span's ``e2e_s`` within float rounding (the PR 7 ±1 µs discipline —
+a checked number, pinned by a tier-1 test). Probe ticks, breaker
+flips and rotation-membership transitions are instants on dedicated
+``router-probe`` / ``router-breaker`` / ``router-backend`` tracks.
+Router SLOs (``observability/slo.py router_slos``) burn from the
+request counters; :func:`handle_router_admin_path` serves them on a
+GET-only admin plane (``/metrics`` ``/healthz`` ``/readyz``
+``/fleetz``) through the SAME HTTP shell the daemon admin uses
+(``serving/admin.py AdminServer(handler=...)``). ``dump_fleet`` also
+exports the router's own trace + SLO report into ``outdir/router/``
+and stitches the merged fleet artifacts
+(``observability/fleet_report.py``).
 """
 
 from __future__ import annotations
@@ -78,6 +103,7 @@ import time
 from typing import Callable, Sequence
 
 from ate_replication_causalml_tpu import observability as obs
+from ate_replication_causalml_tpu.observability import slo as _slo
 from ate_replication_causalml_tpu.serving import protocol
 
 __all__ = [
@@ -85,8 +111,10 @@ __all__ = [
     "CircuitBreaker",
     "ConsistentHashRing",
     "FleetSupervisor",
+    "ROUTER_ADMIN_ROUTES",
     "RouterConfig",
     "RouterServer",
+    "handle_router_admin_path",
     "parse_backend_specs",
 ]
 
@@ -399,9 +427,18 @@ class HealthProber:
         self._thread: threading.Thread | None = None
 
     def probe_once(self) -> None:
+        ready_n = 0
         for spec in self._router.config.backends:
             ready, alive, models = probe_backend(spec, self._timeout_s)
+            ready_n += 1 if (ready and alive) else 0
             self._router.update_health(spec.name, ready, alive, models)
+        # One instant per probe round on its dedicated track (bounded
+        # volume — per-backend outcomes are already counter slopes),
+        # and an SLO clock tick so burn windows advance while idle.
+        obs.emit("router_probe", status="ok", track="router-probe",
+                 backends=len(self._router.config.backends),
+                 ready=ready_n)
+        self._router.slo.tick()
 
     def start(self) -> None:
         if self._thread is not None:
@@ -438,17 +475,35 @@ class _BackendConn:
         self.sock.settimeout(io_timeout_s)
         self.rw = self.sock.makefile("rwb")
 
-    def roundtrip(self, header: dict, arrays: dict):
-        """Forward one frame and read the reply; a clean server close
-        mid-request surfaces as :class:`protocol.ProtocolError` (the
-        caller treats every transport failure identically)."""
+    def send(self, header: dict, arrays: dict) -> None:
+        """Put one request frame on the wire (the ``send`` phase)."""
         protocol.write_frame(self.rw, header, arrays)
+
+    def wait_reply(self) -> None:
+        """Block until the first reply byte is buffered (the ``wait``
+        phase — backend think time). ``peek`` never consumes, so the
+        subsequent :meth:`read_reply` sees the full frame; EOF here is
+        silent and surfaces as the read's ProtocolError."""
+        self.rw.peek(1)
+
+    def read_reply(self):
+        """Read the buffered reply frame (the ``reply`` phase); a clean
+        server close mid-request surfaces as
+        :class:`protocol.ProtocolError` (the caller treats every
+        transport failure identically)."""
         frame = protocol.read_frame(self.rw)
         if frame is None:
             raise protocol.ProtocolError(
                 "backend closed the connection before replying"
             )
         return frame
+
+    def roundtrip(self, header: dict, arrays: dict):
+        """Forward one frame and read the reply — the un-phased
+        convenience the direct (non-routed) channel uses."""
+        self.send(header, arrays)
+        self.wait_reply()
+        return self.read_reply()
 
     def close(self) -> None:
         for closer in (self.rw.close, self.sock.close):
@@ -505,6 +560,10 @@ class RouterServer:
             self, config.probe_interval_s, config.probe_timeout_s
         )
         self._stopped = False
+        # Trace filter for dump_fleet: only records born after this
+        # router exist in ITS dump (the event ring is process-global
+        # and in-process tests run several routers per process).
+        self._born_mono = time.monotonic()
         self._requests = obs.counter(
             "router_requests_total",
             "router forward attempts by backend and outcome",
@@ -517,6 +576,30 @@ class RouterServer:
             "router_backend_state",
             "backend rotation-membership transitions",
         )
+        self._paths = obs.counter(
+            "router_request_path_total",
+            "router forwards by direct/failover/exhausted path",
+        )
+        self._latency = obs.bucket_histogram(
+            "router_request_seconds",
+            "router-observed forward latency (e2e)",
+        )
+        # Born-relative counter baselines, same motive as _born_mono:
+        # the registry is process-global, so the totals this router
+        # PUBLISHES (stats, manifest) must subtract whatever earlier
+        # routers in the process already metered — the campaign runs a
+        # reference episode before the chaos one, and its manifest
+        # must not inherit the reference's traffic.
+        self._req_baseline = dict(
+            obs.REGISTRY.peek("router_requests_total") or {}
+        )
+        self._fo_baseline = dict(
+            obs.REGISTRY.peek("router_failover_total") or {}
+        )
+        #: last-published breaker state per backend; flips become
+        #: instants on the dedicated ``router-breaker`` track.
+        self._breaker_seen = {name: "closed" for name in self._backends}
+        self.slo = _slo.SLOEngine(_slo.router_slos(), clock=clock)
 
     # ── membership ───────────────────────────────────────────────────
 
@@ -539,7 +622,7 @@ class RouterServer:
             state = "admitted" if now else "evicted"
             self._transitions.inc(1, backend=name, state=state)
             obs.emit("router_backend_state", status="ok", backend=name,
-                     state=state)
+                     state=state, track="router-backend")
 
     def set_cordon(self, name: str, cordoned: bool) -> None:
         """Administrative out-of-rotation (the rolling-rotation drain):
@@ -552,7 +635,7 @@ class RouterServer:
         state = "cordoned" if cordoned else "uncordoned"
         self._transitions.inc(1, backend=name, state=state)
         obs.emit("router_backend_state", status="ok", backend=name,
-                 state=state)
+                 state=state, track="router-backend")
 
     def in_rotation(self) -> tuple[str, ...]:
         with self._lock:
@@ -590,12 +673,38 @@ class RouterServer:
             with self._lock:
                 ok = self._backends[name].in_rotation()
             if ok and self._backends[name].breaker.allow():
+                # allow() is where open → half_open happens (the trial
+                # release); publish the flip instant from here.
+                self._note_breaker(name)
                 out.append(name)
                 if len(out) > self.config.failover_hops:
                     break
         return out
 
     # ── forwarding ───────────────────────────────────────────────────
+
+    def _note_breaker(self, name: str) -> None:
+        """Publish a breaker state flip as an instant on the dedicated
+        ``router-breaker`` track. Deduplicated against the last
+        published state so steady-state traffic emits nothing."""
+        state = self._backends[name].breaker.state
+        with self._lock:
+            if self._breaker_seen.get(name) == state:
+                return
+            self._breaker_seen[name] = state
+        obs.emit("router_breaker", status="ok", backend=name,
+                 state=state, track="router-breaker")
+
+    def _attempt_failed(self, name: str,
+                        conn: _BackendConn | None) -> None:
+        """One connection-level attempt failure: breaker bookkeeping
+        (+ flip instant), the attempt counter, and the dead
+        connection's release."""
+        self._backends[name].breaker.record_failure()
+        self._note_breaker(name)
+        self._requests.inc(1, backend=name, outcome="connection_error")
+        if conn is not None:
+            self._release(name, conn, reusable=False)
 
     def _acquire(self, name: str) -> _BackendConn:
         with self._lock:
@@ -635,55 +744,120 @@ class RouterServer:
         model = str(header.get("model") or "default")
         rid = str(header.get("id", ""))
         hops = 0
-        for name in self.candidates(model):
-            if hops:
-                self._failovers.inc(1)
-                obs.emit("router_failover", status="ok", request_id=rid,
-                         backend=name, hop=hops)
-            hops += 1
-            try:
-                conn = self._acquire(name)
-            except OSError:
-                self._backends[name].breaker.record_failure()
-                self._requests.inc(1, backend=name,
-                                   outcome="connection_error")
-                continue
-            try:
-                reply, out_arrays = conn.roundtrip(header, arrays)
-            except (protocol.ProtocolError, OSError):
-                # The backend died mid-stream (kill -9's wire
-                # signature). The request id is the idempotency key —
-                # resubmitting the SAME frame to the next owner is the
-                # client's own retry discipline, applied one tier down.
-                self._backends[name].breaker.record_failure()
-                self._requests.inc(1, backend=name,
-                                   outcome="connection_error")
-                self._release(name, conn, reusable=False)
-                continue
-            self._backends[name].breaker.record_success()
-            self._release(name, conn, reusable=True)
-            outcome = ("ok" if reply.get("ok")
-                       else "reject" if reply.get("error") else "error")
-            self._requests.inc(1, backend=name, outcome=outcome)
-            return reply, out_arrays
-        self._requests.inc(1, backend="-", outcome="unavailable")
-        return {
-            "ok": False, "id": rid, "error": BACKEND_UNAVAILABLE,
-            "message": f"no backend in rotation for model {model!r}",
-            "retry_after_s": self.config.retry_after_s,
-        }, {}
+        # Contiguous perf_counter intervals: every moment between t0
+        # and the final mark lands in exactly one phase bucket, so the
+        # four phases telescope to e2e by construction (the PR 7 ±1 µs
+        # discipline) — across failover hops included.
+        t0 = time.perf_counter()
+        last = t0
+        phases = {"connect_s": 0.0, "send_s": 0.0, "wait_s": 0.0,
+                  "reply_s": 0.0}
+
+        def mark(phase: str) -> float:
+            nonlocal last
+            now = time.perf_counter()
+            phases[phase] += now - last
+            last = now
+            return now
+
+        with obs.span("router_request", request_id=rid,
+                      model=model) as sp:
+            reply: dict | None = None
+            out_arrays: dict = {}
+            backend, outcome = "-", "unavailable"
+            for name in self.candidates(model):
+                if hops:
+                    self._failovers.inc(1)
+                    obs.emit("router_failover", status="ok",
+                             request_id=rid, backend=name, hop=hops)
+                hops += 1
+                try:
+                    conn = self._acquire(name)
+                except OSError:
+                    mark("connect_s")
+                    self._attempt_failed(name, None)
+                    continue
+                mark("connect_s")
+                try:
+                    conn.send(header, arrays)
+                except (protocol.ProtocolError, OSError):
+                    mark("send_s")
+                    self._attempt_failed(name, conn)
+                    continue
+                mark("send_s")
+                try:
+                    conn.wait_reply()
+                except (protocol.ProtocolError, OSError):
+                    mark("wait_s")
+                    self._attempt_failed(name, conn)
+                    continue
+                mark("wait_s")
+                try:
+                    reply, out_arrays = conn.read_reply()
+                except (protocol.ProtocolError, OSError):
+                    # The backend died mid-stream (kill -9's wire
+                    # signature). The request id is the idempotency
+                    # key — resubmitting the SAME frame to the next
+                    # owner is the client's own retry discipline,
+                    # applied one tier down.
+                    mark("reply_s")
+                    self._attempt_failed(name, conn)
+                    continue
+                self._backends[name].breaker.record_success()
+                self._note_breaker(name)
+                self._release(name, conn, reusable=True)
+                backend = name
+                outcome = ("ok" if reply.get("ok")
+                           else "reject" if reply.get("error")
+                           else "error")
+                self._requests.inc(1, backend=name, outcome=outcome)
+                break
+            if reply is None:
+                # Candidate scan (or the whole empty loop) is connect
+                # work; the reject build below lands in reply_s.
+                mark("connect_s")
+                self._requests.inc(1, backend="-", outcome="unavailable")
+                reply = {
+                    "ok": False, "id": rid, "error": BACKEND_UNAVAILABLE,
+                    "message": ("no backend in rotation for model "
+                                f"{model!r}"),
+                    "retry_after_s": self.config.retry_after_s,
+                }
+            end = mark("reply_s")
+            e2e = end - t0
+            path = ("exhausted" if backend == "-"
+                    else "failover" if hops > 1 else "direct")
+            sp.set_attr("backend", backend)
+            sp.set_attr("hops", hops - 1 if hops else 0)
+            sp.set_attr("outcome", outcome)
+            sp.set_attr("path", path)
+            for key, value in phases.items():
+                sp.set_attr(key, round(value, 9))
+            sp.set_attr("e2e_s", round(e2e, 9))
+            sp.set_status("ok" if outcome in ("ok", "reject") else "error")
+            self._latency.observe(e2e, outcome=outcome)
+            self._paths.inc(1, path=path)
+            self.slo.tick()
+        return reply, out_arrays
 
     def call_backend(self, name: str, header: dict,
                      arrays: dict | None = None) -> tuple[dict, dict]:
         """One direct (non-routed) op against a named backend — the
         fleet supervisor's rotate/stats/dump channel. Connection
         errors propagate: the caller decides what a dead backend
-        means."""
-        conn = self._acquire(name)
+        means — but they still count as breaker evidence, same as on
+        the routed path."""
+        try:
+            conn = self._acquire(name)
+        except OSError:
+            self._backends[name].breaker.record_failure()
+            self._note_breaker(name)
+            raise
         try:
             reply, out_arrays = conn.roundtrip(header, arrays or {})
         except (protocol.ProtocolError, OSError):
             self._backends[name].breaker.record_failure()
+            self._note_breaker(name)
             self._release(name, conn, reusable=False)
             raise
         self._release(name, conn, reusable=True)
@@ -705,30 +879,47 @@ class RouterServer:
                 }
                 for name, b in sorted(self._backends.items())
             }
-        requests = obs.REGISTRY.peek("router_requests_total") or {}
-        failovers = obs.REGISTRY.peek("router_failover_total") or {}
         return {
             "role": "router",
             "backends": backends,
             "ring": {"vnodes": self.ring.vnodes,
                      "backends": list(self.ring.backends)},
-            "requests": {k: int(v) for k, v in sorted(requests.items())},
-            "failover_total": int(sum(failovers.values())),
+            "requests": self._born_counts("router_requests_total",
+                                          self._req_baseline),
+            "failover_total": self.failover_total(),
+            "slo": self.slo.health(),
         }
+
+    def _born_counts(self, name: str,
+                     baseline: dict) -> dict[str, int]:
+        """Per-label-key counter totals SINCE this router was built —
+        the process-global value minus the construction-time baseline
+        (zero-delta keys dropped)."""
+        out: dict[str, int] = {}
+        for key, v in sorted(
+                (obs.REGISTRY.peek(name) or {}).items()):
+            n = int(v) - int(baseline.get(key, 0))
+            if n > 0:
+                out[key] = n
+        return out
+
+    def failover_total(self) -> int:
+        return sum(self._born_counts("router_failover_total",
+                                     self._fo_baseline).values())
 
     def request_counts(self) -> dict[str, dict[str, int]]:
         """``{backend: {outcome: n}}`` from the router's own counter —
         the totals the fleet manifest publishes for reconciliation.
-        The registry is process-global, so the view is filtered to THIS
-        router's backends (plus the ``-`` null backend): another
-        router in the same process must not leak into the manifest."""
+        The registry is process-global, so the view is BORN-RELATIVE
+        (this router's own traffic only) and filtered to this router's
+        backends (plus the ``-`` null backend): another router in the
+        same process — the campaign's fault-free reference episode,
+        an earlier test rig — must not leak into the manifest."""
         mine = set(self._backends) | {"-"}
         out: dict[str, dict[str, int]] = {}
-        for key, v in (obs.REGISTRY.peek("router_requests_total")
-                       or {}).items():
-            labels = dict(
-                pair.split("=", 1) for pair in key.split(",") if "=" in pair
-            )
+        for key, v in self._born_counts(
+                "router_requests_total", self._req_baseline).items():
+            labels = obs.parse_label_key(key)
             backend = labels.get("backend", "?")
             outcome = labels.get("outcome", "?")
             if backend not in mine:
@@ -736,14 +927,42 @@ class RouterServer:
             out.setdefault(backend, {})[outcome] = int(v)
         return out
 
+    def _own_records(self) -> list[dict]:
+        """The router's slice of the process-global event ring: its
+        own record families, born after THIS router — in-process
+        fleets (tests, campaign) share the ring with daemons and
+        earlier routers, and a daemon span must never appear twice in
+        the merged fleet timeline. ``chaos_`` rides along because the
+        campaign injects faults from the router's process — the
+        SIGKILL instant belongs on the fleet timeline."""
+        born = self._born_mono - 1e-6
+        return [
+            r for r in obs.EVENTS.records()
+            if r.get("start_mono_s", -1.0) >= born
+            and str(r.get("name", "")).startswith(
+                ("router_", "fleet_", "chaos_")
+            )
+        ]
+
     def dump_fleet(self, outdir: str) -> dict:
         """Merged fleet dump: every in-rotation daemon exports its
         artifact set into ``outdir/daemon-<name>/`` (the daemon's own
         ``dump`` op — trace, serving report, SLO report, metrics
-        triple), and the router writes ``fleet_manifest.json`` beside
+        triple), the router writes ``fleet_manifest.json`` beside
         them with its request totals per backend so the validator can
-        reconcile the two views. Returns the manifest dict."""
+        reconcile the two views, plus its OWN trace + SLO report into
+        ``outdir/router/``, and finally stitches the merged fleet
+        artifacts (``fleet_trace.json`` / ``fleet_report.json`` /
+        ``fleet_stat_health.json``) — a pure function of the dump dir
+        (``observability/fleet_report.py``), so ``scripts/
+        fleet_report.py`` reproduces them bit-for-bit offline.
+        Returns the manifest dict."""
         os.makedirs(outdir, exist_ok=True)
+        # The dump marker guarantees the router trace is non-empty
+        # (its wall anchor must exist for the fleet re-base) even for
+        # a router that admitted no backend.
+        obs.emit("router_dump", status="ok", track="router-backend",
+                 dir=os.path.basename(outdir))
         backends: dict[str, dict] = {}
         for name in sorted(self._backends):
             with self._lock:
@@ -766,15 +985,32 @@ class RouterServer:
             "backends": backends,
             "router": {
                 "requests": self.request_counts(),
-                "failover_total": int(sum(
-                    (obs.REGISTRY.peek("router_failover_total")
-                     or {}).values()
-                )),
+                "failover_total": self.failover_total(),
             },
+            "router_dir": "router",
         }
         obs.atomic_write_json(
             os.path.join(outdir, "fleet_manifest.json"), manifest
         )
+        # The router's own artifact set (trace + SLO report), then the
+        # merged fleet triple — recomputed from the on-disk dump only,
+        # never from live state, so the offline script's recomputation
+        # is byte-identical by construction.
+        rdir = os.path.join(outdir, "router")
+        os.makedirs(rdir, exist_ok=True)
+        trace = obs.build_trace(
+            self._own_records(), meta={"tool": "router"}
+        )
+        obs.write_trace_json(os.path.join(rdir, "trace.json"),
+                             trace=trace)
+        obs.atomic_write_json(
+            os.path.join(rdir, "slo_report.json"), self.slo.evaluate()
+        )
+        from ate_replication_causalml_tpu.observability import (
+            fleet_report as _fleet_report,
+        )
+
+        _fleet_report.write_fleet_artifacts(outdir)
         return manifest
 
     def stop(self) -> None:
@@ -794,6 +1030,71 @@ class RouterServer:
     def stopped(self) -> bool:
         with self._lock:
             return self._stopped
+
+
+# ── router admin plane (GET-only, shares the daemon's HTTP shell) ────
+
+#: routes the router admin plane serves; anything else is a 404 with
+#: this list in the body.
+ROUTER_ADMIN_ROUTES = ("/metrics", "/healthz", "/readyz", "/fleetz")
+
+
+def handle_router_admin_path(router: RouterServer,
+                             path: str) -> tuple[int, str, bytes]:
+    """Resolve one GET ``path`` against the router — the transport-free
+    core ``serving/admin.py AdminServer(handler=...)`` mounts, so the
+    router and the daemon share ONE HTTP shell (GET-only,
+    500-never-kill, silent logs) with different path resolvers:
+
+    * ``/metrics`` — the registry in Prometheus text format;
+    * ``/healthz`` — liveness: 200 with per-backend breaker states and
+      the router SLO burn until :meth:`RouterServer.stop`;
+    * ``/readyz`` — readiness: 200 iff at least one backend is in
+      rotation (a router fronting an empty fleet can take no traffic —
+      the load balancer should know);
+    * ``/fleetz`` — the full :meth:`RouterServer.stats` view (ring,
+      per-backend rotation/breaker/in-flight, request totals).
+    """
+    from ate_replication_causalml_tpu.serving.admin import _json_bytes
+
+    if path == "/metrics":
+        from ate_replication_causalml_tpu.observability.promtext import (
+            render_prom_text,
+        )
+
+        return 200, "text/plain; version=0.0.4", render_prom_text().encode()
+    if path == "/healthz":
+        with router._lock:
+            items = sorted(router._backends.items())
+        # Breaker states read OUTSIDE the router lock: the breaker
+        # locks itself and the committed concurrency model has no
+        # router-lock → breaker-lock edge to add.
+        payload = {
+            "role": "router",
+            "state": "stopped" if router.stopped else "routing",
+            "breakers": {n: b.breaker.state for n, b in items},
+            "in_rotation": list(router.in_rotation()),
+            "slo": router.slo.health(),
+        }
+        code = 200 if not router.stopped else 503
+        return code, "application/json", _json_bytes(payload)
+    if path == "/readyz":
+        rotation = router.in_rotation()
+        ready = bool(rotation) and not router.stopped
+        return (
+            200 if ready else 503,
+            "application/json",
+            _json_bytes({"ready": ready, "role": "router",
+                         "in_rotation": list(rotation)}),
+        )
+    if path == "/fleetz":
+        return 200, "application/json", _json_bytes(router.stats())
+    return (
+        404,
+        "application/json",
+        _json_bytes({"error": "not found",
+                     "routes": list(ROUTER_ADMIN_ROUTES)}),
+    )
 
 
 # ── wire serving (client-facing loop) ────────────────────────────────

@@ -1738,6 +1738,12 @@ SERVE_BENCH_REQUESTS = 120
 SERVE_BENCH_SEED = 0
 SERVE_BENCH_RATE_HZ = 2000.0
 
+#: --serving fleet leg (ISSUE 20): a short seeded replay through the
+#: 2-daemon router rig — enough requests for a stable overhead p50,
+#: small enough to keep --serving a quick mode.
+FLEET_BENCH_REQUESTS = 60
+FLEET_BENCH_BACKENDS = 2
+
 
 def _serving_measurements(n=SERVE_BENCH_ROWS):
     """All the jax work behind the ``serving_quick`` record: fit a
@@ -1820,7 +1826,9 @@ def _serving_measurements(n=SERVE_BENCH_ROWS):
     pad_mean = server.pad_fraction_mean()
     leaked = server.compile_events_in_window()
     server.stop()  # raises on any compile event in the window
+    fleet = _fleet_measurements(ckpt, buckets)
     return {
+        **fleet,
         "rows": n,
         "requests": replay["served"],
         "buckets": list(buckets.sizes),
@@ -1838,6 +1846,134 @@ def _serving_measurements(n=SERVE_BENCH_ROWS):
         "close_reasons": close_reasons,
         "mean_pad_fraction": pad_mean,
         "zero_compile": leaked == 0.0,
+    }
+
+
+def _fleet_measurements(ckpt, buckets):
+    """The ``--serving`` fleet leg (ISSUE 20): the same verified
+    checkpoint behind TWO in-process daemons — each with a real
+    loopback socket and admin plane — and the consistent-hash router,
+    a seeded replay driven through ``router.forward_predict``, and the
+    router's own overhead: each ``router_request`` span's e2e minus
+    the matched ``serving_request`` span's e2e on the same request id,
+    read back from the shared event ring, reported as p50/p99. The
+    daemons answer over real sockets, so the overhead prices the full
+    router path (ring lookup, breaker bookkeeping, connection reuse,
+    span capture, wire round-trip) — not just python dispatch."""
+    import threading
+
+    import numpy as np
+
+    from ate_replication_causalml_tpu.serving import daemon as daemon_mod
+    from ate_replication_causalml_tpu.serving import loadgen
+    from ate_replication_causalml_tpu.serving import router as rt
+    from ate_replication_causalml_tpu.serving.admin import AdminServer
+    from ate_replication_causalml_tpu.serving.daemon import (
+        CateServer,
+        ServeConfig,
+    )
+
+    schedule = loadgen.build_schedule(
+        SERVE_BENCH_SEED, FLEET_BENCH_REQUESTS,
+        rate_hz=SERVE_BENCH_RATE_HZ, mix="1:2,2:1,8:1", id_prefix="fb",
+    )
+    queries = loadgen.build_queries(SERVE_BENCH_SEED, schedule, 6)
+
+    servers, admins, threads = [], [], []
+    router = None
+    t0 = time.monotonic()
+    try:
+        specs = []
+        names = tuple(f"b{i}" for i in range(FLEET_BENCH_BACKENDS))
+        for name in names:
+            server = CateServer(ServeConfig(
+                checkpoint=ckpt, buckets=buckets, window_s=0.001,
+                max_depth=64, retry_after_s=0.002,
+                # The serving leg already enforced the zero-compile
+                # window on this checkpoint; the fleet daemons re-warm
+                # the same executables.
+                strict_no_compile=False,
+            ))
+            server.startup()
+            servers.append(server)
+            adm = AdminServer(server)
+            aport = adm.start(0)
+            admins.append(adm)
+            bound_evt = threading.Event()
+            bound: dict = {}
+
+            def on_bound(port, _evt=bound_evt, _bound=bound):
+                _bound["port"] = port
+                _evt.set()
+
+            t = threading.Thread(
+                target=daemon_mod.serve_socket, args=(server,),
+                kwargs=dict(port=0, on_bound=on_bound), daemon=True,
+                name=f"bench-fleet-{name}",
+            )
+            t.start()
+            threads.append(t)
+            if not bound_evt.wait(30):
+                raise RuntimeError("fleet bench daemon failed to bind")
+            specs.append(
+                rt.BackendSpec(name, "127.0.0.1", bound["port"], aport)
+            )
+
+        router = rt.RouterServer(rt.RouterConfig(backends=tuple(specs)))
+        router.start()
+        for i, sched in enumerate(schedule):
+            header, _ = router.forward_predict(
+                {"op": "predict", "id": sched.request_id,
+                 "model": sched.model or "default"},
+                {"x": queries[i]},
+            )
+            if not header.get("ok", False):
+                raise RuntimeError(f"fleet bench forward failed: {header}")
+        for name in names:
+            reply, _ = router.call_backend(name, {"op": "shutdown"})
+            if not reply.get("ok", False):
+                raise RuntimeError(f"fleet bench shutdown failed: {reply}")
+    finally:
+        if router is not None:
+            router.stop()
+        for t in threads:
+            t.join(10)
+        for adm in admins:
+            adm.stop()
+        for server in servers:
+            if server.lifecycle.state != "stopped":
+                server.stop()
+
+    # Match router to daemon spans on request id. Everything ran in
+    # THIS process on one shared event ring, so both sides of every
+    # pair are present; the t0 fence keeps the serving leg's spans out.
+    rids = {s.request_id for s in schedule}
+    router_e2e, daemon_e2e = {}, {}
+    for rec in obs.EVENTS.records():
+        if rec.get("start_mono_s", 0.0) < t0:
+            continue
+        rid = (rec.get("attrs") or {}).get("request_id")
+        if rid not in rids:
+            continue
+        if rec.get("name") == "router_request":
+            router_e2e[rid] = rec["dur_s"]
+        elif rec.get("name") == "serving_request":
+            daemon_e2e[rid] = rec["dur_s"]
+    matched = sorted(set(router_e2e) & set(daemon_e2e))
+    if len(matched) != len(schedule):
+        raise RuntimeError(
+            f"fleet bench span matching: {len(matched)} matched pairs "
+            f"for {len(schedule)} requests — the overhead quantiles "
+            "would silently measure a subset"
+        )
+    overheads = np.array(
+        [router_e2e[r] - daemon_e2e[r] for r in matched], dtype=np.float64
+    )
+    return {
+        "fleet_requests": len(matched),
+        "fleet_backends": FLEET_BENCH_BACKENDS,
+        "fleet_router_overhead_p50_s": float(np.percentile(overheads, 50)),
+        "fleet_router_overhead_p99_s": float(np.percentile(overheads, 99)),
     }
 
 
@@ -1871,7 +2007,11 @@ def bench_serving_quick(n=SERVE_BENCH_ROWS):
         f"coalesce_p99={_phase_ms(ph, 'coalesce_wait', 'p99_s')}ms "
         f"device_p99={_phase_ms(ph, 'device', 'p99_s')}ms "
         f"close={m['close_reasons']} "
-        f"zero_compile={m['zero_compile']}",
+        f"zero_compile={m['zero_compile']} "
+        f"fleet_overhead_p50="
+        f"{m['fleet_router_overhead_p50_s'] * 1e3:.3f}ms "
+        f"(x{m['fleet_backends']} backends, "
+        f"{m['fleet_requests']} requests)",
         file=sys.stderr,
     )
     return obs.bench_record(
@@ -1902,6 +2042,17 @@ def bench_serving_quick(n=SERVE_BENCH_ROWS):
         buckets=m["buckets"],
         rows=m["rows"],
         zero_compile=m["zero_compile"],
+        # ISSUE 20: the fleet leg — what the consistent-hash router
+        # adds on top of a daemon's own e2e, measured span-to-span on
+        # matched request ids through a live 2-daemon rig.
+        fleet_router_overhead_p50_ms=round(
+            m["fleet_router_overhead_p50_s"] * 1e3, 3
+        ),
+        fleet_router_overhead_p99_ms=round(
+            m["fleet_router_overhead_p99_s"] * 1e3, 3
+        ),
+        fleet_requests=m["fleet_requests"],
+        fleet_backends=m["fleet_backends"],
     )
 
 

@@ -1775,6 +1775,313 @@ def _metrics_counter_total(snap: dict, name: str,
     return total
 
 
+def validate_fleet_trace(trace: dict,
+                         manifest: dict | None = None) -> list[str]:
+    """The merged ``fleet_trace.json`` (PR 20): every process's trace
+    re-based onto one wall-clock axis. Checks:
+
+    * shape — ``otherData.kind == "fleet_trace"``, a process table with
+      distinct pids, known event phases;
+    * re-base sanity — the merged ``wall_anchor_unix`` is the MINIMUM
+      of the per-process anchors (so every shift is non-negative and no
+      event lands before the axis origin), and within every
+      ``(pid, tid)`` track the complete spans' START times are
+      monotonic — per-process traces emit spans sorted by start, and a
+      correct re-base (one constant shift per process) preserves that;
+    * cross-process flows — every ``fleet_req`` arrow has exactly one
+      ``s`` and one ``f`` per flow id, the two ends live in DIFFERENT
+      processes, the ``s`` binds to a ``router_request`` span start and
+      the ``f`` to a ``serving_request`` span start (same pid/tid/ts) —
+      an arrow into empty space means the stitcher matched a request id
+      to a span that is not in the merged timeline.
+    """
+    errors: list[str] = []
+    events = trace.get("traceEvents")
+    if not isinstance(events, list):
+        return ["fleet_trace: traceEvents missing or not a list"]
+    other = trace.get("otherData") or {}
+    if other.get("kind") != "fleet_trace":
+        errors.append(
+            f"fleet_trace: otherData.kind {other.get('kind')!r} != "
+            "'fleet_trace'"
+        )
+    processes = other.get("processes")
+    if not isinstance(processes, dict) or not processes:
+        return errors + ["fleet_trace: otherData.processes missing"]
+    pids = [p.get("pid") for p in processes.values()
+            if isinstance(p, dict)]
+    if len(set(pids)) != len(processes):
+        errors.append(f"fleet_trace: pids not distinct: {pids}")
+    anchors = [
+        p.get("wall_anchor_unix") for p in processes.values()
+        if isinstance(p, dict)
+        and isinstance(p.get("wall_anchor_unix"), (int, float))
+    ]
+    origin = other.get("wall_anchor_unix")
+    if anchors:
+        if not isinstance(origin, (int, float)):
+            errors.append("fleet_trace: wall_anchor_unix missing")
+        elif abs(min(anchors) - origin) > 1e-6:
+            errors.append(
+                f"fleet_trace: wall_anchor_unix {origin} != min "
+                f"process anchor {min(anchors)}"
+            )
+    if manifest is not None:
+        known = set(manifest.get("backends") or {})
+        for pname in processes:
+            if pname == "router":
+                continue
+            if not (pname.startswith("daemon-")
+                    and pname[len("daemon-"):] in known):
+                errors.append(
+                    f"fleet_trace: process {pname!r} absent from the "
+                    "manifest backend table"
+                )
+    last_start: dict[tuple, float] = {}
+    span_starts: dict[tuple, set] = {}
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            errors.append(f"fleet_trace: event {i} is not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in _TRACE_PHASES:
+            errors.append(f"fleet_trace: event {i} unknown ph {ph!r}")
+            continue
+        ts = ev.get("ts")
+        if isinstance(ts, (int, float)) and ts < -1e-3:
+            errors.append(
+                f"fleet_trace: event {i} ({ev.get('name')!r}) at "
+                f"ts {ts} — before the re-based origin"
+            )
+        if ph == "X":
+            key = (ev.get("pid"), ev.get("tid"))
+            start = float(ev.get("ts", 0.0))
+            if start < last_start.get(key, float("-inf")) - 1.0:
+                errors.append(
+                    f"fleet_trace: track {key} span starts not "
+                    f"monotonic at event {i} ({ev.get('name')!r})"
+                )
+            last_start[key] = max(
+                last_start.get(key, float("-inf")), start)
+            span_starts.setdefault(
+                (ev.get("name"), ev.get("pid"), ev.get("tid")), set()
+            ).add(round(float(ev.get("ts", 0.0)), 3))
+    flows: dict[str, dict[str, list[dict]]] = {}
+    for ev in events:
+        if isinstance(ev, dict) and ev.get("cat") == "fleet_req":
+            flows.setdefault(
+                str(ev.get("id")), {"s": [], "f": []}
+            ).setdefault(str(ev.get("ph")), []).append(ev)
+    for fid, ends_of in sorted(flows.items()):
+        s_evs, f_evs = ends_of.get("s", []), ends_of.get("f", [])
+        if len(s_evs) != 1 or len(f_evs) != 1:
+            errors.append(
+                f"fleet_trace: flow {fid!r} has {len(s_evs)} starts / "
+                f"{len(f_evs)} finishes (want exactly 1 + 1)"
+            )
+            continue
+        s_ev, f_ev = s_evs[0], f_evs[0]
+        if s_ev.get("pid") == f_ev.get("pid"):
+            errors.append(
+                f"fleet_trace: flow {fid!r} does not cross processes "
+                f"(both ends in pid {s_ev.get('pid')})"
+            )
+        for ev, span_name, side in ((s_ev, "router_request", "s"),
+                                    (f_ev, "serving_request", "f")):
+            starts = span_starts.get(
+                (span_name, ev.get("pid"), ev.get("tid")), set()
+            )
+            if round(float(ev.get("ts", 0.0)), 3) not in starts:
+                errors.append(
+                    f"fleet_trace: flow {fid!r} {side}-end does not "
+                    f"bind to a {span_name} span start on track "
+                    f"({ev.get('pid')}, {ev.get('tid')})"
+                )
+    return errors
+
+
+def validate_fleet_report(report: dict,
+                          manifest: dict | None = None) -> list[str]:
+    """The merged ``fleet_report.json`` (PR 20): request matching and
+    the router↔daemon counter reconciliation. Internal consistency
+    (counts add up, quantiles ordered, ``consistent`` honestly
+    derived) plus — when the manifest is supplied — the cross-check
+    that the report's per-backend router ok-counts are exactly the
+    manifest's (the two files describe one dump)."""
+    errors: list[str] = []
+    if report.get("kind") != "fleet_report":
+        errors.append(
+            f"fleet_report: kind {report.get('kind')!r} != 'fleet_report'"
+        )
+    if report.get("schema_version") != EXPECTED_SCHEMA_VERSION:
+        errors.append(
+            f"fleet_report: schema_version "
+            f"{report.get('schema_version')!r} != {EXPECTED_SCHEMA_VERSION}"
+        )
+    req = report.get("requests")
+    if not isinstance(req, dict):
+        return errors + ["fleet_report: requests section missing"]
+    for key in ("router_spans", "daemon_spans", "matched",
+                "routed_to_undumped", "orphan_router", "orphan_daemon"):
+        v = req.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errors.append(
+                f"fleet_report: requests.{key} = {v!r} is not an "
+                "int >= 0"
+            )
+    if all(isinstance(req.get(k), int) for k in
+           ("matched", "orphan_router", "routed_to_undumped",
+            "router_spans")):
+        routed = (req["matched"] + req["orphan_router"]
+                  + req["routed_to_undumped"])
+        if routed > req["router_spans"]:
+            errors.append(
+                f"fleet_report: matched+orphans+undumped {routed} > "
+                f"router_spans {req['router_spans']}"
+            )
+    for key in ("orphan_router", "orphan_daemon"):
+        ids = req.get(f"{key}_ids")
+        if not isinstance(ids, list):
+            errors.append(f"fleet_report: requests.{key}_ids missing")
+        elif isinstance(req.get(key), int) and len(ids) > req[key]:
+            errors.append(
+                f"fleet_report: {len(ids)} {key}_ids listed but "
+                f"{key} = {req[key]}"
+            )
+    for backend, st in sorted((report.get("residual_gap") or {}).items()):
+        if not isinstance(st, dict):
+            errors.append(f"fleet_report: residual_gap[{backend!r}] "
+                          "malformed")
+            continue
+        vals = [st.get(k) for k in ("min_s", "p50_s", "p99_s", "max_s")]
+        if not all(isinstance(v, (int, float)) for v in vals):
+            errors.append(
+                f"fleet_report: residual_gap[{backend!r}] quantiles "
+                "missing"
+            )
+        elif not (vals[0] <= vals[1] <= vals[2] <= vals[3]):
+            errors.append(
+                f"fleet_report: residual_gap[{backend!r}] quantiles "
+                f"out of order: {vals}"
+            )
+    rec = report.get("reconciliation")
+    if not isinstance(rec, dict):
+        return errors + ["fleet_report: reconciliation section missing"]
+    router_ok = rec.get("router_ok")
+    if not isinstance(router_ok, dict):
+        errors.append("fleet_report: reconciliation.router_ok missing")
+        router_ok = {}
+    total = rec.get("router_ok_total")
+    if isinstance(total, int) and total != sum(
+        v for v in router_ok.values() if isinstance(v, int)
+    ):
+        errors.append(
+            f"fleet_report: router_ok_total {total} != sum of "
+            "per-backend oks"
+        )
+    daemon_total = rec.get("daemon_ok_total")
+    if (isinstance(total, int) and isinstance(daemon_total, int)
+            and total > daemon_total):
+        errors.append(
+            f"fleet_report: router claims {total} acknowledged "
+            f"forwards but the daemons served only {daemon_total}"
+        )
+    if rec.get("consistent") is not True:
+        errors.append(
+            "fleet_report: reconciliation.consistent is not True"
+        )
+    if manifest is not None:
+        mreq = (manifest.get("router") or {}).get("requests") or {}
+        for backend, n in sorted(router_ok.items()):
+            m = (mreq.get(backend) or {}).get("ok", 0)
+            if n != m:
+                errors.append(
+                    f"fleet_report: router_ok[{backend!r}] = {n} but "
+                    f"the manifest says {m}"
+                )
+    return errors
+
+
+def validate_fleet_stat_health(payload: dict,
+                               manifest: dict | None = None) -> list[str]:
+    """The merged ``fleet_stat_health.json`` (PR 20): folded sketches
+    and fleet-level drift figures. Counts are non-negative ints,
+    per-model window totals add up, and every ``stat_drift:*`` /
+    ``stat_calibration:*`` figure is honestly derived (``good <=
+    total``, ``burning`` iff the ratio misses the objective)."""
+    errors: list[str] = []
+    if payload.get("kind") != "fleet_stat_health":
+        errors.append(
+            f"fleet_stat_health: kind {payload.get('kind')!r} != "
+            "'fleet_stat_health'"
+        )
+    if payload.get("schema_version") != EXPECTED_SCHEMA_VERSION:
+        errors.append(
+            f"fleet_stat_health: schema_version "
+            f"{payload.get('schema_version')!r} != "
+            f"{EXPECTED_SCHEMA_VERSION}"
+        )
+    daemons = payload.get("daemons")
+    if not isinstance(daemons, list):
+        errors.append("fleet_stat_health: daemons list missing")
+        daemons = []
+    if manifest is not None:
+        known = set(manifest.get("backends") or {})
+        for name in daemons:
+            if name not in known:
+                errors.append(
+                    f"fleet_stat_health: daemon {name!r} absent from "
+                    "the manifest backend table"
+                )
+    models = payload.get("models")
+    if not isinstance(models, dict):
+        return errors + ["fleet_stat_health: models section missing"]
+    for m, ms in sorted(models.items()):
+        if not isinstance(ms, dict):
+            errors.append(f"fleet_stat_health: model {m!r} malformed")
+            continue
+        for ch, cs in sorted((ms.get("channels") or {}).items()):
+            if not isinstance(cs, dict) or "error" in cs:
+                continue
+            for key in ("count", "underflow", "overflow", "nan",
+                        "windows_ok", "windows_drift", "windows_sparse"):
+                v = cs.get(key)
+                if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                    errors.append(
+                        f"fleet_stat_health: {m}:{ch} {key} = {v!r} is "
+                        "not an int >= 0"
+                    )
+    for name, fig in sorted((payload.get("slo") or {}).items()):
+        if not isinstance(fig, dict):
+            errors.append(f"fleet_stat_health: slo[{name!r}] malformed")
+            continue
+        good, total = fig.get("good"), fig.get("total")
+        if not (isinstance(good, int) and isinstance(total, int)
+                and 0 <= good <= total):
+            errors.append(
+                f"fleet_stat_health: slo[{name!r}] good/total "
+                f"{good!r}/{total!r} malformed"
+            )
+            continue
+        obj = fig.get("objective")
+        expect_burning = bool(
+            total and isinstance(obj, (int, float))
+            and good / total < obj
+        )
+        if bool(fig.get("burning")) != expect_burning:
+            errors.append(
+                f"fleet_stat_health: slo[{name!r}] burning "
+                f"{fig.get('burning')!r} inconsistent with "
+                f"{good}/{total} vs objective {obj!r}"
+            )
+        if total == 0 and fig.get("ratio") is not None:
+            errors.append(
+                f"fleet_stat_health: slo[{name!r}] ratio on an empty "
+                "window"
+            )
+    return errors
+
+
 def validate_fleet_dump(outdir: str) -> list[str]:
     """A merged fleet dump directory (ISSUE 18): ``fleet_manifest.json``
     (written by the router's ``dump_fleet``) beside one ``daemon-<name>``
@@ -1895,6 +2202,27 @@ def validate_fleet_dump(outdir: str) -> list[str]:
                 f"{int(daemon_ok)} ok requests but the router claims "
                 f"{router_ok} successful forwards to it"
             )
+    # The merged triple (PR 20): dump_fleet writes all three beside the
+    # manifest and scripts/fleet_report.py recomputes them bit-for-bit,
+    # so a dump missing one is a failed dump, not an old format. Each
+    # validator also cross-checks its artifact against the manifest —
+    # the four files describe ONE dump and must agree.
+    for basename, validator in (
+        ("fleet_trace.json", validate_fleet_trace),
+        ("fleet_report.json", validate_fleet_report),
+        ("fleet_stat_health.json", validate_fleet_stat_health),
+    ):
+        path = os.path.join(outdir, basename)
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            errors.append(f"fleet: cannot read {path}: {e}")
+            continue
+        if not isinstance(payload, dict):
+            errors.append(f"fleet: {basename} is not a JSON object")
+            continue
+        errors += validator(payload, manifest)
     return errors
 
 
@@ -1941,7 +2269,19 @@ def main(argv: list[str] | None = None) -> int:
          validate_chaos_campaign_record),
         ("campaign_report", "campaign", validate_campaign_report),
         ("stat_health", "stat", validate_stat_health),
+        # Merged fleet artifacts (PR 20), standalone — shape-only
+        # without the manifest; the fleet-dump dir branch below runs
+        # the full cross-checked form.
+        ("fleet_trace", "fleet_trace", validate_fleet_trace),
+        ("fleet_report", "fleet_report", validate_fleet_report),
+        ("fleet_stat_health", "fleet_stat_health",
+         validate_fleet_stat_health),
     )
+    if len(args.paths) == 1 and os.path.isdir(args.paths[0]):
+        # A directory never matches a by-filename evidence record —
+        # keeps e.g. a dump dir named fleet_report_run/ out of the
+        # single-file branch.
+        _EVIDENCE_VALIDATORS = ()
     if len(args.paths) == 1:
         base = os.path.basename(args.paths[0])
         for prefix, tag, validator in _EVIDENCE_VALIDATORS:

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Recompute the merged fleet artifacts from a fleet dump (PR 20).
+
+Usage::
+
+    python scripts/fleet_report.py results/fleet_dump
+    python scripts/fleet_report.py results/fleet_dump --check
+
+Reads a ``RouterServer.dump_fleet`` output directory —
+``fleet_manifest.json``, the router's ``router/trace.json`` and every
+``daemon-<name>/`` artifact set — and rebuilds the merged triple
+(``fleet_trace.json`` / ``fleet_report.json`` /
+``fleet_stat_health.json``) through the SAME pure functions the live
+dump ran (``observability/fleet_report.py``), so the recomputation is
+bit-for-bit: ``--check`` reads the committed artifacts first, rewrites
+them, and exits non-zero if any byte changed — the offline
+reproducibility acceptance gate.
+
+Pure stdlib, no JAX — runs on a laptop against a dump captured on a
+TPU host, like ``scripts/analyze_trace.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import types
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+# Import ONLY the observability subpackage (stdlib at import time; jax
+# is lazy inside device.py): executing the parent package's __init__
+# would pull the estimator stack and with it jax — wrong for an
+# analyzer that must run on saved artifacts anywhere.
+if "ate_replication_causalml_tpu" not in sys.modules:
+    _pkg = types.ModuleType("ate_replication_causalml_tpu")
+    _pkg.__path__ = [os.path.join(_REPO_ROOT, "ate_replication_causalml_tpu")]
+    sys.modules["ate_replication_causalml_tpu"] = _pkg
+
+from ate_replication_causalml_tpu.observability import (  # noqa: E402
+    fleet_report as freport,
+)
+
+
+def _read_bytes(path: str) -> bytes | None:
+    try:
+        with open(path, "rb") as f:
+            return f.read()
+    except OSError:
+        return None
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("dump_dir",
+                    help="a RouterServer.dump_fleet output directory "
+                         "(contains fleet_manifest.json)")
+    ap.add_argument("--check", action="store_true",
+                    help="byte-compare the recomputed artifacts against "
+                         "the committed ones; exit 1 on any difference")
+    args = ap.parse_args(argv)
+
+    before: dict[str, bytes | None] = {}
+    names = (freport.FLEET_TRACE_BASENAME,
+             freport.FLEET_REPORT_BASENAME,
+             freport.FLEET_STAT_HEALTH_BASENAME)
+    if args.check:
+        for name in names:
+            before[name] = _read_bytes(os.path.join(args.dump_dir, name))
+
+    try:
+        paths = freport.write_fleet_artifacts(args.dump_dir)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    for p in paths:
+        print(f"wrote {p}")
+
+    if args.check:
+        changed = []
+        for name in names:
+            after = _read_bytes(os.path.join(args.dump_dir, name))
+            if before[name] != after:
+                changed.append(name)
+        if changed:
+            print(
+                "check FAILED — recomputation changed: "
+                + ", ".join(changed),
+                file=sys.stderr,
+            )
+            return 1
+        print("check ok — recomputation is byte-identical")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -45,11 +45,17 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--failover", type=int, default=None,
                     help="max failover hops past the ring owner "
                          "(default $ATE_TPU_ROUTER_FAILOVER or 2)")
+    ap.add_argument("--admin-port", type=int, default=None,
+                    help="GET-only admin plane (/metrics /healthz "
+                         "/readyz /fleetz; 0 = ephemeral; default "
+                         "$ATE_TPU_ROUTER_ADMIN_PORT, unset = off)")
     args = ap.parse_args(argv)
 
+    from ate_replication_causalml_tpu.serving.admin import AdminServer
     from ate_replication_causalml_tpu.serving.router import (
         RouterConfig,
         RouterServer,
+        handle_router_admin_path,
         parse_backend_specs,
         serve_socket,
     )
@@ -66,6 +72,29 @@ def main(argv: list[str] | None = None) -> int:
     )
     router = RouterServer(config)
     router.start()
+
+    # Admin plane (PR 20): the daemon's HTTP shell mounted on the
+    # router's own path resolver. Off unless a port is given — the
+    # router stays a one-listener process by default.
+    admin_port = args.admin_port
+    if admin_port is None:
+        raw = os.environ.get("ATE_TPU_ROUTER_ADMIN_PORT", "").strip()
+        if raw:
+            try:
+                admin_port = int(raw)
+            except ValueError:
+                raise SystemExit(
+                    f"ATE_TPU_ROUTER_ADMIN_PORT={raw!r}: expected an "
+                    "integer"
+                ) from None
+    admin = None
+    if admin_port is not None:
+        admin = AdminServer(router, host=args.host,
+                            handler=handle_router_admin_path,
+                            thread_name="router-admin")
+        bound_admin = admin.start(admin_port)
+        print(f"# admin endpoint on {args.host}:{bound_admin}",
+              file=sys.stderr, flush=True)
 
     # SIGTERM = stop accepting, close the probe thread, exit 0 — the
     # daemons behind the router drain on their own SIGTERMs; the router
@@ -88,7 +117,11 @@ def main(argv: list[str] | None = None) -> int:
         ) + f" in_rotation={list(router.in_rotation())}",
         file=sys.stderr, flush=True,
     )
-    serve_socket(router, args.host, args.port)
+    try:
+        serve_socket(router, args.host, args.port)
+    finally:
+        if admin is not None:
+            admin.stop()
     return 0
 
 

@@ -139,6 +139,11 @@ def test_serving_quick_record_schema_stubbed(monkeypatch):
         "close_reasons": {"bucket_full": 10, "window_expired": 25},
         "mean_pad_fraction": 0.2,
         "zero_compile": True,
+        # ISSUE 20: the fleet leg's overhead quantiles ride the same
+        # measurements dict (seconds in, ms in the record).
+        "fleet_requests": 60, "fleet_backends": 2,
+        "fleet_router_overhead_p50_s": 0.0002,
+        "fleet_router_overhead_p99_s": 0.0011,
     }
     monkeypatch.setattr(bench, "_serving_measurements", lambda n: canned)
     rec = bench.bench_serving_quick(n=400)
@@ -151,7 +156,11 @@ def test_serving_quick_record_schema_stubbed(monkeypatch):
                   "coalesce_wait_p50_ms", "coalesce_wait_p99_ms",
                   "mean_pad_fraction", "close_reasons",
                   "offered_rate_hz", "achieved_rate_hz", "seed",
-                  "requests", "buckets", "rows", "zero_compile"):
+                  "requests", "buckets", "rows", "zero_compile",
+                  # ISSUE 20: the fleet router-overhead leg.
+                  "fleet_router_overhead_p50_ms",
+                  "fleet_router_overhead_p99_ms",
+                  "fleet_requests", "fleet_backends"):
         assert field in rec, field
     assert rec["metric"] == "serving_quick" and rec["unit"] == "ms"
     assert rec["value"] == rec["p50_ms"] == 3.0
@@ -161,6 +170,9 @@ def test_serving_quick_record_schema_stubbed(monkeypatch):
     assert rec["coalesce_wait_p50_ms"] == 1.0
     assert rec["mean_pad_fraction"] == 0.2
     assert rec["close_reasons"] == {"bucket_full": 10, "window_expired": 25}
+    assert rec["fleet_router_overhead_p50_ms"] == 0.2
+    assert rec["fleet_router_overhead_p99_ms"] == 1.1
+    assert rec["fleet_requests"] == 60 and rec["fleet_backends"] == 2
 
 
 def test_chaos_campaign_record_schema_stubbed(monkeypatch):

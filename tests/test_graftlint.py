@@ -1294,6 +1294,85 @@ def test_jgl020_quiet_on_locals_and_suppression():
     assert [f.line for f in res.suppressed] == [6]
 
 
+# --------------------------------------------------------------- JGL021
+
+
+# The rule cross-checks against the REAL install_jax_monitoring (it
+# AST-parses the device.py shipped next to the analysis package), so
+# fixtures use real pre-created family names on the quiet side and
+# never-pre-created names on the firing side.
+JGL021_BAD = """\
+from ate_replication_causalml_tpu import observability as obs
+from ate_replication_causalml_tpu.observability import registry as _registry
+from ate_replication_causalml_tpu.observability.registry import REGISTRY
+
+_FAMILY = "jgl021_fixture_bytes_total"
+
+def emit():
+    obs.counter("jgl021_fixture_total", "help").inc(1)            # line 8
+    REGISTRY.bucket_histogram("jgl021_fixture_seconds", "help")   # line 9
+    _registry.counter(_FAMILY, "help").inc(1)                     # line 10
+"""
+
+JGL021_GOOD = """\
+from ate_replication_causalml_tpu import observability as obs
+from ate_replication_causalml_tpu.observability.registry import counter
+
+def emit(self, name):
+    obs.counter("serving_requests_total").inc(1)     # pre-created
+    counter("chaos_injections_total").inc(1)         # pre-created
+    obs.counter(name).inc(1)                         # dynamic: skipped
+    self._registry.counter("jgl021_fixture_total")   # injected double
+    stats.counter("jgl021_fixture_total")            # not the registry
+"""
+
+
+def test_jgl021_fires_on_unprecreated_families():
+    """ISSUE 20: a family first created at its emit site exists only on
+    runs whose traffic reaches that line — the metrics.json key set
+    then depends on the code path, which is exactly what the
+    install_jax_monitoring pre-creation contract forbids."""
+    assert _lines(JGL021_BAD, "JGL021", relpath="pkg/serving/mod.py") \
+        == [8, 9, 10]
+    msgs = _messages(JGL021_BAD, "JGL021", relpath="pkg/serving/mod.py")
+    assert "jgl021_fixture_total" in msgs[0]
+    assert "bucket_histogram" in msgs[1]
+    assert "jgl021_fixture_bytes_total" in msgs[2]  # module-const resolved
+
+
+def test_jgl021_quiet_on_precreated_dynamic_and_origin_files():
+    assert _lines(JGL021_GOOD, "JGL021", relpath="pkg/serving/mod.py") == []
+    # the pre-creation site itself and the registry module are exempt —
+    # they are where families legitimately originate
+    for origin in ("observability/device.py", "observability/registry.py"):
+        assert _lines(JGL021_BAD, "JGL021", relpath=origin) == []
+
+
+def test_jgl021_suppression_comment_holds_it_back():
+    src = JGL021_BAD.replace(
+        '    obs.counter("jgl021_fixture_total", "help").inc(1)'
+        "            # line 8",
+        '    obs.counter("jgl021_fixture_total", "help").inc(1)'
+        "  # graftlint: disable=JGL021 -- test-only family",
+    )
+    res = lint_source(src, relpath="pkg/serving/mod.py", select=["JGL021"])
+    assert [f.line for f in res.findings] == [9, 10]
+    assert [f.line for f in res.suppressed] == [8]
+
+
+def test_jgl021_precreated_set_tracks_real_device_py():
+    """The cross-check is an AST read of the shipped device.py: the set
+    must contain the loop-created cache families (dict .values() and
+    literal-tuple iterables) as well as direct literal creations."""
+    from ate_replication_causalml_tpu.analysis import rules as _rules
+
+    fams = _rules.precreated_families()
+    assert "compile_cache_hits_total" in fams      # dict .values() loop
+    assert "shard_attempts_total" in fams          # literal-tuple loop
+    assert "router_request_seconds" in fams        # direct literal
+    assert "jgl021_fixture_total" not in fams
+
+
 # ----------------------------------------------------- suppressions etc.
 
 
